@@ -1,0 +1,14 @@
+"""Root conftest: force JAX onto a virtual 8-device CPU mesh before jax is imported.
+
+The reference has no multi-node tests at all (SURVEY.md §4); we stand in for TPU
+hardware with XLA's host-platform device virtualization so sharding/collective
+paths are exercised hermetically in CI.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

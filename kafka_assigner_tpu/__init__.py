@@ -1,0 +1,25 @@
+"""kafka_assigner_tpu — a TPU-native rack-aware Kafka partition assignment framework.
+
+Re-designs SiftScience/kafka-assigner (Java CLI, reference at
+src/main/java/siftscience/kafka/tools/) as a JAX/XLA framework:
+
+- ``solvers.greedy``  — faithful reimplementation of the reference's 5-phase
+  greedy algorithm (``KafkaAssignmentStrategy.java:40-63``): the correctness
+  oracle and the movement/latency baseline.
+- ``solvers.tpu``     — the TPU-native solver: vectorized sticky fill, a
+  wave-auction orphan placement that runs under ``jax.jit``, and rotation-based
+  leadership balancing; batched over topics with ``vmap`` and sharded over a
+  device mesh with ``jax.sharding`` for the headline scales.
+- ``io``              — metadata backends (hermetic JSON snapshot, ZooKeeper /
+  Kafka-admin bridges) replacing the reference's ZkUtils layer
+  (``KafkaAssignmentGenerator.java:273-276``).
+- ``cli``             — the byte-compatible CLI surface
+  (``KafkaAssignmentGenerator.java:53-101``) plus ``--solver={greedy,tpu}``.
+"""
+
+__version__ = "0.1.0"
+
+from .assigner import TopicAssigner
+from .solvers.base import Context
+
+__all__ = ["TopicAssigner", "Context", "__version__"]

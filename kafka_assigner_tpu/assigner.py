@@ -1,0 +1,70 @@
+"""Per-topic orchestration (L2), mirroring ``KafkaTopicAssigner.java:18-72``.
+
+Responsibilities (SURVEY.md §1 L2):
+  - infer the replication factor from the current assignment when the desired
+    RF is negative, asserting it is uniform across partitions
+    (``KafkaTopicAssigner.java:49-62``);
+  - validate ``0 < RF <= |brokers|`` (``KafkaTopicAssigner.java:65-69``);
+  - hold one cross-topic ``Context`` per assigner instance so leadership
+    balancing spans all topics assigned through it
+    (``KafkaTopicAssigner.java:19-23``).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Sequence, Set
+
+from .solvers.base import Context, Solver, get_solver
+
+
+class TopicAssigner:
+    """Generates a minimal-movement assignment for one topic at a time.
+
+    ``solver`` selects the backend: ``"greedy"`` (reference-faithful oracle) or
+    ``"tpu"`` (JAX/XLA solver). Instances are not shared across threads, but
+    unlike the reference the cross-topic state is confined to the ``Context``
+    object and all solver math is functional.
+    """
+
+    def __init__(self, solver: str | Solver = "greedy") -> None:
+        self.solver: Solver = get_solver(solver) if isinstance(solver, str) else solver
+        self.context = Context()
+
+    def generate_assignment(
+        self,
+        topic: str,
+        current_assignment: Mapping[int, Sequence[int]],
+        brokers: Set[int],
+        rack_assignment: Mapping[int, str],
+        desired_replication_factor: int = -1,
+    ) -> Dict[int, List[int]]:
+        """Compute a new assignment with minimal movement
+        (``KafkaTopicAssigner.java:42-72``)."""
+        replication_factor = desired_replication_factor
+        partitions: Set[int] = set()
+        for partition, replicas in sorted(current_assignment.items()):
+            partitions.add(partition)
+            if replication_factor < 0:
+                replication_factor = len(replicas)
+            elif desired_replication_factor < 0 and replication_factor != len(replicas):
+                raise ValueError(
+                    f"Topic {topic} has partition {partition} with unexpected "
+                    f"replication factor {len(replicas)}"
+                )
+        if replication_factor <= 0:
+            raise ValueError(
+                f"Topic {topic} does not have a positive replication factor!"
+            )
+        if replication_factor > len(brokers):
+            raise ValueError(
+                f"Topic {topic} has a higher replication factor "
+                f"({replication_factor}) than available brokers!"
+            )
+        return self.solver.assign(
+            topic,
+            current_assignment,
+            rack_assignment,
+            set(brokers),
+            partitions,
+            replication_factor,
+            self.context,
+        )

@@ -1,0 +1,3 @@
+from .base import Context, Solver, get_solver
+
+__all__ = ["Context", "Solver", "get_solver"]

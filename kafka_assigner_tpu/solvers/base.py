@@ -1,0 +1,67 @@
+"""Solver seam: the interface the reference exposes at
+``KafkaAssignmentStrategy.getRackAwareAssignment`` (``KafkaAssignmentStrategy.java:40-63``)
+and the cross-topic ``Context`` (``KafkaAssignmentStrategy.java:360-369``).
+
+Every solver backend (greedy oracle, TPU) honors identical inputs/outputs:
+``assign(topic, current_assignment, rack_assignment, nodes, partitions, rf, ctx)``
+returning ``{partition: [broker, ...]}`` ordered by leadership preference.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Protocol, Sequence, Set
+
+
+class Context:
+    """Cross-topic leadership-balancing state.
+
+    Mirrors ``KafkaAssignmentStrategy.Context`` (``KafkaAssignmentStrategy.java:360-369``):
+    ``counter[node_id][replica_slot] -> count`` of how often ``node_id`` has been
+    placed at preference-list position ``replica_slot``, accumulated across every
+    topic solved through one assigner instance. Unlike the reference's mutable
+    shared object, solvers here treat it as explicit carried state (functional
+    update inside the TPU path), which removes the reference's thread-safety
+    hazard (SURVEY.md §5 "race detection").
+    """
+
+    __slots__ = ("counter",)
+
+    def __init__(self) -> None:
+        self.counter: Dict[int, Dict[int, int]] = {}
+
+    def get(self, node_id: int, slot: int) -> int:
+        return self.counter.get(node_id, {}).get(slot, 0)
+
+    def increment(self, node_id: int, slot: int) -> None:
+        self.counter.setdefault(node_id, {})[slot] = self.get(node_id, slot) + 1
+
+
+class Solver(Protocol):
+    """A pluggable assignment backend (selected via ``--solver``)."""
+
+    def assign(
+        self,
+        topic: str,
+        current_assignment: Mapping[int, Sequence[int]],
+        rack_assignment: Mapping[int, str],
+        nodes: Set[int],
+        partitions: Set[int],
+        replication_factor: int,
+        context: Context | None = None,
+    ) -> Dict[int, List[int]]: ...
+
+
+def get_solver(name: str) -> "Solver":
+    """Resolve a solver backend by name (``--solver={greedy,tpu}``)."""
+    if name == "greedy":
+        from .greedy import GreedySolver
+
+        return GreedySolver()
+    if name == "tpu":
+        try:
+            from .tpu import TpuSolver
+        except ImportError as e:
+            raise NotImplementedError(
+                "the 'tpu' solver backend is not available in this build"
+            ) from e
+        return TpuSolver()
+    raise ValueError(f"unknown solver {name!r}; expected 'greedy' or 'tpu'")

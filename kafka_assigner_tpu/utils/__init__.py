@@ -1,0 +1,3 @@
+from .javahash import java_string_hash, topic_start_index
+
+__all__ = ["java_string_hash", "topic_start_index"]

@@ -1,0 +1,66 @@
+"""Shared assignment verifier, ported from the reference test suite's
+``verifyPartitionsAndBuildReplicaCounts`` (``KafkaTopicAssignerTest.java:159-187``)
+plus the extra invariants SURVEY.md §4 calls for (rack exclusivity, capacity)."""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Mapping, Sequence
+
+
+def verify_and_count(
+    current: Mapping[int, Sequence[int]],
+    new: Mapping[int, Sequence[int]],
+    minimal_movement_threshold: int = 1,
+) -> Dict[int, int]:
+    """Assert validity + stickiness; return broker -> replica-count histogram."""
+    counts: Dict[int, int] = {}
+    for partition, replicas in new.items():
+        # No broker appears twice in one replica list (KafkaTopicAssignerTest.java:168).
+        assert len(replicas) == len(set(replicas)), (
+            f"partition {partition} has duplicate brokers: {replicas}"
+        )
+        for broker in replicas:
+            counts[broker] = counts.get(broker, 0) + 1
+        # Stickiness: >= threshold survivors from the old set
+        # (KafkaTopicAssignerTest.java:179-184).
+        overlap = set(replicas) & set(current[partition])
+        assert len(overlap) >= minimal_movement_threshold, (
+            f"partition {partition} moved entirely: {current[partition]} -> {replicas}"
+        )
+    return counts
+
+
+def verify_full_invariants(
+    new: Mapping[int, Sequence[int]],
+    rack_assignment: Mapping[int, str],
+    brokers: Sequence[int],
+    replication_factor: int,
+) -> None:
+    """Extra structural invariants of any valid solve (SURVEY.md §4):
+    exact RF, rack exclusivity, per-node capacity ceil(P*RF/N)."""
+    cap = math.ceil(len(new) * replication_factor / len(brokers))
+    counts: Dict[int, int] = {}
+    for partition, replicas in new.items():
+        assert len(replicas) == replication_factor, (
+            f"partition {partition}: expected RF={replication_factor}, got {replicas}"
+        )
+        racks = [rack_assignment.get(b, str(b)) for b in replicas]
+        assert len(racks) == len(set(racks)), (
+            f"partition {partition} has two replicas on one rack: {replicas} -> {racks}"
+        )
+        for broker in replicas:
+            assert broker in set(brokers), f"unknown broker {broker}"
+            counts[broker] = counts.get(broker, 0) + 1
+    for broker, count in counts.items():
+        assert count <= cap, f"broker {broker} over capacity: {count} > {cap}"
+
+
+def moved_replicas(
+    current: Mapping[int, Sequence[int]], new: Mapping[int, Sequence[int]]
+) -> int:
+    """Number of replicas that changed broker — the BASELINE movement metric."""
+    moved = 0
+    for partition, replicas in new.items():
+        old = set(current.get(partition, ()))
+        moved += sum(1 for b in replicas if b not in old)
+    return moved

@@ -1,0 +1,84 @@
+"""Pin the greedy oracle to the reference's *exact* semantics: Java hashCode,
+rotated node-processing order, deterministic output, cross-topic leadership
+counters, and the documented RF-decrease quirk."""
+from __future__ import annotations
+
+
+from kafka_assigner_tpu.assigner import TopicAssigner
+from kafka_assigner_tpu.solvers.greedy import node_processing_order
+from kafka_assigner_tpu.utils.javahash import java_string_hash, topic_start_index
+
+
+def test_java_string_hash_known_values():
+    # Values computed by the JVM's String.hashCode.
+    assert java_string_hash("") == 0
+    assert java_string_hash("test") == 3556498
+    assert java_string_hash("a") == 97
+    # 32-bit wraparound on longer strings (negative JVM hashes).
+    assert java_string_hash("kafka-assigner") == -1652112221
+    assert java_string_hash("the-quick-brown-fox-jumps-over") == -617901171
+    assert java_string_hash("__consumer_offsets") == -970371369
+
+
+def test_topic_start_index_negative_hash():
+    # Math.abs of a negative hash, then modulo (KafkaAssignmentStrategy.java:190).
+    h = java_string_hash("kafka-assigner")
+    assert h < 0
+    assert topic_start_index("kafka-assigner", 7) == abs(h) % 7
+
+
+def test_node_processing_order_rotation():
+    # "test".hashCode() == 3556498; 3556498 % 5 == 3, so ascending ids are laid
+    # out starting at slot 3 with wraparound (KafkaAssignmentStrategy.java:188-200).
+    assert node_processing_order("test", [10, 11, 12, 13, 14]) == [12, 13, 14, 10, 11]
+    assert node_processing_order("test", [1]) == [1]
+
+
+def test_determinism():
+    current = {p: [(p + i) % 7 + 10 for i in range(3)] for p in range(20)}
+    brokers = set(range(10, 19))
+    racks = {b: f"r{b % 3}" for b in brokers}
+    a1 = TopicAssigner("greedy").generate_assignment("t", current, brokers, racks, -1)
+    a2 = TopicAssigner("greedy").generate_assignment("t", current, brokers, racks, -1)
+    assert a1 == a2
+
+
+def test_cross_topic_context_balances_leaders():
+    # The Context persists across topics through one assigner
+    # (KafkaTopicAssigner.java:19-23): leaders must spread across brokers
+    # rather than repeating one favorite.
+    assigner = TopicAssigner("greedy")
+    brokers = {10, 11, 12}
+    leaders = []
+    for t in ("alpha", "beta", "gamma"):
+        current = {0: [10, 11, 12]}
+        new = assigner.generate_assignment(t, current, brokers, {}, -1)
+        leaders.append(new[0][0])
+    # Three solves of the same replica set: each broker leads exactly once.
+    assert sorted(leaders) == [10, 11, 12]
+
+
+def test_rf_decrease_quirk_preserved():
+    # Reference behavior: sticky fill has no per-partition limit
+    # (KafkaAssignmentStrategy.java:320-324), so lowering RF can leave
+    # partitions with more replicas than requested. Bug-compatible on purpose.
+    current = {0: [10, 11, 12], 1: [11, 12, 13], 2: [12, 13, 10], 3: [13, 10, 11]}
+    brokers = {10, 11, 12, 13}
+    new = TopicAssigner("greedy").generate_assignment("test", current, brokers, {}, 2)
+    sizes = sorted(len(r) for r in new.values())
+    # cap = ceil(4*2/4) = 2 limits totals to 8, but individual partitions may
+    # keep up to 3 sticky replicas.
+    assert sum(sizes) <= 8
+    assert max(sizes) >= 2
+
+
+def test_sticky_round_robin_capacity_order():
+    # Round-robin sticky fill: slot 0 of every partition is offered before any
+    # slot 1 (KafkaAssignmentStrategy.java:101-131). With capacity 1 per node,
+    # each node keeps the partition whose *leader* it was, not a follower.
+    current = {0: [10, 11], 1: [11, 10]}
+    brokers = {10, 11, 12, 13}
+    new = TopicAssigner("greedy").generate_assignment("t", current, brokers, {}, -1)
+    # cap = ceil(2*2/4)=1: node 10 keeps p0 (leader slot), node 11 keeps p1.
+    assert 10 in new[0] and 11 in new[1]
+    assert 10 not in new[1] and 11 not in new[0]

@@ -1,0 +1,141 @@
+"""Randomized property tests: every solver must uphold the structural
+invariants (validity, rack exclusivity, capacity, stickiness) on generated
+clusters scaled down from the BASELINE configs — the test style SURVEY.md §4
+prescribes in place of the reference's four fixed scenarios."""
+from __future__ import annotations
+
+import math
+import random
+
+import pytest
+
+from kafka_assigner_tpu.assigner import TopicAssigner
+
+from .helpers import moved_replicas, verify_full_invariants
+from .test_strategy_scenarios import SOLVERS
+
+
+def make_cluster(seed, n_brokers, n_partitions, rf, n_racks, remove=0, add=0):
+    """Build (current_assignment, live_brokers, racks): a balanced, rack-valid
+    current assignment (as Kafka's own round-robin assigner would produce,
+    generated here by a fresh greedy solve), then a membership change.
+    Removals are spread across racks — the reference's greedy is documented to
+    dead-end on rack-unbalanced clusters (KafkaAssignmentStrategy.java:29-30),
+    so tests stay within its supported envelope."""
+    rng = random.Random(seed)
+    base = list(range(100, 100 + n_brokers))
+    racks = {b: f"rack{i % n_racks}" for i, b in enumerate(base)}
+    # Balanced rack-aware start via rack-interleaved striping: order brokers
+    # rack0[0], rack1[0], ..., rackR[0], rack0[1], ...; partition p takes rf
+    # consecutive entries starting at p. Consecutive entries sit on distinct
+    # racks, and every broker carries ~P*rf/N replicas — the shape Kafka's own
+    # assigner produces.
+    by_rack = {}
+    for b in base:
+        by_rack.setdefault(racks[b], []).append(b)
+    depth = max(len(v) for v in by_rack.values())
+    interleaved = [
+        by_rack[r][d]
+        for d in range(depth)
+        for r in sorted(by_rack)
+        if d < len(by_rack[r])
+    ]
+    n = len(interleaved)
+    current = {
+        p: [interleaved[(p + i) % n] for i in range(rf)] for p in range(n_partitions)
+    }
+    live = list(base)
+    if remove:
+        by_rack = {}
+        for b in rng.sample(base, len(base)):
+            by_rack.setdefault(racks[b], []).append(b)
+        removed = set()
+        rack_cycle = sorted(by_rack)
+        i = 0
+        while len(removed) < remove:
+            bucket = by_rack[rack_cycle[i % len(rack_cycle)]]
+            if bucket:
+                removed.add(bucket.pop())
+            i += 1
+        live = [b for b in live if b not in removed]
+    for j in range(add):
+        nb = 100 + n_brokers + j
+        live.append(nb)
+        racks[nb] = f"rack{(n_brokers + j) % n_racks}"
+    rack_map = {b: racks[b] for b in live}
+    return current, set(live), rack_map
+
+
+# (brokers, partitions, rf, racks, remove, add) — shrunk BASELINE configs 1-3,
+# all within the greedy's practical envelope (cluster-scale broker counts, low
+# per-node caps; the reference's first-fit is documented to dead-end outside it,
+# KafkaAssignmentStrategy.java:29-30).
+CASES = [
+    (10, 50, 3, 5, 0, 0),   # steady state, fully saturated caps
+    (12, 40, 3, 3, 3, 0),   # decommission one broker per rack
+    (30, 40, 3, 5, 5, 0),   # decommission at cluster scale
+    (20, 30, 3, 5, 0, 5),   # rack-aware expansion
+    (25, 30, 3, 5, 5, 5),   # replacement (remove 5, add 5)
+    (10, 40, 2, 5, 0, 5),   # rf=2 expansion
+    (12, 40, 2, 4, 2, 2),   # rf=2 replacement
+    (15, 60, 1, 5, 3, 0),   # rf=1 decommission
+    (24, 64, 3, 4, 4, 0),   # 4 racks, one removal per rack
+]
+
+
+@pytest.mark.parametrize("solver", SOLVERS)
+@pytest.mark.parametrize("case", CASES, ids=[str(c) for c in CASES])
+def test_random_cluster_invariants(solver, case):
+    n_brokers, n_partitions, rf, n_racks, remove, add = case
+    for seed in range(3):
+        current, live, rack_map = make_cluster(
+            seed, n_brokers, n_partitions, rf, n_racks, remove, add
+        )
+        assigner = TopicAssigner(solver)
+        new = assigner.generate_assignment("topic-%d" % seed, current, live, rack_map, -1)
+        assert set(new) == set(current)
+        verify_full_invariants(new, rack_map, sorted(live), rf)
+        # Aggregate stickiness: movement is bounded by replicas that *had* to
+        # move — dead brokers ("lost") plus capacity evictions when the
+        # per-node cap tightens ("forced", e.g. on expansion a fraction of each
+        # broker's replicas must migrate to the new brokers,
+        # KafkaTopicAssigner.java:28-31) — plus small churn slack.
+        # (Per-partition retention is NOT an invariant of the reference: under
+        # capacity pressure the sticky fill can evict a partition's last
+        # survivor, KafkaAssignmentStrategy.java:120-124.)
+        total = len(current) * rf
+        cap = math.ceil(total / len(live))
+        lost = sum(1 for r in current.values() for b in r if b not in live)
+        load = {}
+        for r in current.values():
+            for b in r:
+                load[b] = load.get(b, 0) + 1
+        forced = sum(max(0, c - cap) for b, c in load.items() if b in live)
+        moved = moved_replicas(current, new)
+        assert moved <= lost + forced + 0.15 * total, (
+            f"moved={moved} lost={lost} forced={forced} total={total}: excessive churn"
+        )
+
+
+@pytest.mark.parametrize("solver", SOLVERS)
+def test_no_change_is_noop_movement(solver):
+    # Rebalancing an already-balanced cluster must move (almost) nothing.
+    current, live, rack_map = make_cluster(7, 12, 48, 3, 4)
+    assigner = TopicAssigner(solver)
+    new = assigner.generate_assignment("steady", current, live, rack_map, -1)
+    moved = moved_replicas(current, new)
+    # capacity = ceil(48*3/12) = 12; a balanced-ish random start may exceed the
+    # cap on a few nodes, so allow a small shuffle but not churn.
+    assert moved <= 48 * 3 * 0.25, f"moved {moved} replicas on a no-op rebalance"
+
+
+@pytest.mark.parametrize("solver", SOLVERS)
+def test_decommission_moves_only_lost_replicas(solver):
+    current, live, rack_map = make_cluster(3, 30, 40, 3, 5, remove=5)
+    assigner = TopicAssigner(solver)
+    new = assigner.generate_assignment("decom", current, live, rack_map, -1)
+    lost = sum(1 for r in current.values() for b in r if b not in live)
+    moved = moved_replicas(current, new)
+    # Movement should be dominated by the replicas that *had* to move, with
+    # limited extra churn from capacity tightening.
+    assert moved <= lost + 40 * 3 * 0.1, f"moved={moved} lost={lost}"
