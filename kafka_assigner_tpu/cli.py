@@ -1,0 +1,134 @@
+"""CLI entry point — flag-for-flag compatible with the reference
+(``KafkaAssignmentGenerator.java:53-101, 256-299``), plus ``--solver``.
+
+Usage (mirrors ``KafkaAssignmentGenerator.java:39-47``)::
+
+    kafka-assignment-generator \
+        --zk_string zkhost:2181 \
+        --mode PRINT_REASSIGNMENT \
+        --broker_hosts host1,host2,host3 \
+        --broker_hosts_to_remove misbehaving_host1
+
+``--zk_string`` additionally accepts ``file://cluster.json`` (or any ``*.json``
+path) for hermetic snapshot runs — the offline mode the reference lacks.
+
+Divergences from the reference, on purpose:
+  - the mutual-exclusion error names the real flags (the reference's message
+    cites nonexistent ``--kafka_assigner_*`` names — latent bug,
+    ``KafkaAssignmentGenerator.java:263-265``);
+  - bad usage exits with status 1 after printing usage to stderr (the
+    reference returns 0, ``KafkaAssignmentGenerator.java:266-270``).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .generator import (
+    build_rack_assignment,
+    print_current_assignment,
+    print_current_brokers,
+    print_least_disruptive_reassignment,
+    resolve_broker_ids,
+    resolve_excluded_broker_ids,
+)
+from .io.base import open_backend
+from .solvers.base import get_solver
+
+MODES = ("PRINT_CURRENT_ASSIGNMENT", "PRINT_CURRENT_BROKERS", "PRINT_REASSIGNMENT")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kafka-assignment-generator",
+        description="Prints assignments of topic partition replicas to brokers "
+        "in Kafka-parseable JSON.",
+        add_help=True,
+    )
+    p.add_argument("--zk_string", default=None,
+                   help="ZK quorum as comma-separated host:port pairs, or a "
+                        "file://cluster.json snapshot")
+    p.add_argument("--mode", default=None, choices=MODES,
+                   help="the mode to run")
+    p.add_argument("--integer_broker_ids", default=None,
+                   help="comma-separated list of Kafka broker IDs (integers)")
+    p.add_argument("--broker_hosts", default=None,
+                   help="comma-separated list of broker hostnames (instead of broker IDs)")
+    p.add_argument("--broker_hosts_to_remove", default=None,
+                   help="comma-separated list of broker hostnames to exclude")
+    p.add_argument("--topics", default=None,
+                   help="comma-separated list of topics")
+    p.add_argument("--desired_replication_factor", type=int, default=-1,
+                   help="used for changing replication factor for topics; "
+                        "if not present it will use the existing number")
+    p.add_argument("--disable_rack_awareness", action="store_true",
+                   help="set to true to ignore rack configurations")
+    p.add_argument("--solver", default="greedy", choices=("greedy", "tpu"),
+                   help="assignment backend: reference-faithful greedy or the "
+                        "TPU (JAX/XLA) solver")
+    return p
+
+
+def run_tool(argv: Optional[List[str]] = None) -> int:
+    """Parse, validate, connect, dispatch (``KafkaAssignmentGenerator.java:256-299``)."""
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    try:
+        if args.zk_string is None:
+            raise ValueError("--zk_string is required")
+        if args.mode is None:
+            raise ValueError("--mode is required")
+        if args.integer_broker_ids is not None and args.broker_hosts is not None:
+            raise ValueError(
+                "--integer_broker_ids and --broker_hosts cannot be used together!"
+            )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        parser.print_usage(sys.stderr)
+        return 1
+
+    topics = args.topics.split(",") if args.topics is not None else None
+
+    # Fail fast on an unavailable solver backend, before any metadata is read
+    # or partial output emitted.
+    get_solver(args.solver)
+
+    backend = open_backend(args.zk_string)
+    try:
+        live_brokers = backend.brokers()  # single metadata read, reused below
+        broker_ids = resolve_broker_ids(
+            live_brokers, args.integer_broker_ids, args.broker_hosts
+        )
+        excluded = resolve_excluded_broker_ids(
+            live_brokers, args.broker_hosts_to_remove
+        )
+        rack_assignment = build_rack_assignment(
+            live_brokers, args.disable_rack_awareness
+        )
+        if args.mode == "PRINT_CURRENT_ASSIGNMENT":
+            print_current_assignment(backend, topics)
+        elif args.mode == "PRINT_CURRENT_BROKERS":
+            print_current_brokers(backend, live_brokers=live_brokers)
+        else:
+            print_least_disruptive_reassignment(
+                backend,
+                topics,
+                broker_ids,
+                excluded,
+                rack_assignment,
+                args.desired_replication_factor,
+                solver=args.solver,
+                live_brokers=live_brokers,
+            )
+    finally:
+        backend.close()
+    return 0
+
+
+def main() -> None:
+    sys.exit(run_tool())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from .base import BrokerInfo, MetadataBackend, open_backend
+
+__all__ = ["BrokerInfo", "MetadataBackend", "open_backend"]

@@ -1,0 +1,72 @@
+"""Cluster-metadata backends — the pluggable replacement for the reference's
+ZooKeeper layer (L3: ``ZkUtils`` reads at ``KafkaAssignmentGenerator.java:103-129,
+138-164, 189-250``, connection at ``:273-276``).
+
+The reference hardwires one backend (live ZooKeeper via ZkClient, 10 s
+timeouts) and therefore has no hermetic test path at all (SURVEY.md §4). Here
+the backend is a protocol with three implementations:
+
+- :mod:`snapshot`     — JSON cluster-snapshot file (hermetic/offline; used by
+                        tests and what-if sweeps);
+- :mod:`zk`           — live ZooKeeper bridge (gated on ``kazoo``);
+- :mod:`kafka_admin`  — Kafka AdminClient bridge (gated on a kafka client lib).
+
+``open_backend`` dispatches on the connect string, keeping the reference's
+single ``--zk_string`` flag surface: ``file://...``/``*.json`` opens a
+snapshot, anything else a live ZK quorum.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Protocol, Sequence
+
+
+@dataclass(frozen=True)
+class BrokerInfo:
+    """One live broker: id/host/port and optional rack, as read from broker
+    metadata (``KafkaAssignmentGenerator.java:116-126``)."""
+
+    id: int
+    host: str
+    port: int
+    rack: Optional[str] = None
+
+
+class MetadataBackend(Protocol):
+    """The metadata reads L4 performs, lifted verbatim from the reference's
+    ZkUtils usage (``KafkaAssignmentGenerator.java:106,114,163``)."""
+
+    def brokers(self) -> List[BrokerInfo]: ...
+
+    def all_topics(self) -> List[str]: ...
+
+    def partition_assignment(
+        self, topics: Sequence[str]
+    ) -> Dict[str, Dict[int, List[int]]]: ...
+
+    def close(self) -> None: ...
+
+
+def open_backend(connect_string: str) -> MetadataBackend:
+    """Open a metadata backend from a connect string.
+
+    ``file:///path.json`` or a path ending in ``.json`` → hermetic snapshot;
+    ``kafka://host:port,...`` → Kafka AdminClient bridge; otherwise treated as
+    a ZooKeeper quorum string (``host:port,...``), the reference's only mode
+    (``KafkaAssignmentGenerator.java:273-276``).
+    """
+    if connect_string.startswith("file://"):
+        from .snapshot import SnapshotBackend
+
+        return SnapshotBackend(connect_string[len("file://"):])
+    if connect_string.endswith(".json"):
+        from .snapshot import SnapshotBackend
+
+        return SnapshotBackend(connect_string)
+    if connect_string.startswith("kafka://"):
+        from .kafka_admin import KafkaAdminBackend
+
+        return KafkaAdminBackend(connect_string[len("kafka://"):])
+    from .zk import ZkBackend
+
+    return ZkBackend(connect_string)

@@ -1,0 +1,70 @@
+"""Kafka reassignment-JSON formatting, byte-compatible with the reference.
+
+Two producers exist in the reference and both must round-trip through Kafka's
+``kafka-reassign-partitions`` tool (``README.md:52``):
+
+- PRINT_CURRENT_ASSIGNMENT delegates to Kafka's own
+  ``zkUtils.formatAsReassignmentJson`` (``KafkaAssignmentGenerator.java:108-110``);
+- PRINT_REASSIGNMENT hand-builds ``{"version":1,"partitions":[{topic,partition,
+  replicas}...]}`` with org.json (``KafkaAssignmentGenerator.java:169-186``).
+
+We emit one canonical compact form for both: key order ``version, partitions``
+and ``topic, partition, replicas``, no whitespace — the shape Kafka's parser
+accepts and the reference's org.json ``toString()`` emits.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Mapping, Sequence
+
+from .base import BrokerInfo
+
+KAFKA_FORMAT_VERSION = 1  # KafkaAssignmentGenerator.java:49
+
+
+def format_reassignment_json(
+    assignments: Mapping[str, Mapping[int, Sequence[int]]],
+    topic_order: Sequence[str] | None = None,
+) -> str:
+    """Serialize ``{topic: {partition: [replicas]}}`` as Kafka reassignment
+    JSON. Topics follow ``topic_order`` (the CLI's topic iteration order,
+    ``KafkaAssignmentGenerator.java:173``); partitions ascend within a topic
+    (TreeMap semantics, ``KafkaAssignmentStrategy.java:205,221``)."""
+    topics = list(topic_order) if topic_order is not None else sorted(assignments)
+    partitions = [
+        {"topic": t, "partition": p, "replicas": list(assignments[t][p])}
+        for t in topics
+        for p in sorted(assignments[t])
+    ]
+    return json.dumps(
+        {"version": KAFKA_FORMAT_VERSION, "partitions": partitions},
+        separators=(",", ":"),
+    )
+
+
+def parse_reassignment_json(payload: str) -> Dict[str, Dict[int, List[int]]]:
+    """Inverse of :func:`format_reassignment_json` (accepts any Kafka-parseable
+    reassignment JSON, whatever the key order/whitespace)."""
+    data = json.loads(payload)
+    version = data.get("version")
+    if version != KAFKA_FORMAT_VERSION:
+        raise ValueError(f"unsupported reassignment JSON version: {version!r}")
+    out: Dict[str, Dict[int, List[int]]] = {}
+    for entry in data.get("partitions", []):
+        out.setdefault(entry["topic"], {})[int(entry["partition"])] = [
+            int(r) for r in entry["replicas"]
+        ]
+    return out
+
+
+def format_brokers_json(brokers: Sequence[BrokerInfo]) -> str:
+    """PRINT_CURRENT_BROKERS payload: JSON array of ``{id, host, port, rack?}``
+    per live broker, rack omitted when undefined
+    (``KafkaAssignmentGenerator.java:113-129``)."""
+    entries = []
+    for b in brokers:
+        entry = {"id": b.id, "host": b.host, "port": b.port}
+        if b.rack is not None:
+            entry["rack"] = b.rack
+        entries.append(entry)
+    return json.dumps(entries, separators=(",", ":"))

@@ -1,0 +1,78 @@
+"""Kafka AdminClient bridge — modern replacement for ZooKeeper metadata reads
+(Kafka ≥ 2.x clusters increasingly deny direct ZK access; the reference
+predates this and only speaks ZK, ``pom.xml:50-58``).
+
+Gated on ``confluent_kafka`` or ``kafka-python``; raises a clear error when
+neither is installed. Offline runs should use the snapshot backend.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from .base import BrokerInfo
+
+
+class KafkaAdminBackend:
+    def __init__(self, bootstrap_servers: str) -> None:
+        self._impl = None
+        try:
+            from confluent_kafka.admin import AdminClient  # type: ignore
+
+            self._impl = "confluent"
+            self._admin = AdminClient({"bootstrap.servers": bootstrap_servers})
+        except ImportError:
+            try:
+                from kafka import KafkaAdminClient  # type: ignore
+
+                self._impl = "kafka-python"
+                self._admin = KafkaAdminClient(bootstrap_servers=bootstrap_servers)
+            except ImportError as e:
+                raise RuntimeError(
+                    "Kafka AdminClient access requires 'confluent-kafka' or "
+                    "'kafka-python'; use a file://cluster.json snapshot for "
+                    "offline runs"
+                ) from e
+
+    def brokers(self) -> List[BrokerInfo]:
+        if self._impl == "confluent":
+            md = self._admin.list_topics(timeout=10)
+            return [
+                BrokerInfo(id=b.id, host=b.host, port=b.port, rack=None)
+                for b in sorted(md.brokers.values(), key=lambda b: b.id)
+            ]
+        cluster = self._admin.describe_cluster()
+        return [
+            BrokerInfo(
+                id=int(b["node_id"]), host=b["host"], port=int(b["port"]),
+                rack=b.get("rack"),
+            )
+            for b in sorted(cluster["brokers"], key=lambda b: int(b["node_id"]))
+        ]
+
+    def all_topics(self) -> List[str]:
+        if self._impl == "confluent":
+            return sorted(self._admin.list_topics(timeout=10).topics)
+        return sorted(self._admin.list_topics())
+
+    def partition_assignment(
+        self, topics: Sequence[str]
+    ) -> Dict[str, Dict[int, List[int]]]:
+        out: Dict[str, Dict[int, List[int]]] = {}
+        if self._impl == "confluent":
+            md = self._admin.list_topics(timeout=10)
+            for topic in topics:
+                tmeta = md.topics[topic]
+                out[topic] = {
+                    int(p): list(pm.replicas) for p, pm in tmeta.partitions.items()
+                }
+            return out
+        for t in self._admin.describe_topics(topics):
+            out[t["topic"]] = {
+                int(p["partition"]): [int(r) for r in p["replicas"]]
+                for p in t["partitions"]
+            }
+        return out
+
+    def close(self) -> None:
+        if self._impl == "kafka-python":
+            self._admin.close()

@@ -1,0 +1,84 @@
+"""Hermetic JSON cluster-snapshot backend.
+
+The reference has no offline mode — every run needs a live ZooKeeper quorum
+(``KafkaAssignmentGenerator.java:273-276``). A snapshot file captures the same
+metadata so the CLI, tests, and batched what-if sweeps run without a cluster:
+
+.. code-block:: json
+
+    {
+      "brokers": [{"id": 0, "host": "b0", "port": 9092, "rack": "r0"}, ...],
+      "topics": {"events": {"0": [0, 1, 2], "1": [1, 2, 3]}}
+    }
+
+``rack`` is optional per broker, mirroring ``broker.rack().isDefined()``
+(``KafkaAssignmentGenerator.java:122-124``).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence
+
+from .base import BrokerInfo
+
+
+class SnapshotBackend:
+    def __init__(self, path: str) -> None:
+        self.path = path
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        self._brokers = [
+            BrokerInfo(
+                id=int(b["id"]),
+                host=str(b.get("host", f"broker-{b['id']}")),
+                port=int(b.get("port", 9092)),
+                rack=b.get("rack"),
+            )
+            for b in data.get("brokers", [])
+        ]
+        self._topics: Dict[str, Dict[int, List[int]]] = {
+            topic: {int(p): [int(x) for x in replicas] for p, replicas in parts.items()}
+            for topic, parts in data.get("topics", {}).items()
+        }
+
+    def brokers(self) -> List[BrokerInfo]:
+        return list(self._brokers)
+
+    def all_topics(self) -> List[str]:
+        return list(self._topics)
+
+    def partition_assignment(
+        self, topics: Sequence[str]
+    ) -> Dict[str, Dict[int, List[int]]]:
+        missing = [t for t in topics if t not in self._topics]
+        if missing:
+            raise KeyError(f"topics not in snapshot: {missing}")
+        return {t: {p: list(r) for p, r in self._topics[t].items()} for t in topics}
+
+    def close(self) -> None:
+        pass
+
+
+def write_snapshot(
+    path: str,
+    brokers: Sequence[BrokerInfo],
+    topics: Dict[str, Dict[int, List[int]]],
+) -> None:
+    """Serialize cluster metadata to a snapshot file (inverse of the loader)."""
+    data = {
+        "brokers": [
+            {
+                "id": b.id,
+                "host": b.host,
+                "port": b.port,
+                **({"rack": b.rack} if b.rack is not None else {}),
+            }
+            for b in brokers
+        ],
+        "topics": {
+            t: {str(p): list(r) for p, r in sorted(parts.items())}
+            for t, parts in topics.items()
+        },
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1)

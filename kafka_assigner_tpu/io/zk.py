@@ -1,0 +1,93 @@
+"""Live ZooKeeper bridge — the tpu-framework equivalent of the reference's
+``ZkClient``/``ZkUtils`` layer (``KafkaAssignmentGenerator.java:273-276``,
+``pom.xml:50-58``).
+
+Reads the same znodes Kafka's ZkUtils reads:
+  - ``/brokers/ids/<id>``      → ``{"host":..., "port":..., "rack":...}``
+  - ``/brokers/topics``        → topic list
+  - ``/brokers/topics/<name>`` → ``{"partitions": {"0": [ids...]}}``
+
+Gated on ``kazoo`` (pure-python ZK client). When it is not installed the
+backend raises a clear error at construction — the hermetic snapshot backend
+covers every offline use.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence
+
+from .base import BrokerInfo
+
+# Session/connect timeouts follow the reference: new ZkClient(zk, 10000, 10000)
+# (KafkaAssignmentGenerator.java:273-274).
+ZK_TIMEOUT_S = 10.0
+
+
+def _resolve_endpoint(meta: dict, broker_id: str) -> tuple:
+    """Extract (host, port) from a broker znode.
+
+    Kafka ≥0.9 brokers with non-PLAINTEXT or multiple listeners register
+    ``host: null`` plus an ``endpoints`` list (``"SSL://host:9093"``); the
+    reference resolves via ``broker.getBrokerEndPoint(SecurityProtocol.
+    PLAINTEXT)`` and fails loudly when absent
+    (``KafkaAssignmentGenerator.java:117,194``). We prefer the top-level
+    host, fall back to the first parseable endpoint, and raise rather than
+    silently returning an unmatchable empty hostname.
+    """
+    host = meta.get("host")
+    if host:
+        return host, int(meta.get("port") or 9092)
+    for ep in meta.get("endpoints", []):
+        rest = ep.split("://", 1)[-1]
+        if ":" in rest:
+            h, _, p = rest.rpartition(":")
+            if h:
+                return h, int(p)
+    raise ValueError(
+        f"broker {broker_id} has no resolvable host (host=null and no "
+        f"parseable endpoints in {meta.get('endpoints')!r})"
+    )
+
+
+class ZkBackend:
+    def __init__(self, connect_string: str) -> None:
+        try:
+            from kazoo.client import KazooClient
+        except ImportError as e:
+            raise RuntimeError(
+                "live ZooKeeper access requires the 'kazoo' package; use a "
+                "file://cluster.json snapshot for offline runs"
+            ) from e
+        self._zk = KazooClient(hosts=connect_string, timeout=ZK_TIMEOUT_S)
+        self._zk.start(timeout=ZK_TIMEOUT_S)
+
+    def brokers(self) -> List[BrokerInfo]:
+        out = []
+        for bid in sorted(self._zk.get_children("/brokers/ids"), key=int):
+            raw, _ = self._zk.get(f"/brokers/ids/{bid}")
+            meta = json.loads(raw)
+            host, port = _resolve_endpoint(meta, bid)
+            out.append(
+                BrokerInfo(id=int(bid), host=host, port=port, rack=meta.get("rack"))
+            )
+        return out
+
+    def all_topics(self) -> List[str]:
+        return sorted(self._zk.get_children("/brokers/topics"))
+
+    def partition_assignment(
+        self, topics: Sequence[str]
+    ) -> Dict[str, Dict[int, List[int]]]:
+        out: Dict[str, Dict[int, List[int]]] = {}
+        for topic in topics:
+            raw, _ = self._zk.get(f"/brokers/topics/{topic}")
+            meta = json.loads(raw)
+            out[topic] = {
+                int(p): [int(x) for x in replicas]
+                for p, replicas in meta.get("partitions", {}).items()
+            }
+        return out
+
+    def close(self) -> None:
+        self._zk.stop()
+        self._zk.close()

@@ -1,0 +1,69 @@
+"""Backend dispatch + znode endpoint parsing + snapshot round-trip."""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from kafka_assigner_tpu.io.base import BrokerInfo, open_backend
+from kafka_assigner_tpu.io.snapshot import SnapshotBackend, write_snapshot
+from kafka_assigner_tpu.io.zk import _resolve_endpoint
+
+
+def test_open_backend_dispatch(tmp_path):
+    path = tmp_path / "c.json"
+    path.write_text(json.dumps({"brokers": [], "topics": {}}))
+    assert isinstance(open_backend(f"file://{path}"), SnapshotBackend)
+    assert isinstance(open_backend(str(path)), SnapshotBackend)
+    # Gated live backends fail with actionable errors when client libs are absent.
+    with pytest.raises(RuntimeError, match="kazoo"):
+        open_backend("zkhost:2181")
+    with pytest.raises(RuntimeError, match="confluent-kafka|kafka-python"):
+        open_backend("kafka://broker:9092")
+
+
+def test_snapshot_round_trip(tmp_path):
+    path = str(tmp_path / "c.json")
+    brokers = [BrokerInfo(1, "h1", 9092, "a"), BrokerInfo(2, "h2", 9093, None)]
+    topics = {"t": {0: [1, 2], 1: [2, 1]}}
+    write_snapshot(path, brokers, topics)
+    backend = SnapshotBackend(path)
+    assert backend.brokers() == brokers
+    assert backend.all_topics() == ["t"]
+    assert backend.partition_assignment(["t"]) == topics
+    with pytest.raises(KeyError, match="not in snapshot"):
+        backend.partition_assignment(["missing"])
+
+
+def test_zk_endpoint_resolution():
+    # Plain pre-0.9 znode: top-level host/port.
+    assert _resolve_endpoint({"host": "h", "port": 9092}, "1") == ("h", 9092)
+    # Multi-listener znode: host null, endpoints list (Kafka >= 0.9).
+    meta = {"host": None, "endpoints": ["SSL://secure-host:9093"]}
+    assert _resolve_endpoint(meta, "1") == ("secure-host", 9093)
+    # IPv6-ish / multiple endpoints: first parseable wins.
+    meta = {"host": None, "endpoints": ["PLAINTEXT://h1:9092", "SSL://h1:9093"]}
+    assert _resolve_endpoint(meta, "1") == ("h1", 9092)
+    # Nothing resolvable: loud failure, never an empty hostname.
+    with pytest.raises(ValueError, match="no resolvable host"):
+        _resolve_endpoint({"host": None, "endpoints": []}, "7")
+
+
+def test_cli_validates_solver_before_output(tmp_path, capsys, monkeypatch):
+    """--solver must be validated before any metadata read or stdout output."""
+    from kafka_assigner_tpu.cli import run_tool
+    from kafka_assigner_tpu.solvers import base as solver_base
+
+    path = tmp_path / "c.json"
+    path.write_text(json.dumps(
+        {"brokers": [{"id": 1, "host": "h", "port": 1}], "topics": {"t": {"0": [1]}}}
+    ))
+
+    def broken_get_solver(name):
+        raise NotImplementedError("backend unavailable")
+
+    monkeypatch.setattr("kafka_assigner_tpu.cli.get_solver", broken_get_solver)
+    with pytest.raises(NotImplementedError):
+        run_tool(["--zk_string", str(path), "--mode", "PRINT_REASSIGNMENT"])
+    # No partial rollback snapshot was emitted before the failure.
+    assert capsys.readouterr().out == ""

@@ -1,0 +1,55 @@
+"""Reassignment-JSON byte-format and round-trip tests
+(contract: ``KafkaAssignmentGenerator.java:169-186`` and Kafka's
+``formatAsReassignmentJson``)."""
+from __future__ import annotations
+
+import json
+
+from kafka_assigner_tpu.io.base import BrokerInfo
+from kafka_assigner_tpu.io.json_io import (
+    format_brokers_json,
+    format_reassignment_json,
+    parse_reassignment_json,
+)
+
+
+def test_reassignment_json_shape_and_compactness():
+    payload = format_reassignment_json({"t": {1: [3, 1], 0: [1, 2]}})
+    # Compact (org.json toString has no whitespace), version first,
+    # partitions ascending, replica order preserved (leadership order!).
+    assert payload == (
+        '{"version":1,"partitions":['
+        '{"topic":"t","partition":0,"replicas":[1,2]},'
+        '{"topic":"t","partition":1,"replicas":[3,1]}]}'
+    )
+
+
+def test_reassignment_topic_order_follows_cli_order():
+    payload = format_reassignment_json(
+        {"b": {0: [1]}, "a": {0: [2]}}, topic_order=["b", "a"]
+    )
+    parts = json.loads(payload)["partitions"]
+    assert [e["topic"] for e in parts] == ["b", "a"]
+
+
+def test_reassignment_round_trip():
+    original = {"events": {0: [1, 2, 3], 1: [2, 3, 4]}, "logs": {0: [5, 6, 7]}}
+    assert parse_reassignment_json(format_reassignment_json(original)) == original
+
+
+def test_parse_rejects_bad_version():
+    import pytest
+
+    with pytest.raises(ValueError, match="version"):
+        parse_reassignment_json('{"version":2,"partitions":[]}')
+
+
+def test_brokers_json_rack_optional():
+    # rack key present iff defined (KafkaAssignmentGenerator.java:122-124).
+    payload = format_brokers_json(
+        [BrokerInfo(1, "h1", 9092, "r1"), BrokerInfo(2, "h2", 9092, None)]
+    )
+    assert payload == (
+        '[{"id":1,"host":"h1","port":9092,"rack":"r1"},'
+        '{"id":2,"host":"h2","port":9092}]'
+    )
