@@ -1,14 +1,26 @@
-"""Root conftest: force JAX onto a virtual 8-device CPU mesh before jax is imported.
+"""Root conftest: force JAX onto a virtual 8-device CPU mesh.
 
-The reference has no multi-node tests at all (SURVEY.md §4); we stand in for TPU
-hardware with XLA's host-platform device virtualization so sharding/collective
-paths are exercised hermetically in CI.
+The reference has no multi-node tests at all (SURVEY.md §4); we stand in for
+TPU hardware with XLA's host-platform device virtualization so the sharding/
+collective paths are exercised hermetically in CI.
+
+Environment subtlety: this machine's interpreter boots with a TPU PJRT plugin
+already registered (sitecustomize imports jax and freezes JAX_PLATFORMS from
+the environment before any test code runs), so setting ``os.environ`` here is
+too late — ``jax.config.update`` is the only switch that still works. It also
+keeps the test suite off the single tunneled TPU chip, which must never be
+contended by CI.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before the CPU client is created (first jax.devices() call,
+# which happens well after conftest import).
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

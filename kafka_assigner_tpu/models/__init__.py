@@ -1,0 +1,3 @@
+from .problem import ProblemEncoding, encode_problem, decode_assignment
+
+__all__ = ["ProblemEncoding", "encode_problem", "decode_assignment"]

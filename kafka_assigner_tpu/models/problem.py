@@ -1,0 +1,141 @@
+"""Dense problem encoding: the bridge between the reference's map-of-lists
+world (``Map<partition, List<brokerId>>``, ``KafkaAssignmentStrategy.java:40-43``)
+and the index-space tensors the TPU solver operates on.
+
+Everything downstream of this module works on int32 arrays over *index* space
+(broker row 0..N-1, rack 0..R-1, partition row 0..P-1); ids appear only here.
+Shapes are padded to power-of-two buckets so XLA compiles one kernel per
+bucket instead of one per topic.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Sequence, Set
+
+import numpy as np
+
+from ..solvers.base import Context
+from ..solvers.greedy import max_replicas_per_node
+from ..utils.javahash import java_string_hash, topic_start_index
+
+
+def _next_bucket(n: int, floor: int = 8) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class ProblemEncoding:
+    """One topic's assignment problem, canonicalized to dense index space."""
+
+    topic: str
+    broker_ids: np.ndarray      # (N,) int64, ascending — index -> broker id
+    partition_ids: np.ndarray   # (P,) int64, ascending — row -> partition id
+    rack_idx: np.ndarray        # (N_pad,) int32; rack index per node, unique for padded rows
+    current: np.ndarray         # (P_pad, L) int32; broker *index* or -1 (dead/absent)
+    rf: int                     # replication factor to assign
+    cap: int                    # ceil(P * RF / N)   (KafkaAssignmentStrategy.java:65-71)
+    start: int                  # abs(hash(topic)) % N rotation origin (:188-200)
+    jhash: int                  # abs(java hash), for per-slot tie-break rotations
+    n: int                      # real node count (N)
+    p: int                      # real partition count (P)
+    n_pad: int
+    p_pad: int
+
+
+def encode_problem(
+    topic: str,
+    current_assignment: Mapping[int, Sequence[int]],
+    rack_assignment: Mapping[int, str],
+    nodes: Set[int],
+    partitions: Set[int],
+    replication_factor: int,
+) -> ProblemEncoding:
+    broker_ids = np.array(sorted(nodes), dtype=np.int64)
+    partition_ids = np.array(sorted(partitions), dtype=np.int64)
+    n, p = len(broker_ids), len(partition_ids)
+    n_pad, p_pad = _next_bucket(n), _next_bucket(p)
+
+    # Rack factorization. A node with no rack uses its id *string* as the rack
+    # id (KafkaAssignmentStrategy.java:82-86) — including the reference's
+    # corner where a rackless node collides with a real rack literally named
+    # after its id. Empty-string rack names are real racks, not "no rack".
+    rack_names = []
+    for b in broker_ids:
+        name = rack_assignment.get(int(b))
+        rack_names.append(str(int(b)) if name is None else name)
+    uniq: Dict[str, int] = {}
+    rack_idx = np.empty(n_pad, dtype=np.int32)
+    for i, name in enumerate(rack_names):
+        rack_idx[i] = uniq.setdefault(name, len(uniq))
+    for i in range(n, n_pad):
+        rack_idx[i] = len(uniq) + (i - n)
+
+    broker_to_idx = {int(b): i for i, b in enumerate(broker_ids)}
+    lengths = [len(r) for r in current_assignment.values()]
+    # Width is bucketed too (extra columns are -1 no-ops in the sticky fill),
+    # so historical replica-list length doesn't multiply kernel compiles.
+    width = _next_bucket(max(max(lengths, default=0), 1), floor=2)
+    current = np.full((p_pad, width), -1, dtype=np.int32)
+    part_to_row = {int(pid): i for i, pid in enumerate(partition_ids)}
+    for pid, replicas in current_assignment.items():
+        row = part_to_row.get(int(pid))
+        if row is None:
+            continue  # L2 guarantees key equality; tolerate extras defensively
+        for s, b in enumerate(replicas):
+            current[row, s] = broker_to_idx.get(int(b), -1)
+
+    return ProblemEncoding(
+        topic=topic,
+        broker_ids=broker_ids,
+        partition_ids=partition_ids,
+        rack_idx=rack_idx,
+        current=current,
+        rf=replication_factor,
+        cap=max_replicas_per_node(n, p, replication_factor),
+        start=topic_start_index(topic, n),
+        jhash=abs(java_string_hash(topic)),
+        n=n,
+        p=p,
+        n_pad=n_pad,
+        p_pad=p_pad,
+    )
+
+
+def decode_assignment(
+    enc: ProblemEncoding, ordered: np.ndarray
+) -> Dict[int, List[int]]:
+    """(P_pad, RF) broker-index matrix -> {partition_id: [broker_id, ...]}."""
+    out: Dict[int, List[int]] = {}
+    for row in range(enc.p):
+        ids = [int(enc.broker_ids[i]) for i in ordered[row] if i >= 0]
+        out[int(enc.partition_ids[row])] = ids
+    return out
+
+
+def context_to_array(ctx: Context, enc: ProblemEncoding) -> np.ndarray:
+    """Materialize the cross-topic leadership counters
+    (``KafkaAssignmentStrategy.java:360-369``) as a dense (N_pad, RF) slab for
+    the solve; slots beyond RF stay in the dict untouched."""
+    counters = np.zeros((enc.n_pad, enc.rf), dtype=np.int32)
+    for i, b in enumerate(enc.broker_ids):
+        per_node = ctx.counter.get(int(b))
+        if per_node:
+            for slot in range(enc.rf):
+                counters[i, slot] = per_node.get(slot, 0)
+    return counters
+
+
+def apply_counter_updates(
+    ctx: Context, enc: ProblemEncoding, before: np.ndarray, after: np.ndarray
+) -> None:
+    """Fold the solve's counter increments back into the shared Context."""
+    delta = np.asarray(after, dtype=np.int64) - np.asarray(before, dtype=np.int64)
+    for i, b in enumerate(enc.broker_ids):
+        for slot in range(enc.rf):
+            d = int(delta[i, slot])
+            if d:
+                node = ctx.counter.setdefault(int(b), {})
+                node[slot] = node.get(slot, 0) + d

@@ -1,0 +1,3 @@
+from .assignment import AssignState, leadership_order, solve_assignment
+
+__all__ = ["AssignState", "solve_assignment", "leadership_order"]

@@ -1,0 +1,292 @@
+"""On-device assignment kernels: vectorized sticky fill, wave-auction orphan
+spread, and exact leadership ordering — the TPU-native re-formulation of the
+reference's sequential greedy (``KafkaAssignmentStrategy.java:101-302``).
+
+Design notes (tpu-first, not a translation):
+
+- **Sticky fill** (reference: round-robin iterators over TreeMaps, ``:101-131``)
+  becomes a static loop over replica slots; within a slot every partition's
+  re-acceptance test is evaluated in parallel, and per-node capacity
+  arbitration uses a sort-based *rank among same-node requests* — partitions
+  in ascending order win first, exactly the TreeMap iteration tie-break.
+- **Orphan spread** (reference: per-partition first-fit scans, ``:162-186``)
+  becomes a *wave auction* under ``lax.while_loop``: every deficient partition
+  bids for its best (lowest topic-rotated position, ``:188-200``) eligible
+  node simultaneously; per-node winners are the lowest partition rows within
+  remaining capacity; losers rebid next wave. Node loads grow monotonically,
+  so each wave the globally lowest-row bid always lands → guaranteed progress,
+  and a partition with a deficit and no eligible node is *provably* infeasible
+  (eligibility only shrinks), matching the reference's hard failure ``:183-184``.
+- **Leadership ordering** (reference: least-seen counter scan with first-
+  minimum-in-rotated-order tie-break, ``:202-302``) is replicated *bit-for-bit*:
+  "first strict minimum in rotated scan order" ≡ argmin of the lexicographic
+  key ``count * m + rotated_pos`` over the remaining candidates (m = number of
+  remaining candidates, rotation start = abs(hash) % m, ``:263-278``). The
+  cross-partition counter dependency is carried through ``lax.scan``.
+
+All shapes are static (padded buckets); all control flow is ``lax`` — nothing
+here falls back to the host inside ``jit``.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+BIG = jnp.int32(0x3FFFFFFF)
+
+
+class AssignState(NamedTuple):
+    """Carried solver state (functional equivalent of the reference's mutable
+    Node/Rack objects, ``KafkaAssignmentStrategy.java:307-355``)."""
+
+    acc_nodes: jnp.ndarray   # (P, RF) accepted broker index per slot, -1 empty
+    acc_count: jnp.ndarray   # (P,)   number accepted per partition
+    node_load: jnp.ndarray   # (N+1,) replicas per node (+1 scratch row)
+    deficit: jnp.ndarray     # (P,)   replicas still to place
+    infeasible: jnp.ndarray  # ()     bool: some partition cannot be completed
+
+
+def _requests_rank(pick: jnp.ndarray, valid: jnp.ndarray, sentinel: int) -> jnp.ndarray:
+    """Rank of each valid request among requests for the same node, in
+    ascending partition-row order — the vectorized stand-in for 'TreeMap
+    iteration order decides who hits the capacity gate first'."""
+    p = pick.shape[0]
+    keys = jnp.where(valid, pick, sentinel)
+    order = jnp.argsort(keys, stable=True)
+    sorted_keys = keys[order]
+    first = jnp.searchsorted(sorted_keys, sorted_keys, side="left")
+    rank_sorted = jnp.arange(p, dtype=jnp.int32) - first.astype(jnp.int32)
+    return jnp.zeros(p, dtype=jnp.int32).at[order].set(rank_sorted)
+
+
+def _accept_batch(
+    state: AssignState, cand: jnp.ndarray, accept: jnp.ndarray
+) -> AssignState:
+    """Record one accepted replica per accepting partition (functional
+    ``Node.accept`` + ``Rack.accept``, ``KafkaAssignmentStrategy.java:326-331``)."""
+    p, rf = state.acc_nodes.shape
+    n_scratch = state.node_load.shape[0] - 1
+    slot_onehot = jnp.arange(rf, dtype=jnp.int32)[None, :] == state.acc_count[:, None]
+    write = slot_onehot & accept[:, None]
+    acc_nodes = jnp.where(write, cand[:, None], state.acc_nodes)
+    acc_count = state.acc_count + accept.astype(jnp.int32)
+    node_load = state.node_load.at[jnp.where(accept, cand, n_scratch)].add(1)
+    deficit = state.deficit - accept.astype(jnp.int32)
+    return state._replace(
+        acc_nodes=acc_nodes, acc_count=acc_count, node_load=node_load, deficit=deficit
+    )
+
+
+def _candidate_ok(
+    state: AssignState, cand: jnp.ndarray, rack_idx: jnp.ndarray, rf: int
+) -> jnp.ndarray:
+    """Per-partition acceptability of candidate nodes, sans capacity:
+    node exists, not already holding the partition, rack not already used
+    (``Node.canAccept`` ∧ ``Rack.canAccept``, ``:320-324, 346-348``)."""
+    exists = cand >= 0
+    safe = jnp.maximum(cand, 0)
+    dup_node = jnp.any(state.acc_nodes == cand[:, None], axis=1)
+    cand_rack = rack_idx[safe]
+    acc_racks = jnp.where(state.acc_nodes >= 0, rack_idx[jnp.maximum(state.acc_nodes, 0)], -1)
+    dup_rack = jnp.any(acc_racks == cand_rack[:, None], axis=1)
+    under_rf = state.acc_count < rf
+    return exists & ~dup_node & ~dup_rack & under_rf
+
+
+def sticky_fill(
+    current: jnp.ndarray,   # (P, L) broker index or -1
+    rack_idx: jnp.ndarray,  # (N_pad,)
+    rf: int,
+    cap: jnp.ndarray,       # scalar int32
+    n: int,                 # real node count (scratch row = n)
+    p_real: jnp.ndarray | None = None,  # real partition count; padded rows get no deficit
+) -> AssignState:
+    """Vectorized sticky fill (``fillNodesFromAssignment``, ``:101-131``).
+
+    Slot-by-slot (the round-robin pass order: slot 0 of every partition is
+    offered before any slot 1, so leader replicas win sticky capacity before
+    followers); within a slot, ascending partition rows win capacity ties.
+
+    Divergence from the reference, on purpose: a partition never keeps more
+    than ``rf`` replicas. The reference's sticky fill has no per-partition
+    limit (``:320-324``), which on an RF decrease emits non-uniform replica
+    lists (see greedy.py header); the TPU solver clamps to the requested RF.
+    """
+    p, width = current.shape
+    if p_real is None:
+        p_real = jnp.int32(p)
+    deficit = jnp.where(jnp.arange(p, dtype=jnp.int32) < p_real, rf, 0).astype(
+        jnp.int32
+    )
+    state = AssignState(
+        acc_nodes=jnp.full((p, rf), -1, dtype=jnp.int32),
+        acc_count=jnp.zeros(p, dtype=jnp.int32),
+        node_load=jnp.zeros(n + 1, dtype=jnp.int32),
+        deficit=deficit,
+        infeasible=jnp.asarray(False),
+    )
+    for s in range(width):  # static unroll: width == historical RF, small
+        cand = current[:, s]
+        ok = _candidate_ok(state, cand, rack_idx, rf)
+        rank = _requests_rank(cand, ok, n)
+        load = state.node_load[jnp.maximum(cand, 0)]
+        accept = ok & (load + rank < cap)
+        state = _accept_batch(state, cand, accept)
+    return state
+
+
+def _wave_body(rack_idx: jnp.ndarray, pos: jnp.ndarray, cap: jnp.ndarray, n: int):
+    """One auction wave over all deficient partitions."""
+
+    def body(state: AssignState) -> AssignState:
+        p = state.acc_nodes.shape[0]
+        rows = jnp.arange(p, dtype=jnp.int32)[:, None]
+
+        # (P, N) eligibility: node not already holding the partition, rack
+        # free for the partition, node under capacity.
+        assigned = (
+            jnp.zeros((p, n + 1), dtype=bool)
+            .at[jnp.broadcast_to(rows, state.acc_nodes.shape),
+                jnp.where(state.acc_nodes >= 0, state.acc_nodes, n)]
+            .set(True)[:, :n]
+        )
+        acc_racks = jnp.where(
+            state.acc_nodes >= 0, rack_idx[jnp.maximum(state.acc_nodes, 0)], -1
+        )
+        n_racks = rack_idx.shape[0] + 1
+        rack_used = (
+            jnp.zeros((p, n_racks + 1), dtype=bool)
+            .at[jnp.broadcast_to(rows, acc_racks.shape),
+                jnp.where(acc_racks >= 0, acc_racks, n_racks)]
+            .set(True)
+        )
+        rack_blocked = jnp.take(rack_used, rack_idx[:n], axis=1)
+        under_cap = (state.node_load[:n] < cap)[None, :]
+        eligible = ~assigned & ~rack_blocked & under_cap & (state.deficit > 0)[:, None]
+
+        # Bid: lowest topic-rotated position (first-fit order, :162-186).
+        score = jnp.where(eligible, pos[None, :n], BIG)
+        pick = jnp.argmin(score, axis=1).astype(jnp.int32)
+        has_choice = jnp.any(eligible, axis=1)
+        valid = (state.deficit > 0) & has_choice
+
+        # Monotonicity ⇒ no eligible node now means never again: infeasible.
+        infeasible = state.infeasible | jnp.any((state.deficit > 0) & ~has_choice)
+
+        # Per-node winners: ascending partition rows within remaining capacity.
+        rank = _requests_rank(pick, valid, n)
+        load = state.node_load[jnp.maximum(pick, 0)]
+        accept = valid & (load + rank < cap)
+        state = _accept_batch(state, pick, accept)
+        return state._replace(infeasible=infeasible)
+
+    return body
+
+
+def spread_orphans(
+    state: AssignState,
+    rack_idx: jnp.ndarray,
+    pos: jnp.ndarray,      # (N_pad,) rotated position per node index
+    cap: jnp.ndarray,
+    n: int,
+) -> AssignState:
+    """Wave-auction placement of all outstanding replicas
+    (``getOrphanedReplicas`` + ``assignOrphans``, ``:133-186``)."""
+    body = _wave_body(rack_idx, pos, cap, n)
+
+    def cond(state: AssignState) -> jnp.ndarray:
+        return jnp.any(state.deficit > 0) & ~state.infeasible
+
+    # Progress is ≥ 1 placement per wave while feasible (the lowest-row bid on
+    # any node always lands), so P*RF waves is a hard upper bound; while_loop
+    # exits early via cond.
+    return lax.while_loop(cond, body, state)
+
+
+def leadership_order(
+    acc_nodes: jnp.ndarray,   # (P, RF) broker indices (complete rows)
+    acc_count: jnp.ndarray,   # (P,)
+    counters: jnp.ndarray,    # (N_pad, RF) Context slab
+    jhash: jnp.ndarray,       # scalar: abs(java hash of topic)
+    rf: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Order each partition's replica set by leadership preference,
+    reproducing ``computePreferenceLists`` (``:202-302``) exactly.
+
+    For slot r with m = rf - r remaining candidates, the reference scans the
+    candidates in rotated order (start = abs(hash) % m over the *sorted
+    remaining* set) and takes the first strict minimum of counter[node][r] —
+    equivalently the argmin of the key ``count * m + rotated_pos``. Counters
+    persist across partitions (and topics, via Context), so partitions are
+    processed with ``lax.scan``.
+
+    Returns (ordered (P, RF), updated counters).
+    """
+
+    def per_partition(counters, row):
+        cand, count = row  # (RF,), ()
+        remaining = jnp.arange(rf, dtype=jnp.int32) < count
+        ordered = jnp.full((rf,), -1, dtype=jnp.int32)
+        for r in range(rf):  # static unroll, rf small
+            m = rf - r
+            start = (jhash % jnp.int32(m)).astype(jnp.int32)
+            # Rank of each candidate among the remaining, by broker index
+            # ascending (TreeSet order, :228).
+            lt = (cand[None, :] < cand[:, None]) & remaining[None, :]
+            k = jnp.sum(lt, axis=1).astype(jnp.int32)
+            rot = (k + start) % jnp.int32(m)
+            cnt = counters[jnp.maximum(cand, 0), r]
+            key = jnp.where(remaining, cnt * jnp.int32(m) + rot, BIG)
+            # Partitions whose replica list is shorter than rf (defensive;
+            # complete solves always have count == rf) stop early.
+            valid_slot = jnp.int32(r) < count
+            choice = jnp.argmin(key).astype(jnp.int32)
+            chosen_node = cand[choice]
+            ordered = ordered.at[r].set(jnp.where(valid_slot, chosen_node, -1))
+            remaining = remaining & (jnp.arange(rf, dtype=jnp.int32) != choice)
+            counters = counters.at[jnp.maximum(chosen_node, 0), r].add(
+                jnp.where(valid_slot, 1, 0)
+            )
+        return counters, ordered
+
+    counters, ordered = lax.scan(per_partition, counters, (acc_nodes, acc_count))
+    return ordered, counters
+
+
+def solve_assignment(
+    current: jnp.ndarray,
+    rack_idx: jnp.ndarray,
+    counters: jnp.ndarray,
+    cap: jnp.ndarray,
+    start: jnp.ndarray,
+    jhash: jnp.ndarray,
+    p_real: jnp.ndarray,
+    n: int,
+    rf: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Full single-topic solve: sticky fill → wave spread → leadership order.
+
+    Returns (ordered (P, RF) broker indices, updated counters, infeasible
+    flag, deficit vector for error reporting).
+    """
+    n_pad = rack_idx.shape[0]
+    # Rotated position of node k: (k + start) % n for real nodes
+    # (getNodeProcessingOrder, :188-200); padded nodes sort last.
+    idx = jnp.arange(n_pad, dtype=jnp.int32)
+    pos = jnp.where(idx < n, (idx + start) % jnp.int32(max(n, 1)), BIG)
+
+    state = sticky_fill(current, rack_idx, rf, cap, n, p_real)
+    state = spread_orphans(state, rack_idx, pos, cap, n)
+    ordered, counters = leadership_order(
+        state.acc_nodes, state.acc_count, counters, jhash, rf
+    )
+    # Failed solves must not pollute the cross-topic counters.
+    return ordered, counters, state.infeasible, state.deficit
+
+
+solve_assignment_jit = jax.jit(
+    solve_assignment, static_argnames=("n", "rf"), donate_argnums=()
+)

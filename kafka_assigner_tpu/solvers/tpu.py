@@ -1,0 +1,89 @@
+"""The TPU solver backend: canonicalize → one jitted on-device solve → decode.
+
+Honors the same interface and invariants as the greedy oracle
+(``KafkaAssignmentStrategy.getRackAwareAssignment``,
+``KafkaAssignmentStrategy.java:40-63``):
+
+- identical sticky-fill decisions (movement therefore identical to greedy);
+- identical leadership ordering given identical replica sets (the counter
+  tie-break is replicated exactly, see ``ops/assignment.py``);
+- orphan placement may differ in *which* eligible node takes an orphan (wave
+  auction vs sequential first-fit) but satisfies the same rack/capacity
+  constraints and the same topic-rotated probing preference;
+- infeasible solves raise the reference's error
+  ("Partition N could not be fully assigned!", ``:183-184``).
+
+Divergence (documented): on an RF decrease the solver emits exactly RF
+replicas per partition instead of the reference's unbounded sticky retention
+(see ``greedy.py`` header).
+
+Shapes are padded to power-of-two buckets, so XLA compiles one kernel per
+(P-bucket, N-bucket, L, RF) signature and reuses it across topics — the warm
+path runs entirely on device.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Sequence, Set
+
+import numpy as np
+
+from ..models.problem import (
+    ProblemEncoding,
+    apply_counter_updates,
+    context_to_array,
+    decode_assignment,
+    encode_problem,
+)
+from .base import Context
+
+
+class TpuSolver:
+    """Solver-protocol implementation backed by the jitted assignment kernel."""
+
+    name = "tpu"
+
+    def assign(
+        self,
+        topic: str,
+        current_assignment: Mapping[int, Sequence[int]],
+        rack_assignment: Mapping[int, str],
+        nodes: Set[int],
+        partitions: Set[int],
+        replication_factor: int,
+        context: Context | None = None,
+    ) -> Dict[int, List[int]]:
+        import jax.numpy as jnp
+
+        from ..ops.assignment import solve_assignment_jit
+
+        if context is None:
+            context = Context()
+        enc = encode_problem(
+            topic, current_assignment, rack_assignment, nodes, partitions,
+            replication_factor,
+        )
+        counters_before = context_to_array(context, enc)
+
+        import jax
+
+        ordered, counters_after, infeasible, deficit = jax.device_get(
+            solve_assignment_jit(
+                jnp.asarray(enc.current),
+                jnp.asarray(enc.rack_idx),
+                jnp.asarray(counters_before),
+                jnp.int32(enc.cap),
+                jnp.int32(enc.start),
+                jnp.int32(enc.jhash),
+                jnp.int32(enc.p),
+                n=enc.n,
+                rf=enc.rf,
+            )
+        )
+        if bool(infeasible):
+            bad = int(np.argmax(deficit > 0))
+            raise ValueError(
+                f"Partition {int(enc.partition_ids[bad])} could not be fully "
+                "assigned!"
+            )
+        apply_counter_updates(context, enc, counters_before, counters_after)
+        return decode_assignment(enc, ordered)

@@ -1,0 +1,93 @@
+"""Differential tests: TPU solver vs the greedy oracle.
+
+Contract (solvers/tpu.py header):
+- movement parity: the sticky phase reproduces greedy's decisions exactly, so
+  the moved-replica count is *identical* (0% extra, vs the ≤1% BASELINE budget);
+- leadership parity: given identical replica sets, preference ordering matches
+  greedy bit-for-bit (same counter tie-breaks);
+- steady state (no orphans): full output equality.
+"""
+from __future__ import annotations
+
+import pytest
+
+from kafka_assigner_tpu.assigner import TopicAssigner
+
+from .helpers import moved_replicas
+from .test_invariants import CASES, make_cluster
+
+
+@pytest.mark.parametrize("case", CASES, ids=[str(c) for c in CASES])
+def test_movement_parity_with_greedy(case):
+    n_brokers, n_partitions, rf, n_racks, remove, add = case
+    for seed in range(2):
+        current, live, rack_map = make_cluster(
+            seed, n_brokers, n_partitions, rf, n_racks, remove, add
+        )
+        g = TopicAssigner("greedy").generate_assignment(
+            f"topic-{seed}", current, live, rack_map, -1
+        )
+        t = TopicAssigner("tpu").generate_assignment(
+            f"topic-{seed}", current, live, rack_map, -1
+        )
+        assert moved_replicas(current, g) == moved_replicas(current, t)
+
+
+def test_steady_state_exact_output_parity():
+    # No orphans → sticky keeps everything → identical replica sets → the
+    # leadership pass must reproduce greedy's exact preference lists.
+    current, live, rack_map = make_cluster(0, 10, 50, 3, 5)
+    g = TopicAssigner("greedy").generate_assignment("topic-0", current, live, rack_map, -1)
+    t = TopicAssigner("tpu").generate_assignment("topic-0", current, live, rack_map, -1)
+    assert g == t
+
+
+def test_leadership_parity_across_topics():
+    # Counter state carries across topics identically in both backends.
+    ga, ta = TopicAssigner("greedy"), TopicAssigner("tpu")
+    current, live, rack_map = make_cluster(1, 12, 24, 3, 4)
+    for name in ("alpha", "beta", "gamma", "delta"):
+        g = ga.generate_assignment(name, current, live, rack_map, -1)
+        t = ta.generate_assignment(name, current, live, rack_map, -1)
+        assert g == t, f"diverged at topic {name}"
+
+
+def test_infeasible_matches_reference_error():
+    current = {0: [10, 11], 1: [11, 10]}
+    racks = {10: "a", 11: "a", 12: "a"}
+    with pytest.raises(ValueError, match="could not be fully assigned"):
+        TopicAssigner("tpu").generate_assignment("t", current, {10, 11, 12}, racks, -1)
+
+
+def test_failed_solve_does_not_pollute_context():
+    a = TopicAssigner("tpu")
+    current = {0: [10, 11], 1: [11, 10]}
+    racks = {10: "a", 11: "a", 12: "a"}
+    with pytest.raises(ValueError):
+        a.generate_assignment("t", current, {10, 11, 12}, racks, -1)
+    assert a.context.counter == {}
+
+    # and the assigner keeps working afterwards
+    ok = a.generate_assignment("t2", {0: [10, 11]}, {10, 11, 12}, {}, -1)
+    assert len(ok[0]) == 2
+
+
+@pytest.mark.parametrize("solver", ["greedy", "tpu"])
+def test_empty_string_rack_is_a_real_rack(solver):
+    # rack "" is a rack like any other: three brokers sharing it cannot host
+    # two replicas of one partition.
+    current = {0: [10, 11], 1: [11, 10]}
+    racks = {10: "", 11: "", 12: ""}
+    with pytest.raises(ValueError, match="could not be fully assigned"):
+        TopicAssigner(solver).generate_assignment("t", current, {10, 11, 12}, racks, -1)
+
+
+@pytest.mark.parametrize("solver", ["greedy", "tpu"])
+def test_rackless_node_uses_id_string_as_rack(solver):
+    # Reference semantics (KafkaAssignmentStrategy.java:82-86): a rackless
+    # node's rack id is its id string, so it collides with a real rack named
+    # after that id. Bug-compatible in both backends.
+    current = {0: [10, 11]}
+    racks = {10: "11"}  # node 11 rackless -> rack "11" too
+    with pytest.raises(ValueError, match="could not be fully assigned"):
+        TopicAssigner(solver).generate_assignment("t", current, {10, 11}, racks, -1)
