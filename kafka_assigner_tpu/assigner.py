@@ -11,7 +11,7 @@ Responsibilities (SURVEY.md §1 L2):
 """
 from __future__ import annotations
 
-from typing import Dict, List, Mapping, Sequence, Set
+from typing import Dict, List, Mapping, Sequence, Set, Tuple
 
 from .solvers.base import Context, Solver, get_solver
 
@@ -29,20 +29,16 @@ class TopicAssigner:
         self.solver: Solver = get_solver(solver) if isinstance(solver, str) else solver
         self.context = Context()
 
-    def generate_assignment(
+    def _infer_replication_factor(
         self,
         topic: str,
         current_assignment: Mapping[int, Sequence[int]],
         brokers: Set[int],
-        rack_assignment: Mapping[int, str],
-        desired_replication_factor: int = -1,
-    ) -> Dict[int, List[int]]:
-        """Compute a new assignment with minimal movement
-        (``KafkaTopicAssigner.java:42-72``)."""
+        desired_replication_factor: int,
+    ) -> int:
+        """RF inference + validation (``KafkaTopicAssigner.java:49-69``)."""
         replication_factor = desired_replication_factor
-        partitions: Set[int] = set()
         for partition, replicas in sorted(current_assignment.items()):
-            partitions.add(partition)
             if replication_factor < 0:
                 replication_factor = len(replicas)
             elif desired_replication_factor < 0 and replication_factor != len(replicas):
@@ -59,12 +55,91 @@ class TopicAssigner:
                 f"Topic {topic} has a higher replication factor "
                 f"({replication_factor}) than available brokers!"
             )
+        return replication_factor
+
+    def generate_assignment(
+        self,
+        topic: str,
+        current_assignment: Mapping[int, Sequence[int]],
+        brokers: Set[int],
+        rack_assignment: Mapping[int, str],
+        desired_replication_factor: int = -1,
+    ) -> Dict[int, List[int]]:
+        """Compute a new assignment with minimal movement
+        (``KafkaTopicAssigner.java:42-72``)."""
+        replication_factor = self._infer_replication_factor(
+            topic, current_assignment, brokers, desired_replication_factor
+        )
         return self.solver.assign(
             topic,
             current_assignment,
             rack_assignment,
             set(brokers),
-            partitions,
+            set(current_assignment),
             replication_factor,
             self.context,
         )
+
+    def generate_assignments(
+        self,
+        topic_assignments: (
+            Mapping[str, Mapping[int, Sequence[int]]]
+            | Sequence[Tuple[str, Mapping[int, Sequence[int]]]]
+        ),
+        brokers: Set[int],
+        rack_assignment: Mapping[int, str],
+        desired_replication_factor: int = -1,
+    ) -> List[Tuple[str, Dict[int, List[int]]]]:
+        """Solve many topics through one shared Context, returning
+        ``[(topic, assignment), ...]`` in input order.
+
+        Accepts an ordered mapping or a sequence of (topic, current) pairs;
+        pairs may repeat a topic name, in which case every occurrence is
+        solved and advances the leadership Context, exactly like the
+        reference's topic loop (``KafkaAssignmentGenerator.java:173-176``).
+        When the backend supports batching (``assign_many``), consecutive
+        same-RF topics are solved in a single device dispatch with identical
+        output to the serial loop (the scan carries the leadership counters in
+        topic order).
+        """
+        items = (
+            list(topic_assignments.items())
+            if isinstance(topic_assignments, Mapping)
+            else list(topic_assignments)
+        )
+        rfs = [
+            self._infer_replication_factor(
+                topic, cur, brokers, desired_replication_factor
+            )
+            for topic, cur in items
+        ]
+        assign_many = getattr(self.solver, "assign_many", None)
+        out: List[Tuple[str, Dict[int, List[int]]]] = []
+        if assign_many is None:
+            for (topic, cur), rf in zip(items, rfs):
+                out.append(
+                    (
+                        topic,
+                        self.solver.assign(
+                            topic, cur, rack_assignment, set(brokers), set(cur),
+                            rf, self.context,
+                        ),
+                    )
+                )
+            return out
+
+        # Batch runs of consecutive topics sharing an RF (almost always one
+        # run); order across runs stays the CLI topic order so the Context
+        # evolves exactly as in the serial loop.
+        i = 0
+        while i < len(items):
+            j = i
+            while j < len(items) and rfs[j] == rfs[i]:
+                j += 1
+            out.extend(
+                assign_many(
+                    items[i:j], rack_assignment, set(brokers), rfs[i], self.context
+                )
+            )
+            i = j
+        return out

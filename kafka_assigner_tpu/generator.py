@@ -19,6 +19,7 @@ from .io.base import BrokerInfo, MetadataBackend
 from .io.json_io import (
     format_brokers_json,
     format_reassignment_json,
+    format_reassignment_pairs,
 )
 
 
@@ -141,16 +142,17 @@ def print_least_disruptive_reassignment(
     print("CURRENT ASSIGNMENT:", file=out)
     print(format_reassignment_json(initial, topic_order=topic_list), file=out)
 
-    # One topic at a time through the shared-context assigner — batching across
-    # topics happens inside the TPU solver, not by changing this contract
-    # (KafkaAssignmentGenerator.java:166-176).
+    # Topics flow through one shared-context assigner in CLI order
+    # (KafkaAssignmentGenerator.java:166-176), duplicates solved per
+    # occurrence like the reference loop. The TPU backend folds the whole
+    # loop into a single device dispatch with identical output.
     assigner = TopicAssigner(solver=solver)
-    final: Dict[str, Dict[int, List[int]]] = {}
-    for topic in topic_list:
-        final[topic] = assigner.generate_assignment(
-            topic, initial[topic], brokers, rack_assignment,
-            desired_replication_factor,
-        )
-    payload = format_reassignment_json(final, topic_order=topic_list)
+    final_pairs = assigner.generate_assignments(
+        [(topic, initial[topic]) for topic in topic_list],
+        brokers,
+        rack_assignment,
+        desired_replication_factor,
+    )
+    payload = format_reassignment_pairs(final_pairs)
     print("NEW ASSIGNMENT:\n" + payload, file=out)
-    return final
+    return dict(final_pairs)

@@ -42,6 +42,24 @@ def format_reassignment_json(
     )
 
 
+def format_reassignment_pairs(
+    pairs: Sequence,  # [(topic, {partition: [replicas]}), ...], duplicates allowed
+) -> str:
+    """Like :func:`format_reassignment_json` but over an ordered list of
+    (topic, assignment) pairs — the shape the reassignment driver produces,
+    where a topic listed twice on the CLI is solved and emitted twice
+    (reference topic loop, ``KafkaAssignmentGenerator.java:173-183``)."""
+    partitions = [
+        {"topic": t, "partition": p, "replicas": list(assignment[p])}
+        for t, assignment in pairs
+        for p in sorted(assignment)
+    ]
+    return json.dumps(
+        {"version": KAFKA_FORMAT_VERSION, "partitions": partitions},
+        separators=(",", ":"),
+    )
+
+
 def parse_reassignment_json(payload: str) -> Dict[str, Dict[int, List[int]]]:
     """Inverse of :func:`format_reassignment_json` (accepts any Kafka-parseable
     reassignment JSON, whatever the key order/whitespace)."""

@@ -26,6 +26,28 @@ def _next_bucket(n: int, floor: int = 8) -> int:
     return b
 
 
+def group_pads(currents: Sequence[Mapping[int, Sequence[int]]]) -> tuple:
+    """(p_pad, width) bucket covering a whole topic group, using the same
+    bucketing rules as :func:`encode_problem` so group overrides are correct
+    by construction."""
+    p_pad = max((_next_bucket(len(cur)) for cur in currents), default=8)
+    width = max(
+        (
+            _next_bucket(max((len(r) for r in cur.values()), default=1), floor=2)
+            for cur in currents
+        ),
+        default=2,
+    )
+    return p_pad, width
+
+
+def batch_bucket(b: int) -> int:
+    """Bucket for the batch (topic-count) axis: scans are compiled per batch
+    shape, so topic-count changes must not trigger recompiles. Padding topics
+    are inert (p_real == 0)."""
+    return _next_bucket(b, floor=1)
+
+
 @dataclass
 class ProblemEncoding:
     """One topic's assignment problem, canonicalized to dense index space."""
@@ -52,11 +74,18 @@ def encode_problem(
     nodes: Set[int],
     partitions: Set[int],
     replication_factor: int,
+    p_pad_override: int | None = None,
+    width_override: int | None = None,
 ) -> ProblemEncoding:
+    """Canonicalize one topic. ``p_pad_override``/``width_override`` let the
+    batched solver pad a whole topic group to one common shape."""
     broker_ids = np.array(sorted(nodes), dtype=np.int64)
     partition_ids = np.array(sorted(partitions), dtype=np.int64)
     n, p = len(broker_ids), len(partition_ids)
-    n_pad, p_pad = _next_bucket(n), _next_bucket(p)
+    n_pad = _next_bucket(n)
+    p_pad = p_pad_override if p_pad_override is not None else _next_bucket(p)
+    if p_pad < p:
+        raise ValueError(f"p_pad_override {p_pad} < partition count {p}")
 
     # Rack factorization. A node with no rack uses its id *string* as the rack
     # id (KafkaAssignmentStrategy.java:82-86) — including the reference's
@@ -77,7 +106,13 @@ def encode_problem(
     lengths = [len(r) for r in current_assignment.values()]
     # Width is bucketed too (extra columns are -1 no-ops in the sticky fill),
     # so historical replica-list length doesn't multiply kernel compiles.
-    width = _next_bucket(max(max(lengths, default=0), 1), floor=2)
+    width = (
+        width_override
+        if width_override is not None
+        else _next_bucket(max(max(lengths, default=0), 1), floor=2)
+    )
+    if any(length > width for length in lengths):
+        raise ValueError(f"width_override {width} < max replica-list length")
     current = np.full((p_pad, width), -1, dtype=np.int32)
     part_to_row = {int(pid): i for i, pid in enumerate(partition_ids)}
     for pid, replicas in current_assignment.items():

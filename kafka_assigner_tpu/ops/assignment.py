@@ -256,22 +256,20 @@ def leadership_order(
     return ordered, counters
 
 
-def solve_assignment(
-    current: jnp.ndarray,
-    rack_idx: jnp.ndarray,
+def _solve_one_topic(
     counters: jnp.ndarray,
+    current: jnp.ndarray,
     cap: jnp.ndarray,
     start: jnp.ndarray,
     jhash: jnp.ndarray,
     p_real: jnp.ndarray,
+    rack_idx: jnp.ndarray,
     n: int,
     rf: int,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Full single-topic solve: sticky fill → wave spread → leadership order.
-
-    Returns (ordered (P, RF) broker indices, updated counters, infeasible
-    flag, deficit vector for error reporting).
-    """
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
+    """One topic's pipeline: sticky fill → wave spread → leadership order.
+    Shared by the single-topic and batched (scan) entry points so the two
+    paths cannot drift."""
     n_pad = rack_idx.shape[0]
     # Rotated position of node k: (k + start) % n for real nodes
     # (getNodeProcessingOrder, :188-200); padded nodes sort last.
@@ -283,10 +281,72 @@ def solve_assignment(
     ordered, counters = leadership_order(
         state.acc_nodes, state.acc_count, counters, jhash, rf
     )
-    # Failed solves must not pollute the cross-topic counters.
-    return ordered, counters, state.infeasible, state.deficit
+    return counters, (ordered, state.infeasible, state.deficit)
+
+
+def solve_assignment(
+    current: jnp.ndarray,
+    rack_idx: jnp.ndarray,
+    counters: jnp.ndarray,
+    cap: jnp.ndarray,
+    start: jnp.ndarray,
+    jhash: jnp.ndarray,
+    p_real: jnp.ndarray,
+    n: int,
+    rf: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Full single-topic solve.
+
+    Returns (ordered (P, RF) broker indices, updated counters, infeasible
+    flag, deficit vector for error reporting).
+    """
+    counters, (ordered, infeasible, deficit) = _solve_one_topic(
+        counters, current, cap, start, jhash, p_real, rack_idx, n, rf
+    )
+    return ordered, counters, infeasible, deficit
 
 
 solve_assignment_jit = jax.jit(
     solve_assignment, static_argnames=("n", "rf"), donate_argnums=()
 )
+
+
+def solve_batched(
+    currents: jnp.ndarray,   # (B, P_pad, L) broker index or -1
+    rack_idx: jnp.ndarray,   # (N_pad,) shared across topics (one broker set per run)
+    counters: jnp.ndarray,   # (N_pad, RF) cross-topic Context slab
+    caps: jnp.ndarray,       # (B,)
+    starts: jnp.ndarray,     # (B,)
+    jhashes: jnp.ndarray,    # (B,)
+    p_reals: jnp.ndarray,    # (B,)
+    n: int,
+    rf: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Solve B topics in one device dispatch.
+
+    The reference solves topics serially in CLI order because the leadership
+    Context carries across topics (``KafkaAssignmentGenerator.java:166-176``,
+    ``KafkaTopicAssigner.java:19-23``). We keep those exact semantics — the
+    counter slab is the ``lax.scan`` carry and topics run in the given order —
+    but the entire loop is one compiled program, so per-topic dispatch latency
+    (the dominant cost through a TPU tunnel) is paid once per run instead of
+    once per topic.
+
+    Returns (ordered (B, P_pad, RF), counters, infeasible (B,), deficits
+    (B, P_pad)). Inert padding topics (p_real == 0) are no-ops: nothing to
+    stick, no deficit, no counter updates.
+    """
+
+    def per_topic(counters, inp):
+        current, cap, start, jhash, p_real = inp
+        return _solve_one_topic(
+            counters, current, cap, start, jhash, p_real, rack_idx, n, rf
+        )
+
+    counters, (ordered, infeasible, deficits) = lax.scan(
+        per_topic, counters, (currents, caps, starts, jhashes, p_reals)
+    )
+    return ordered, counters, infeasible, deficits
+
+
+solve_batched_jit = jax.jit(solve_batched, static_argnames=("n", "rf"))

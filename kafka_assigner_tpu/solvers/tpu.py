@@ -28,11 +28,12 @@ from typing import Dict, List, Mapping, Sequence, Set
 import numpy as np
 
 from ..models.problem import (
-    ProblemEncoding,
     apply_counter_updates,
+    batch_bucket,
     context_to_array,
     decode_assignment,
     encode_problem,
+    group_pads,
 )
 from .base import Context
 
@@ -87,3 +88,85 @@ class TpuSolver:
             )
         apply_counter_updates(context, enc, counters_before, counters_after)
         return decode_assignment(enc, ordered)
+
+    def assign_many(
+        self,
+        named_currents: Sequence[tuple],  # [(topic, current_assignment), ...]
+        rack_assignment: Mapping[int, str],
+        nodes: Set[int],
+        replication_factor: int,
+        context: Context | None = None,
+    ) -> List[tuple]:
+        """Solve a group of same-RF topics in ONE device dispatch, returning
+        ``[(topic, assignment), ...]`` in input order (duplicate topic names
+        are solved per occurrence, like the reference's topic loop).
+
+        The topic loop the reference runs on the host
+        (``KafkaAssignmentGenerator.java:173-176``) becomes a ``lax.scan``
+        carrying the leadership-counter slab, so the output — including
+        cross-topic leader balancing — is identical to solving the topics
+        serially in the given order, while dispatch/transfer latency is paid
+        once per run instead of once per topic. Every topic is padded to the
+        group-wide (P, L) bucket; padded rows are inert.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.assignment import solve_batched_jit
+
+        if context is None:
+            context = Context()
+        if not named_currents:
+            return []
+        p_pad, width = group_pads([cur for _, cur in named_currents])
+        encs = [
+            encode_problem(
+                topic, cur, rack_assignment, nodes, set(cur), replication_factor,
+                p_pad_override=p_pad, width_override=width,
+            )
+            for topic, cur in named_currents
+        ]
+        counters_before = context_to_array(context, encs[0])
+
+        # The batch axis is bucketed like every other axis: padding topics are
+        # inert (empty current, p_real 0), so topic-count changes reuse the
+        # compiled scan instead of recompiling per B.
+        b_real = len(encs)
+        b_pad = batch_bucket(b_real)
+        currents = np.full((b_pad, p_pad, width), -1, dtype=np.int32)
+        caps = np.ones(b_pad, dtype=np.int32)
+        starts = np.zeros(b_pad, dtype=np.int32)
+        jhashes = np.zeros(b_pad, dtype=np.int32)
+        p_reals = np.zeros(b_pad, dtype=np.int32)
+        for i, e in enumerate(encs):
+            currents[i] = e.current
+            caps[i] = e.cap
+            starts[i] = e.start
+            jhashes[i] = e.jhash
+            p_reals[i] = e.p
+
+        ordered, counters_after, infeasible, deficits = jax.device_get(
+            solve_batched_jit(
+                jnp.asarray(currents),
+                jnp.asarray(encs[0].rack_idx),
+                jnp.asarray(counters_before),
+                jnp.asarray(caps),
+                jnp.asarray(starts),
+                jnp.asarray(jhashes),
+                jnp.asarray(p_reals),
+                n=encs[0].n,
+                rf=replication_factor,
+            )
+        )
+        if infeasible[:b_real].any():
+            b = int(np.argmax(infeasible[:b_real]))
+            bad = int(np.argmax(deficits[b] > 0))
+            raise ValueError(
+                f"Partition {int(encs[b].partition_ids[bad])} could not be "
+                "fully assigned!"
+            )
+        apply_counter_updates(context, encs[0], counters_before, counters_after)
+        return [
+            (enc.topic, decode_assignment(enc, ordered[i]))
+            for i, enc in enumerate(encs)
+        ]

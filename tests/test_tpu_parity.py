@@ -91,3 +91,71 @@ def test_rackless_node_uses_id_string_as_rack(solver):
     racks = {10: "11"}  # node 11 rackless -> rack "11" too
     with pytest.raises(ValueError, match="could not be fully assigned"):
         TopicAssigner(solver).generate_assignment("t", current, {10, 11}, racks, -1)
+
+
+def test_batched_equals_serial():
+    # assign_many must reproduce the serial per-topic loop exactly, including
+    # cross-topic leadership counter evolution.
+    current, live, rack_map = make_cluster(2, 12, 24, 3, 4, remove=2)
+    topics = {f"topic-{i}": current for i in range(5)}
+
+    serial = TopicAssigner("tpu")
+    expected = {
+        t: serial.generate_assignment(t, cur, live, rack_map, -1)
+        for t, cur in topics.items()
+    }
+
+    batched = TopicAssigner("tpu")
+    got = dict(batched.generate_assignments(topics, live, rack_map, -1))
+    assert got == expected
+    assert batched.context.counter == serial.context.counter
+
+
+def test_batched_equals_greedy_steady_state():
+    # Steady state: batched TPU output == greedy reference output, topic after
+    # topic (identical replica sets -> identical leadership ordering).
+    current, live, rack_map = make_cluster(0, 10, 50, 3, 5)
+    topics = {f"t{i}": current for i in range(4)}
+    greedy = TopicAssigner("greedy")
+    expected = {
+        t: greedy.generate_assignment(t, cur, live, rack_map, -1)
+        for t, cur in topics.items()
+    }
+    got = dict(TopicAssigner("tpu").generate_assignments(topics, live, rack_map, -1))
+    assert got == expected
+
+
+def test_batched_mixed_rf_groups():
+    # Topics with different RFs split into consecutive same-RF runs.
+    c2 = {p: [10 + (p + i) % 4 for i in range(2)] for p in range(8)}
+    c3 = {p: [10 + (p + i) % 4 for i in range(3)] for p in range(8)}
+    topics = {"a": c2, "b": c2, "c": c3, "d": c2}
+    live = {10, 11, 12, 13}
+    got = dict(TopicAssigner("tpu").generate_assignments(topics, live, {}, -1))
+    assert set(got) == {"a", "b", "c", "d"}
+    assert all(len(r) == 2 for r in got["a"].values())
+    assert all(len(r) == 3 for r in got["c"].values())
+
+
+def test_batched_infeasible_raises():
+    racks = {10: "a", 11: "a", 12: "a"}
+    topics = {"ok": {0: [10]}, "bad": {0: [10, 11], 1: [11, 10]}}
+    with pytest.raises(ValueError, match="could not be fully assigned"):
+        TopicAssigner("tpu").generate_assignments(topics, {10, 11, 12}, racks, -1)
+
+
+@pytest.mark.parametrize("solver", ["greedy", "tpu"])
+def test_duplicate_topics_solved_per_occurrence(solver):
+    # A topic listed twice is solved twice; the second solve sees leadership
+    # counters advanced by the first (reference loop semantics,
+    # KafkaAssignmentGenerator.java:173-176).
+    current = {0: [10, 11, 12]}
+    live = {10, 11, 12}
+    pairs = TopicAssigner(solver).generate_assignments(
+        [("dup", current), ("dup", current)], live, {}, -1
+    )
+    assert [t for t, _ in pairs] == ["dup", "dup"]
+    first, second = pairs[0][1], pairs[1][1]
+    # Same replica set, but the leader rotates because counters advanced.
+    assert set(first[0]) == set(second[0])
+    assert first[0][0] != second[0][0]
