@@ -64,8 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "if not present it will use the existing number")
     p.add_argument("--disable_rack_awareness", action="store_true",
                    help="set to true to ignore rack configurations")
-    p.add_argument("--solver", default="greedy", choices=("greedy", "tpu"),
-                   help="assignment backend: reference-faithful greedy or the "
+    p.add_argument("--solver", default="greedy",
+                   choices=("greedy", "native", "tpu"),
+                   help="assignment backend: reference-faithful greedy "
+                        "(python), the same algorithm as native C++, or the "
                         "TPU (JAX/XLA) solver")
     return p
 

@@ -16,6 +16,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, TextIO
 
 from .assigner import TopicAssigner
 from .io.base import BrokerInfo, MetadataBackend
+from .validate import validate_cluster_feasibility
 from .io.json_io import (
     format_brokers_json,
     format_reassignment_json,
@@ -141,6 +142,22 @@ def print_least_disruptive_reassignment(
     # the same read the solver uses.
     print("CURRENT ASSIGNMENT:", file=out)
     print(format_reassignment_json(initial, topic_order=topic_list), file=out)
+
+    # Up-front feasibility report on stderr — the reference only discovers
+    # infeasibility mid-solve (KafkaAssignmentStrategy.java:183-184); the
+    # solver's hard error remains the backstop.
+    issues = validate_cluster_feasibility(
+        [(t, initial[t]) for t in topic_list], brokers, rack_assignment,
+        desired_replication_factor,
+    )
+    for issue in issues:
+        # Straight to stderr, not through the (default-ERROR) logger: the
+        # operator about to apply a reassignment must see these unprompted,
+        # while stdout stays machine-parseable.
+        print(
+            f"feasibility {issue.severity}: topic {issue.topic}: {issue.message}",
+            file=sys.stderr,
+        )
 
     # Topics flow through one shared-context assigner in CLI order
     # (KafkaAssignmentGenerator.java:166-176), duplicates solved per

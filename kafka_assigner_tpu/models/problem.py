@@ -15,8 +15,7 @@ from typing import Dict, List, Mapping, Sequence, Set
 import numpy as np
 
 from ..solvers.base import Context
-from ..solvers.greedy import max_replicas_per_node
-from ..utils.javahash import java_string_hash, topic_start_index
+from ..utils.javahash import java_string_hash
 
 
 def _next_bucket(n: int, floor: int = 8) -> int:
@@ -49,6 +48,46 @@ def batch_bucket(b: int) -> int:
 
 
 @dataclass
+class ClusterEncoding:
+    """Broker/rack canonicalization shared by every topic in a run (the
+    reference re-derives none of this per topic either — one broker set per
+    process, ``KafkaAssignmentGenerator.java:137-151``)."""
+
+    broker_ids: np.ndarray      # (N,) int64 ascending
+    rack_idx: np.ndarray        # (N_pad,) int32
+    broker_to_idx: Dict[int, int]
+    n: int
+    n_pad: int
+
+
+def encode_cluster(
+    rack_assignment: Mapping[int, str], nodes: Set[int]
+) -> ClusterEncoding:
+    """Factorize the broker set + rack map once for a whole multi-topic run."""
+    broker_ids = np.array(sorted(nodes), dtype=np.int64)
+    n = len(broker_ids)
+    n_pad = _next_bucket(n)
+    uniq: Dict[str, int] = {}
+    rack_idx = np.empty(n_pad, dtype=np.int32)
+    for i, b in enumerate(broker_ids):
+        name = rack_assignment.get(int(b))
+        if name is None:
+            # A rackless node's rack id is its id string
+            # (KafkaAssignmentStrategy.java:82-86), collisions included.
+            name = str(int(b))
+        rack_idx[i] = uniq.setdefault(name, len(uniq))
+    for i in range(n, n_pad):
+        rack_idx[i] = len(uniq) + (i - n)
+    return ClusterEncoding(
+        broker_ids=broker_ids,
+        rack_idx=rack_idx,
+        broker_to_idx={int(b): i for i, b in enumerate(broker_ids)},
+        n=n,
+        n_pad=n_pad,
+    )
+
+
+@dataclass
 class ProblemEncoding:
     """One topic's assignment problem, canonicalized to dense index space."""
 
@@ -58,9 +97,10 @@ class ProblemEncoding:
     rack_idx: np.ndarray        # (N_pad,) int32; rack index per node, unique for padded rows
     current: np.ndarray         # (P_pad, L) int32; broker *index* or -1 (dead/absent)
     rf: int                     # replication factor to assign
-    cap: int                    # ceil(P * RF / N)   (KafkaAssignmentStrategy.java:65-71)
-    start: int                  # abs(hash(topic)) % N rotation origin (:188-200)
-    jhash: int                  # abs(java hash), for per-slot tie-break rotations
+    jhash: int                  # abs(java hash); drives the topic rotation start
+                                # abs(hash) % N (KafkaAssignmentStrategy.java:188-200)
+                                # and the per-slot leadership tie-breaks — the
+                                # solvers derive cap/start from it on device
     n: int                      # real node count (N)
     p: int                      # real partition count (P)
     n_pad: int
@@ -76,33 +116,23 @@ def encode_problem(
     replication_factor: int,
     p_pad_override: int | None = None,
     width_override: int | None = None,
+    cluster: ClusterEncoding | None = None,
 ) -> ProblemEncoding:
     """Canonicalize one topic. ``p_pad_override``/``width_override`` let the
-    batched solver pad a whole topic group to one common shape."""
-    broker_ids = np.array(sorted(nodes), dtype=np.int64)
+    batched solver pad a whole topic group to one common shape; ``cluster``
+    reuses a shared broker/rack encoding across topics (empty-string rack
+    names are real racks, not "no rack")."""
+    if cluster is None:
+        cluster = encode_cluster(rack_assignment, nodes)
+    broker_ids = cluster.broker_ids
+    rack_idx = cluster.rack_idx
+    broker_to_idx = cluster.broker_to_idx
+    n, n_pad = cluster.n, cluster.n_pad
     partition_ids = np.array(sorted(partitions), dtype=np.int64)
-    n, p = len(broker_ids), len(partition_ids)
-    n_pad = _next_bucket(n)
+    p = len(partition_ids)
     p_pad = p_pad_override if p_pad_override is not None else _next_bucket(p)
     if p_pad < p:
         raise ValueError(f"p_pad_override {p_pad} < partition count {p}")
-
-    # Rack factorization. A node with no rack uses its id *string* as the rack
-    # id (KafkaAssignmentStrategy.java:82-86) — including the reference's
-    # corner where a rackless node collides with a real rack literally named
-    # after its id. Empty-string rack names are real racks, not "no rack".
-    rack_names = []
-    for b in broker_ids:
-        name = rack_assignment.get(int(b))
-        rack_names.append(str(int(b)) if name is None else name)
-    uniq: Dict[str, int] = {}
-    rack_idx = np.empty(n_pad, dtype=np.int32)
-    for i, name in enumerate(rack_names):
-        rack_idx[i] = uniq.setdefault(name, len(uniq))
-    for i in range(n, n_pad):
-        rack_idx[i] = len(uniq) + (i - n)
-
-    broker_to_idx = {int(b): i for i, b in enumerate(broker_ids)}
     lengths = [len(r) for r in current_assignment.values()]
     # Width is bucketed too (extra columns are -1 no-ops in the sticky fill),
     # so historical replica-list length doesn't multiply kernel compiles.
@@ -122,6 +152,15 @@ def encode_problem(
         for s, b in enumerate(replicas):
             current[row, s] = broker_to_idx.get(int(b), -1)
 
+    h = java_string_hash(topic)
+    if h == -(2**31):
+        # Same pathological input the reference crashes on (Math.abs of
+        # Integer.MIN_VALUE stays negative -> negative array index); surface
+        # it as a clear error at encode time.
+        raise ValueError(
+            f"topic {topic!r} hashes to Integer.MIN_VALUE; the reference tool "
+            "crashes on this input (negative array index)"
+        )
     return ProblemEncoding(
         topic=topic,
         broker_ids=broker_ids,
@@ -129,9 +168,7 @@ def encode_problem(
         rack_idx=rack_idx,
         current=current,
         rf=replication_factor,
-        cap=max_replicas_per_node(n, p, replication_factor),
-        start=topic_start_index(topic, n),
-        jhash=abs(java_string_hash(topic)),
+        jhash=abs(h),
         n=n,
         p=p,
         n_pad=n_pad,

@@ -1,0 +1,3 @@
+from .build import load_native_library
+
+__all__ = ["load_native_library"]

@@ -1,0 +1,87 @@
+"""Build/load the native greedy oracle (C++ via g++, bound with ctypes —
+this image ships no pybind11). The library is rebuilt automatically when the
+source is newer than the cached .so; callers fall back to the Python oracle
+when no compiler is available.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_SRC = os.path.join(os.path.dirname(__file__), "greedy.cpp")
+_LIB = os.path.join(os.path.dirname(__file__), "libkagreedy.so")
+_lock = threading.Lock()
+_cached: ctypes.CDLL | None = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build() -> None:
+    # Compile to a temp file and os.replace into place: concurrent processes
+    # (pytest workers, bench + CLI) must never dlopen a half-written .so, and
+    # the loser of the race just overwrites with identical bits.
+    tmp = f"{_LIB}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise NativeBuildError(f"g++ unavailable or timed out: {e}") from e
+    if proc.returncode != 0:
+        raise NativeBuildError(f"native build failed:\n{proc.stderr}")
+    try:
+        os.replace(tmp, _LIB)
+    except OSError as e:
+        raise NativeBuildError(f"cannot install native library: {e}") from e
+
+
+def load_native_library() -> ctypes.CDLL:
+    """Compile (if stale) and load the greedy oracle; raises NativeBuildError
+    when the toolchain is missing."""
+    global _cached
+    with _lock:
+        if _cached is not None:
+            return _cached
+        if (
+            not os.path.exists(_LIB)
+            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        ):
+            _build()
+        lib = ctypes.CDLL(_LIB)
+        fn = lib.ka_solve_topic
+        fn.restype = ctypes.c_int32
+        fn.argtypes = [
+            ctypes.c_int32,                  # n
+            ctypes.POINTER(ctypes.c_int32),  # rack_of
+            ctypes.c_int32,                  # n_racks
+            ctypes.c_int32,                  # p
+            ctypes.POINTER(ctypes.c_int32),  # current
+            ctypes.c_int32,                  # width
+            ctypes.c_int32,                  # rf
+            ctypes.c_int64,                  # jhash_abs
+            ctypes.POINTER(ctypes.c_int32),  # counters (in/out)
+            ctypes.POINTER(ctypes.c_int32),  # out_ordered
+        ]
+        many = lib.ka_solve_many
+        many.restype = ctypes.c_int32
+        many.argtypes = [
+            ctypes.c_int32,                  # n
+            ctypes.POINTER(ctypes.c_int32),  # rack_of
+            ctypes.c_int32,                  # n_racks
+            ctypes.c_int32,                  # n_topics
+            ctypes.POINTER(ctypes.c_int32),  # p_counts
+            ctypes.POINTER(ctypes.c_int32),  # widths
+            ctypes.POINTER(ctypes.c_int64),  # jhashes
+            ctypes.POINTER(ctypes.c_int32),  # currents_concat
+            ctypes.POINTER(ctypes.c_int64),  # current_offsets
+            ctypes.c_int32,                  # rf
+            ctypes.POINTER(ctypes.c_int32),  # counters (in/out)
+            ctypes.POINTER(ctypes.c_int32),  # ordered_concat
+            ctypes.POINTER(ctypes.c_int64),  # ordered_offsets
+            ctypes.POINTER(ctypes.c_int32),  # fail_part
+        ]
+        _cached = lib
+        return lib

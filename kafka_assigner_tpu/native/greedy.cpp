@@ -1,0 +1,195 @@
+// Native greedy assignment oracle.
+//
+// Same five-phase semantics as the Python oracle (solvers/greedy.py) and the
+// reference algorithm (KafkaAssignmentStrategy.java:40-63), operating in
+// dense index space (node row = rank of broker id ascending, rack ids
+// factorized, partitions row-major ascending). Exists so the BASELINE
+// comparison at headline scale (5k brokers / 200k partitions) measures the
+// TPU solver against a serious single-thread native implementation of the
+// reference's algorithm, not against interpreted Python.
+//
+// Exposed via a C ABI for ctypes (no pybind11 in this image).
+//
+// Phase map (reference line numbers):
+//   capacity  ceil(P*RF/N)                KafkaAssignmentStrategy.java:65-71
+//   sticky    slot-major round-robin      KafkaAssignmentStrategy.java:101-131
+//   orphans   deficit per partition       KafkaAssignmentStrategy.java:133-160
+//   spread    first-fit in rotated order  KafkaAssignmentStrategy.java:162-200
+//   leaders   least-seen counter ordering KafkaAssignmentStrategy.java:202-302
+
+#include <cstddef>
+#include <cstdint>
+#include <climits>
+#include <vector>
+
+namespace {
+
+struct Topic {
+    int n;           // nodes
+    int p;           // partitions
+    int rf;          // replicas to place
+    int cap;         // per-node capacity
+    const int32_t* rack_of;  // (n) factorized rack id per node
+    int n_racks;
+};
+
+// Membership tracking: per node a small flat list of held partitions (loads
+// are bounded by cap, typically 1-16), per (rack, partition) a bitfield.
+struct State {
+    std::vector<std::vector<int>> node_parts;  // per node
+    std::vector<uint8_t> rack_has;             // n_racks * p
+    std::vector<int> acc_count;                // per partition
+    std::vector<int> acc_nodes;                // p * rf, -1 empty
+
+    State(const Topic& t)
+        : node_parts(t.n),
+          rack_has(static_cast<size_t>(t.n_racks) * t.p, 0),
+          acc_count(t.p, 0),
+          acc_nodes(static_cast<size_t>(t.p) * t.rf, -1) {}
+};
+
+inline bool node_holds(const State& s, int node, int part) {
+    for (int q : s.node_parts[node])
+        if (q == part) return true;
+    return false;
+}
+
+inline bool can_accept(const Topic& t, const State& s, int node, int part) {
+    return !node_holds(s, node, part) &&
+           static_cast<int>(s.node_parts[node].size()) < t.cap &&
+           !s.rack_has[static_cast<size_t>(t.rack_of[node]) * t.p + part];
+}
+
+inline void accept(const Topic& t, State& s, int node, int part) {
+    s.node_parts[node].push_back(part);
+    s.rack_has[static_cast<size_t>(t.rack_of[node]) * t.p + part] = 1;
+    int c = s.acc_count[part]++;
+    s.acc_nodes[static_cast<size_t>(part) * t.rf + c] = node;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success; (partition_row + 1) when that partition cannot be
+// fully assigned (the reference's hard failure, :183-184).
+//
+// current: (p x width) node indices or -1. counters: (n x rf) leadership
+// counters, updated in place. out_ordered: (p x rf) preference lists.
+int32_t ka_solve_topic(
+    int32_t n, const int32_t* rack_of, int32_t n_racks,
+    int32_t p, const int32_t* current, int32_t width,
+    int32_t rf, int64_t jhash_abs,
+    int32_t* counters, int32_t* out_ordered) {
+    Topic t;
+    t.n = n;
+    t.p = p;
+    t.rf = rf;
+    t.cap = static_cast<int>((static_cast<int64_t>(p) * rf + n - 1) / n);
+    t.rack_of = rack_of;
+    t.n_racks = n_racks;
+
+    State s(t);
+
+    // Sticky fill: slot-major round-robin, ascending partitions within a
+    // pass — replica i of every partition is offered before any replica i+1.
+    // NOTE: unlike the reference (no per-partition limit, see greedy.py
+    // header on the RF-decrease quirk), acceptance is clamped to rf, matching
+    // the TPU solver's documented divergence.
+    for (int s_idx = 0; s_idx < width; ++s_idx) {
+        for (int part = 0; part < p; ++part) {
+            int cand = current[static_cast<size_t>(part) * width + s_idx];
+            if (cand < 0 || s.acc_count[part] >= rf) continue;
+            if (can_accept(t, s, cand, part)) accept(t, s, cand, part);
+        }
+    }
+
+    // Orphan spread: ascending partitions; nodes probed in topic-rotated
+    // order starting at abs(hash) % n, greedy first-fit.
+    int start = static_cast<int>(jhash_abs % n);
+    for (int part = 0; part < p; ++part) {
+        int deficit = rf - s.acc_count[part];
+        if (deficit <= 0) continue;
+        for (int k = 0; k < n && deficit > 0; ++k) {
+            // rotated iteration: position i holds sorted node (i - start mod n)
+            int node = (k + (n - start)) % n;
+            if (can_accept(t, s, node, part)) {
+                accept(t, s, node, part);
+                --deficit;
+            }
+        }
+        if (deficit != 0) return part + 1;
+    }
+
+    // Leadership ordering: for slot r over m remaining candidates, take the
+    // first strict minimum of counter[node][r] scanning the remaining set in
+    // rotated order == argmin of (count * m + rotated_pos).
+    std::vector<int> remaining(rf);
+    for (int part = 0; part < p; ++part) {
+        const int32_t* cand = &s.acc_nodes[static_cast<size_t>(part) * rf];
+        int m_all = s.acc_count[part];
+        int n_rem = 0;
+        for (int i = 0; i < m_all; ++i) remaining[n_rem++] = cand[i];
+        for (int r = 0; r < m_all; ++r) {
+            int m = n_rem;
+            int rot_start = static_cast<int>(jhash_abs % m);
+            int64_t best_key = INT64_MAX;
+            int best_i = -1;
+            for (int i = 0; i < n_rem; ++i) {
+                int node = remaining[i];
+                // rank among remaining by node index ascending
+                int k = 0;
+                for (int j = 0; j < n_rem; ++j)
+                    if (remaining[j] < node) ++k;
+                int pos = (k + rot_start) % m;
+                int64_t key =
+                    static_cast<int64_t>(counters[static_cast<size_t>(node) * rf + r]) * m + pos;
+                if (key < best_key) {
+                    best_key = key;
+                    best_i = i;
+                }
+            }
+            int chosen = remaining[best_i];
+            remaining[best_i] = remaining[--n_rem];
+            out_ordered[static_cast<size_t>(part) * rf + r] = chosen;
+        }
+        for (int r = m_all; r < rf; ++r)
+            out_ordered[static_cast<size_t>(part) * rf + r] = -1;
+        for (int r = 0; r < m_all; ++r)
+            ++counters[static_cast<size_t>(out_ordered[static_cast<size_t>(part) * rf + r]) * rf + r];
+    }
+    return 0;
+}
+
+// Multi-topic entry: the reference's serial topic loop
+// (KafkaAssignmentGenerator.java:173-176) run entirely in native code with
+// the leadership counters shared across topics. Topics are concatenated:
+// currents at current_offsets[i] with shape (p_counts[i] x widths[i]),
+// outputs at ordered_offsets[i] with shape (p_counts[i] x rf).
+//
+// Returns 0 on success; on infeasibility returns (topic_index + 1) and
+// writes the failing partition row to *fail_part.
+int32_t ka_solve_many(
+    int32_t n, const int32_t* rack_of, int32_t n_racks,
+    int32_t n_topics,
+    const int32_t* p_counts, const int32_t* widths, const int64_t* jhashes,
+    const int32_t* currents_concat, const int64_t* current_offsets,
+    int32_t rf,
+    int32_t* counters,
+    int32_t* ordered_concat, const int64_t* ordered_offsets,
+    int32_t* fail_part) {
+    for (int32_t i = 0; i < n_topics; ++i) {
+        int32_t rc = ka_solve_topic(
+            n, rack_of, n_racks,
+            p_counts[i], currents_concat + current_offsets[i], widths[i],
+            rf, jhashes[i],
+            counters, ordered_concat + ordered_offsets[i]);
+        if (rc != 0) {
+            *fail_part = rc - 1;
+            return i + 1;
+        }
+    }
+    return 0;
+}
+
+}  // extern "C"

@@ -35,7 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-BIG = jnp.int32(0x3FFFFFFF)
+# Plain int (not a jax array): module import must not initialize a backend.
+BIG = 0x3FFFFFFF
 
 
 class AssignState(NamedTuple):
@@ -81,13 +82,18 @@ def _accept_batch(
 
 
 def _candidate_ok(
-    state: AssignState, cand: jnp.ndarray, rack_idx: jnp.ndarray, rf: int
+    state: AssignState,
+    cand: jnp.ndarray,
+    rack_idx: jnp.ndarray,
+    rf: int,
+    alive: jnp.ndarray,
 ) -> jnp.ndarray:
     """Per-partition acceptability of candidate nodes, sans capacity:
-    node exists, not already holding the partition, rack not already used
+    node exists and is alive in this scenario, not already holding the
+    partition, rack not already used
     (``Node.canAccept`` ∧ ``Rack.canAccept``, ``:320-324, 346-348``)."""
-    exists = cand >= 0
     safe = jnp.maximum(cand, 0)
+    exists = (cand >= 0) & alive[safe]
     dup_node = jnp.any(state.acc_nodes == cand[:, None], axis=1)
     cand_rack = rack_idx[safe]
     acc_racks = jnp.where(state.acc_nodes >= 0, rack_idx[jnp.maximum(state.acc_nodes, 0)], -1)
@@ -103,6 +109,7 @@ def sticky_fill(
     cap: jnp.ndarray,       # scalar int32
     n: int,                 # real node count (scratch row = n)
     p_real: jnp.ndarray | None = None,  # real partition count; padded rows get no deficit
+    alive: jnp.ndarray | None = None,   # (N_pad,) scenario liveness; default: first n
 ) -> AssignState:
     """Vectorized sticky fill (``fillNodesFromAssignment``, ``:101-131``).
 
@@ -116,8 +123,11 @@ def sticky_fill(
     lists (see greedy.py header); the TPU solver clamps to the requested RF.
     """
     p, width = current.shape
+    n_pad = rack_idx.shape[0]
     if p_real is None:
         p_real = jnp.int32(p)
+    if alive is None:
+        alive = jnp.arange(n_pad, dtype=jnp.int32) < n
     deficit = jnp.where(jnp.arange(p, dtype=jnp.int32) < p_real, rf, 0).astype(
         jnp.int32
     )
@@ -130,7 +140,7 @@ def sticky_fill(
     )
     for s in range(width):  # static unroll: width == historical RF, small
         cand = current[:, s]
-        ok = _candidate_ok(state, cand, rack_idx, rf)
+        ok = _candidate_ok(state, cand, rack_idx, rf, alive)
         rank = _requests_rank(cand, ok, n)
         load = state.node_load[jnp.maximum(cand, 0)]
         accept = ok & (load + rank < cap)
@@ -138,7 +148,13 @@ def sticky_fill(
     return state
 
 
-def _wave_body(rack_idx: jnp.ndarray, pos: jnp.ndarray, cap: jnp.ndarray, n: int):
+def _wave_body(
+    rack_idx: jnp.ndarray,
+    pos: jnp.ndarray,
+    cap: jnp.ndarray,
+    n: int,
+    alive: jnp.ndarray,
+):
     """One auction wave over all deficient partitions."""
 
     def body(state: AssignState) -> AssignState:
@@ -164,7 +180,7 @@ def _wave_body(rack_idx: jnp.ndarray, pos: jnp.ndarray, cap: jnp.ndarray, n: int
             .set(True)
         )
         rack_blocked = jnp.take(rack_used, rack_idx[:n], axis=1)
-        under_cap = (state.node_load[:n] < cap)[None, :]
+        under_cap = ((state.node_load[:n] < cap) & alive[:n])[None, :]
         eligible = ~assigned & ~rack_blocked & under_cap & (state.deficit > 0)[:, None]
 
         # Bid: lowest topic-rotated position (first-fit order, :162-186).
@@ -192,10 +208,13 @@ def spread_orphans(
     pos: jnp.ndarray,      # (N_pad,) rotated position per node index
     cap: jnp.ndarray,
     n: int,
+    alive: jnp.ndarray | None = None,
 ) -> AssignState:
     """Wave-auction placement of all outstanding replicas
     (``getOrphanedReplicas`` + ``assignOrphans``, ``:133-186``)."""
-    body = _wave_body(rack_idx, pos, cap, n)
+    if alive is None:
+        alive = jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
+    body = _wave_body(rack_idx, pos, cap, n, alive)
 
     def cond(state: AssignState) -> jnp.ndarray:
         return jnp.any(state.deficit > 0) & ~state.infeasible
@@ -259,37 +278,43 @@ def leadership_order(
 def _solve_one_topic(
     counters: jnp.ndarray,
     current: jnp.ndarray,
-    cap: jnp.ndarray,
-    start: jnp.ndarray,
     jhash: jnp.ndarray,
     p_real: jnp.ndarray,
     rack_idx: jnp.ndarray,
+    alive: jnp.ndarray,  # (N_pad,) bool — scenario liveness mask
     n: int,
     rf: int,
-) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
     """One topic's pipeline: sticky fill → wave spread → leadership order.
-    Shared by the single-topic and batched (scan) entry points so the two
-    paths cannot drift."""
-    n_pad = rack_idx.shape[0]
-    # Rotated position of node k: (k + start) % n for real nodes
-    # (getNodeProcessingOrder, :188-200); padded nodes sort last.
-    idx = jnp.arange(n_pad, dtype=jnp.int32)
-    pos = jnp.where(idx < n, (idx + start) % jnp.int32(max(n, 1)), BIG)
+    Shared by the single-topic, batched (scan), and what-if (vmap over
+    ``alive``) entry points so they cannot drift.
 
-    state = sticky_fill(current, rack_idx, rf, cap, n, p_real)
-    state = spread_orphans(state, rack_idx, pos, cap, n)
+    Capacity ``ceil(P*RF/N_alive)`` (``KafkaAssignmentStrategy.java:65-71``),
+    the rotation start ``abs(hash) % N_alive`` (``:188-200``) and the rotated
+    node positions are all computed on device from the traced liveness mask,
+    so broker-removal scenarios need no host-side re-encoding.
+    """
+    n_alive = jnp.maximum(jnp.sum(alive[: max(n, 1)].astype(jnp.int32)), 1)
+    cap = (p_real * jnp.int32(rf) + n_alive - 1) // n_alive
+    start = jhash % n_alive
+    # Rotated position: rank among live nodes (ascending id), shifted by
+    # start with wraparound; dead/padded nodes sort last.
+    alive_rank = jnp.cumsum(alive.astype(jnp.int32)) - 1
+    pos = jnp.where(alive, (alive_rank + start) % n_alive, BIG)
+
+    state = sticky_fill(current, rack_idx, rf, cap, n, p_real, alive)
+    sticky_kept = jnp.sum(state.acc_count)
+    state = spread_orphans(state, rack_idx, pos, cap, n, alive)
     ordered, counters = leadership_order(
         state.acc_nodes, state.acc_count, counters, jhash, rf
     )
-    return counters, (ordered, state.infeasible, state.deficit)
+    return counters, (ordered, state.infeasible, state.deficit, sticky_kept)
 
 
 def solve_assignment(
     current: jnp.ndarray,
     rack_idx: jnp.ndarray,
     counters: jnp.ndarray,
-    cap: jnp.ndarray,
-    start: jnp.ndarray,
     jhash: jnp.ndarray,
     p_real: jnp.ndarray,
     n: int,
@@ -300,8 +325,9 @@ def solve_assignment(
     Returns (ordered (P, RF) broker indices, updated counters, infeasible
     flag, deficit vector for error reporting).
     """
-    counters, (ordered, infeasible, deficit) = _solve_one_topic(
-        counters, current, cap, start, jhash, p_real, rack_idx, n, rf
+    alive = jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
+    counters, (ordered, infeasible, deficit, _) = _solve_one_topic(
+        counters, current, jhash, p_real, rack_idx, alive, n, rf
     )
     return ordered, counters, infeasible, deficit
 
@@ -315,13 +341,12 @@ def solve_batched(
     currents: jnp.ndarray,   # (B, P_pad, L) broker index or -1
     rack_idx: jnp.ndarray,   # (N_pad,) shared across topics (one broker set per run)
     counters: jnp.ndarray,   # (N_pad, RF) cross-topic Context slab
-    caps: jnp.ndarray,       # (B,)
-    starts: jnp.ndarray,     # (B,)
     jhashes: jnp.ndarray,    # (B,)
     p_reals: jnp.ndarray,    # (B,)
     n: int,
     rf: int,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    alive: jnp.ndarray | None = None,  # (N_pad,) scenario liveness mask
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Solve B topics in one device dispatch.
 
     The reference solves topics serially in CLI order because the leadership
@@ -333,20 +358,61 @@ def solve_batched(
     once per topic.
 
     Returns (ordered (B, P_pad, RF), counters, infeasible (B,), deficits
-    (B, P_pad)). Inert padding topics (p_real == 0) are no-ops: nothing to
-    stick, no deficit, no counter updates.
+    (B, P_pad), sticky_kept (B,)). Inert padding topics (p_real == 0) are
+    no-ops: nothing to stick, no deficit, no counter updates.
     """
+    if alive is None:
+        alive = jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
 
     def per_topic(counters, inp):
-        current, cap, start, jhash, p_real = inp
+        current, jhash, p_real = inp
         return _solve_one_topic(
-            counters, current, cap, start, jhash, p_real, rack_idx, n, rf
+            counters, current, jhash, p_real, rack_idx, alive, n, rf
         )
 
-    counters, (ordered, infeasible, deficits) = lax.scan(
-        per_topic, counters, (currents, caps, starts, jhashes, p_reals)
+    counters, (ordered, infeasible, deficits, kept) = lax.scan(
+        per_topic, counters, (currents, jhashes, p_reals)
     )
-    return ordered, counters, infeasible, deficits
+    return ordered, counters, infeasible, deficits, kept
 
 
 solve_batched_jit = jax.jit(solve_batched, static_argnames=("n", "rf"))
+
+
+def whatif_sweep(
+    currents: jnp.ndarray,   # (B, P_pad, L) the cluster's topics
+    rack_idx: jnp.ndarray,   # (N_pad,)
+    jhashes: jnp.ndarray,    # (B,)
+    p_reals: jnp.ndarray,    # (B,)
+    alive_masks: jnp.ndarray,  # (S, N_pad) one liveness mask per scenario
+    n: int,
+    rf: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Evaluate S broker-removal scenarios over the full cluster in parallel.
+
+    The reference answers "what if we removed these brokers" one scenario per
+    process run (``--broker_hosts_to_remove``); here the scenario axis is a
+    ``vmap`` over the liveness mask, embarrassingly parallel, and shards
+    across a device mesh (``parallel/whatif.py``) — BASELINE config 5.
+
+    Each scenario starts from a fresh leadership Context (independent runs).
+    Returns per-scenario (moved_replicas (S,), any_infeasible (S,),
+    max_node_load (S,)).
+    """
+    counters0 = jnp.zeros((rack_idx.shape[0], rf), dtype=jnp.int32)
+
+    def one_scenario(alive):
+        ordered, _, infeasible, _, kept = solve_batched(
+            currents, rack_idx, counters0, jhashes, p_reals, n, rf, alive
+        )
+        total = jnp.sum(p_reals) * rf
+        moved = total - jnp.sum(kept)
+        # Node loads across every topic's final assignment.
+        safe = jnp.where(ordered >= 0, ordered, rack_idx.shape[0])
+        loads = jnp.zeros(rack_idx.shape[0] + 1, dtype=jnp.int32).at[safe].add(1)
+        return moved, jnp.any(infeasible), jnp.max(loads[: rack_idx.shape[0]])
+
+    return jax.vmap(one_scenario)(alive_masks)
+
+
+whatif_sweep_jit = jax.jit(whatif_sweep, static_argnames=("n", "rf"))

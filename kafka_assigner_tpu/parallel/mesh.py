@@ -1,0 +1,81 @@
+"""Device-mesh construction and sharding specs.
+
+The reference is a single JVM thread with no parallel execution of any kind
+(SURVEY.md §2 parallelism table). The tpu-native framework scales on two axes:
+
+- ``scenarios`` — the data-parallel axis: independent what-if solves
+  (candidate broker removals, RF changes) spread across chips; collectives
+  ride ICI within a slice.
+- ``part`` — the long-axis analogue of sequence parallelism: the partition
+  dimension of the (P × N) eligibility/cost tensors is sharded so one giant
+  topic's solve fits and scales; XLA inserts the all-gathers/psums the
+  blockwise reductions need (the role ring-attention's collectives play for
+  sequence length, SURVEY.md §5).
+
+Multi-host (DCN) runs initialize ``jax.distributed`` first
+(:func:`initialize_distributed`) and then build the same mesh over the global
+device list.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def build_mesh(
+    n_scenarios_axis: Optional[int] = None,
+    n_part_axis: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a ``(scenarios, part)`` mesh over the available devices.
+
+    Defaults to all devices on the scenario (data-parallel) axis — the right
+    layout for what-if fleets on a single slice. Pass ``n_part_axis > 1`` to
+    carve devices for partition-axis sharding of very large single topics.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if n_scenarios_axis is None:
+        n_scenarios_axis = len(devs) // n_part_axis
+    if n_scenarios_axis * n_part_axis != len(devs):
+        raise ValueError(
+            f"mesh {n_scenarios_axis}x{n_part_axis} != {len(devs)} devices"
+        )
+    grid = np.array(devs).reshape(n_scenarios_axis, n_part_axis)
+    return Mesh(grid, ("scenarios", "part"))
+
+
+def scenario_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for per-scenario arrays: split the leading axis across the
+    ``scenarios`` mesh axis, replicate everything else."""
+    return NamedSharding(mesh, PartitionSpec("scenarios"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize multi-host JAX (one process per host, DCN between hosts).
+
+    The reference has no multi-process story at all — one JVM, one thread
+    (``KafkaAssignmentGenerator.java:301-303``). For fleet-scale what-if
+    sweeps across TPU slices, call this once per process before building a
+    mesh; XLA then routes intra-slice collectives over ICI and inter-slice
+    traffic over DCN. No-op when jax.distributed is already initialized.
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        if "already initialized" not in str(e):
+            raise

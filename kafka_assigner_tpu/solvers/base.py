@@ -64,4 +64,19 @@ def get_solver(name: str) -> "Solver":
                 "the 'tpu' solver backend is not available in this build"
             ) from e
         return TpuSolver()
-    raise ValueError(f"unknown solver {name!r}; expected 'greedy' or 'tpu'")
+    if name == "native":
+        from ..native.build import NativeBuildError
+
+        try:
+            from .native import NativeGreedySolver
+
+            return NativeGreedySolver()
+        except (NativeBuildError, OSError) as e:
+            # OSError covers ctypes.CDLL on a stale/foreign-platform .so and
+            # missing-source stat failures — same graceful degradation.
+            raise NotImplementedError(
+                f"the 'native' solver backend could not be built: {e}"
+            ) from e
+    raise ValueError(
+        f"unknown solver {name!r}; expected 'greedy', 'native' or 'tpu'"
+    )

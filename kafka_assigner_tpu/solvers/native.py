@@ -1,0 +1,149 @@
+"""Native greedy solver backend (``--solver native``): the C++ oracle behind
+the same Solver protocol.
+
+Semantics match the Python greedy oracle exactly (same five phases, same
+tie-breaks — differential-tested), except the documented RF-decrease clamp it
+shares with the TPU backend (see ``native/greedy.cpp`` header). Exists as the
+honest single-thread *native* baseline for BASELINE timing at headline scale,
+where interpreted Python would distort the comparison in the TPU solver's
+favor.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Mapping, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..models.problem import (
+    apply_counter_updates,
+    context_to_array,
+    decode_assignment,
+    encode_cluster,
+    encode_problem,
+)
+from ..native.build import load_native_library
+from .base import Context
+
+
+class NativeGreedySolver:
+    name = "native"
+
+    def __init__(self) -> None:
+        self._lib = load_native_library()
+
+    def assign(
+        self,
+        topic: str,
+        current_assignment: Mapping[int, Sequence[int]],
+        rack_assignment: Mapping[int, str],
+        nodes: Set[int],
+        partitions: Set[int],
+        replication_factor: int,
+        context: Context | None = None,
+    ) -> Dict[int, List[int]]:
+        if context is None:
+            context = Context()
+        enc = encode_problem(
+            topic, current_assignment, rack_assignment, nodes, partitions,
+            replication_factor,
+        )
+        counters = np.ascontiguousarray(context_to_array(context, enc))
+        before = counters.copy()
+        rack_of = np.ascontiguousarray(enc.rack_idx[: enc.n])
+        current = np.ascontiguousarray(enc.current[: enc.p])
+        ordered = np.full((enc.p, enc.rf), -1, dtype=np.int32)
+        counters_live = np.ascontiguousarray(counters[: enc.n])
+
+        rc = self._lib.ka_solve_topic(
+            enc.n,
+            rack_of.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            int(rack_of.max()) + 1,
+            enc.p,
+            current.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            current.shape[1],
+            enc.rf,
+            enc.jhash,
+            counters_live.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ordered.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        if rc != 0:
+            raise ValueError(
+                f"Partition {int(enc.partition_ids[rc - 1])} could not be "
+                "fully assigned!"
+            )
+        counters[: enc.n] = counters_live
+        apply_counter_updates(context, enc, before, counters)
+        full = np.full((enc.p_pad, enc.rf), -1, dtype=np.int32)
+        full[: enc.p] = ordered
+        return decode_assignment(enc, full)
+
+    def assign_many(
+        self,
+        named_currents: Sequence[tuple],  # [(topic, current_assignment), ...]
+        rack_assignment: Mapping[int, str],
+        nodes: Set[int],
+        replication_factor: int,
+        context: Context | None = None,
+    ) -> List[Tuple[str, Dict[int, List[int]]]]:
+        """Run the whole serial topic loop in native code, counters shared in
+        memory across topics (one ctypes call per run, not per topic)."""
+        if context is None:
+            context = Context()
+        if not named_currents:
+            return []
+        cluster = encode_cluster(rack_assignment, nodes)
+        rf = replication_factor
+        encs = [
+            encode_problem(t, cur, rack_assignment, nodes, set(cur), rf,
+                           cluster=cluster)
+            for t, cur in named_currents
+        ]
+        n = cluster.n
+        rack_of = np.ascontiguousarray(cluster.rack_idx[:n])
+        n_racks = int(rack_of.max()) + 1
+
+        p_counts = np.array([e.p for e in encs], dtype=np.int32)
+        widths = np.array([e.current.shape[1] for e in encs], dtype=np.int32)
+        jhashes = np.array([e.jhash for e in encs], dtype=np.int64)
+        cur_sizes = p_counts.astype(np.int64) * widths
+        cur_offsets = np.zeros(len(encs), dtype=np.int64)
+        np.cumsum(cur_sizes[:-1], out=cur_offsets[1:])
+        currents = np.concatenate(
+            [np.ascontiguousarray(e.current[: e.p]).ravel() for e in encs]
+        ).astype(np.int32)
+        ord_sizes = p_counts.astype(np.int64) * rf
+        ord_offsets = np.zeros(len(encs), dtype=np.int64)
+        np.cumsum(ord_sizes[:-1], out=ord_offsets[1:])
+        ordered = np.full(int(ord_sizes.sum()), -1, dtype=np.int32)
+
+        counters = np.ascontiguousarray(context_to_array(context, encs[0]))
+        before = counters.copy()
+        counters_live = np.ascontiguousarray(counters[:n])
+        fail_part = np.zeros(1, dtype=np.int32)
+
+        as_i32 = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        as_i64 = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        rc = self._lib.ka_solve_many(
+            n, as_i32(rack_of), n_racks, len(encs),
+            as_i32(p_counts), as_i32(widths), as_i64(jhashes),
+            as_i32(currents), as_i64(cur_offsets),
+            rf, as_i32(counters_live), as_i32(ordered), as_i64(ord_offsets),
+            as_i32(fail_part),
+        )
+        if rc != 0:
+            enc = encs[rc - 1]
+            raise ValueError(
+                f"Partition {int(enc.partition_ids[int(fail_part[0])])} could "
+                "not be fully assigned!"
+            )
+        counters[:n] = counters_live
+        apply_counter_updates(context, encs[0], before, counters)
+        out: List[Tuple[str, Dict[int, List[int]]]] = []
+        for i, enc in enumerate(encs):
+            full = np.full((enc.p_pad, rf), -1, dtype=np.int32)
+            full[: enc.p] = ordered[
+                ord_offsets[i]: ord_offsets[i] + ord_sizes[i]
+            ].reshape(enc.p, rf)
+            out.append((enc.topic, decode_assignment(enc, full)))
+        return out

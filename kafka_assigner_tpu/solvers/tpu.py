@@ -32,6 +32,7 @@ from ..models.problem import (
     batch_bucket,
     context_to_array,
     decode_assignment,
+    encode_cluster,
     encode_problem,
     group_pads,
 )
@@ -72,8 +73,6 @@ class TpuSolver:
                 jnp.asarray(enc.current),
                 jnp.asarray(enc.rack_idx),
                 jnp.asarray(counters_before),
-                jnp.int32(enc.cap),
-                jnp.int32(enc.start),
                 jnp.int32(enc.jhash),
                 jnp.int32(enc.p),
                 n=enc.n,
@@ -119,10 +118,11 @@ class TpuSolver:
         if not named_currents:
             return []
         p_pad, width = group_pads([cur for _, cur in named_currents])
+        cluster = encode_cluster(rack_assignment, nodes)
         encs = [
             encode_problem(
                 topic, cur, rack_assignment, nodes, set(cur), replication_factor,
-                p_pad_override=p_pad, width_override=width,
+                p_pad_override=p_pad, width_override=width, cluster=cluster,
             )
             for topic, cur in named_currents
         ]
@@ -134,24 +134,18 @@ class TpuSolver:
         b_real = len(encs)
         b_pad = batch_bucket(b_real)
         currents = np.full((b_pad, p_pad, width), -1, dtype=np.int32)
-        caps = np.ones(b_pad, dtype=np.int32)
-        starts = np.zeros(b_pad, dtype=np.int32)
         jhashes = np.zeros(b_pad, dtype=np.int32)
         p_reals = np.zeros(b_pad, dtype=np.int32)
         for i, e in enumerate(encs):
             currents[i] = e.current
-            caps[i] = e.cap
-            starts[i] = e.start
             jhashes[i] = e.jhash
             p_reals[i] = e.p
 
-        ordered, counters_after, infeasible, deficits = jax.device_get(
+        ordered, counters_after, infeasible, deficits, _ = jax.device_get(
             solve_batched_jit(
                 jnp.asarray(currents),
                 jnp.asarray(encs[0].rack_idx),
                 jnp.asarray(counters_before),
-                jnp.asarray(caps),
-                jnp.asarray(starts),
                 jnp.asarray(jhashes),
                 jnp.asarray(p_reals),
                 n=encs[0].n,

@@ -1,0 +1,29 @@
+"""Logging/observability: structured diagnostics on stderr, machine-parseable
+JSON alone on stdout.
+
+The reference achieves the stdout/stderr separation by configuring log4j to
+ERROR-only console output and silencing the ZK/Kafka client loggers
+(``src/main/config/log4j.properties:21-31``). Here stdout is reserved for
+payload JSON by construction; diagnostics go to a stderr logger whose level
+is controlled by ``KA_LOG`` (default ERROR, same posture as the reference).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LOGGER_NAME = "kafka_assigner_tpu"
+
+
+def get_logger(child: str | None = None) -> logging.Logger:
+    root = logging.getLogger(_LOGGER_NAME)
+    if not root.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s")
+        )
+        root.addHandler(handler)
+        root.setLevel(os.environ.get("KA_LOG", "ERROR").upper())
+        root.propagate = False
+    return root.getChild(child) if child else root

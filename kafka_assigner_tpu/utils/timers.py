@@ -1,0 +1,51 @@
+"""Per-phase wall-clock timers and an optional device profiler hook.
+
+The reference has no tracing or profiling of any kind (SURVEY.md §5) — solve
+latency is our headline metric, so phases are first-class observable here.
+
+Usage::
+
+    timers = Timers()
+    with timers.phase("encode"):
+        ...
+    timers.report()            # -> {"encode": 12.3, ...} and stderr log
+
+``device_trace`` wraps ``jax.profiler.trace`` so a TPU trace of a solve can
+be captured with one context manager (view with TensorBoard/XProf).
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator
+
+from .logging import get_logger
+
+_log = get_logger("timers")
+
+
+class Timers:
+    def __init__(self) -> None:
+        self.ms: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = (time.perf_counter() - t0) * 1000.0
+            self.ms[name] = self.ms.get(name, 0.0) + elapsed
+            _log.info("phase %s: %.2f ms", name, elapsed)
+
+    def report(self) -> Dict[str, float]:
+        return dict(self.ms)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """Capture a device profile (TPU trace) for everything in the block."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

@@ -165,3 +165,18 @@ def test_invalid_broker_id(capsys, snapshot):
             "--zk_string", path, "--mode", "PRINT_REASSIGNMENT",
             "--integer_broker_ids", "100,abc",
         ])
+
+
+def test_native_solver_cli_matches_greedy(capsys, snapshot):
+    path, _ = snapshot
+    try:
+        from kafka_assigner_tpu.solvers.base import get_solver
+        get_solver("native")
+    except NotImplementedError:
+        pytest.skip("no C++ toolchain")
+    rc1, out1, _ = _run(capsys, "--zk_string", path, "--mode", "PRINT_REASSIGNMENT",
+                        "--solver", "greedy")
+    rc2, out2, _ = _run(capsys, "--zk_string", path, "--mode", "PRINT_REASSIGNMENT",
+                        "--solver", "native")
+    assert rc1 == rc2 == 0
+    assert out1 == out2  # byte-identical, including leadership ordering

@@ -14,7 +14,7 @@ import pytest
 from kafka_assigner_tpu.assigner import TopicAssigner
 
 from .helpers import moved_replicas
-from .test_invariants import CASES, make_cluster
+from .test_invariants import CASES, make_cluster  # noqa: F401
 
 
 @pytest.mark.parametrize("case", CASES, ids=[str(c) for c in CASES])
@@ -159,3 +159,51 @@ def test_duplicate_topics_solved_per_occurrence(solver):
     # Same replica set, but the leader rotates because counters advanced.
     assert set(first[0]) == set(second[0])
     assert first[0][0] != second[0][0]
+
+
+def _native_available():
+    try:
+        from kafka_assigner_tpu.solvers.base import get_solver
+        get_solver("native")
+        return True
+    except NotImplementedError:
+        return False
+
+
+@pytest.mark.skipif(not _native_available(), reason="no C++ toolchain")
+def test_native_matches_python_greedy():
+    # The C++ oracle reproduces the Python oracle exactly (same phases, same
+    # tie-breaks) on every practical-envelope config.
+    for case in CASES[:5]:
+        for seed in range(2):
+            current, live, rack_map = make_cluster(seed, *case)
+            g = TopicAssigner("greedy").generate_assignment(
+                f"topic-{seed}", current, live, rack_map, -1
+            )
+            n = TopicAssigner("native").generate_assignment(
+                f"topic-{seed}", current, live, rack_map, -1
+            )
+            assert g == n
+
+
+@pytest.mark.skipif(not _native_available(), reason="no C++ toolchain")
+def test_native_assign_many_matches_serial():
+    current, live, rack_map = make_cluster(2, 12, 24, 3, 4, remove=2)
+    topics = [(f"topic-{i}", current) for i in range(5)]
+    serial = TopicAssigner("greedy")
+    expected = [
+        (t, serial.generate_assignment(t, cur, live, rack_map, -1))
+        for t, cur in topics
+    ]
+    batched = TopicAssigner("native")
+    got = batched.generate_assignments(topics, live, rack_map, -1)
+    assert got == expected
+    assert batched.context.counter == serial.context.counter
+
+
+@pytest.mark.skipif(not _native_available(), reason="no C++ toolchain")
+def test_native_infeasible_raises():
+    racks = {10: "a", 11: "a", 12: "a"}
+    topics = [("ok", {0: [10]}), ("bad", {0: [10, 11], 1: [11, 10]})]
+    with pytest.raises(ValueError, match="could not be fully assigned"):
+        TopicAssigner("native").generate_assignments(topics, {10, 11, 12}, racks, -1)

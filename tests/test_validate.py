@@ -1,0 +1,44 @@
+"""Feasibility pre-validation tests (SURVEY.md §5 failure-detection build
+item: catch infeasible solves before the solver's mid-run hard error)."""
+from __future__ import annotations
+
+from kafka_assigner_tpu.validate import (
+    validate_cluster_feasibility,
+    validate_topic_feasibility,
+)
+
+
+def test_rf_exceeds_racks_is_error():
+    issues = validate_topic_feasibility(
+        "t", 4, 3, {1, 2, 3}, {1: "a", 2: "a", 3: "b"}
+    )
+    assert [i.severity for i in issues] == ["error"]
+    assert "exceeds rack count" in issues[0].message
+
+
+def test_rackless_nodes_count_as_own_racks():
+    # No rack map: every node is its own rack, so RF <= N is always rack-feasible.
+    issues = validate_topic_feasibility("t", 4, 3, {1, 2, 3, 4}, {})
+    assert all(i.severity != "error" for i in issues)
+
+
+def test_uneven_racks_with_rf_equal_racks():
+    # 2 racks of sizes 1 and 3, RF=2: every partition needs both racks; the
+    # singleton rack can hold at most cap partitions.
+    brokers = {1, 2, 3, 4}
+    racks = {1: "a", 2: "b", 3: "b", 4: "b"}
+    issues = validate_topic_feasibility("t", 10, 2, brokers, racks)
+    assert any(i.severity == "error" for i in issues)
+
+
+def test_feasible_balanced_cluster_is_clean_or_warning_only():
+    brokers = set(range(12))
+    racks = {b: f"r{b % 4}" for b in brokers}
+    issues = validate_topic_feasibility("t", 12, 3, brokers, racks)
+    assert all(i.severity == "warning" for i in issues)
+
+
+def test_cluster_validation_infers_rf():
+    topics = [("t", {0: [1, 2], 1: [2, 1]})]
+    issues = validate_cluster_feasibility(topics, {1, 2, 3}, {1: "a", 2: "a", 3: "a"})
+    assert issues and issues[0].severity == "error"

@@ -1,0 +1,80 @@
+"""What-if fleet tests on the virtual 8-device CPU mesh: scenario metrics
+must agree with individually-run solves, and sharding across the mesh must
+not change results."""
+from __future__ import annotations
+
+import jax
+import pytest
+
+from kafka_assigner_tpu.assigner import TopicAssigner
+from kafka_assigner_tpu.parallel.mesh import build_mesh, scenario_sharding
+from kafka_assigner_tpu.parallel.whatif import (
+    evaluate_removal_scenarios,
+    rank_decommission_candidates,
+)
+
+from .helpers import moved_replicas
+from .test_invariants import make_cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    current, live, rack_map = make_cluster(0, 16, 32, 3, 4)
+    topics = {f"t{i}": current for i in range(3)}
+    return topics, live, rack_map
+
+
+def test_whatif_matches_individual_solves(cluster):
+    topics, live, rack_map = cluster
+    scenarios = [[], [100], [101], [100, 104]]
+    results = evaluate_removal_scenarios(topics, live, rack_map, scenarios, 3)
+    assert len(results) == 4
+
+    for res, removed in zip(results, scenarios):
+        live_s = set(live) - set(removed)
+        rack_s = {b: r for b, r in rack_map.items() if b in live_s}
+        assigner = TopicAssigner("tpu")
+        try:
+            pairs = assigner.generate_assignments(topics, live_s, rack_s, 3)
+            moved = sum(
+                moved_replicas(topics[t], a) for t, a in pairs
+            )
+            assert res.feasible, res
+            assert res.moved_replicas == moved, (removed, res.moved_replicas, moved)
+        except ValueError:
+            assert not res.feasible
+
+
+def test_whatif_empty_scenario_moves_nothing(cluster):
+    topics, live, rack_map = cluster
+    (res,) = evaluate_removal_scenarios(topics, live, rack_map, [[]], 3)
+    assert res.feasible and res.moved_replicas == 0
+
+
+def test_whatif_sharded_equals_unsharded(cluster):
+    topics, live, rack_map = cluster
+    assert len(jax.devices()) == 8, "conftest should provide 8 virtual devices"
+    mesh = build_mesh()  # 8x1: scenarios axis across all devices
+    scenarios = [[100 + i] for i in range(8)]
+    unsharded = evaluate_removal_scenarios(topics, live, rack_map, scenarios, 3)
+    sharded = evaluate_removal_scenarios(
+        topics, live, rack_map, scenarios, 3, mesh=mesh
+    )
+    assert unsharded == sharded
+
+
+def test_rank_decommission_candidates(cluster):
+    topics, live, rack_map = cluster
+    ranked = rank_decommission_candidates(topics, live, rack_map, None, 3)
+    assert len(ranked) == len(live)
+    # Results are sorted: feasible before infeasible, then by movement.
+    feas = [r.feasible for r in ranked]
+    assert feas == sorted(feas, reverse=True)
+    moves = [r.moved_replicas for r in ranked if r.feasible]
+    assert moves == sorted(moves)
+
+
+def test_unknown_broker_in_scenario(cluster):
+    topics, live, rack_map = cluster
+    with pytest.raises(ValueError, match="unknown broker"):
+        evaluate_removal_scenarios(topics, live, rack_map, [[999999]], 3)
