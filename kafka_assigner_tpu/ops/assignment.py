@@ -81,6 +81,13 @@ def _accept_batch(
     )
 
 
+def _acc_racks(state: AssignState, rack_idx: jnp.ndarray) -> jnp.ndarray:
+    """(P, RF) rack id of each accepted replica, -1 for empty slots."""
+    return jnp.where(
+        state.acc_nodes >= 0, rack_idx[jnp.maximum(state.acc_nodes, 0)], -1
+    )
+
+
 def _candidate_ok(
     state: AssignState,
     cand: jnp.ndarray,
@@ -96,8 +103,7 @@ def _candidate_ok(
     exists = (cand >= 0) & alive[safe]
     dup_node = jnp.any(state.acc_nodes == cand[:, None], axis=1)
     cand_rack = rack_idx[safe]
-    acc_racks = jnp.where(state.acc_nodes >= 0, rack_idx[jnp.maximum(state.acc_nodes, 0)], -1)
-    dup_rack = jnp.any(acc_racks == cand_rack[:, None], axis=1)
+    dup_rack = jnp.any(_acc_racks(state, rack_idx) == cand_rack[:, None], axis=1)
     under_rf = state.acc_count < rf
     return exists & ~dup_node & ~dup_rack & under_rf
 
@@ -148,30 +154,30 @@ def sticky_fill(
     return state
 
 
-def _wave_body(
+def _wave_body_dense(
     rack_idx: jnp.ndarray,
     pos: jnp.ndarray,
     cap: jnp.ndarray,
     n: int,
     alive: jnp.ndarray,
 ):
-    """One auction wave over all deficient partitions."""
+    """Dense-eligibility wave: every deficient partition bids for its best
+    eligible node over an explicit (P × N) mask. O(P·N) per wave — the
+    fallback when the fast rack-factored wave strands (its different packing
+    can dead-end near saturation where this one does not, and vice versa the
+    dense one is too slow to be the common path at 5k-broker scale)."""
 
     def body(state: AssignState) -> AssignState:
         p = state.acc_nodes.shape[0]
         rows = jnp.arange(p, dtype=jnp.int32)[:, None]
 
-        # (P, N) eligibility: node not already holding the partition, rack
-        # free for the partition, node under capacity.
         assigned = (
             jnp.zeros((p, n + 1), dtype=bool)
             .at[jnp.broadcast_to(rows, state.acc_nodes.shape),
                 jnp.where(state.acc_nodes >= 0, state.acc_nodes, n)]
             .set(True)[:, :n]
         )
-        acc_racks = jnp.where(
-            state.acc_nodes >= 0, rack_idx[jnp.maximum(state.acc_nodes, 0)], -1
-        )
+        acc_racks = _acc_racks(state, rack_idx)
         n_racks = rack_idx.shape[0] + 1
         rack_used = (
             jnp.zeros((p, n_racks + 1), dtype=bool)
@@ -183,20 +189,100 @@ def _wave_body(
         under_cap = ((state.node_load[:n] < cap) & alive[:n])[None, :]
         eligible = ~assigned & ~rack_blocked & under_cap & (state.deficit > 0)[:, None]
 
-        # Bid: lowest topic-rotated position (first-fit order, :162-186).
         score = jnp.where(eligible, pos[None, :n], BIG)
         pick = jnp.argmin(score, axis=1).astype(jnp.int32)
         has_choice = jnp.any(eligible, axis=1)
         valid = (state.deficit > 0) & has_choice
-
-        # Monotonicity ⇒ no eligible node now means never again: infeasible.
         infeasible = state.infeasible | jnp.any((state.deficit > 0) & ~has_choice)
 
-        # Per-node winners: ascending partition rows within remaining capacity.
         rank = _requests_rank(pick, valid, n)
         load = state.node_load[jnp.maximum(pick, 0)]
         accept = valid & (load + rank < cap)
         state = _accept_batch(state, pick, accept)
+        return state._replace(infeasible=infeasible)
+
+    return body
+
+
+def _wave_body(
+    rack_idx: jnp.ndarray,
+    pos: jnp.ndarray,
+    cap: jnp.ndarray,
+    n: int,
+    alive: jnp.ndarray,
+    rf: int,
+):
+    """One auction wave over all deficient partitions.
+
+    The eligible-node choice is factored through *racks* instead of a dense
+    (P × N) matrix: rack exclusivity already subsumes the node-duplicate check
+    (a node holding p occupies its rack for p), so a partition's first-fit
+    node is "the min-rotated-position available node of the best unblocked
+    rack". Per wave that needs one scatter-min over nodes (O(N)), a top-(RF+1)
+    over racks, and an O(P·RF²) candidate scan — at headline scale ~100x less
+    work than the dense formulation, on either CPU or TPU.
+
+    Correctness of top-(RF+1): a partition blocks at most RF racks, so among
+    the RF+1 globally-best rack candidates at least one is unblocked, and any
+    rack outside the candidates has a worse position than all of them.
+    """
+    n_pad = rack_idx.shape[0]
+    # Rack ids: reals < n, padded rows get n..2n_pad-ish; bound generously.
+    r_cap = 2 * n_pad
+    k = rf + 1
+
+    def body(state: AssignState) -> AssignState:
+        avail = alive[:n] & (state.node_load[:n] < cap)
+        # combo packs (pos, node) so a scatter-min yields both the best
+        # position and its node per rack.
+        combo = jnp.where(
+            avail, pos[:n] * n_pad + jnp.arange(n, dtype=jnp.int32), BIG
+        )
+        rack_min = (
+            jnp.full((r_cap,), BIG, dtype=jnp.int32)
+            .at[rack_idx[:n]]
+            .min(combo)
+        )
+        neg_top, cand_racks = lax.top_k(-rack_min, k)
+        cand_racks = cand_racks.astype(jnp.int32)
+        cand_ok = -neg_top < BIG                  # rack has an available node
+
+        # Available nodes sorted by (rack, pos): the j-th same-rack requester
+        # this wave takes the rack's j-th best node, so placements stay
+        # parallel instead of serializing on each rack's single best node.
+        sort_key = jnp.where(
+            avail, rack_idx[:n] * n_pad + pos[:n], BIG
+        )
+        order = jnp.argsort(sort_key)             # node indices, avail first
+        sorted_racks = jnp.where(
+            avail[order], rack_idx[:n][order], jnp.int32(r_cap)
+        )
+        seg_start = jnp.searchsorted(sorted_racks, cand_racks, side="left")
+        seg_count = (
+            jnp.searchsorted(sorted_racks, cand_racks, side="right") - seg_start
+        ).astype(jnp.int32)
+
+        acc_racks = _acc_racks(state, rack_idx)  # (P, RF)
+        blocked = jnp.any(
+            cand_racks[None, :, None] == acc_racks[:, None, :], axis=2
+        )  # (P, K)
+        ok = ~blocked & cand_ok[None, :] & (state.deficit > 0)[:, None]
+        has_choice = jnp.any(ok, axis=1)
+        first_ok = jnp.argmax(ok, axis=1)         # (P,) candidate slot
+        valid = (state.deficit > 0) & has_choice
+
+        # Monotonicity ⇒ no eligible rack now means never again: infeasible.
+        infeasible = state.infeasible | jnp.any((state.deficit > 0) & ~has_choice)
+
+        # Rank among same-rack requesters (ascending partition rows), then
+        # hand out that rack's j-th best available node. Rank 0 always lands,
+        # so every requested rack places at least one replica per wave.
+        pick_rack = jnp.where(valid, cand_racks[first_ok], jnp.int32(r_cap))
+        j = _requests_rank(pick_rack, valid, r_cap)
+        accept = valid & (j < seg_count[first_ok])
+        slot = jnp.clip(seg_start[first_ok] + j, 0, n - 1)
+        node = order[slot].astype(jnp.int32)
+        state = _accept_batch(state, node, accept)
         return state._replace(infeasible=infeasible)
 
     return body
@@ -209,20 +295,50 @@ def spread_orphans(
     cap: jnp.ndarray,
     n: int,
     alive: jnp.ndarray | None = None,
+    wave_mode: str = "auto",  # "auto" | "fast" | "dense"
 ) -> AssignState:
     """Wave-auction placement of all outstanding replicas
     (``getOrphanedReplicas`` + ``assignOrphans``, ``:133-186``)."""
     if alive is None:
         alive = jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
-    body = _wave_body(rack_idx, pos, cap, n, alive)
+    rf = state.acc_nodes.shape[1]
+    n_pad = rack_idx.shape[0]
+    # The fast wave packs (pos, node) / (rack, pos) into int32 keys; beyond
+    # this bound the packing would overflow, so use the dense path outright.
+    if n_pad * n_pad >= BIG and wave_mode != "dense":
+        wave_mode = "dense"
 
     def cond(state: AssignState) -> jnp.ndarray:
         return jnp.any(state.deficit > 0) & ~state.infeasible
 
-    # Progress is ≥ 1 placement per wave while feasible (the lowest-row bid on
-    # any node always lands), so P*RF waves is a hard upper bound; while_loop
-    # exits early via cond.
-    return lax.while_loop(cond, body, state)
+    # Progress is ≥ 1 placement per wave while feasible (the rank-0 bid on any
+    # requested rack/node always lands), so P*RF waves is a hard upper bound;
+    # while_loop exits early via cond.
+    if wave_mode == "dense":
+        return lax.while_loop(
+            cond, _wave_body_dense(rack_idx, pos, cap, n, alive), state
+        )
+
+    fast = lax.while_loop(
+        cond, _wave_body(rack_idx, pos, cap, n, alive, rf), state
+    )
+    if wave_mode == "fast":
+        # No in-graph fallback: under vmap a lax.cond lowers to select and
+        # would run the dense branch for EVERY batch element. Callers (the
+        # what-if sweep) re-run only the stranded scenarios in dense mode.
+        return fast
+
+    # wave_mode == "auto": the fast path's packing (j-th requester → rack's
+    # j-th best node) can strand near saturation where the dense first-fit
+    # packing does not; fall back from the original post-sticky state in that
+    # rare case. A dense failure is then a genuine infeasibility.
+    return lax.cond(
+        fast.infeasible,
+        lambda: lax.while_loop(
+            cond, _wave_body_dense(rack_idx, pos, cap, n, alive), state
+        ),
+        lambda: fast,
+    )
 
 
 def leadership_order(
@@ -284,6 +400,7 @@ def _solve_one_topic(
     alive: jnp.ndarray,  # (N_pad,) bool — scenario liveness mask
     n: int,
     rf: int,
+    wave_mode: str = "auto",
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
     """One topic's pipeline: sticky fill → wave spread → leadership order.
     Shared by the single-topic, batched (scan), and what-if (vmap over
@@ -304,7 +421,7 @@ def _solve_one_topic(
 
     state = sticky_fill(current, rack_idx, rf, cap, n, p_real, alive)
     sticky_kept = jnp.sum(state.acc_count)
-    state = spread_orphans(state, rack_idx, pos, cap, n, alive)
+    state = spread_orphans(state, rack_idx, pos, cap, n, alive, wave_mode)
     ordered, counters = leadership_order(
         state.acc_nodes, state.acc_count, counters, jhash, rf
     )
@@ -346,6 +463,7 @@ def solve_batched(
     n: int,
     rf: int,
     alive: jnp.ndarray | None = None,  # (N_pad,) scenario liveness mask
+    wave_mode: str = "auto",
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Solve B topics in one device dispatch.
 
@@ -367,7 +485,7 @@ def solve_batched(
     def per_topic(counters, inp):
         current, jhash, p_real = inp
         return _solve_one_topic(
-            counters, current, jhash, p_real, rack_idx, alive, n, rf
+            counters, current, jhash, p_real, rack_idx, alive, n, rf, wave_mode
         )
 
     counters, (ordered, infeasible, deficits, kept) = lax.scan(
@@ -376,7 +494,9 @@ def solve_batched(
     return ordered, counters, infeasible, deficits, kept
 
 
-solve_batched_jit = jax.jit(solve_batched, static_argnames=("n", "rf"))
+solve_batched_jit = jax.jit(
+    solve_batched, static_argnames=("n", "rf", "wave_mode")
+)
 
 
 def whatif_sweep(
@@ -387,6 +507,7 @@ def whatif_sweep(
     alive_masks: jnp.ndarray,  # (S, N_pad) one liveness mask per scenario
     n: int,
     rf: int,
+    wave_mode: str = "fast",
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Evaluate S broker-removal scenarios over the full cluster in parallel.
 
@@ -401,9 +522,13 @@ def whatif_sweep(
     """
     counters0 = jnp.zeros((rack_idx.shape[0], rf), dtype=jnp.int32)
 
+    # wave_mode "fast" (no in-graph dense fallback): under vmap, lax.cond
+    # lowers to select and both branches would execute for every scenario.
+    # Stranded scenarios are re-run in dense mode by the caller.
     def one_scenario(alive):
         ordered, _, infeasible, _, kept = solve_batched(
-            currents, rack_idx, counters0, jhashes, p_reals, n, rf, alive
+            currents, rack_idx, counters0, jhashes, p_reals, n, rf, alive,
+            wave_mode,
         )
         total = jnp.sum(p_reals) * rf
         moved = total - jnp.sum(kept)
@@ -415,4 +540,6 @@ def whatif_sweep(
     return jax.vmap(one_scenario)(alive_masks)
 
 
-whatif_sweep_jit = jax.jit(whatif_sweep, static_argnames=("n", "rf"))
+whatif_sweep_jit = jax.jit(
+    whatif_sweep, static_argnames=("n", "rf", "wave_mode")
+)

@@ -105,6 +105,31 @@ def evaluate_removal_scenarios(
             rf=rf,
         )
     )
+    # The sweep runs the fast wave only (an in-graph dense fallback would
+    # execute for every vmapped scenario); a raised flag can mean "fast
+    # packing stranded" rather than true infeasibility, so re-run just the
+    # flagged scenarios with the dense wave.
+    flagged = [s for s in range(s_real) if infeasible[s]]
+    if flagged:
+        sub = np.zeros((batch_bucket(len(flagged)), enc0.n_pad), dtype=bool)
+        for i, s in enumerate(flagged):
+            sub[i] = alive[s]
+        moved2, infeasible2, max_load2 = jax.device_get(
+            whatif_sweep_jit(
+                jnp.asarray(currents),
+                jnp.asarray(enc0.rack_idx),
+                jnp.asarray(jhashes),
+                jnp.asarray(p_reals),
+                jnp.asarray(sub),
+                n=enc0.n,
+                rf=rf,
+                wave_mode="dense",
+            )
+        )
+        for i, s in enumerate(flagged):
+            moved[s] = moved2[i]
+            infeasible[s] = infeasible2[i]
+            max_load[s] = max_load2[i]
     return [
         ScenarioResult(
             removed=tuple(sorted(int(b) for b in scenarios[s])),
