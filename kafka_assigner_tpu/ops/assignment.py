@@ -211,6 +211,7 @@ def _wave_body(
     n: int,
     alive: jnp.ndarray,
     rf: int,
+    balance: bool = False,
 ):
     """One auction wave over all deficient partitions.
 
@@ -221,6 +222,11 @@ def _wave_body(
     rack". Per wave that needs one scatter-min over nodes (O(N)), a top-(RF+1)
     over racks, and an O(P·RF²) candidate scan — at headline scale ~100x less
     work than the dense formulation, on either CPU or TPU.
+
+    ``balance=True`` ranks candidate racks by *remaining capacity* instead of
+    first-fit position (ties → lowest rack id). Capacity-greedy rack choice
+    keeps rack fill levels even, which solves saturated *fresh* placements
+    where every first-fit order (the reference's included) dead-ends.
 
     Correctness of top-(RF+1): a partition blocks at most RF racks, so among
     the RF+1 globally-best rack candidates at least one is unblocked, and any
@@ -243,9 +249,20 @@ def _wave_body(
             .at[rack_idx[:n]]
             .min(combo)
         )
-        neg_top, cand_racks = lax.top_k(-rack_min, k)
-        cand_racks = cand_racks.astype(jnp.int32)
-        cand_ok = -neg_top < BIG                  # rack has an available node
+        if balance:
+            headroom = jnp.where(avail, cap - state.node_load[:n], 0)
+            rack_room = (
+                jnp.zeros((r_cap,), dtype=jnp.int32)
+                .at[rack_idx[:n]]
+                .add(headroom)
+            )
+            _, cand_racks = lax.top_k(rack_room, k)
+            cand_racks = cand_racks.astype(jnp.int32)
+            cand_ok = rack_room[cand_racks] > 0
+        else:
+            neg_top, cand_racks = lax.top_k(-rack_min, k)
+            cand_racks = cand_racks.astype(jnp.int32)
+            cand_ok = -neg_top < BIG              # rack has an available node
 
         # Available nodes sorted by (rack, pos): the j-th same-rack requester
         # this wave takes the rack's j-th best node, so placements stay
@@ -288,6 +305,24 @@ def _wave_body(
     return body
 
 
+#: Legal wave modes and the packing chain each one runs. Every leg restarts
+#: from the post-sticky state; a later leg runs only if the previous stranded.
+#:   "auto"    — fast → dense → balance  (reassignments; maximal robustness)
+#:   "fresh"   — balance → fast → dense  (from-scratch placements)
+#:   "fast"    — fast only   (vmapped sweeps: lax.cond under vmap lowers to
+#:               select and would run fallback legs for every batch element;
+#:               callers re-run stranded elements in "auto")
+#:   "dense"   — dense only  (reference-faithful first-fit probing order)
+#:   "balance" — balance only (capacity-greedy rack choice)
+WAVE_MODES = {
+    "auto": ("fast", "dense", "balance"),
+    "fresh": ("balance", "fast", "dense"),
+    "fast": ("fast",),
+    "dense": ("dense",),
+    "balance": ("balance",),
+}
+
+
 def spread_orphans(
     state: AssignState,
     rack_idx: jnp.ndarray,
@@ -295,50 +330,60 @@ def spread_orphans(
     cap: jnp.ndarray,
     n: int,
     alive: jnp.ndarray | None = None,
-    wave_mode: str = "auto",  # "auto" | "fast" | "dense"
+    wave_mode: str = "auto",  # see WAVE_MODES
 ) -> AssignState:
     """Wave-auction placement of all outstanding replicas
-    (``getOrphanedReplicas`` + ``assignOrphans``, ``:133-186``)."""
+    (``getOrphanedReplicas`` + ``assignOrphans``, ``:133-186``).
+
+    The fast path's packing (j-th requester → rack's j-th best node) can
+    strand near saturation where dense first-fit does not; the capacity-greedy
+    balance packing solves saturated instances where *every* first-fit order
+    (the reference's included, ``KafkaAssignmentStrategy.java:29-30``)
+    dead-ends. The chained modes report infeasible only when every leg fails.
+    """
+    if wave_mode not in WAVE_MODES:
+        raise ValueError(
+            f"unknown wave_mode {wave_mode!r}; expected one of {sorted(WAVE_MODES)}"
+        )
     if alive is None:
         alive = jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
     rf = state.acc_nodes.shape[1]
     n_pad = rack_idx.shape[0]
-    # The fast wave packs (pos, node) / (rack, pos) into int32 keys; beyond
-    # this bound the packing would overflow, so use the dense path outright.
-    if n_pad * n_pad >= BIG and wave_mode != "dense":
-        wave_mode = "dense"
+    # The fast/balance waves pack (pos, node) / (rack, pos) into int32 keys;
+    # beyond this bound the packing would overflow. First-fit modes degrade to
+    # dense; balance has no dense equivalent, so fail loudly rather than
+    # silently change algorithm (clusters this size exceed any known Kafka
+    # deployment — revisit with int64 keys if one appears).
+    legs = WAVE_MODES[wave_mode]
+    if n_pad * n_pad >= BIG:
+        if wave_mode == "balance":
+            raise ValueError(
+                f"wave_mode 'balance' packs (rack, pos) into int32 keys, "
+                f"which overflows at n_pad={n_pad}"
+            )
+        legs = ("dense",)
 
     def cond(state: AssignState) -> jnp.ndarray:
         return jnp.any(state.deficit > 0) & ~state.infeasible
 
+    bodies = {
+        "fast": lambda: _wave_body(rack_idx, pos, cap, n, alive, rf),
+        "dense": lambda: _wave_body_dense(rack_idx, pos, cap, n, alive),
+        "balance": lambda: _wave_body(rack_idx, pos, cap, n, alive, rf, balance=True),
+    }
+
     # Progress is ≥ 1 placement per wave while feasible (the rank-0 bid on any
     # requested rack/node always lands), so P*RF waves is a hard upper bound;
     # while_loop exits early via cond.
-    if wave_mode == "dense":
-        return lax.while_loop(
-            cond, _wave_body_dense(rack_idx, pos, cap, n, alive), state
+    def run_chain(chain) -> AssignState:
+        result = lax.while_loop(cond, bodies[chain[0]](), state)
+        if len(chain) == 1:
+            return result
+        return lax.cond(
+            result.infeasible, lambda: run_chain(chain[1:]), lambda: result
         )
 
-    fast = lax.while_loop(
-        cond, _wave_body(rack_idx, pos, cap, n, alive, rf), state
-    )
-    if wave_mode == "fast":
-        # No in-graph fallback: under vmap a lax.cond lowers to select and
-        # would run the dense branch for EVERY batch element. Callers (the
-        # what-if sweep) re-run only the stranded scenarios in dense mode.
-        return fast
-
-    # wave_mode == "auto": the fast path's packing (j-th requester → rack's
-    # j-th best node) can strand near saturation where the dense first-fit
-    # packing does not; fall back from the original post-sticky state in that
-    # rare case. A dense failure is then a genuine infeasibility.
-    return lax.cond(
-        fast.infeasible,
-        lambda: lax.while_loop(
-            cond, _wave_body_dense(rack_idx, pos, cap, n, alive), state
-        ),
-        lambda: fast,
-    )
+    return run_chain(legs)
 
 
 def leadership_order(

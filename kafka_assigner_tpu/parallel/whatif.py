@@ -105,10 +105,11 @@ def evaluate_removal_scenarios(
             rf=rf,
         )
     )
-    # The sweep runs the fast wave only (an in-graph dense fallback would
-    # execute for every vmapped scenario); a raised flag can mean "fast
-    # packing stranded" rather than true infeasibility, so re-run just the
-    # flagged scenarios with the dense wave.
+    # The sweep runs the fast wave only (an in-graph fallback would execute
+    # for every vmapped scenario); a raised flag can mean "fast packing
+    # stranded" rather than true infeasibility, so re-run just the flagged
+    # scenarios with the full fallback chain — matching what the actual
+    # solver would do for that scenario.
     flagged = [s for s in range(s_real) if infeasible[s]]
     if flagged:
         sub = np.zeros((batch_bucket(len(flagged)), enc0.n_pad), dtype=bool)
@@ -123,7 +124,7 @@ def evaluate_removal_scenarios(
                 jnp.asarray(sub),
                 n=enc0.n,
                 rf=rf,
-                wave_mode="dense",
+                wave_mode="auto",
             )
         )
         for i, s in enumerate(flagged):
@@ -137,6 +138,77 @@ def evaluate_removal_scenarios(
             feasible=not bool(infeasible[s]),
             max_node_load=int(max_load[s]),
         )
+        for s in range(s_real)
+    ]
+
+
+def estimate_removal_scenarios(
+    topic_assignments: Mapping[str, Mapping[int, Sequence[int]]],
+    brokers: Set[int],
+    rack_assignment: Mapping[int, str],
+    scenarios: Sequence[Sequence[int]],
+    replication_factor: int = -1,
+    mesh=None,
+) -> List[Tuple[Tuple[int, ...], float]]:
+    """Relaxed (entropic-transport) movement estimates for a wide scenario
+    scan — the cheap front half before exact solves confirm a shortlist.
+
+    Returns ``[(removed, estimated_moved), ...]`` in input order. Estimates
+    rank scenarios reliably but sit slightly above the exact optimum (see
+    ``ops.sinkhorn.movement_estimate``); they know nothing of rack
+    feasibility.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..ops.sinkhorn import relaxed_movement_sweep_jit
+
+    items = list(topic_assignments.items())
+    if not items or not scenarios:
+        return []
+    rf = replication_factor
+    if rf < 0:
+        rf = len(next(iter(items[0][1].values())))
+    p_pad, width = group_pads([cur for _, cur in items])
+    cluster = encode_cluster(rack_assignment, brokers)
+    encs = [
+        encode_problem(t, cur, rack_assignment, brokers, set(cur), rf,
+                       p_pad_override=p_pad, width_override=width,
+                       cluster=cluster)
+        for t, cur in items
+    ]
+    b_pad = batch_bucket(len(encs))
+    currents = np.full((b_pad, p_pad, width), -1, dtype=np.int32)
+    p_reals = np.zeros(b_pad, dtype=np.int32)
+    for i, e in enumerate(encs):
+        currents[i] = e.current
+        p_reals[i] = e.p
+
+    s_real = len(scenarios)
+    s_pad = batch_bucket(s_real)
+    alive = np.zeros((s_pad, cluster.n_pad), dtype=bool)
+    alive[:, : cluster.n] = True
+    for s, removed in enumerate(scenarios):
+        for b in removed:
+            idx = cluster.broker_to_idx.get(int(b))
+            if idx is None:
+                raise ValueError(f"scenario {s}: unknown broker {b}")
+            alive[s, idx] = False
+
+    alive_dev = jnp.asarray(alive)
+    if mesh is not None:
+        alive_dev = jax.device_put(
+            alive_dev, NamedSharding(mesh, PartitionSpec("scenarios", None))
+        )
+    est = jax.device_get(
+        relaxed_movement_sweep_jit(
+            jnp.asarray(currents), jnp.asarray(p_reals), alive_dev,
+            n=cluster.n, rf=rf,
+        )
+    )
+    return [
+        (tuple(sorted(int(b) for b in scenarios[s])), float(est[s]))
         for s in range(s_real)
     ]
 

@@ -39,6 +39,35 @@ from ..models.problem import (
 from .base import Context
 
 
+def _fresh_solve(rack_idx, counters, jhash, p_real, p_pad, n, rf):
+    """Jitted fresh-placement kernel: the shared per-topic pipeline with an
+    empty current assignment (everything is an orphan) and the "fresh" wave
+    chain — capacity-greedy balance first, first-fit legs as fallback."""
+    import jax.numpy as jnp
+
+    from ..ops.assignment import _solve_one_topic
+
+    empty = jnp.full((p_pad, 2), -1, dtype=jnp.int32)
+    alive = jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
+    counters, (ordered, infeasible, deficit, _) = _solve_one_topic(
+        counters, empty, jhash, p_real, rack_idx, alive, n, rf,
+        wave_mode="fresh",
+    )
+    return ordered, counters, infeasible, deficit
+
+
+def _fresh_solve_jit(*args, **kwargs):
+    import jax
+
+    global _fresh_solve_jit_impl
+    try:
+        fn = _fresh_solve_jit_impl
+    except NameError:
+        fn = jax.jit(_fresh_solve, static_argnames=("p_pad", "n", "rf"))
+        _fresh_solve_jit_impl = fn
+    return fn(*args, **kwargs)
+
+
 class TpuSolver:
     """Solver-protocol implementation backed by the jitted assignment kernel."""
 
@@ -164,3 +193,57 @@ class TpuSolver:
             (enc.topic, decode_assignment(enc, ordered[i]))
             for i, enc in enumerate(encs)
         ]
+
+    def fresh_assignment(
+        self,
+        topic: str,
+        partitions: Sequence[int] | int,
+        nodes: Set[int],
+        rack_assignment: Mapping[int, str],
+        replication_factor: int,
+        context: Context | None = None,
+    ) -> Dict[int, List[int]]:
+        """Place a topic from scratch (no current assignment) — a capability
+        the reference lacks: its greedy first-fit provably dead-ends on fresh
+        placements at moderate saturation (KafkaAssignmentStrategy.java:29-30;
+        e.g. 50 partitions x RF=3 over 10 brokers / 5 racks fails outright).
+
+        Uses the shared solve pipeline with the "fresh" wave chain: the
+        capacity-greedy balance packing keeps rack fill levels even (which is
+        what saturated instances need), with the first-fit packings as
+        fallback. Leadership ordering uses the shared Context as usual.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if isinstance(partitions, int):
+            partitions = list(range(partitions))
+        if context is None:
+            context = Context()
+        # Empty replica lists: same encode path, sticky has nothing to keep.
+        current = {int(p): [] for p in partitions}
+        enc = encode_problem(
+            topic, current, rack_assignment, nodes, set(current),
+            replication_factor,
+        )
+        counters_before = context_to_array(context, enc)
+
+        ordered, counters_after, infeasible, deficit = jax.device_get(
+            _fresh_solve_jit(
+                jnp.asarray(enc.rack_idx),
+                jnp.asarray(counters_before),
+                jnp.int32(enc.jhash),
+                jnp.int32(enc.p),
+                p_pad=enc.p_pad,
+                n=enc.n,
+                rf=enc.rf,
+            )
+        )
+        if bool(infeasible):
+            bad = int(np.argmax(deficit > 0))
+            raise ValueError(
+                f"Partition {int(enc.partition_ids[bad])} could not be fully "
+                "assigned!"
+            )
+        apply_counter_updates(context, enc, counters_before, counters_after)
+        return decode_assignment(enc, ordered)

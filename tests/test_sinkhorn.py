@@ -1,0 +1,164 @@
+"""Sinkhorn relaxation + fresh-assignment tests, including sharded execution
+of the blockwise row/col normalizations over the partition axis."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kafka_assigner_tpu.ops.sinkhorn import (
+    capacity_sinkhorn,
+    movement_estimate,
+    topk_candidates,
+)
+from kafka_assigner_tpu.solvers.tpu import TpuSolver
+
+from .helpers import verify_full_invariants
+
+
+def test_sinkhorn_marginals():
+    rng = np.random.default_rng(0)
+    p, n, rf = 32, 16, 3
+    cost = jnp.asarray(rng.uniform(size=(p, n)).astype(np.float32))
+    row_target = jnp.full((p,), float(rf))
+    cap = float(np.ceil(p * rf / n))
+    col_cap = jnp.full((n,), cap)
+    x = capacity_sinkhorn(cost, row_target, col_cap, iters=128)
+    np.testing.assert_allclose(np.asarray(x.sum(1)), rf, rtol=1e-3)
+    assert (np.asarray(x.sum(0)) <= cap * (1 + 1e-3)).all()
+    assert (np.asarray(x) >= 0).all()
+
+
+def test_sinkhorn_respects_forbidden_cells():
+    p, n = 8, 8
+    cost = jnp.zeros((p, n)).at[:, 0].set(jnp.inf)
+    x = capacity_sinkhorn(cost, jnp.full((p,), 2.0), jnp.full((n,), 4.0), iters=64)
+    assert float(x[:, 0].sum()) == 0.0
+
+
+def test_sinkhorn_prefers_cheap_cells():
+    # Two nodes, one clearly cheaper: mass should concentrate up to capacity.
+    cost = jnp.array([[0.0, 1.0]] * 4)
+    x = capacity_sinkhorn(
+        cost, jnp.full((4,), 1.0), jnp.asarray([2.0, 4.0]), eps=0.02, iters=256
+    )
+    # cheap column saturates its cap of 2; the rest overflows to column 1
+    assert float(x[:, 0].sum()) == pytest.approx(2.0, rel=1e-2)
+    assert float(x[:, 1].sum()) == pytest.approx(2.0, rel=1e-2)
+
+
+def test_movement_estimate_zero_when_sticky_feasible():
+    p, n, rf = 8, 8, 2
+    sticky = np.zeros((p, n), dtype=bool)
+    for i in range(p):
+        sticky[i, i % n] = True
+        sticky[i, (i + 1) % n] = True
+    cost = jnp.where(jnp.asarray(sticky), 0.0, 1.0)
+    x = capacity_sinkhorn(
+        cost, jnp.full((p,), float(rf)), jnp.full((n,), float(rf)), eps=0.02,
+        iters=256,
+    )
+    lb = float(movement_estimate(x, jnp.asarray(sticky), jnp.full((p,), float(rf))))
+    assert lb == pytest.approx(0.0, abs=0.1)
+
+
+def test_topk_candidates_shape():
+    x = jnp.asarray(np.random.default_rng(1).uniform(size=(4, 10)).astype(np.float32))
+    idx, vals = topk_candidates(x, 3)
+    assert idx.shape == (4, 3) and vals.shape == (4, 3)
+    assert (np.asarray(vals[:, 0]) >= np.asarray(vals[:, 1])).all()
+
+
+def test_sharded_sinkhorn_matches_unsharded():
+    # Partition-axis sharding (the SP analogue): same plan, collectives
+    # inserted by XLA for the column reductions.
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs.reshape(-1), ("part",))
+    rng = np.random.default_rng(2)
+    p, n = 64, 16
+    cost = rng.uniform(size=(p, n)).astype(np.float32)
+    row_target = np.full((p,), 3.0, np.float32)
+    col_cap = np.full((n,), float(np.ceil(p * 3 / n)), np.float32)
+
+    base = capacity_sinkhorn(
+        jnp.asarray(cost), jnp.asarray(row_target), jnp.asarray(col_cap)
+    )
+    sharded_cost = jax.device_put(
+        jnp.asarray(cost), NamedSharding(mesh, PartitionSpec("part", None))
+    )
+    sharded_rows = jax.device_put(
+        jnp.asarray(row_target), NamedSharding(mesh, PartitionSpec("part"))
+    )
+    out = jax.jit(capacity_sinkhorn)(sharded_cost, sharded_rows, jnp.asarray(col_cap))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(base), rtol=1e-4, atol=1e-5)
+
+
+def test_fresh_assignment_where_greedy_dead_ends():
+    # 50 partitions x RF=3 over 10 brokers / 5 racks: the reference's greedy
+    # first-fit provably cannot place this from scratch (verified in round-1
+    # analysis); the capacity-greedy balance waves must.
+    brokers = set(range(100, 110))
+    racks = {b: f"rack{b % 5}" for b in brokers}
+    solver = TpuSolver()
+    out = solver.fresh_assignment("fresh", 50, brokers, racks, 3)
+    assert set(out) == set(range(50))
+    verify_full_invariants(out, racks, sorted(brokers), 3)
+
+
+def test_fresh_assignment_balances_load():
+    brokers = set(range(20))
+    racks = {b: f"r{b % 4}" for b in brokers}
+    out = TpuSolver().fresh_assignment("t", 40, brokers, racks, 2)
+    loads = {}
+    for r in out.values():
+        for b in r:
+            loads[b] = loads.get(b, 0) + 1
+    # cap = ceil(80/20) = 4; perfect balance respects the cap everywhere
+    assert max(loads.values()) <= 4
+    assert min(loads.values()) >= 2
+
+
+def test_reassignment_succeeds_where_reference_strands():
+    # Rack-unaware 10 -> 8 broker decommission of a striped cluster: the
+    # reference's first-fit strands ("Partition 49 could not be fully
+    # assigned!"); the tpu solver's balance fallback completes it with
+    # exactly minimal movement (only the dead brokers' replicas).
+    from kafka_assigner_tpu.assigner import TopicAssigner
+    from .helpers import moved_replicas
+
+    n, p, rf = 10, 50, 3
+    base = list(range(n))
+    cur = {q: [base[(q + i) % n] for i in range(rf)] for q in range(p)}
+    live = set(base[2:])
+    with pytest.raises(ValueError, match="could not be fully assigned"):
+        TopicAssigner("greedy").generate_assignment("t", cur, live, {}, -1)
+    new = TopicAssigner("tpu").generate_assignment("t", cur, live, {}, -1)
+    verify_full_invariants(new, {}, sorted(live), rf)
+    lost = sum(1 for r in cur.values() for b in r if b not in live)
+    assert moved_replicas(cur, new) == lost  # minimal movement
+
+
+def test_relaxed_estimates_rank_scenarios():
+    # Relaxed estimates must track exact movement ordering: removing a loaded
+    # broker costs more than removing an idle one.
+    from kafka_assigner_tpu.parallel.whatif import (
+        estimate_removal_scenarios,
+        evaluate_removal_scenarios,
+    )
+    from .test_invariants import make_cluster
+
+    current, live, rack_map = make_cluster(0, 16, 32, 3, 4)
+    topics = {"t": current}
+    idle = max(live) + 1
+    live2 = set(live) | {idle}
+    rack_map2 = dict(rack_map); rack_map2[idle] = "rack0"
+    scenarios = [[], [idle], [min(live)]]
+    est = estimate_removal_scenarios(topics, live2, rack_map2, scenarios, 3)
+    exact = evaluate_removal_scenarios(topics, live2, rack_map2, scenarios, 3)
+    # ordering: no-op <= idle-removal < loaded-removal
+    assert est[0][1] <= est[1][1] + 1e-3
+    assert est[1][1] < est[2][1]
+    assert exact[1].moved_replicas <= exact[2].moved_replicas
