@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Drop-in replacement for the reference's appassembler-generated launcher
+# (pom.xml:87-92): same name, same flags, Python/JAX underneath.
+# Extra flags beyond the reference: --solver {greedy,native,tpu},
+# --leadership_context PATH. --zk_string also accepts file://cluster.json.
+set -euo pipefail
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="${REPO_ROOT}${PYTHONPATH:+:${PYTHONPATH}}"
+exec python3 -m kafka_assigner_tpu.cli "$@"

@@ -69,6 +69,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="assignment backend: reference-faithful greedy "
                         "(python), the same algorithm as native C++, or the "
                         "TPU (JAX/XLA) solver")
+    p.add_argument("--leadership_context", default=None, metavar="PATH",
+                   help="persist cross-run leadership counters to PATH "
+                        "(loaded if present, saved after PRINT_REASSIGNMENT) "
+                        "so repeated partial reassignments keep balancing "
+                        "leaders cluster-wide")
     return p
 
 
@@ -122,6 +127,7 @@ def run_tool(argv: Optional[List[str]] = None) -> int:
                 args.desired_replication_factor,
                 solver=args.solver,
                 live_brokers=live_brokers,
+                context_file=args.leadership_context,
             )
     finally:
         backend.close()

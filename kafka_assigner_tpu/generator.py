@@ -11,10 +11,12 @@ log4j.properties:21-31``).
 """
 from __future__ import annotations
 
+import os
 import sys
 from typing import Dict, Iterable, List, Optional, Sequence, Set, TextIO
 
 from .assigner import TopicAssigner
+from .solvers.base import Context
 from .io.base import BrokerInfo, MetadataBackend
 from .validate import validate_cluster_feasibility
 from .io.json_io import (
@@ -116,6 +118,7 @@ def print_least_disruptive_reassignment(
     solver: str = "greedy",
     out: Optional[TextIO] = None,
     live_brokers: Optional[Sequence[BrokerInfo]] = None,
+    context_file: Optional[str] = None,
 ) -> Dict[str, Dict[int, List[int]]]:
     """Mode 3 — the reassignment driver (``KafkaAssignmentGenerator.java:131-187``):
     resolve the broker set (all-live default, minus exclusions), choose topics,
@@ -164,6 +167,13 @@ def print_least_disruptive_reassignment(
     # occurrence like the reference loop. The TPU backend folds the whole
     # loop into a single device dispatch with identical output.
     assigner = TopicAssigner(solver=solver)
+    if context_file is not None and os.path.exists(context_file):
+        try:
+            assigner.context = Context.load(context_file)
+        except (ValueError, KeyError, TypeError, AttributeError, OSError) as e:
+            raise ValueError(
+                f"invalid leadership context file {context_file!r}: {e}"
+            ) from e
     final_pairs = assigner.generate_assignments(
         [(topic, initial[topic]) for topic in topic_list],
         brokers,
@@ -172,4 +182,8 @@ def print_least_disruptive_reassignment(
     )
     payload = format_reassignment_pairs(final_pairs)
     print("NEW ASSIGNMENT:\n" + payload, file=out)
+    # Save after the payload is out: a failing save (unwritable path, disk
+    # full) must never discard a completed solve.
+    if context_file is not None:
+        assigner.context.save(context_file)
     return dict(final_pairs)

@@ -34,6 +34,39 @@ class Context:
     def increment(self, node_id: int, slot: int) -> None:
         self.counter.setdefault(node_id, {})[slot] = self.get(node_id, slot) + 1
 
+    # -- persistence (SURVEY.md §5 checkpoint/resume): the reference's Context
+    # dies with the JVM, so leadership balance resets between invocations.
+    # Saving it lets iterative what-if sessions and repeated partial
+    # reassignments keep balancing leaders cluster-wide across runs.
+
+    def save(self, path: str) -> None:
+        import json
+        import os
+
+        # Write-then-rename: an interrupted save must never leave a truncated
+        # file that bricks every later run pointing at this path.
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {str(n): {str(s): c for s, c in slots.items()}
+                 for n, slots in self.counter.items()},
+                f,
+            )
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "Context":
+        import json
+
+        ctx = cls()
+        with open(path, "r", encoding="utf-8") as f:
+            raw = json.load(f)
+        ctx.counter = {
+            int(n): {int(s): int(c) for s, c in slots.items()}
+            for n, slots in raw.items()
+        }
+        return ctx
+
 
 class Solver(Protocol):
     """A pluggable assignment backend (selected via ``--solver``)."""

@@ -180,3 +180,30 @@ def test_native_solver_cli_matches_greedy(capsys, snapshot):
                         "--solver", "native")
     assert rc1 == rc2 == 0
     assert out1 == out2  # byte-identical, including leadership ordering
+
+
+def test_leadership_context_persists_across_runs(capsys, snapshot, tmp_path):
+    # SURVEY.md §5 checkpoint/resume: counters survive process boundaries, so
+    # a second run continues balancing instead of restarting from zero.
+    path, _ = snapshot
+    ctx_file = str(tmp_path / "ctx.json")
+    rc, out1, _ = _run(capsys, "--zk_string", path, "--mode", "PRINT_REASSIGNMENT",
+                       "--topics", "events", "--leadership_context", ctx_file)
+    assert rc == 0
+    import json as _json
+    saved = _json.load(open(ctx_file))
+    assert saved  # counters recorded
+    rc, out2, _ = _run(capsys, "--zk_string", path, "--mode", "PRINT_REASSIGNMENT",
+                       "--topics", "events", "--leadership_context", ctx_file)
+    assert rc == 0
+    # Same cluster state -> same replica sets; the persisted counters keep
+    # accumulating across processes (the reference's Context dies with the
+    # JVM, KafkaAssignmentStrategy.java:360-369).
+    new1 = parse_reassignment_json(out1.split("NEW ASSIGNMENT:\n", 1)[1].strip())
+    new2 = parse_reassignment_json(out2.split("NEW ASSIGNMENT:\n", 1)[1].strip())
+    assert {t: {p: set(r) for p, r in parts.items()} for t, parts in new1.items()} \
+        == {t: {p: set(r) for p, r in parts.items()} for t, parts in new2.items()}
+    saved2 = _json.load(open(ctx_file))
+    total1 = sum(c for slots in saved.values() for c in slots.values())
+    total2 = sum(c for slots in saved2.values() for c in slots.values())
+    assert total2 == 2 * total1
