@@ -44,15 +44,23 @@ class Context:
         import os
 
         # Write-then-rename: an interrupted save must never leave a truncated
-        # file that bricks every later run pointing at this path.
+        # file that bricks every later run pointing at this path; a failed
+        # write must not litter tmp files either.
         tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(
-                {str(n): {str(s): c for s, c in slots.items()}
-                 for n, slots in self.counter.items()},
-                f,
-            )
-        os.replace(tmp, path)
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(
+                    {str(n): {str(s): c for s, c in slots.items()}
+                     for n, slots in self.counter.items()},
+                    f,
+                )
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     @classmethod
     def load(cls, path: str) -> "Context":

@@ -207,3 +207,18 @@ def test_native_infeasible_raises():
     topics = [("ok", {0: [10]}), ("bad", {0: [10, 11], 1: [11, 10]})]
     with pytest.raises(ValueError, match="could not be fully assigned"):
         TopicAssigner("native").generate_assignments(topics, {10, 11, 12}, racks, -1)
+
+
+def test_partitions_superset_of_current_assignment():
+    from kafka_assigner_tpu.solvers.base import get_solver
+    # A partition with no current assignment (newly created) is a fresh row:
+    # all replicas orphaned, solved like any other. The vectorized encode
+    # fast path must not assume every partition id has a current entry.
+    from kafka_assigner_tpu.solvers.base import Context
+
+    solver = get_solver("tpu")
+    out = solver.assign(
+        "t", {0: [1, 2], 1: [2, 3]}, {}, {1, 2, 3, 4}, {0, 1, 2}, 2, Context()
+    )
+    assert set(out) == {0, 1, 2}
+    assert all(len(r) == 2 for r in out.values())
