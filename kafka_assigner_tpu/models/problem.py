@@ -145,7 +145,8 @@ def encode_problem(
         raise ValueError(f"width_override {width} < max replica-list length")
     current = np.full((p_pad, width), -1, dtype=np.int32)
     uniform = (
-        len(lengths) == 1
+        n > 0
+        and len(lengths) == 1
         and next(iter(lengths)) > 0
         # The fast path indexes current_assignment by every partition id, so
         # partitions with no current assignment (fresh rows, left -1) must go
