@@ -64,3 +64,14 @@ def moved_replicas(
         old = set(current.get(partition, ()))
         moved += sum(1 for b in replicas if b not in old)
     return moved
+
+
+def native_available() -> bool:
+    """True when the C++ greedy backend can be built/loaded on this machine."""
+    try:
+        from kafka_assigner_tpu.solvers.base import get_solver
+
+        get_solver("native")
+        return True
+    except NotImplementedError:
+        return False

@@ -1,0 +1,101 @@
+"""Property-based tests (hypothesis): generated clusters across the whole
+input space, asserting the cross-solver contracts that must hold wherever a
+solve succeeds — validity invariants, greedy/native byte-equality, and
+greedy/tpu movement parity."""
+from __future__ import annotations
+
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from kafka_assigner_tpu.assigner import TopicAssigner
+
+from .helpers import moved_replicas, native_available, verify_full_invariants
+
+
+@st.composite
+def clusters(draw):
+    """A random cluster + rack-valid current assignment + membership change."""
+    n_racks = draw(st.integers(2, 6))
+    per_rack = draw(st.integers(1, 4))
+    n_brokers = n_racks * per_rack
+    rf = draw(st.integers(1, min(3, n_racks)))
+    n_parts = draw(st.integers(1, 24))
+    base = list(range(100, 100 + n_brokers))
+    racks = {b: f"r{i % n_racks}" for i, b in enumerate(base)}
+    # rack-interleaved striping => rack-valid, balanced start
+    by_rack: dict = {}
+    for b in base:
+        by_rack.setdefault(racks[b], []).append(b)
+    inter = [
+        by_rack[r][d]
+        for d in range(per_rack)
+        for r in sorted(by_rack)
+    ]
+    offset = draw(st.integers(0, n_brokers - 1))
+    current = {
+        p: [inter[(offset + p + i) % n_brokers] for i in range(rf)]
+        for p in range(n_parts)
+    }
+    # membership change: remove up to 1 broker per rack, add up to 3
+    n_remove = draw(st.integers(0, min(n_racks, n_brokers - rf)))
+    removed = {by_rack[f"r{i}"][0] for i in range(n_remove)}
+    n_add = draw(st.integers(0, 3))
+    live = [b for b in base if b not in removed]
+    for j in range(n_add):
+        nb = 100 + n_brokers + j
+        live.append(nb)
+        racks[nb] = f"r{j % n_racks}"
+    rack_map = {b: racks[b] for b in live}
+    topic = draw(st.sampled_from(["t", "events", "__consumer_offsets", "x-1"]))
+    return topic, current, set(live), rack_map, rf
+
+
+@pytest.mark.skipif(not native_available(), reason="no C++ toolchain")
+@settings(max_examples=40, deadline=None)
+@given(clusters())
+def test_greedy_native_byte_equality(case):
+    topic, current, live, rack_map, rf = case
+    try:
+        g = TopicAssigner("greedy").generate_assignment(topic, current, live, rack_map, -1)
+    except ValueError as e:
+        try:
+            TopicAssigner("native").generate_assignment(topic, current, live, rack_map, -1)
+            raise AssertionError("native succeeded where greedy failed") from e
+        except ValueError:
+            return
+    n = TopicAssigner("native").generate_assignment(topic, current, live, rack_map, -1)
+    assert g == n
+
+
+@settings(max_examples=25, deadline=None)
+@given(clusters())
+def test_tpu_invariants_and_movement(case):
+    topic, current, live, rack_map, rf = case
+    try:
+        g = TopicAssigner("greedy").generate_assignment(topic, current, live, rack_map, -1)
+        greedy_ok = True
+    except ValueError:
+        greedy_ok = False
+    try:
+        t = TopicAssigner("tpu").generate_assignment(topic, current, live, rack_map, -1)
+    except ValueError:
+        # tpu may fail ONLY where greedy also fails (it is a strict superset)
+        assert not greedy_ok
+        return
+    verify_full_invariants(t, rack_map, sorted(live), rf)
+    if greedy_ok:
+        assert moved_replicas(current, t) == moved_replicas(current, g)
+
+
+@settings(max_examples=20, deadline=None)
+@given(clusters())
+def test_determinism(case):
+    topic, current, live, rack_map, rf = case
+    try:
+        a = TopicAssigner("greedy").generate_assignment(topic, current, live, rack_map, -1)
+    except ValueError:
+        return
+    b = TopicAssigner("greedy").generate_assignment(topic, current, live, rack_map, -1)
+    assert a == b

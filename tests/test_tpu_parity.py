@@ -161,13 +161,7 @@ def test_duplicate_topics_solved_per_occurrence(solver):
     assert first[0][0] != second[0][0]
 
 
-def _native_available():
-    try:
-        from kafka_assigner_tpu.solvers.base import get_solver
-        get_solver("native")
-        return True
-    except NotImplementedError:
-        return False
+from .helpers import native_available as _native_available
 
 
 @pytest.mark.skipif(not _native_available(), reason="no C++ toolchain")
