@@ -20,6 +20,14 @@ src/main/java/siftscience/kafka/tools/) as a JAX/XLA framework:
 __version__ = "0.1.0"
 
 from .assigner import TopicAssigner
-from .solvers.base import Context
+from .solvers.base import Context, get_solver
+from .validate import validate_cluster_feasibility, validate_topic_feasibility
 
-__all__ = ["TopicAssigner", "Context", "__version__"]
+__all__ = [
+    "TopicAssigner",
+    "Context",
+    "get_solver",
+    "validate_cluster_feasibility",
+    "validate_topic_feasibility",
+    "__version__",
+]
