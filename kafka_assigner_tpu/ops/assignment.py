@@ -463,6 +463,7 @@ def _solve_one_topic(
     n: int,
     rf: int,
     wave_mode: str = "auto",
+    use_pallas: bool = False,
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
     """One topic's pipeline: sticky fill → wave spread → leadership order.
     Shared by the single-topic, batched (scan), and what-if (vmap over
@@ -484,9 +485,21 @@ def _solve_one_topic(
     state = sticky_fill(current, rack_idx, rf, cap, n, p_real, alive)
     sticky_kept = jnp.sum(state.acc_count)
     state = spread_orphans(state, rack_idx, pos, cap, n, alive, wave_mode)
-    ordered, counters = leadership_order(
-        state.acc_nodes, state.acc_count, counters, jhash, rf
-    )
+
+    if use_pallas:
+        # Opt-in TPU kernel: VMEM-resident counters, no per-partition scan
+        # overhead; bit-identical to leadership_order (see module docstring).
+        # The flag arrives as a static jit argument from the solver (never
+        # from the vmapped what-if path).
+        from .pallas_leadership import leadership_order_pallas
+
+        ordered, counters = leadership_order_pallas(
+            state.acc_nodes, state.acc_count, counters, jhash, rf
+        )
+    else:
+        ordered, counters = leadership_order(
+            state.acc_nodes, state.acc_count, counters, jhash, rf
+        )
     return counters, (ordered, state.infeasible, state.deficit, sticky_kept)
 
 
@@ -498,6 +511,7 @@ def solve_assignment(
     p_real: jnp.ndarray,
     n: int,
     rf: int,
+    use_pallas: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Full single-topic solve.
 
@@ -506,13 +520,14 @@ def solve_assignment(
     """
     alive = jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
     counters, (ordered, infeasible, deficit, _) = _solve_one_topic(
-        counters, current, jhash, p_real, rack_idx, alive, n, rf
+        counters, current, jhash, p_real, rack_idx, alive, n, rf,
+        use_pallas=use_pallas,
     )
     return ordered, counters, infeasible, deficit
 
 
 solve_assignment_jit = jax.jit(
-    solve_assignment, static_argnames=("n", "rf"), donate_argnums=()
+    solve_assignment, static_argnames=("n", "rf", "use_pallas"), donate_argnums=()
 )
 
 
@@ -526,6 +541,7 @@ def solve_batched(
     rf: int,
     alive: jnp.ndarray | None = None,  # (N_pad,) scenario liveness mask
     wave_mode: str = "auto",
+    use_pallas: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Solve B topics in one device dispatch.
 
@@ -547,7 +563,8 @@ def solve_batched(
     def per_topic(counters, inp):
         current, jhash, p_real = inp
         return _solve_one_topic(
-            counters, current, jhash, p_real, rack_idx, alive, n, rf, wave_mode
+            counters, current, jhash, p_real, rack_idx, alive, n, rf,
+            wave_mode, use_pallas,
         )
 
     counters, (ordered, infeasible, deficits, kept) = lax.scan(
@@ -557,7 +574,7 @@ def solve_batched(
 
 
 solve_batched_jit = jax.jit(
-    solve_batched, static_argnames=("n", "rf", "wave_mode")
+    solve_batched, static_argnames=("n", "rf", "wave_mode", "use_pallas")
 )
 
 

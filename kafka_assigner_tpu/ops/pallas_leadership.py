@@ -1,0 +1,162 @@
+"""Pallas TPU kernel for the leadership-ordering hot loop.
+
+The leadership pass (``ops/assignment.py:leadership_order``) is inherently
+sequential — each partition's choice reads counters the previous partition
+wrote (``KafkaAssignmentStrategy.java:218-237``) — so under XLA it runs as a
+``lax.scan`` whose per-step fixed overhead dominates at headline scale
+(~200k partitions). This kernel removes that overhead the TPU-native way:
+
+- the counter table (N_pad × RF int32, ≤ ~100 KB at 8k brokers) lives in
+  VMEM for the whole call, updated in place via ``input_output_aliases``
+  (the enclosing ``lax.scan`` over topics carries it between calls — the
+  cross-topic Context semantics);
+- the grid walks partition *blocks* sequentially, so only one
+  (BLOCK_P, RF) tile of candidates/outputs is VMEM-resident at a time —
+  arbitrarily large topics never exceed VMEM;
+- within a block, a ``fori_loop`` walks partitions, and the RF² candidate
+  scan is fully unrolled scalar code on the TPU's scalar core — no per-step
+  XLA dispatch, no buffer shuffling.
+
+Semantics are bit-identical to ``leadership_order`` (differential-tested in
+interpret mode). Engaged only when the solver passes ``use_pallas=True``
+(TpuSolver reads ``KA_PALLAS_LEADERSHIP=1`` per call; the flag participates
+in the jit cache key as a static argument). The vmapped what-if sweep never
+engages it (batching aliased pallas buffers is not exercised). Kept opt-in
+until validated on real hardware — this container's chip tunnel was down
+when the kernel was written, so only interpret-mode correctness is proven.
+"""
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+BIG = 0x3FFFFFFF
+BLOCK_P = 512
+
+
+def _kernel(jhash_ref, cand_ref, count_ref, counters_in_ref, out_ref, counters_ref):
+    # counters_in_ref and counters_ref (the output) are aliased — one VMEM
+    # buffer persisting across the sequential partition-block grid; all
+    # reads/writes go through the output ref.
+    del counters_in_ref
+    p_block, rf = cand_ref.shape
+    jh = jhash_ref[0]
+
+    def per_partition(p, _):
+        count = count_ref[p, 0]
+        cands = [cand_ref[p, r] for r in range(rf)]
+        alive = [jnp.int32(r) < count for r in range(rf)]
+
+        for r in range(rf):  # slot loop, static
+            m = rf - r
+            start = jh % jnp.int32(m)
+            # key_i = counter[cand_i, r] * m + rotated_rank_i, BIG if taken
+            best_key = jnp.int32(BIG)
+            best_i = jnp.int32(-1)
+            for i in range(rf):
+                # rank of cand_i among remaining candidates (ascending ids)
+                k = jnp.int32(0)
+                for j in range(rf):
+                    k = k + jnp.where(
+                        alive[j] & (cands[j] < cands[i]), 1, 0
+                    ).astype(jnp.int32)
+                rot = (k + start) % jnp.int32(m)
+                cnt = counters_ref[cands[i], r]
+                key = jnp.where(
+                    alive[i], cnt * jnp.int32(m) + rot, jnp.int32(BIG)
+                )
+                take = key < best_key
+                best_key = jnp.where(take, key, best_key)
+                best_i = jnp.where(take, jnp.int32(i), best_i)
+
+            valid_slot = jnp.int32(r) < count
+            chosen = jnp.int32(0)
+            for i in range(rf):
+                chosen = jnp.where(best_i == i, cands[i], chosen)
+            out_ref[p, r] = jnp.where(valid_slot, chosen, jnp.int32(-1))
+            counters_ref[chosen, r] = counters_ref[chosen, r] + jnp.where(
+                valid_slot, 1, 0
+            ).astype(jnp.int32)
+            new_alive = []
+            for i in range(rf):
+                new_alive.append(alive[i] & (best_i != i))
+            alive = new_alive
+        return 0
+
+    lax.fori_loop(0, p_block, per_partition, 0)
+
+
+def leadership_order_pallas(
+    acc_nodes: jnp.ndarray,   # (P, RF) broker indices (complete rows)
+    acc_count: jnp.ndarray,   # (P,)
+    counters: jnp.ndarray,    # (N_pad, RF) Context slab
+    jhash: jnp.ndarray,       # scalar
+    rf: int,
+    interpret: bool | None = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Drop-in replacement for ``leadership_order`` backed by the kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = should_interpret()
+    p = acc_nodes.shape[0]
+    block = min(BLOCK_P, p)  # both powers of two -> block divides p
+    # -1 padding rows index counters row 0 harmlessly (valid_slot masks the
+    # write); clamp for safety.
+    cand = jnp.maximum(acc_nodes, 0).astype(jnp.int32)
+    jh = jnp.asarray(jhash, jnp.int32).reshape(1)
+
+    ordered, counters_out = pl.pallas_call(
+        _kernel,
+        grid=(p // block,),
+        out_shape=(
+            jax.ShapeDtypeStruct((p, rf), jnp.int32),         # out
+            jax.ShapeDtypeStruct(counters.shape, jnp.int32),  # counters alias
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),            # jhash scalar
+            pl.BlockSpec((block, rf), lambda i: (i, 0)),      # cand tile
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),       # count tile
+            pl.BlockSpec(counters.shape, lambda i: (0, 0)),   # counters whole
+        ],
+        out_specs=(
+            pl.BlockSpec((block, rf), lambda i: (i, 0)),
+            pl.BlockSpec(counters.shape, lambda i: (0, 0)),
+        ),
+        input_output_aliases={3: 1},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),  # sequential grid: counters carry
+        ),
+        interpret=interpret,
+    )(
+        jh,
+        cand,
+        acc_count.astype(jnp.int32).reshape(p, 1),
+        counters.astype(jnp.int32),
+    )
+    return ordered, counters_out
+
+
+def pallas_leadership_enabled() -> bool:
+    """Opt-in until validated on real TPU hardware (see module docstring)."""
+    return os.environ.get("KA_PALLAS_LEADERSHIP") == "1"
+
+
+def should_interpret() -> bool:
+    """Interpret (pure-python) mode everywhere except a real TPU backend.
+
+    The platform name is canonicalized because TPU access may go through an
+    experimental plugin whose backend name differs (e.g. this container's
+    tunneled chip registers as ``axon`` but canonicalizes to ``tpu``).
+    """
+    try:
+        import jax._src.xla_bridge as xb
+
+        return xb.canonicalize_platform(jax.default_backend()) != "tpu"
+    except Exception:
+        return True

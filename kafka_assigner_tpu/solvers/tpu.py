@@ -97,6 +97,8 @@ class TpuSolver:
 
         import jax
 
+        from ..ops.pallas_leadership import pallas_leadership_enabled
+
         ordered, counters_after, infeasible, deficit = jax.device_get(
             solve_assignment_jit(
                 jnp.asarray(enc.current),
@@ -106,6 +108,7 @@ class TpuSolver:
                 jnp.int32(enc.p),
                 n=enc.n,
                 rf=enc.rf,
+                use_pallas=pallas_leadership_enabled(),
             )
         )
         if bool(infeasible):
@@ -170,6 +173,8 @@ class TpuSolver:
             jhashes[i] = e.jhash
             p_reals[i] = e.p
 
+        from ..ops.pallas_leadership import pallas_leadership_enabled
+
         ordered, counters_after, infeasible, deficits, _ = jax.device_get(
             solve_batched_jit(
                 jnp.asarray(currents),
@@ -179,6 +184,7 @@ class TpuSolver:
                 jnp.asarray(p_reals),
                 n=encs[0].n,
                 rf=replication_factor,
+                use_pallas=pallas_leadership_enabled(),
             )
         )
         if infeasible[:b_real].any():
