@@ -53,6 +53,32 @@ def scenario_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec("scenarios"))
 
 
+def put_sharded(array, mesh: Mesh, spec: PartitionSpec):
+    """Place host data onto a (possibly multi-process) mesh sharding.
+
+    Single-process: a plain ``device_put``. Multi-process (one process per
+    host, mesh spanning all hosts): every process holds the full host array,
+    so ``make_array_from_callback`` hands each addressable device its slice —
+    the standard way to feed a DCN-spanning mesh without a distributed
+    filesystem.
+    """
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(array, sharding)
+    return jax.make_array_from_callback(
+        array.shape, sharding, lambda idx: array[idx]
+    )
+
+
+def fetch_global(x):
+    """Materialize a (possibly cross-process-sharded) array on every host."""
+    if jax.process_count() == 1:
+        return jax.device_get(x)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x, tiled=True)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 

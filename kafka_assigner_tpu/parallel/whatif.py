@@ -48,7 +48,7 @@ def evaluate_removal_scenarios(
     """
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec
+    from jax.sharding import PartitionSpec
 
     from ..ops.assignment import whatif_sweep_jit
 
@@ -88,22 +88,26 @@ def evaluate_removal_scenarios(
                 raise ValueError(f"scenario {s}: unknown broker {b}")
             alive[s, idx] = False
 
-    alive_dev = jnp.asarray(alive)
-    if mesh is not None:
-        alive_dev = jax.device_put(
-            alive_dev, NamedSharding(mesh, PartitionSpec("scenarios", None))
-        )
+    from .mesh import fetch_global, put_sharded
 
-    moved, infeasible, max_load = jax.device_get(
-        whatif_sweep_jit(
-            jnp.asarray(currents),
-            jnp.asarray(enc0.rack_idx),
-            jnp.asarray(jhashes),
-            jnp.asarray(p_reals),
-            alive_dev,
-            n=enc0.n,
-            rf=rf,
-        )
+    if mesh is not None:
+        alive_dev = put_sharded(alive, mesh, PartitionSpec("scenarios", None))
+    else:
+        alive_dev = jnp.asarray(alive)
+
+    moved, infeasible, max_load = map(
+        np.array,  # forced copy: the rescue pass below mutates these rows
+        fetch_global(
+            whatif_sweep_jit(
+                jnp.asarray(currents),
+                jnp.asarray(enc0.rack_idx),
+                jnp.asarray(jhashes),
+                jnp.asarray(p_reals),
+                alive_dev,
+                n=enc0.n,
+                rf=rf,
+            )
+        ),
     )
     # The sweep runs the fast wave only (an in-graph fallback would execute
     # for every vmapped scenario); a raised flag can mean "fast packing
@@ -158,11 +162,11 @@ def estimate_removal_scenarios(
     ``ops.sinkhorn.movement_estimate``); they know nothing of rack
     feasibility.
     """
-    import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec
+    from jax.sharding import PartitionSpec
 
     from ..ops.sinkhorn import relaxed_movement_sweep_jit
+    from .mesh import fetch_global, put_sharded
 
     items = list(topic_assignments.items())
     if not items or not scenarios:
@@ -196,12 +200,11 @@ def estimate_removal_scenarios(
                 raise ValueError(f"scenario {s}: unknown broker {b}")
             alive[s, idx] = False
 
-    alive_dev = jnp.asarray(alive)
     if mesh is not None:
-        alive_dev = jax.device_put(
-            alive_dev, NamedSharding(mesh, PartitionSpec("scenarios", None))
-        )
-    est = jax.device_get(
+        alive_dev = put_sharded(alive, mesh, PartitionSpec("scenarios", None))
+    else:
+        alive_dev = jnp.asarray(alive)
+    est = fetch_global(
         relaxed_movement_sweep_jit(
             jnp.asarray(currents), jnp.asarray(p_reals), alive_dev,
             n=cluster.n, rf=rf,
