@@ -29,6 +29,7 @@ from .generator import (
     build_rack_assignment,
     print_current_assignment,
     print_current_brokers,
+    print_decommission_ranking,
     print_least_disruptive_reassignment,
     resolve_broker_ids,
     resolve_excluded_broker_ids,
@@ -36,7 +37,16 @@ from .generator import (
 from .io.base import open_backend
 from .solvers.base import get_solver
 
-MODES = ("PRINT_CURRENT_ASSIGNMENT", "PRINT_CURRENT_BROKERS", "PRINT_REASSIGNMENT")
+# The reference's three modes (KafkaAssignmentGenerator.java:86-101) plus
+# RANK_DECOMMISSION, which exposes the what-if fleet: it solves one candidate
+# broker-removal scenario per live broker (or per --broker_hosts candidate)
+# in a single batched sweep and prints the ranking least-disruptive-first.
+MODES = (
+    "PRINT_CURRENT_ASSIGNMENT",
+    "PRINT_CURRENT_BROKERS",
+    "PRINT_REASSIGNMENT",
+    "RANK_DECOMMISSION",
+)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -117,6 +127,23 @@ def run_tool(argv: Optional[List[str]] = None) -> int:
             print_current_assignment(backend, topics)
         elif args.mode == "PRINT_CURRENT_BROKERS":
             print_current_brokers(backend, live_brokers=live_brokers)
+        elif args.mode == "RANK_DECOMMISSION":
+            # Sweep-based mode: always the JAX backend; --solver is not
+            # meaningful here.
+            if args.solver != "greedy":
+                print(
+                    f"note: --solver {args.solver} is ignored by "
+                    "RANK_DECOMMISSION (always the batched JAX sweep)",
+                    file=sys.stderr,
+                )
+            # --broker_hosts_to_remove narrows the cluster first (rank the
+            # remaining removals GIVEN those already gone).
+            live = [b for b in live_brokers if b.id not in excluded]
+            print_decommission_ranking(
+                backend, topics, (broker_ids - excluded) or None,
+                {k: v for k, v in rack_assignment.items() if k not in excluded},
+                args.desired_replication_factor, live_brokers=live,
+            )
         else:
             print_least_disruptive_reassignment(
                 backend,

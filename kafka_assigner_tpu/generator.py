@@ -11,6 +11,7 @@ log4j.properties:21-31``).
 """
 from __future__ import annotations
 
+import json
 import os
 import sys
 from typing import Dict, Iterable, List, Optional, Sequence, Set, TextIO
@@ -106,6 +107,57 @@ def print_current_brokers(
         live_brokers = backend.brokers()
     print("CURRENT BROKERS:", file=out)
     print(format_brokers_json(live_brokers), file=out)
+
+
+def print_decommission_ranking(
+    backend: MetadataBackend,
+    topics: Optional[Sequence[str]],
+    candidate_brokers: Optional[Set[int]],
+    rack_assignment: Dict[int, str],
+    desired_replication_factor: int,
+    out: Optional[TextIO] = None,
+    live_brokers: Optional[Sequence[BrokerInfo]] = None,
+) -> None:
+    """RANK_DECOMMISSION: one batched what-if sweep over candidate
+    single-broker removals (every live broker by default), printed
+    least-disruptive-first as a JSON array on stdout.
+
+    The reference can only answer this one process run at a time
+    (``--broker_hosts_to_remove`` + eyeballing the JSON); the sweep solves
+    all candidates at once (BASELINE config 5).
+    """
+    from .parallel.whatif import rank_decommission_candidates
+
+    out = out if out is not None else sys.stdout
+    if live_brokers is None:
+        live_brokers = backend.brokers()
+    brokers = {b.id for b in live_brokers}
+    topic_list = list(topics) if topics is not None else backend.all_topics()
+    initial = backend.partition_assignment(topic_list)
+
+    ranked = rank_decommission_candidates(
+        {t: initial[t] for t in topic_list},
+        brokers,
+        {k: v for k, v in rack_assignment.items() if k in brokers},
+        sorted(candidate_brokers) if candidate_brokers else None,
+        desired_replication_factor,
+    )
+    print("DECOMMISSION RANKING:", file=out)
+    print(
+        json.dumps(
+            [
+                {
+                    "broker": r.removed[0],
+                    "moved_replicas": r.moved_replicas,
+                    "feasible": r.feasible,
+                    "max_node_load": r.max_node_load,
+                }
+                for r in ranked
+            ],
+            separators=(",", ":"),
+        ),
+        file=out,
+    )
 
 
 def print_least_disruptive_reassignment(

@@ -92,7 +92,7 @@ def _candidate_ok(
     state: AssignState,
     cand: jnp.ndarray,
     rack_idx: jnp.ndarray,
-    rf: int,
+    rf,  # static int or traced per-topic scalar
     alive: jnp.ndarray,
 ) -> jnp.ndarray:
     """Per-partition acceptability of candidate nodes, sans capacity:
@@ -116,6 +116,7 @@ def sticky_fill(
     n: int,                 # real node count (scratch row = n)
     p_real: jnp.ndarray | None = None,  # real partition count; padded rows get no deficit
     alive: jnp.ndarray | None = None,   # (N_pad,) scenario liveness; default: first n
+    rf_actual: jnp.ndarray | None = None,  # traced per-topic RF <= rf (mixed-RF sweeps)
 ) -> AssignState:
     """Vectorized sticky fill (``fillNodesFromAssignment``, ``:101-131``).
 
@@ -134,9 +135,11 @@ def sticky_fill(
         p_real = jnp.int32(p)
     if alive is None:
         alive = jnp.arange(n_pad, dtype=jnp.int32) < n
-    deficit = jnp.where(jnp.arange(p, dtype=jnp.int32) < p_real, rf, 0).astype(
-        jnp.int32
-    )
+    if rf_actual is None:
+        rf_actual = jnp.int32(rf)
+    deficit = jnp.where(
+        jnp.arange(p, dtype=jnp.int32) < p_real, rf_actual, 0
+    ).astype(jnp.int32)
     state = AssignState(
         acc_nodes=jnp.full((p, rf), -1, dtype=jnp.int32),
         acc_count=jnp.zeros(p, dtype=jnp.int32),
@@ -146,7 +149,7 @@ def sticky_fill(
     )
     for s in range(width):  # static unroll: width == historical RF, small
         cand = current[:, s]
-        ok = _candidate_ok(state, cand, rack_idx, rf, alive)
+        ok = _candidate_ok(state, cand, rack_idx, rf_actual, alive)
         rank = _requests_rank(cand, ok, n)
         load = state.node_load[jnp.maximum(cand, 0)]
         accept = ok & (load + rank < cap)
@@ -409,16 +412,20 @@ def leadership_order(
     def order_one(counters, cand, count):
         remaining = jnp.arange(rf, dtype=jnp.int32) < count
         ordered = jnp.full((rf,), -1, dtype=jnp.int32)
-        for r in range(rf):  # static unroll, rf small
-            m = rf - r
-            start = (jhash % jnp.int32(m)).astype(jnp.int32)
+        for r in range(rf):  # static unroll, rf <= batch-max RF
+            # m = number of remaining candidates = count - r (the reference's
+            # per-partition replicationFactor, :227-229) — computed from the
+            # partition's own count so mixed-RF batches and partial rows get
+            # the exact reference rotation, not the batch-max one.
+            m = jnp.maximum(count - jnp.int32(r), 1)
+            start = (jhash % m).astype(jnp.int32)
             # Rank of each candidate among the remaining, by broker index
             # ascending (TreeSet order, :228).
             lt = (cand[None, :] < cand[:, None]) & remaining[None, :]
             k = jnp.sum(lt, axis=1).astype(jnp.int32)
-            rot = (k + start) % jnp.int32(m)
+            rot = (k + start) % m
             cnt = counters[jnp.maximum(cand, 0), r]
-            key = jnp.where(remaining, cnt * jnp.int32(m) + rot, BIG)
+            key = jnp.where(remaining, cnt * m + rot, BIG)
             # Partitions whose replica list is shorter than rf (defensive;
             # complete solves always have count == rf) stop early.
             valid_slot = jnp.int32(r) < count
@@ -464,6 +471,7 @@ def _solve_one_topic(
     rf: int,
     wave_mode: str = "auto",
     use_pallas: bool = False,
+    rf_actual: jnp.ndarray | None = None,  # traced per-topic RF (mixed-RF sweeps)
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
     """One topic's pipeline: sticky fill → wave spread → leadership order.
     Shared by the single-topic, batched (scan), and what-if (vmap over
@@ -474,15 +482,17 @@ def _solve_one_topic(
     node positions are all computed on device from the traced liveness mask,
     so broker-removal scenarios need no host-side re-encoding.
     """
+    if rf_actual is None:
+        rf_actual = jnp.int32(rf)
     n_alive = jnp.maximum(jnp.sum(alive[: max(n, 1)].astype(jnp.int32)), 1)
-    cap = (p_real * jnp.int32(rf) + n_alive - 1) // n_alive
+    cap = (p_real * rf_actual + n_alive - 1) // n_alive
     start = jhash % n_alive
     # Rotated position: rank among live nodes (ascending id), shifted by
     # start with wraparound; dead/padded nodes sort last.
     alive_rank = jnp.cumsum(alive.astype(jnp.int32)) - 1
     pos = jnp.where(alive, (alive_rank + start) % n_alive, BIG)
 
-    state = sticky_fill(current, rack_idx, rf, cap, n, p_real, alive)
+    state = sticky_fill(current, rack_idx, rf, cap, n, p_real, alive, rf_actual)
     sticky_kept = jnp.sum(state.acc_count)
     state = spread_orphans(state, rack_idx, pos, cap, n, alive, wave_mode)
 
@@ -538,10 +548,11 @@ def solve_batched(
     jhashes: jnp.ndarray,    # (B,)
     p_reals: jnp.ndarray,    # (B,)
     n: int,
-    rf: int,
+    rf: int,                 # static max RF (array width)
     alive: jnp.ndarray | None = None,  # (N_pad,) scenario liveness mask
     wave_mode: str = "auto",
     use_pallas: bool = False,
+    rfs: jnp.ndarray | None = None,  # (B,) per-topic RF for mixed-RF sweeps
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Solve B topics in one device dispatch.
 
@@ -559,16 +570,18 @@ def solve_batched(
     """
     if alive is None:
         alive = jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
+    if rfs is None:
+        rfs = jnp.full(currents.shape[0], rf, dtype=jnp.int32)
 
     def per_topic(counters, inp):
-        current, jhash, p_real = inp
+        current, jhash, p_real, rf_actual = inp
         return _solve_one_topic(
             counters, current, jhash, p_real, rack_idx, alive, n, rf,
-            wave_mode, use_pallas,
+            wave_mode, use_pallas, rf_actual,
         )
 
     counters, (ordered, infeasible, deficits, kept) = lax.scan(
-        per_topic, counters, (currents, jhashes, p_reals)
+        per_topic, counters, (currents, jhashes, p_reals, rfs)
     )
     return ordered, counters, infeasible, deficits, kept
 
@@ -585,8 +598,9 @@ def whatif_sweep(
     p_reals: jnp.ndarray,    # (B,)
     alive_masks: jnp.ndarray,  # (S, N_pad) one liveness mask per scenario
     n: int,
-    rf: int,
+    rf: int,                   # static max RF (array width)
     wave_mode: str = "fast",
+    rfs: jnp.ndarray | None = None,  # (B,) per-topic RF
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Evaluate S broker-removal scenarios over the full cluster in parallel.
 
@@ -600,6 +614,8 @@ def whatif_sweep(
     max_node_load (S,)).
     """
     counters0 = jnp.zeros((rack_idx.shape[0], rf), dtype=jnp.int32)
+    if rfs is None:
+        rfs = jnp.full(currents.shape[0], rf, dtype=jnp.int32)
 
     # wave_mode "fast" (no in-graph dense fallback): under vmap, lax.cond
     # lowers to select and both branches would execute for every scenario.
@@ -607,9 +623,9 @@ def whatif_sweep(
     def one_scenario(alive):
         ordered, _, infeasible, _, kept = solve_batched(
             currents, rack_idx, counters0, jhashes, p_reals, n, rf, alive,
-            wave_mode,
+            wave_mode, False, rfs,
         )
-        total = jnp.sum(p_reals) * rf
+        total = jnp.sum(p_reals * rfs)
         moved = total - jnp.sum(kept)
         # Node loads across every topic's final assignment.
         safe = jnp.where(ordered >= 0, ordered, rack_idx.shape[0])
@@ -620,5 +636,5 @@ def whatif_sweep(
 
 
 whatif_sweep_jit = jax.jit(
-    whatif_sweep, static_argnames=("n", "rf", "wave_mode")
+    whatif_sweep, static_argnames=("n", "rf", "wave_mode")  # rfs traced
 )

@@ -52,8 +52,10 @@ def _kernel(jhash_ref, cand_ref, count_ref, counters_in_ref, out_ref, counters_r
         alive = [jnp.int32(r) < count for r in range(rf)]
 
         for r in range(rf):  # slot loop, static
-            m = rf - r
-            start = jh % jnp.int32(m)
+            # per-partition m = count - r (reference semantics; see
+            # ops/assignment.py order_one)
+            m = jnp.maximum(count - jnp.int32(r), 1)
+            start = jh % m
             # key_i = counter[cand_i, r] * m + rotated_rank_i, BIG if taken
             best_key = jnp.int32(BIG)
             best_i = jnp.int32(-1)
@@ -64,11 +66,9 @@ def _kernel(jhash_ref, cand_ref, count_ref, counters_in_ref, out_ref, counters_r
                     k = k + jnp.where(
                         alive[j] & (cands[j] < cands[i]), 1, 0
                     ).astype(jnp.int32)
-                rot = (k + start) % jnp.int32(m)
+                rot = (k + start) % m
                 cnt = counters_ref[cands[i], r]
-                key = jnp.where(
-                    alive[i], cnt * jnp.int32(m) + rot, jnp.int32(BIG)
-                )
+                key = jnp.where(alive[i], cnt * m + rot, jnp.int32(BIG))
                 take = key < best_key
                 best_key = jnp.where(take, key, best_key)
                 best_i = jnp.where(take, jnp.int32(i), best_i)

@@ -92,8 +92,9 @@ def relaxed_movement_sweep(
     currents: jnp.ndarray,     # (B, P_pad, L) broker index or -1, per topic
     p_reals: jnp.ndarray,      # (B,)
     alive_masks: jnp.ndarray,  # (S, N_pad) one liveness mask per scenario
-    n: int,
-    rf: int,
+    rfs: jnp.ndarray | None = None,  # (B,) per-topic RF
+    n: int = 0,
+    rf: int = 0,
     eps: float = 0.05,
     iters: int = 24,
 ) -> jnp.ndarray:
@@ -107,14 +108,16 @@ def relaxed_movement_sweep(
     """
     p_pad = currents.shape[1]
     rows = jnp.arange(p_pad, dtype=jnp.int32)
+    if rfs is None:
+        rfs = jnp.full(currents.shape[0], rf, dtype=jnp.int32)
 
     def one_scenario(alive):
         n_alive = jnp.maximum(jnp.sum(alive[:n].astype(jnp.int32)), 1)
 
         def one_topic(carry, inp):
-            current, p_real = inp
+            current, p_real, rf_t = inp
             real_row = rows < p_real
-            cap = (p_real * jnp.int32(rf) + n_alive - 1) // n_alive
+            cap = (p_real * rf_t + n_alive - 1) // n_alive
             sticky = (
                 jnp.zeros((p_pad, alive.shape[0] + 1), dtype=bool)
                 .at[jnp.repeat(rows[:, None], current.shape[1], 1),
@@ -124,13 +127,13 @@ def relaxed_movement_sweep(
             sticky = sticky & alive[None, :]
             allowed = real_row[:, None] & alive[None, :]
             cost = jnp.where(allowed, 1.0 - sticky.astype(jnp.float32), jnp.inf)
-            row_target = jnp.where(real_row, jnp.float32(rf), 0.0)
+            row_target = jnp.where(real_row, rf_t.astype(jnp.float32), 0.0)
             col_cap = jnp.where(alive, cap.astype(jnp.float32), 0.0)
             x = capacity_sinkhorn(cost, row_target, col_cap, eps=eps, iters=iters)
             return carry + movement_estimate(x, sticky, row_target), None
 
         total, _ = lax.scan(
-            one_topic, jnp.float32(0.0), (currents, p_reals)
+            one_topic, jnp.float32(0.0), (currents, p_reals, rfs)
         )
         return total
 

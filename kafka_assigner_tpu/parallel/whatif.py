@@ -21,6 +21,19 @@ from ..models.problem import (
 )
 
 
+def _topic_rfs(items, replication_factor):
+    """Per-topic RF: the desired override, else inferred from each topic's
+    own replica lists (clusters routinely mix RFs). Topics with no partitions
+    are skipped by callers (they contribute nothing to any scenario)."""
+    out = []
+    for _, cur in items:
+        if replication_factor >= 0:
+            out.append(replication_factor)
+        else:
+            out.append(len(next(iter(cur.values()))) if cur else 0)
+    return out
+
+
 @dataclass
 class ScenarioResult:
     """Outcome metrics for one candidate change."""
@@ -52,28 +65,32 @@ def evaluate_removal_scenarios(
 
     from ..ops.assignment import whatif_sweep_jit
 
-    items = list(topic_assignments.items())
+    all_items = list(topic_assignments.items())
+    all_rfs = _topic_rfs(all_items, replication_factor)
+    # Topics with no partitions contribute nothing to any scenario.
+    items = [it for it, r in zip(all_items, all_rfs) if r > 0 and it[1]]
+    topic_rfs = [r for it, r in zip(all_items, all_rfs) if r > 0 and it[1]]
     if not items:
         return []
-    rf = replication_factor
-    if rf < 0:
-        rf = len(next(iter(items[0][1].values())))
+    rf = max(topic_rfs)
     p_pad, width = group_pads([cur for _, cur in items])
     cluster = encode_cluster(rack_assignment, brokers)
     encs = [
-        encode_problem(t, cur, rack_assignment, brokers, set(cur), rf,
+        encode_problem(t, cur, rack_assignment, brokers, set(cur), t_rf,
                        p_pad_override=p_pad, width_override=width,
                        cluster=cluster)
-        for t, cur in items
+        for (t, cur), t_rf in zip(items, topic_rfs)
     ]
     b_pad = batch_bucket(len(encs))
     currents = np.full((b_pad, p_pad, width), -1, dtype=np.int32)
     jhashes = np.zeros(b_pad, dtype=np.int32)
     p_reals = np.zeros(b_pad, dtype=np.int32)
-    for i, e in enumerate(encs):
+    rfs = np.zeros(b_pad, dtype=np.int32)
+    for i, (e, t_rf) in enumerate(zip(encs, topic_rfs)):
         currents[i] = e.current
         jhashes[i] = e.jhash
         p_reals[i] = e.p
+        rfs[i] = t_rf
 
     enc0 = encs[0]
     broker_to_idx = cluster.broker_to_idx
@@ -106,6 +123,7 @@ def evaluate_removal_scenarios(
                 alive_dev,
                 n=enc0.n,
                 rf=rf,
+                rfs=jnp.asarray(rfs),
             )
         ),
     )
@@ -129,6 +147,7 @@ def evaluate_removal_scenarios(
                 n=enc0.n,
                 rf=rf,
                 wave_mode="auto",
+                rfs=jnp.asarray(rfs),
             )
         )
         for i, s in enumerate(flagged):
@@ -168,26 +187,29 @@ def estimate_removal_scenarios(
     from ..ops.sinkhorn import relaxed_movement_sweep_jit
     from .mesh import fetch_global, put_sharded
 
-    items = list(topic_assignments.items())
+    all_items = list(topic_assignments.items())
+    all_rfs = _topic_rfs(all_items, replication_factor)
+    items = [it for it, r in zip(all_items, all_rfs) if r > 0 and it[1]]
+    topic_rfs = [r for it, r in zip(all_items, all_rfs) if r > 0 and it[1]]
     if not items or not scenarios:
         return []
-    rf = replication_factor
-    if rf < 0:
-        rf = len(next(iter(items[0][1].values())))
+    rf = max(topic_rfs)
     p_pad, width = group_pads([cur for _, cur in items])
     cluster = encode_cluster(rack_assignment, brokers)
     encs = [
-        encode_problem(t, cur, rack_assignment, brokers, set(cur), rf,
+        encode_problem(t, cur, rack_assignment, brokers, set(cur), t_rf,
                        p_pad_override=p_pad, width_override=width,
                        cluster=cluster)
-        for t, cur in items
+        for (t, cur), t_rf in zip(items, topic_rfs)
     ]
     b_pad = batch_bucket(len(encs))
     currents = np.full((b_pad, p_pad, width), -1, dtype=np.int32)
     p_reals = np.zeros(b_pad, dtype=np.int32)
-    for i, e in enumerate(encs):
+    rfs = np.zeros(b_pad, dtype=np.int32)
+    for i, (e, t_rf) in enumerate(zip(encs, topic_rfs)):
         currents[i] = e.current
         p_reals[i] = e.p
+        rfs[i] = t_rf
 
     s_real = len(scenarios)
     s_pad = batch_bucket(s_real)
@@ -207,7 +229,7 @@ def estimate_removal_scenarios(
     est = fetch_global(
         relaxed_movement_sweep_jit(
             jnp.asarray(currents), jnp.asarray(p_reals), alive_dev,
-            n=cluster.n, rf=rf,
+            jnp.asarray(rfs), n=cluster.n, rf=rf,
         )
     )
     return [

@@ -144,21 +144,25 @@ class TpuSolver:
         import jax.numpy as jnp
 
         from ..ops.assignment import solve_batched_jit
+        from ..utils.timers import Timers
 
+        timers = Timers()
         if context is None:
             context = Context()
         if not named_currents:
             return []
-        p_pad, width = group_pads([cur for _, cur in named_currents])
-        cluster = encode_cluster(rack_assignment, nodes)
-        encs = [
-            encode_problem(
-                topic, cur, rack_assignment, nodes, set(cur), replication_factor,
-                p_pad_override=p_pad, width_override=width, cluster=cluster,
-            )
-            for topic, cur in named_currents
-        ]
-        counters_before = context_to_array(context, encs[0])
+        with timers.phase("encode"):
+            p_pad, width = group_pads([cur for _, cur in named_currents])
+            cluster = encode_cluster(rack_assignment, nodes)
+            encs = [
+                encode_problem(
+                    topic, cur, rack_assignment, nodes, set(cur),
+                    replication_factor,
+                    p_pad_override=p_pad, width_override=width, cluster=cluster,
+                )
+                for topic, cur in named_currents
+            ]
+            counters_before = context_to_array(context, encs[0])
 
         # The batch axis is bucketed like every other axis: padding topics are
         # inert (empty current, p_real 0), so topic-count changes reuse the
@@ -175,18 +179,19 @@ class TpuSolver:
 
         from ..ops.pallas_leadership import pallas_leadership_enabled
 
-        ordered, counters_after, infeasible, deficits, _ = jax.device_get(
-            solve_batched_jit(
-                jnp.asarray(currents),
-                jnp.asarray(encs[0].rack_idx),
-                jnp.asarray(counters_before),
-                jnp.asarray(jhashes),
-                jnp.asarray(p_reals),
-                n=encs[0].n,
-                rf=replication_factor,
-                use_pallas=pallas_leadership_enabled(),
+        with timers.phase("solve"):
+            ordered, counters_after, infeasible, deficits, _ = jax.device_get(
+                solve_batched_jit(
+                    jnp.asarray(currents),
+                    jnp.asarray(encs[0].rack_idx),
+                    jnp.asarray(counters_before),
+                    jnp.asarray(jhashes),
+                    jnp.asarray(p_reals),
+                    n=encs[0].n,
+                    rf=replication_factor,
+                    use_pallas=pallas_leadership_enabled(),
+                )
             )
-        )
         if infeasible[:b_real].any():
             b = int(np.argmax(infeasible[:b_real]))
             bad = int(np.argmax(deficits[b] > 0))
@@ -194,11 +199,15 @@ class TpuSolver:
                 f"Partition {int(encs[b].partition_ids[bad])} could not be "
                 "fully assigned!"
             )
-        apply_counter_updates(context, encs[0], counters_before, counters_after)
-        return [
-            (enc.topic, decode_assignment(enc, ordered[i]))
-            for i, enc in enumerate(encs)
-        ]
+        with timers.phase("decode"):
+            apply_counter_updates(
+                context, encs[0], counters_before, counters_after
+            )
+            result = [
+                (enc.topic, decode_assignment(enc, ordered[i]))
+                for i, enc in enumerate(encs)
+            ]
+        return result
 
     def fresh_assignment(
         self,

@@ -207,3 +207,20 @@ def test_leadership_context_persists_across_runs(capsys, snapshot, tmp_path):
     total1 = sum(c for slots in saved.values() for c in slots.values())
     total2 = sum(c for slots in saved2.values() for c in slots.values())
     assert total2 == 2 * total1
+
+
+def test_rank_decommission_mode(capsys, snapshot):
+    path, cluster = snapshot
+    rc, out, _ = _run(
+        capsys, "--zk_string", path, "--mode", "RANK_DECOMMISSION",
+        "--disable_rack_awareness",
+    )
+    assert rc == 0
+    header, payload = out.strip().split("\n", 1)
+    assert header == "DECOMMISSION RANKING:"
+    ranking = json.loads(payload)
+    assert {e["broker"] for e in ranking} == {100 + i for i in range(6)}
+    moves = [e["moved_replicas"] for e in ranking if e["feasible"]]
+    assert moves == sorted(moves)
+    # broker 105 holds nothing, so removing it is the least disruptive option
+    assert ranking[0]["broker"] == 105 and ranking[0]["moved_replicas"] == 0
