@@ -30,6 +30,7 @@ from .generator import (
     print_current_assignment,
     print_current_brokers,
     print_decommission_ranking,
+    print_fresh_assignment,
     print_least_disruptive_reassignment,
     resolve_broker_ids,
     resolve_excluded_broker_ids,
@@ -46,6 +47,7 @@ MODES = (
     "PRINT_CURRENT_BROKERS",
     "PRINT_REASSIGNMENT",
     "RANK_DECOMMISSION",
+    "PRINT_FRESH_ASSIGNMENT",
 )
 
 
@@ -79,6 +81,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="assignment backend: reference-faithful greedy "
                         "(python), the same algorithm as native C++, or the "
                         "TPU (JAX/XLA) solver")
+    p.add_argument("--partition_count", type=int, default=None,
+                   help="PRINT_FRESH_ASSIGNMENT: number of partitions to "
+                        "place for each --topics entry")
     p.add_argument("--leadership_context", default=None, metavar="PATH",
                    help="persist cross-run leadership counters to PATH "
                         "(loaded if present, saved after PRINT_REASSIGNMENT) "
@@ -127,6 +132,35 @@ def run_tool(argv: Optional[List[str]] = None) -> int:
             print_current_assignment(backend, topics)
         elif args.mode == "PRINT_CURRENT_BROKERS":
             print_current_brokers(backend, live_brokers=live_brokers)
+        elif args.mode == "PRINT_FRESH_ASSIGNMENT":
+            # From-scratch placement (no current assignment) — a capability
+            # the reference lacks entirely; requires explicit positive shape
+            # flags. Always the JAX backend (like RANK_DECOMMISSION).
+            if not topics or args.partition_count is None \
+                    or args.partition_count <= 0 \
+                    or args.desired_replication_factor <= 0:
+                print(
+                    "error: PRINT_FRESH_ASSIGNMENT requires --topics, a "
+                    "positive --partition_count and a positive "
+                    "--desired_replication_factor",
+                    file=sys.stderr,
+                )
+                return 1
+            if args.solver != "greedy":
+                print(
+                    f"note: --solver {args.solver} is ignored by "
+                    "PRINT_FRESH_ASSIGNMENT (always the JAX solver)",
+                    file=sys.stderr,
+                )
+            # Honor broker selection/exclusion like PRINT_REASSIGNMENT:
+            # target set = (--integer_broker_ids/--broker_hosts or all live)
+            # minus --broker_hosts_to_remove.
+            target = (broker_ids or {b.id for b in live_brokers}) - excluded
+            print_fresh_assignment(
+                topics, args.partition_count, args.desired_replication_factor,
+                [b for b in live_brokers if b.id in target],
+                {k: v for k, v in rack_assignment.items() if k in target},
+            )
         elif args.mode == "RANK_DECOMMISSION":
             # Sweep-based mode: always the JAX backend; --solver is not
             # meaningful here.

@@ -160,6 +160,38 @@ def print_decommission_ranking(
     )
 
 
+def print_fresh_assignment(
+    topics: Sequence[str],
+    partition_count: int,
+    replication_factor: int,
+    live_brokers: Sequence[BrokerInfo],
+    rack_assignment: Dict[int, str],
+    out: Optional[TextIO] = None,
+) -> None:
+    """PRINT_FRESH_ASSIGNMENT: place new topics from scratch (no current
+    assignment) and emit Kafka-parseable reassignment JSON — a capability the
+    reference lacks (its greedy first-fit dead-ends on fresh placements at
+    moderate saturation; the balance-wave chain does not, see
+    solvers/tpu.py:fresh_assignment)."""
+    from .solvers.base import get_solver
+
+    out = out if out is not None else sys.stdout
+    brokers = {b.id for b in live_brokers}
+    solver = get_solver("tpu")  # clean NotImplementedError when jax is absent
+    context = Context()
+    pairs = [
+        (
+            topic,
+            solver.fresh_assignment(
+                topic, partition_count, brokers, rack_assignment,
+                replication_factor, context,
+            ),
+        )
+        for topic in topics
+    ]
+    print("FRESH ASSIGNMENT:\n" + format_reassignment_pairs(pairs), file=out)
+
+
 def print_least_disruptive_reassignment(
     backend: MetadataBackend,
     topics: Optional[Sequence[str]],

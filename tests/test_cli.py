@@ -224,3 +224,45 @@ def test_rank_decommission_mode(capsys, snapshot):
     assert moves == sorted(moves)
     # broker 105 holds nothing, so removing it is the least disruptive option
     assert ranking[0]["broker"] == 105 and ranking[0]["moved_replicas"] == 0
+
+
+def test_print_fresh_assignment_mode(capsys, snapshot):
+    path, _ = snapshot
+    rc, out, _ = _run(
+        capsys, "--zk_string", path, "--mode", "PRINT_FRESH_ASSIGNMENT",
+        "--topics", "newtopic", "--partition_count", "8",
+        "--desired_replication_factor", "2",
+    )
+    assert rc == 0
+    payload = out.split("FRESH ASSIGNMENT:\n", 1)[1].strip()
+    new = parse_reassignment_json(payload)
+    assert set(new["newtopic"]) == set(range(8))
+    rack = {100 + i: f"r{i % 3}" for i in range(6)}
+    for replicas in new["newtopic"].values():
+        assert len(replicas) == 2
+        assert len({rack[b] for b in replicas}) == 2
+
+
+def test_fresh_assignment_requires_shape_flags(capsys, snapshot):
+    path, _ = snapshot
+    rc, _, err = _run(capsys, "--zk_string", path, "--mode", "PRINT_FRESH_ASSIGNMENT")
+    assert rc == 1 and "requires --topics" in err
+
+
+def test_fresh_assignment_honors_exclusions(capsys, snapshot):
+    path, _ = snapshot
+    rc, out, _ = _run(
+        capsys, "--zk_string", path, "--mode", "PRINT_FRESH_ASSIGNMENT",
+        "--topics", "newtopic", "--partition_count", "6",
+        "--desired_replication_factor", "2",
+        "--broker_hosts_to_remove", "host5",
+    )
+    assert rc == 0
+    new = parse_reassignment_json(out.split("FRESH ASSIGNMENT:\n", 1)[1].strip())
+    assert all(105 not in r for r in new["newtopic"].values())
+    rc, _, err = _run(
+        capsys, "--zk_string", path, "--mode", "PRINT_FRESH_ASSIGNMENT",
+        "--topics", "t", "--partition_count", "0",
+        "--desired_replication_factor", "2",
+    )
+    assert rc == 1 and "positive --partition_count" in err
