@@ -460,8 +460,7 @@ def leadership_order(
     return ordered.reshape(p_pad, rf), counters
 
 
-def _solve_one_topic(
-    counters: jnp.ndarray,
+def _place_one_topic(
     current: jnp.ndarray,
     jhash: jnp.ndarray,
     p_real: jnp.ndarray,
@@ -470,12 +469,16 @@ def _solve_one_topic(
     n: int,
     rf: int,
     wave_mode: str = "auto",
-    use_pallas: bool = False,
     rf_actual: jnp.ndarray | None = None,  # traced per-topic RF (mixed-RF sweeps)
-) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
-    """One topic's pipeline: sticky fill → wave spread → leadership order.
-    Shared by the single-topic, batched (scan), and what-if (vmap over
-    ``alive``) entry points so they cannot drift.
+) -> Tuple[AssignState, jnp.ndarray]:
+    """One topic's *placement* (sticky fill → wave spread).
+
+    Placement is independent of the leadership counters, so in principle a
+    batched caller could vmap it; measured on CPU that loses badly (the
+    chained-fallback lax.cond lowers to select under vmap and runs every leg
+    for every topic), so today every caller goes through _solve_one_topic's
+    sequential pipeline. Re-evaluate with real-chip numbers before wiring a
+    vmapped path.
 
     Capacity ``ceil(P*RF/N_alive)`` (``KafkaAssignmentStrategy.java:65-71``),
     the rotation start ``abs(hash) % N_alive`` (``:188-200``) and the rotated
@@ -495,7 +498,16 @@ def _solve_one_topic(
     state = sticky_fill(current, rack_idx, rf, cap, n, p_real, alive, rf_actual)
     sticky_kept = jnp.sum(state.acc_count)
     state = spread_orphans(state, rack_idx, pos, cap, n, alive, wave_mode)
+    return state, sticky_kept
 
+
+def _order_one_topic(
+    counters: jnp.ndarray,
+    state: AssignState,
+    jhash: jnp.ndarray,
+    rf: int,
+    use_pallas: bool,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     if use_pallas:
         # Opt-in TPU kernel: VMEM-resident counters, no per-partition scan
         # overhead; bit-identical to leadership_order (see module docstring).
@@ -503,13 +515,35 @@ def _solve_one_topic(
         # from the vmapped what-if path).
         from .pallas_leadership import leadership_order_pallas
 
-        ordered, counters = leadership_order_pallas(
+        return leadership_order_pallas(
             state.acc_nodes, state.acc_count, counters, jhash, rf
         )
-    else:
-        ordered, counters = leadership_order(
-            state.acc_nodes, state.acc_count, counters, jhash, rf
-        )
+    ordered, counters = leadership_order(
+        state.acc_nodes, state.acc_count, counters, jhash, rf
+    )
+    return ordered, counters
+
+
+def _solve_one_topic(
+    counters: jnp.ndarray,
+    current: jnp.ndarray,
+    jhash: jnp.ndarray,
+    p_real: jnp.ndarray,
+    rack_idx: jnp.ndarray,
+    alive: jnp.ndarray,
+    n: int,
+    rf: int,
+    wave_mode: str = "auto",
+    use_pallas: bool = False,
+    rf_actual: jnp.ndarray | None = None,
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
+    """One topic's full pipeline (placement + leadership), shared by the
+    single-topic, batched (scan over topics), fresh-placement, and what-if
+    (vmap over ``alive``) entry points so their semantics cannot drift."""
+    state, sticky_kept = _place_one_topic(
+        current, jhash, p_real, rack_idx, alive, n, rf, wave_mode, rf_actual
+    )
+    ordered, counters = _order_one_topic(counters, state, jhash, rf, use_pallas)
     return counters, (ordered, state.infeasible, state.deficit, sticky_kept)
 
 
@@ -584,6 +618,7 @@ def solve_batched(
         per_topic, counters, (currents, jhashes, p_reals, rfs)
     )
     return ordered, counters, infeasible, deficits, kept
+
 
 
 solve_batched_jit = jax.jit(
