@@ -14,7 +14,7 @@ from __future__ import annotations
 import json
 import os
 import sys
-from typing import Dict, Iterable, List, Optional, Sequence, Set, TextIO
+from typing import Dict, List, Optional, Sequence, Set, TextIO
 
 from .assigner import TopicAssigner
 from .solvers.base import Context
