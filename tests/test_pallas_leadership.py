@@ -79,3 +79,20 @@ def test_flag_routing_is_per_call(monkeypatch):
     monkeypatch.delenv("KA_PALLAS_LEADERSHIP")
     TopicAssigner("tpu").generate_assignment("flag-off", current, live, {}, -1)
     assert not seen, "kernel ran with the flag unset"
+
+
+def test_batched_solve_with_pallas_flag(monkeypatch):
+    # The kernel also runs inside the batched scan (assign_many); results must
+    # match the XLA-scan batched path bit-for-bit.
+    from kafka_assigner_tpu.assigner import TopicAssigner
+
+    current = {p: [30 + (p + i) % 8 for i in range(3)] for p in range(10)}
+    live = set(range(30, 40))
+    racks = {b: f"r{b % 5}" for b in live}
+    topics = [(f"t{i}", current) for i in range(4)]
+
+    monkeypatch.setenv("KA_PALLAS_LEADERSHIP", "1")
+    with_pallas = TopicAssigner("tpu").generate_assignments(topics, live, racks, -1)
+    monkeypatch.delenv("KA_PALLAS_LEADERSHIP")
+    without = TopicAssigner("tpu").generate_assignments(topics, live, racks, -1)
+    assert with_pallas == without
