@@ -10,8 +10,7 @@ from kafka_assigner_tpu.ops.assignment import leadership_order
 from kafka_assigner_tpu.ops.pallas_leadership import leadership_order_pallas
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2])
-@pytest.mark.parametrize("rf", [1, 2, 3, 4])
+@pytest.mark.parametrize("seed,rf", [(0, 1), (0, 2), (0, 3), (1, 3), (0, 4)])
 def test_kernel_matches_xla(seed, rf):
     rng = np.random.default_rng(seed)
     p, n = 40, 32
