@@ -173,13 +173,17 @@ def estimate_removal_scenarios(
     replication_factor: int = -1,
     mesh=None,
 ) -> List[Tuple[Tuple[int, ...], float]]:
-    """Relaxed (entropic-transport) movement estimates for a wide scenario
-    scan — the cheap front half before exact solves confirm a shortlist.
+    """Relaxed (entropic-transport) movement estimates for a scenario scan.
 
     Returns ``[(removed, estimated_moved), ...]`` in input order. Estimates
     rank scenarios reliably but sit slightly above the exact optimum (see
     ``ops.sinkhorn.movement_estimate``); they know nothing of rack
     feasibility.
+
+    Measured note: at BASELINE-config-5 shapes the *exact* sweep is cheaper
+    than this relaxation (integer waves beat 24 Sinkhorn iterations of dense
+    (P x N) logsumexps), so prefer ``evaluate_removal_scenarios`` unless you
+    specifically want the differentiable/fractional signal.
     """
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec
