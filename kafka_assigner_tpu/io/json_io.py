@@ -39,6 +39,7 @@ def format_reassignment_json(
     return json.dumps(
         {"version": KAFKA_FORMAT_VERSION, "partitions": partitions},
         separators=(",", ":"),
+        ensure_ascii=False,  # org.json writes non-ASCII raw
     )
 
 
@@ -57,6 +58,7 @@ def format_reassignment_pairs(
     return json.dumps(
         {"version": KAFKA_FORMAT_VERSION, "partitions": partitions},
         separators=(",", ":"),
+        ensure_ascii=False,  # org.json writes non-ASCII raw
     )
 
 
@@ -85,4 +87,4 @@ def format_brokers_json(brokers: Sequence[BrokerInfo]) -> str:
         if b.rack is not None:
             entry["rack"] = b.rack
         entries.append(entry)
-    return json.dumps(entries, separators=(",", ":"))
+    return json.dumps(entries, separators=(",", ":"), ensure_ascii=False)

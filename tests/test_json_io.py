@@ -53,3 +53,12 @@ def test_brokers_json_rack_optional():
         '[{"id":1,"host":"h1","port":9092,"rack":"r1"},'
         '{"id":2,"host":"h2","port":9092}]'
     )
+
+
+def test_non_ascii_passes_through_raw():
+    # org.json (the reference's serializer) writes non-ASCII raw, not \uXXXX
+    # escaped; Kafka restricts topic names to ASCII, but host names and any
+    # future fields must round-trip identically.
+    payload = format_reassignment_json({"tøpic": {0: [1]}})
+    assert "tøpic" in payload and "\\u" not in payload
+    assert parse_reassignment_json(payload) == {"tøpic": {0: [1]}}
