@@ -216,3 +216,30 @@ def test_partitions_superset_of_current_assignment():
     )
     assert set(out) == {0, 1, 2}
     assert all(len(r) == 2 for r in out.values())
+
+
+def test_rf_decrease_clamps_to_uniform_rf():
+    # Documented divergence (solvers/tpu.py header): on an RF decrease the
+    # TPU solver emits exactly RF replicas per partition, where the reference
+    # (and the bug-compatible greedy oracle) can retain more.
+    current = {0: [10, 11, 12], 1: [11, 12, 13], 2: [12, 13, 10], 3: [13, 10, 11]}
+    brokers = {10, 11, 12, 13}
+    new = TopicAssigner("tpu").generate_assignment("test", current, brokers, {}, 2)
+    assert all(len(r) == 2 for r in new.values())
+    # every partition keeps at least one old replica (stickiness)
+    for p, r in new.items():
+        assert set(r) & set(current[p])
+
+
+def test_rf_increase_across_width_bucket():
+    # Desired RF far above the historical replica-list width: sticky keeps the
+    # old replicas, orphan waves fill the rest, racks stay diverse.
+    current = {p: [20 + p % 4, 20 + (p + 1) % 4] for p in range(8)}
+    brokers = set(range(20, 30))
+    racks = {b: f"r{b % 5}" for b in brokers}
+    new = TopicAssigner("tpu").generate_assignment("grow", current, brokers, racks, 5)
+    from .helpers import verify_full_invariants
+
+    verify_full_invariants(new, racks, sorted(brokers), 5)
+    for p, r in new.items():
+        assert set(current[p]) <= set(r)  # pure growth: nothing moved
