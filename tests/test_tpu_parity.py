@@ -243,3 +243,25 @@ def test_rf_increase_across_width_bucket():
     verify_full_invariants(new, racks, sorted(brokers), 5)
     for p, r in new.items():
         assert set(current[p]) <= set(r)  # pure growth: nothing moved
+
+
+def test_batched_heterogeneous_topic_sizes():
+    # One batched call with very different partition counts: everything pads
+    # to the group-wide bucket, padded rows stay inert, and the result equals
+    # the serial per-topic loop exactly.
+    live = set(range(50, 70))
+    racks = {b: f"r{b % 5}" for b in live}
+    topics = []
+    for name, p_count in (("tiny", 3), ("small", 17), ("large", 120)):
+        cur = {p: [50 + (p + i) % 20 for i in range(3)] for p in range(p_count)}
+        topics.append((name, cur))
+
+    serial = TopicAssigner("tpu")
+    expected = [
+        (t, serial.generate_assignment(t, cur, live, racks, -1))
+        for t, cur in topics
+    ]
+    batched = TopicAssigner("tpu")
+    got = batched.generate_assignments(topics, live, racks, -1)
+    assert got == expected
+    assert batched.context.counter == serial.context.counter
