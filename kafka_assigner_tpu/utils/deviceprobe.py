@@ -1,0 +1,65 @@
+"""Wedged-accelerator self-defense, shared by ``bench.py`` and
+``__graft_entry__.py``.
+
+A tunneled TPU chip can wedge so that PJRT backend init hangs forever — and
+because jax eagerly initializes every *registered* plugin, even
+``JAX_PLATFORMS=cpu`` runs hang at ``jax.devices()`` while the plugin's site
+dir (``axon``) is importable. The recipe that works (learned the hard way in
+round 1):
+
+1. probe device init in a *subprocess* under a watchdog (the hang must not
+   reach the calling process);
+2. on failure, re-run on the CPU backend with the plugin's site dir stripped
+   from ``PYTHONPATH`` — and, when a virtual mesh is needed, with
+   ``--xla_force_host_platform_device_count=<n>``.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Dict, Optional, Sequence
+
+
+def probe_device_count(timeout_s: float) -> int:
+    """Device count a fresh interpreter sees with the current env, -1 on
+    wedge/failure. Init can legitimately take ~20-40s on first TPU contact;
+    pick ``timeout_s`` above that."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+        if proc.returncode == 0:
+            return int(proc.stdout.strip().splitlines()[-1])
+    except (subprocess.TimeoutExpired, ValueError, IndexError):
+        pass
+    return -1
+
+
+def virtual_cpu_env(
+    n_devices: Optional[int] = None,
+    prepend_path: Sequence[str] = (),
+) -> Dict[str, str]:
+    """Env for a CPU-backend re-run with the TPU plugin unregistered.
+
+    ``n_devices``: when set, force an n-device virtual CPU platform (for mesh
+    code); when None, leave the device count alone (single CPU device).
+    ``prepend_path``: entries to put at the front of ``PYTHONPATH`` (e.g. the
+    repo root so the re-exec'd script still finds its package).
+    """
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        xla = [
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "host_platform_device_count" not in f
+        ]
+        xla.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(xla)
+    env["PYTHONPATH"] = ":".join(
+        p
+        for p in [*prepend_path, *env.get("PYTHONPATH", "").split(":")]
+        if p and "axon" not in p
+    )
+    return env
