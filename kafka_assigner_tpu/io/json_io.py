@@ -1,16 +1,36 @@
 """Kafka reassignment-JSON formatting, byte-compatible with the reference.
 
-Two producers exist in the reference and both must round-trip through Kafka's
-``kafka-reassign-partitions`` tool (``README.md:52``):
+Two distinct serializers exist in the reference, and their bytes differ:
 
-- PRINT_CURRENT_ASSIGNMENT delegates to Kafka's own
-  ``zkUtils.formatAsReassignmentJson`` (``KafkaAssignmentGenerator.java:108-110``);
-- PRINT_REASSIGNMENT hand-builds ``{"version":1,"partitions":[{topic,partition,
-  replicas}...]}`` with org.json (``KafkaAssignmentGenerator.java:169-186``).
+- PRINT_CURRENT_ASSIGNMENT (and the rollback section of mode 3) delegates to
+  Kafka's own ``zkUtils.formatAsReassignmentJson``
+  (``KafkaAssignmentGenerator.java:108-110``). Kafka 0.10's
+  ``kafka.utils.Json.encode`` walks small Scala immutable Maps in insertion
+  order, so the bytes are ``{"version":1,"partitions":[{"topic":…,
+  "partition":…,"replicas":[…]},…]}`` — *insertion* key order, compact, raw
+  strings. :func:`format_reassignment_json` reproduces that.
+- PRINT_REASSIGNMENT's "NEW ASSIGNMENT" and PRINT_CURRENT_BROKERS hand-build
+  JSON with org.json 20131018 (``KafkaAssignmentGenerator.java:113-129,
+  169-186``), whose ``JSONObject`` stores keys in a ``java.util.HashMap`` —
+  ``toString()`` therefore walks **HashMap bucket order**, not insertion
+  order. For a default-capacity-16 JDK8 HashMap (bucket =
+  ``(h ^ h>>>16) & 15`` over ``String.hashCode``, see ``utils/javahash.py``):
 
-We emit one canonical compact form for both: key order ``version, partitions``
-and ``topic, partition, replicas``, no whitespace — the shape Kafka's parser
-accepts and the reference's org.json ``toString()`` emits.
+  ============================  =================================
+  inserted                      org.json/JDK8 emission order
+  ============================  =================================
+  version, partitions           ``partitions, version``
+  topic, partition, replicas    ``partition, replicas, topic``
+  id, host, port[, rack]        ``[rack, ]port, host, id``
+  ============================  =================================
+
+  :func:`format_reassignment_pairs` and :func:`format_brokers_json` reproduce
+  those bytes (``tests/test_golden_output.py`` pins them). JDK7's HashMap
+  spreads hashes differently, so the reference's own bytes vary by JVM; we
+  pin the JDK8 order, the standard runtime of the Kafka-0.10 era.
+
+Every form round-trips through Kafka's ``kafka-reassign-partitions`` parser
+(``README.md:52``), which accepts any key order.
 """
 from __future__ import annotations
 
@@ -46,17 +66,21 @@ def format_reassignment_json(
 def format_reassignment_pairs(
     pairs: Sequence,  # [(topic, {partition: [replicas]}), ...], duplicates allowed
 ) -> str:
-    """Like :func:`format_reassignment_json` but over an ordered list of
-    (topic, assignment) pairs — the shape the reassignment driver produces,
-    where a topic listed twice on the CLI is solved and emitted twice
-    (reference topic loop, ``KafkaAssignmentGenerator.java:173-183``)."""
+    """The "NEW ASSIGNMENT" payload over an ordered list of (topic,
+    assignment) pairs — the shape the reassignment driver produces, where a
+    topic listed twice on the CLI is solved and emitted twice (reference
+    topic loop, ``KafkaAssignmentGenerator.java:173-183``).
+
+    Byte-matches org.json's ``toString()`` on JDK8 (see module docstring):
+    array order is insertion order (topics in CLI order, partitions ascending
+    — TreeMap semantics), object key order is HashMap bucket order."""
     partitions = [
-        {"topic": t, "partition": p, "replicas": list(assignment[p])}
+        {"partition": p, "replicas": list(assignment[p]), "topic": t}
         for t, assignment in pairs
         for p in sorted(assignment)
     ]
     return json.dumps(
-        {"version": KAFKA_FORMAT_VERSION, "partitions": partitions},
+        {"partitions": partitions, "version": KAFKA_FORMAT_VERSION},
         separators=(",", ":"),
         ensure_ascii=False,  # org.json writes non-ASCII raw
     )
@@ -78,13 +102,14 @@ def parse_reassignment_json(payload: str) -> Dict[str, Dict[int, List[int]]]:
 
 
 def format_brokers_json(brokers: Sequence[BrokerInfo]) -> str:
-    """PRINT_CURRENT_BROKERS payload: JSON array of ``{id, host, port, rack?}``
-    per live broker, rack omitted when undefined
-    (``KafkaAssignmentGenerator.java:113-129``)."""
+    """PRINT_CURRENT_BROKERS payload: JSON array, one object per live broker,
+    rack omitted when undefined (``KafkaAssignmentGenerator.java:113-129``).
+
+    Key order is org.json-on-JDK8 bucket order (module docstring):
+    ``rack`` (when defined), ``port``, ``host``, ``id``."""
     entries = []
     for b in brokers:
-        entry = {"id": b.id, "host": b.host, "port": b.port}
-        if b.rack is not None:
-            entry["rack"] = b.rack
+        entry = {} if b.rack is None else {"rack": b.rack}
+        entry.update({"port": b.port, "host": b.host, "id": b.id})
         entries.append(entry)
     return json.dumps(entries, separators=(",", ":"), ensure_ascii=False)

@@ -1,0 +1,136 @@
+"""Golden byte-parity fixtures: full stdout (banners included) diffed
+byte-for-byte against recorded reference-tool output.
+
+The reference jar cannot run in this image (no Maven deps, no JVM network),
+so the fixtures are *derived* recordings, hand-computed from the reference's
+two serializers and pinned as files under ``tests/golden/``:
+
+- "CURRENT ASSIGNMENT" sections: Kafka 0.10's
+  ``zkUtils.formatAsReassignmentJson`` → ``kafka.utils.Json.encode``, which
+  walks small Scala immutable Maps in insertion order
+  (``{"version":…,"partitions":…}``, ``{"topic":…,"partition":…,
+  "replicas":…}``), compact, raw strings.
+- "NEW ASSIGNMENT" / "CURRENT BROKERS" sections: org.json 20131018
+  ``toString()`` (``KafkaAssignmentGenerator.java:113-129,169-186``), which
+  walks ``java.util.HashMap`` bucket order — on JDK8 that is
+  ``partitions,version`` / ``partition,replicas,topic`` /
+  ``[rack,]port,host,id`` (derivation in ``io/json_io.py``; JDK7 buckets
+  differently, so the reference's own bytes are JVM-dependent and we pin the
+  JDK8 order).
+- Replica contents in ``mode3_steady_state.txt`` are hand-traced through the
+  reference greedy: sticky fill keeps the steady-state assignment
+  (``KafkaAssignmentStrategy.java:101-131``) and leadership rotation for
+  topic "x" (``abs(hash)=120``) starts at index 0. The richer
+  ``mode3_replacement.txt`` replica lists come from the bit-faithful greedy
+  oracle (``solvers/greedy.py``, differential-tested against the Java
+  semantics in ``test_strategy_scenarios.py`` / ``test_greedy_semantics.py``).
+
+Known divergence, on purpose: in the reference, the *entry order* of mode 1's
+partitions array is the iteration order of a ``scala.collection.mutable
+.HashMap[TopicAndPartition, _]`` (``ZkUtils.getReplicaAssignmentForTopics``)
+— arbitrary and unstable across Scala versions. We emit topics in request
+order with partitions ascending instead; fixtures use assignments where that
+order is well-defined or singleton. See PARITY.md.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from kafka_assigner_tpu.cli import run_tool
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
+
+def golden(name: str) -> str:
+    with open(os.path.join(GOLDEN_DIR, name), "r", encoding="utf-8") as f:
+        return f.read()
+
+
+@pytest.fixture()
+def steady_snapshot(tmp_path):
+    """1 topic x 1 partition x RF=2 over 2 rackless brokers: every byte of
+    modes 1 and 3 is hand-derivable (sticky keeps all; rotation start 0)."""
+    cluster = {
+        "brokers": [
+            {"id": 1, "host": "h1", "port": 9092},
+            {"id": 2, "host": "h2", "port": 9092},
+        ],
+        "topics": {"x": {"0": [1, 2]}},
+    }
+    path = tmp_path / "steady.json"
+    path.write_text(json.dumps(cluster))
+    return str(path)
+
+
+@pytest.fixture()
+def replacement_snapshot(tmp_path):
+    """Broker 3 replaced by 4 (racks a/b/c): canonical replacement run."""
+    cluster = {
+        "brokers": [
+            {"id": 1, "host": "h1", "port": 9092, "rack": "a"},
+            {"id": 2, "host": "h2", "port": 9092, "rack": "b"},
+            {"id": 4, "host": "h4", "port": 9092, "rack": "c"},
+        ],
+        "topics": {
+            "events": {
+                str(p): [1 + (p + i) % 3 for i in range(2)] for p in range(4)
+            },
+            "logs": {
+                str(p): [1 + (p + i) % 3 for i in range(2)] for p in range(2)
+            },
+        },
+    }
+    path = tmp_path / "replacement.json"
+    path.write_text(json.dumps(cluster))
+    return str(path)
+
+
+def _stdout(capsys, *argv) -> str:
+    rc = run_tool(list(argv))
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    return out
+
+
+def test_golden_mode1_current_assignment(capsys, steady_snapshot):
+    out = _stdout(
+        capsys, "--zk_string", steady_snapshot,
+        "--mode", "PRINT_CURRENT_ASSIGNMENT",
+    )
+    assert out == golden("mode1_single_partition.txt")
+
+
+def test_golden_mode2_brokers(capsys, tmp_path):
+    cluster = {
+        "brokers": [
+            {"id": 1, "host": "h1", "port": 9092, "rack": "a"},
+            {"id": 2, "host": "h2", "port": 9092},
+        ],
+        "topics": {},
+    }
+    path = tmp_path / "brokers.json"
+    path.write_text(json.dumps(cluster))
+    out = _stdout(
+        capsys, "--zk_string", str(path), "--mode", "PRINT_CURRENT_BROKERS"
+    )
+    assert out == golden("mode2_brokers.txt")
+
+
+@pytest.mark.parametrize("solver", ["greedy", "tpu"])
+def test_golden_mode3_steady_state(capsys, steady_snapshot, solver):
+    out = _stdout(
+        capsys, "--zk_string", steady_snapshot,
+        "--mode", "PRINT_REASSIGNMENT", "--solver", solver,
+    )
+    assert out == golden("mode3_steady_state.txt")
+
+
+def test_golden_mode3_replacement(capsys, replacement_snapshot):
+    out = _stdout(
+        capsys, "--zk_string", replacement_snapshot,
+        "--mode", "PRINT_REASSIGNMENT", "--solver", "greedy",
+    )
+    assert out == golden("mode3_replacement.txt")

@@ -15,12 +15,26 @@ from kafka_assigner_tpu.io.json_io import (
 
 def test_reassignment_json_shape_and_compactness():
     payload = format_reassignment_json({"t": {1: [3, 1], 0: [1, 2]}})
-    # Compact (org.json toString has no whitespace), version first,
-    # partitions ascending, replica order preserved (leadership order!).
+    # Kafka's Json.encode byte order (scala Map insertion order): version
+    # first, topic/partition/replicas; compact, partitions ascending, replica
+    # order preserved (leadership order!).
     assert payload == (
         '{"version":1,"partitions":['
         '{"topic":"t","partition":0,"replicas":[1,2]},'
         '{"topic":"t","partition":1,"replicas":[3,1]}]}'
+    )
+
+
+def test_new_assignment_pairs_orgjson_byte_order():
+    # org.json on JDK8 walks HashMap bucket order: partitions before version,
+    # and partition/replicas/topic within an entry (json_io module docstring).
+    from kafka_assigner_tpu.io.json_io import format_reassignment_pairs
+
+    payload = format_reassignment_pairs([("t", {1: [3, 1], 0: [1, 2]})])
+    assert payload == (
+        '{"partitions":['
+        '{"partition":0,"replicas":[1,2],"topic":"t"},'
+        '{"partition":1,"replicas":[3,1],"topic":"t"}],"version":1}'
     )
 
 
@@ -45,13 +59,14 @@ def test_parse_rejects_bad_version():
 
 
 def test_brokers_json_rack_optional():
-    # rack key present iff defined (KafkaAssignmentGenerator.java:122-124).
+    # rack key present iff defined (KafkaAssignmentGenerator.java:122-124);
+    # key order is org.json-on-JDK8 bucket order.
     payload = format_brokers_json(
         [BrokerInfo(1, "h1", 9092, "r1"), BrokerInfo(2, "h2", 9092, None)]
     )
     assert payload == (
-        '[{"id":1,"host":"h1","port":9092,"rack":"r1"},'
-        '{"id":2,"host":"h2","port":9092}]'
+        '[{"rack":"r1","port":9092,"host":"h1","id":1},'
+        '{"port":9092,"host":"h2","id":2}]'
     )
 
 
