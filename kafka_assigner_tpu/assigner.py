@@ -16,6 +16,34 @@ from typing import Dict, List, Mapping, Sequence, Set, Tuple
 from .solvers.base import Context, Solver, get_solver
 
 
+def infer_topic_rf(
+    topic: str,
+    current_assignment: Mapping[int, Sequence[int]],
+    desired_replication_factor: int,
+) -> int:
+    """RF inference with the uniformity assertion
+    (``KafkaTopicAssigner.java:49-62``): a negative desired RF means "keep the
+    existing one", which is only well-defined when every partition agrees.
+
+    Returns the desired RF unchanged (possibly negative) when the assignment
+    is empty — callers that tolerate unknown RF (sweeps, validation) skip
+    those topics; ``TopicAssigner`` turns it into the positivity error.
+
+    Shared by the assigner, the what-if sweep, and feasibility validation so
+    no path silently picks an arbitrary partition's RF.
+    """
+    replication_factor = desired_replication_factor
+    for partition, replicas in sorted(current_assignment.items()):
+        if replication_factor < 0:
+            replication_factor = len(replicas)
+        elif desired_replication_factor < 0 and replication_factor != len(replicas):
+            raise ValueError(
+                f"Topic {topic} has partition {partition} with unexpected "
+                f"replication factor {len(replicas)}"
+            )
+    return replication_factor
+
+
 class TopicAssigner:
     """Generates a minimal-movement assignment for one topic at a time.
 
@@ -37,15 +65,9 @@ class TopicAssigner:
         desired_replication_factor: int,
     ) -> int:
         """RF inference + validation (``KafkaTopicAssigner.java:49-69``)."""
-        replication_factor = desired_replication_factor
-        for partition, replicas in sorted(current_assignment.items()):
-            if replication_factor < 0:
-                replication_factor = len(replicas)
-            elif desired_replication_factor < 0 and replication_factor != len(replicas):
-                raise ValueError(
-                    f"Topic {topic} has partition {partition} with unexpected "
-                    f"replication factor {len(replicas)}"
-                )
+        replication_factor = infer_topic_rf(
+            topic, current_assignment, desired_replication_factor
+        )
         if replication_factor <= 0:
             raise ValueError(
                 f"Topic {topic} does not have a positive replication factor!"

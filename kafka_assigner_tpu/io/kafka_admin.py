@@ -4,8 +4,17 @@ predates this and only speaks ZK, ``pom.xml:50-58``).
 
 Gated on ``confluent_kafka`` or ``kafka-python``; raises a clear error when
 neither is installed. Offline runs should use the snapshot backend.
+
+Caveat: confluent-kafka's AdminClient metadata does not expose broker racks,
+so that path is **rack-blind** — every broker degenerates to its own rack
+(the reference's missing-rack fallback, ``KafkaAssignmentStrategy.java:84-87``)
+and rack diversity is no longer guaranteed. ``brokers()`` emits a loud stderr
+warning when this happens; use the zk:// or file:// backends (or
+kafka-python, whose ``describe_cluster`` carries racks) when racks matter.
 """
 from __future__ import annotations
+
+import sys
 
 from typing import Dict, List, Sequence
 
@@ -15,6 +24,7 @@ from .base import BrokerInfo
 class KafkaAdminBackend:
     def __init__(self, bootstrap_servers: str) -> None:
         self._impl = None
+        self._warned_rack_blind = False
         try:
             from confluent_kafka.admin import AdminClient  # type: ignore
 
@@ -36,6 +46,16 @@ class KafkaAdminBackend:
     def brokers(self) -> List[BrokerInfo]:
         if self._impl == "confluent":
             md = self._admin.list_topics(timeout=10)
+            if not self._warned_rack_blind:
+                self._warned_rack_blind = True
+                print(
+                    "WARNING: confluent-kafka's AdminClient metadata carries "
+                    "no broker rack info; every broker is treated as its own "
+                    "rack and rack-aware assignment CANNOT guarantee rack "
+                    "diversity. Use the zk:// or file:// backend (or install "
+                    "kafka-python) when racks matter.",
+                    file=sys.stderr,
+                )
             return [
                 BrokerInfo(id=b.id, host=b.host, port=b.port, rack=None)
                 for b in sorted(md.brokers.values(), key=lambda b: b.id)

@@ -221,12 +221,30 @@ def context_to_array(ctx: Context, enc: ProblemEncoding) -> np.ndarray:
     """Materialize the cross-topic leadership counters
     (``KafkaAssignmentStrategy.java:360-369``) as a dense (N_pad, RF) slab for
     the solve; slots beyond RF stay in the dict untouched."""
+    # The on-device leadership key is ``count * m + rotated_pos`` (m <= RF)
+    # sharing int32 space with the BIG taken/padded sentinel
+    # (ops/assignment.py:leadership_order). Counters persisted across runs via
+    # --leadership_context grow unboundedly; past the key space a taken
+    # candidate could win the argmin, silently corrupting preference order —
+    # so refuse at encode time. Counters also grow DURING the run (one
+    # increment per placed replica), so reserve headroom of 2^24 (~16.7M
+    # placements — two orders of magnitude beyond the 200k-partition headline)
+    # on top of the hard bound.
+    limit = (0x3FFFFFFF - enc.rf) // max(enc.rf, 1) - (1 << 24)
     counters = np.zeros((enc.n_pad, enc.rf), dtype=np.int32)
     for i, b in enumerate(enc.broker_ids):
         per_node = ctx.counter.get(int(b))
         if per_node:
             for slot in range(enc.rf):
-                counters[i, slot] = per_node.get(slot, 0)
+                c = per_node.get(slot, 0)
+                if c > limit:
+                    raise ValueError(
+                        f"leadership counter for broker {int(b)} slot {slot} "
+                        f"({c}) exceeds the solver's key space ({limit}); the "
+                        "persisted --leadership_context has grown too large — "
+                        "start from a fresh context"
+                    )
+                counters[i, slot] = c
     return counters
 
 

@@ -656,12 +656,19 @@ def whatif_sweep(
     # lowers to select and both branches would execute for every scenario.
     # Stranded scenarios are re-run in dense mode by the caller.
     def one_scenario(alive):
-        ordered, _, infeasible, _, kept = solve_batched(
+        ordered, _, infeasible, _, _ = solve_batched(
             currents, rack_idx, counters0, jhashes, p_reals, n, rf, alive,
             wave_mode, False, rfs,
         )
-        total = jnp.sum(p_reals * rfs)
-        moved = total - jnp.sum(kept)
+        # True moved-replica metric: membership diff of the final assignment
+        # vs the current matrix. (The sticky_kept proxy over-counts: an orphan
+        # the wave auction happens to land on a broker from the partition's
+        # old replica list is not a move.) XLA fuses the (B,P,RF,L) compare
+        # into the reduction, so nothing big materializes.
+        in_old = jnp.any(
+            ordered[:, :, :, None] == currents[:, :, None, :], axis=-1
+        )
+        moved = jnp.sum((ordered >= 0) & ~in_old)
         # Node loads across every topic's final assignment.
         safe = jnp.where(ordered >= 0, ordered, rack_idx.shape[0])
         loads = jnp.zeros(rack_idx.shape[0] + 1, dtype=jnp.int32).at[safe].add(1)

@@ -23,15 +23,15 @@ from ..models.problem import (
 
 def _topic_rfs(items, replication_factor):
     """Per-topic RF: the desired override, else inferred from each topic's
-    own replica lists (clusters routinely mix RFs). Topics with no partitions
-    are skipped by callers (they contribute nothing to any scenario)."""
-    out = []
-    for _, cur in items:
-        if replication_factor >= 0:
-            out.append(replication_factor)
-        else:
-            out.append(len(next(iter(cur.values()))) if cur else 0)
-    return out
+    own replica lists (clusters routinely mix RFs) with the assigner's
+    uniformity assertion — a topic with non-uniform replica lists raises
+    instead of silently adopting an arbitrary partition's RF. Topics with no
+    partitions are skipped by callers (rf <= 0 contributes nothing)."""
+    from ..assigner import infer_topic_rf
+
+    return [
+        infer_topic_rf(topic, cur, replication_factor) for topic, cur in items
+    ]
 
 
 @dataclass
@@ -161,83 +161,6 @@ def evaluate_removal_scenarios(
             feasible=not bool(infeasible[s]),
             max_node_load=int(max_load[s]),
         )
-        for s in range(s_real)
-    ]
-
-
-def estimate_removal_scenarios(
-    topic_assignments: Mapping[str, Mapping[int, Sequence[int]]],
-    brokers: Set[int],
-    rack_assignment: Mapping[int, str],
-    scenarios: Sequence[Sequence[int]],
-    replication_factor: int = -1,
-    mesh=None,
-) -> List[Tuple[Tuple[int, ...], float]]:
-    """Relaxed (entropic-transport) movement estimates for a scenario scan.
-
-    Returns ``[(removed, estimated_moved), ...]`` in input order. Estimates
-    rank scenarios reliably but sit slightly above the exact optimum (see
-    ``ops.sinkhorn.movement_estimate``); they know nothing of rack
-    feasibility.
-
-    Measured note: at BASELINE-config-5 shapes the *exact* sweep is cheaper
-    than this relaxation (integer waves beat 24 Sinkhorn iterations of dense
-    (P x N) logsumexps), so prefer ``evaluate_removal_scenarios`` unless you
-    specifically want the differentiable/fractional signal.
-    """
-    import jax.numpy as jnp
-    from jax.sharding import PartitionSpec
-
-    from ..ops.sinkhorn import relaxed_movement_sweep_jit
-    from .mesh import fetch_global, put_sharded
-
-    all_items = list(topic_assignments.items())
-    all_rfs = _topic_rfs(all_items, replication_factor)
-    items = [it for it, r in zip(all_items, all_rfs) if r > 0 and it[1]]
-    topic_rfs = [r for it, r in zip(all_items, all_rfs) if r > 0 and it[1]]
-    if not items or not scenarios:
-        return []
-    rf = max(topic_rfs)
-    p_pad, width = group_pads([cur for _, cur in items])
-    cluster = encode_cluster(rack_assignment, brokers)
-    encs = [
-        encode_problem(t, cur, rack_assignment, brokers, set(cur), t_rf,
-                       p_pad_override=p_pad, width_override=width,
-                       cluster=cluster)
-        for (t, cur), t_rf in zip(items, topic_rfs)
-    ]
-    b_pad = batch_bucket(len(encs))
-    currents = np.full((b_pad, p_pad, width), -1, dtype=np.int32)
-    p_reals = np.zeros(b_pad, dtype=np.int32)
-    rfs = np.zeros(b_pad, dtype=np.int32)
-    for i, (e, t_rf) in enumerate(zip(encs, topic_rfs)):
-        currents[i] = e.current
-        p_reals[i] = e.p
-        rfs[i] = t_rf
-
-    s_real = len(scenarios)
-    s_pad = batch_bucket(s_real)
-    alive = np.zeros((s_pad, cluster.n_pad), dtype=bool)
-    alive[:, : cluster.n] = True
-    for s, removed in enumerate(scenarios):
-        for b in removed:
-            idx = cluster.broker_to_idx.get(int(b))
-            if idx is None:
-                raise ValueError(f"scenario {s}: unknown broker {b}")
-            alive[s, idx] = False
-
-    if mesh is not None:
-        alive_dev = put_sharded(alive, mesh, PartitionSpec("scenarios", None))
-    else:
-        alive_dev = jnp.asarray(alive)
-    est = fetch_global(
-        relaxed_movement_sweep_jit(
-            jnp.asarray(currents), jnp.asarray(p_reals), alive_dev,
-            jnp.asarray(rfs), n=cluster.n, rf=rf,
-        )
-    )
-    return [
-        (tuple(sorted(int(b) for b in scenarios[s])), float(est[s]))
         for s in range(s_real)
     ]
 

@@ -97,11 +97,17 @@ def validate_cluster_feasibility(
     desired_replication_factor: int = -1,
 ) -> List[FeasibilityIssue]:
     """Validate every (topic, current) pair before a reassignment run."""
+    from .assigner import infer_topic_rf
+
     issues: List[FeasibilityIssue] = []
     for topic, current in topic_assignments:
-        rf = desired_replication_factor
-        if rf < 0 and current:
-            rf = len(next(iter(current.values())))
+        try:
+            rf = infer_topic_rf(topic, current, desired_replication_factor)
+        except ValueError as e:
+            # Non-uniform replica lists: report as a structural issue instead
+            # of aborting the whole validation pass.
+            issues.append(FeasibilityIssue(topic, "error", str(e)))
+            continue
         if rf <= 0:
             continue
         issues.extend(

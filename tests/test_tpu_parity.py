@@ -265,3 +265,17 @@ def test_batched_heterogeneous_topic_sizes():
     got = batched.generate_assignments(topics, live, racks, -1)
     assert got == expected
     assert batched.context.counter == serial.context.counter
+
+
+def test_oversized_context_counter_refused():
+    # ADVICE round 1: the leadership key ``count * m + rot`` shares int32
+    # space with the BIG sentinel; a persisted context grown past the key
+    # space must be refused at encode time, not silently corrupt ordering.
+    from kafka_assigner_tpu.models.problem import context_to_array, encode_problem
+    from kafka_assigner_tpu.solvers.base import Context
+
+    ctx = Context()
+    ctx.counter[1] = {0: 0x3FFFFFFF // 3}
+    enc = encode_problem("t", {0: [1, 2, 3]}, {}, {1, 2, 3}, {0}, 3)
+    with pytest.raises(ValueError, match="key space"):
+        context_to_array(ctx, enc)

@@ -42,3 +42,20 @@ def test_cluster_validation_infers_rf():
     topics = [("t", {0: [1, 2], 1: [2, 1]})]
     issues = validate_cluster_feasibility(topics, {1, 2, 3}, {1: "a", 2: "a", 3: "a"})
     assert issues and issues[0].severity == "error"
+
+
+def test_nonuniform_rf_topic_reported_not_raised():
+    # ADVICE round 1: RF inference must not silently adopt an arbitrary
+    # partition's RF; validation reports the uniformity violation as an issue.
+    brokers = {1, 2, 3}
+    issues = validate_cluster_feasibility(
+        [("bad", {0: [1, 2], 1: [1, 2, 3]}), ("good", {0: [1, 2], 1: [2, 3]})],
+        brokers,
+        {},
+    )
+    assert any(
+        i.topic == "bad" and i.severity == "error"
+        and "unexpected replication factor" in i.message
+        for i in issues
+    )
+    assert not any(i.topic == "good" and i.severity == "error" for i in issues)

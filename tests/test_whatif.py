@@ -78,3 +78,13 @@ def test_unknown_broker_in_scenario(cluster):
     topics, live, rack_map = cluster
     with pytest.raises(ValueError, match="unknown broker"):
         evaluate_removal_scenarios(topics, live, rack_map, [[999999]], 3)
+
+
+def test_whatif_nonuniform_rf_raises(cluster):
+    # ADVICE round 1: the sweep must apply the assigner's RF-uniformity
+    # assertion instead of keying off an arbitrary first partition.
+    topics, live, rack_map = cluster
+    bad = dict(topics)
+    bad["ragged"] = {0: [100, 101, 102], 1: [100, 101]}
+    with pytest.raises(ValueError, match="unexpected replication factor"):
+        evaluate_removal_scenarios(bad, live, rack_map, [[]], -1)
