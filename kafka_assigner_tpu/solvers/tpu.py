@@ -69,9 +69,22 @@ def _fresh_solve_jit(*args, **kwargs):
 
 
 class TpuSolver:
-    """Solver-protocol implementation backed by the jitted assignment kernel."""
+    """Solver-protocol implementation backed by the jitted assignment kernel.
+
+    ``mesh``: optional ``jax.sharding.Mesh`` with a ``part`` axis. When given,
+    ``assign_many`` places the batched current-assignment tensor with its
+    partition axis sharded across that mesh axis and lets GSPMD partition the
+    whole solve — the long-axis sharding story for one giant topic (the
+    sequence-parallel analogue, SURVEY.md §5). Output is bit-identical to the
+    unsharded solve (``tests/test_partition_sharding.py``); scenario-DP
+    (``parallel/whatif.py``) remains the first-choice sharding when there are
+    many independent solves to spread.
+    """
 
     name = "tpu"
+
+    def __init__(self, mesh=None) -> None:
+        self._mesh = mesh
 
     def assign(
         self,
@@ -178,6 +191,17 @@ class TpuSolver:
             p_reals[i] = e.p
 
         from ..ops.pallas_leadership import pallas_leadership_enabled
+
+        if self._mesh is not None:
+            from jax.sharding import PartitionSpec
+
+            from ..parallel.mesh import put_sharded
+
+            # Committed sharded placement: jit respects it and GSPMD
+            # partitions the solve over the partition axis.
+            currents = put_sharded(
+                currents, self._mesh, PartitionSpec(None, "part", None)
+            )
 
         with timers.phase("solve"):
             ordered, counters_after, infeasible, deficits, _ = jax.device_get(

@@ -1,0 +1,107 @@
+"""Partition-axis sharding of the EXACT solver (VERDICT round 1 #7).
+
+The ``part`` mesh axis is the long-axis analogue of sequence parallelism
+(SURVEY.md §5): the (P × N) eligibility tensors of one giant topic are
+sharded across devices and XLA/GSPMD inserts the collectives the wave
+auction's cross-partition reductions need. These tests pin that the sharded
+exact solve is bit-identical to the unsharded one — on the kernel directly
+and through the production ``TpuSolver(mesh=...)`` path.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec
+
+from kafka_assigner_tpu.ops.assignment import solve_batched, solve_batched_jit
+from kafka_assigner_tpu.parallel.mesh import build_mesh
+
+from __graft_entry__ import _example_problem
+
+
+@pytest.fixture(scope="module")
+def part_mesh():
+    assert len(jax.devices()) == 8, "conftest should provide 8 virtual devices"
+    return build_mesh(1, 8)  # every device on the part axis
+
+
+def _solve_plain(enc0, currents, jhashes, p_reals, counters):
+    return jax.device_get(
+        solve_batched_jit(
+            jnp.asarray(currents),
+            jnp.asarray(enc0.rack_idx),
+            jnp.asarray(counters),
+            jnp.asarray(jhashes),
+            jnp.asarray(p_reals),
+            n=enc0.n,
+            rf=enc0.rf,
+        )
+    )
+
+
+def _solve_part_sharded(mesh, enc0, currents, jhashes, p_reals, counters):
+    shard_p = NamedSharding(mesh, PartitionSpec(None, "part", None))
+    repl = NamedSharding(mesh, PartitionSpec())
+    fn = jax.jit(
+        functools.partial(solve_batched, n=enc0.n, rf=enc0.rf),
+        in_shardings=(shard_p, repl, repl, repl, repl),
+    )
+    return jax.device_get(
+        fn(
+            jax.device_put(jnp.asarray(currents), shard_p),
+            jax.device_put(jnp.asarray(enc0.rack_idx), repl),
+            jax.device_put(jnp.asarray(counters), repl),
+            jax.device_put(jnp.asarray(jhashes), repl),
+            jax.device_put(jnp.asarray(p_reals), repl),
+        )
+    )
+
+
+def test_partition_sharded_exact_solve_matches_unsharded(part_mesh):
+    enc0, currents, jhashes, p_reals, counters = _example_problem(
+        n_topics=4, p=256, n=64, rf=3
+    )
+    plain = _solve_plain(enc0, currents, jhashes, p_reals, counters)
+    sharded = _solve_part_sharded(
+        part_mesh, enc0, currents, jhashes, p_reals, counters
+    )
+    for a, b in zip(plain, sharded):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_partition_sharded_giant_single_topic(part_mesh):
+    # One 1024-partition topic — the "one giant topic" long-axis case.
+    enc0, currents, jhashes, p_reals, counters = _example_problem(
+        n_topics=1, p=1024, n=64, rf=3
+    )
+    plain = _solve_plain(enc0, currents, jhashes, p_reals, counters)
+    sharded = _solve_part_sharded(
+        part_mesh, enc0, currents, jhashes, p_reals, counters
+    )
+    ordered_p, _, infeasible_p, _, _ = plain
+    ordered_s, _, infeasible_s, _, _ = sharded
+    assert not np.asarray(infeasible_p).any()
+    np.testing.assert_array_equal(np.asarray(ordered_p), np.asarray(ordered_s))
+
+
+def test_tpu_solver_mesh_option_is_identical(part_mesh):
+    # Production path: TpuSolver(mesh=...) shards the partition axis via data
+    # placement; assignments must be byte-identical to the unsharded solver.
+    from kafka_assigner_tpu.assigner import TopicAssigner
+    from kafka_assigner_tpu.solvers.tpu import TpuSolver
+
+    from .test_invariants import make_cluster
+
+    current, live, rack_map = make_cluster(3, 12, 64, 3, 4)
+    topics = [(f"t{i}", current) for i in range(3)]
+    plain = TopicAssigner(TpuSolver()).generate_assignments(
+        topics, live, rack_map, -1
+    )
+    sharded = TopicAssigner(TpuSolver(mesh=part_mesh)).generate_assignments(
+        topics, live, rack_map, -1
+    )
+    assert plain == sharded
