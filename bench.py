@@ -19,9 +19,7 @@ metric name rather than hanging the driver.
 from __future__ import annotations
 
 import json
-import math
 import os
-import subprocess
 import sys
 import time
 
@@ -35,28 +33,16 @@ DEVICE_WATCHDOG_S = 180
 
 
 def build_headline():
-    """Replace-100-brokers scenario on a rack-striped 5k-broker cluster."""
-    racks = {b: f"rack{b % N_RACKS}" for b in range(N_BROKERS + REPLACED)}
-    by_rack = {}
-    for b in range(N_BROKERS):
-        by_rack.setdefault(b % N_RACKS, []).append(b)
-    inter = [
-        by_rack[r][d]
-        for d in range(math.ceil(N_BROKERS / N_RACKS))
-        for r in range(N_RACKS)
-        if d < len(by_rack[r])
-    ]
-    topics = []
-    for t in range(N_TOPICS):
-        # Each topic's P*RF replicas land on P*RF consecutive interleaved
-        # positions (all distinct brokers, rack-diverse within a partition) —
-        # the balanced steady state a healthy cluster converges to.
-        base = t * 131
-        cur = {
-            p: [inter[(base + p * RF + i) % N_BROKERS] for i in range(RF)]
-            for p in range(P_PER_TOPIC)
-        }
-        topics.append((f"topic-{t:04d}", cur))
+    """Replace-100-brokers scenario on a rack-striped 5k-broker cluster
+    (steady state from ``models/synthetic.py:rack_striped_cluster``)."""
+    from kafka_assigner_tpu.models.synthetic import rack_striped_cluster
+
+    topic_map, _, racks = rack_striped_cluster(
+        N_BROKERS, N_TOPICS, P_PER_TOPIC, RF, N_RACKS,
+        name_fmt="topic-{:04d}",  # round-1 headline names (hash → rotation)
+        extra_brokers=REPLACED,
+    )
+    topics = list(topic_map.items())
     # replace brokers 0..99 (10 per rack) with 5000..5099
     live = set(range(REPLACED, N_BROKERS)) | set(
         range(N_BROKERS, N_BROKERS + REPLACED)
@@ -65,36 +51,21 @@ def build_headline():
     return topics, live, rack_map
 
 
-def probe_device(timeout_s: float) -> bool:
-    """Check device init in a subprocess (a wedged TPU tunnel hangs forever)."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, capture_output=True,
-        )
-        return proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
-
-
 def main() -> None:
+    from kafka_assigner_tpu.utils.deviceprobe import (
+        probe_device_count,
+        virtual_cpu_env,
+    )
+
     platform_note = ""
     if os.environ.get("KA_BENCH_CPU_FALLBACK") != "1":
-        if not probe_device(DEVICE_WATCHDOG_S):
-            # A wedged TPU tunnel hangs backend init even under
-            # JAX_PLATFORMS=cpu (the registered PJRT plugin is still
-            # initialized eagerly); strip the plugin's site dir too.
-            env = dict(os.environ)
-            env["JAX_PLATFORMS"] = "cpu"
-            env["KA_BENCH_CPU_FALLBACK"] = "1"
-            env["PYTHONPATH"] = ":".join(
-                p
-                for p in (
-                    [os.path.dirname(os.path.abspath(__file__))]
-                    + env.get("PYTHONPATH", "").split(":")
-                )
-                if p and "axon" not in p
+        if probe_device_count(DEVICE_WATCHDOG_S) < 1:
+            # Wedged tunnel: re-exec on the CPU backend with the TPU plugin's
+            # site dir stripped (see utils/deviceprobe.py for the why).
+            env = virtual_cpu_env(
+                prepend_path=[os.path.dirname(os.path.abspath(__file__))]
             )
+            env["KA_BENCH_CPU_FALLBACK"] = "1"
             os.execve(sys.executable, [sys.executable] + sys.argv, env)
     else:
         platform_note = "_cpu_fallback"
@@ -134,6 +105,29 @@ def main() -> None:
     m_base, m_tpu = moved(baseline_pairs), moved(tpu_pairs)
     assert m_tpu == m_base, f"movement parity broken: tpu={m_tpu} greedy={m_base}"
 
+    # --- BASELINE config 5: 256-scenario what-if fleet (warm) ---------------
+    # Single-device here (the driver benches one chip); the 8-way-sharded
+    # variant is pinned by tests/test_config5_fleet.py on the virtual mesh.
+    config5 = {}
+    if os.environ.get("KA_BENCH_CONFIG5", "1") == "1":
+        from kafka_assigner_tpu.models.synthetic import build_config5
+        from kafka_assigner_tpu.parallel.whatif import evaluate_removal_scenarios
+
+        c5_topics, c5_live, c5_racks = build_config5()
+        c5_scenarios = [[b] for b in range(256)]
+        evaluate_removal_scenarios(c5_topics, c5_live, c5_racks, c5_scenarios, 3)
+        t0 = time.perf_counter()
+        c5_results = evaluate_removal_scenarios(
+            c5_topics, c5_live, c5_racks, c5_scenarios, 3
+        )
+        c5_ms = (time.perf_counter() - t0) * 1000.0
+        assert all(r.feasible for r in c5_results)
+        config5 = {
+            "config5_scenarios": 256,
+            "config5_warm_ms": round(c5_ms, 1),
+            "config5_ms_per_scenario": round(c5_ms / 256, 2),
+        }
+
     print(
         json.dumps(
             {
@@ -147,6 +141,7 @@ def main() -> None:
                     "tpu_cold_ms": round(cold_ms, 1),
                     "moved_replicas": int(m_tpu),
                     "total_replicas": N_TOPICS * P_PER_TOPIC * RF,
+                    **config5,
                 },
             }
         )

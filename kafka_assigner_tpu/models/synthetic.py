@@ -1,0 +1,51 @@
+"""Synthetic benchmark clusters (BASELINE.md configs).
+
+Rack-striped steady-state clusters: every partition's RF replicas sit on
+consecutive entries of a rack-interleaved broker list, so replicas are
+rack-diverse and per-node load is balanced — the state a healthy cluster
+converges to, and the honest starting point for replacement/decommission
+benchmarks (movement then measures the *change*, not pre-existing skew).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+
+def rack_striped_cluster(
+    n_brokers: int,
+    n_topics: int,
+    p_per_topic: int,
+    rf: int,
+    n_racks: int,
+    name_fmt: str = "topic-{:03d}",
+    extra_brokers: int = 0,
+) -> Tuple[Dict[str, Dict[int, List[int]]], Set[int], Dict[int, str]]:
+    """Return (topics, live_brokers, rack_map) in steady state.
+
+    ``extra_brokers``: additional broker ids (``n_brokers..n_brokers+extra-1``)
+    included in the rack map (same striping formula) but not in the live set
+    or any replica list — replacement brokers for swap scenarios."""
+    racks = {b: f"rack{b % n_racks}" for b in range(n_brokers + extra_brokers)}
+    by_rack: Dict[int, List[int]] = {}
+    for b in range(n_brokers):
+        by_rack.setdefault(b % n_racks, []).append(b)
+    inter = [
+        by_rack[r][d]
+        for d in range((n_brokers + n_racks - 1) // n_racks)
+        for r in range(n_racks)
+        if d < len(by_rack[r])
+    ]
+    topics: Dict[str, Dict[int, List[int]]] = {}
+    for t in range(n_topics):
+        base = t * 131
+        topics[name_fmt.format(t)] = {
+            p: [inter[(base + p * rf + i) % n_brokers] for i in range(rf)]
+            for p in range(p_per_topic)
+        }
+    return topics, set(range(n_brokers)), racks
+
+
+def build_config5():
+    """BASELINE config 5: 1k brokers / 100 topics x 50 partitions / RF=3 /
+    10 racks — the 256-scenario what-if fleet shape."""
+    return rack_striped_cluster(1000, 100, 50, 3, 10)
