@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# Packaging smoke test (reference role: pom.xml:61-131 + assembly.xml tarball).
+#
+# Builds the wheel, installs it into a clean venv (offline: --no-index, deps
+# come from the system site-packages), and runs the installed console script
+# end-to-end against a snapshot — every mode an operator would hit first.
+set -euo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+WORK="$(mktemp -d)"
+trap 'rm -rf "$WORK"' EXIT
+
+# A TPU-plugin site dir on PYTHONPATH (axon) breaks the venv interpreter's
+# sitecustomize import ordering; the smoke test is pure-CPU metadata work.
+export PYTHONPATH=""
+export JAX_PLATFORMS="${JAX_PLATFORMS_OVERRIDE:-cpu}"
+
+echo "== build wheel =="
+python -m pip wheel "$REPO" --no-deps --no-build-isolation -w "$WORK/dist" -q
+WHEEL=$(ls "$WORK"/dist/kafka_assigner_tpu-*.whl)
+echo "built: $WHEEL"
+
+echo "== install into clean venv =="
+python -m venv --system-site-packages "$WORK/venv"
+"$WORK/venv/bin/pip" install --no-index --no-deps -q "$WHEEL"
+
+echo "== console-script smoke =="
+cat > "$WORK/cluster.json" <<'EOF'
+{
+  "brokers": [
+    {"id": 1, "host": "h1", "port": 9092, "rack": "a"},
+    {"id": 2, "host": "h2", "port": 9092, "rack": "b"},
+    {"id": 3, "host": "h3", "port": 9092, "rack": "c"}
+  ],
+  "topics": {"events": {"0": [1, 2], "1": [2, 3], "2": [3, 1]}}
+}
+EOF
+
+GEN="$WORK/venv/bin/kafka-assignment-generator"
+test -x "$GEN" || { echo "console script missing"; exit 1; }
+
+out=$("$GEN" --zk_string "$WORK/cluster.json" --mode PRINT_CURRENT_BROKERS)
+echo "$out" | grep -q '^CURRENT BROKERS:$'
+echo "$out" | grep -q '"id":1'
+
+out=$("$GEN" --zk_string "$WORK/cluster.json" --mode PRINT_CURRENT_ASSIGNMENT)
+echo "$out" | grep -q '^CURRENT ASSIGNMENT:$'
+echo "$out" | grep -q '"version":1'
+
+out=$("$GEN" --zk_string "$WORK/cluster.json" --mode PRINT_REASSIGNMENT --solver greedy)
+echo "$out" | grep -q '^NEW ASSIGNMENT:$'
+echo "$out" | grep -q '"version":1'
+
+echo "== bin/ launcher smoke =="
+PATH="$WORK/venv/bin:$PATH" "$REPO/bin/kafka-assignment-generator.sh" \
+  --zk_string "$WORK/cluster.json" --mode PRINT_CURRENT_BROKERS | grep -q '"id":1'
+
+echo "package smoke OK"
