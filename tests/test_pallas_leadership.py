@@ -95,3 +95,29 @@ def test_batched_solve_with_pallas_flag(monkeypatch):
     monkeypatch.delenv("KA_PALLAS_LEADERSHIP")
     without = TopicAssigner("tpu").generate_assignments(topics, live, racks, -1)
     assert with_pallas == without
+
+
+def test_kernel_multiblock_grid_matches_xla():
+    # P > BLOCK_P forces a multi-step sequential grid: the VMEM counter alias
+    # must carry across blocks exactly like the scan carry. (Interpret mode;
+    # the same grid lowers to real TPU.)
+    rng = np.random.default_rng(7)
+    p, n, rf = 1024, 64, 3
+    assert p > 512, "must exceed BLOCK_P to exercise the grid carry"
+    acc = np.full((p, rf), -1, np.int32)
+    cnt = np.full(p, rf, np.int32)
+    for i in range(p):
+        acc[i] = rng.choice(n, rf, replace=False)
+    counters = rng.integers(0, 5, (n, rf)).astype(np.int32)
+    jh = int(rng.integers(0, 2**30))
+
+    o1, c1 = leadership_order(
+        jnp.asarray(acc), jnp.asarray(cnt), jnp.asarray(counters),
+        jnp.int32(jh), rf,
+    )
+    o2, c2 = leadership_order_pallas(
+        jnp.asarray(acc), jnp.asarray(cnt), jnp.asarray(counters),
+        jnp.int32(jh), rf, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
