@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Build the distributable tarball — the analogue of the reference's
+# assembly.xml packaging (src/assemble/assembly.xml:20-59: bin/repo/conf
+# layout in kafka-assigner-<version>-pkg.tar).
+#
+#   bin/   launcher script (same name as the reference's appassembler output)
+#   repo/  the wheel (the reference puts its jars here)
+#   conf/  logging configuration example
+#   README.md
+#
+# Usage: scripts/make_dist.sh [outdir]   (default: ./dist)
+set -euo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+OUT="${1:-$REPO/dist}"
+# tomllib is 3.11+; fall back to a grep for supported 3.10 installs.
+VERSION=$(python - "$REPO/pyproject.toml" <<'PY'
+import re, sys
+try:
+    import tomllib
+    with open(sys.argv[1], "rb") as f:
+        print(tomllib.load(f)["project"]["version"])
+except ModuleNotFoundError:
+    with open(sys.argv[1]) as f:
+        print(re.search(r'^version\s*=\s*"([^"]+)"', f.read(), re.M).group(1))
+PY
+)
+NAME="kafka-assigner-tpu-${VERSION}-pkg"
+STAGE="$(mktemp -d)"
+trap 'rm -rf "$STAGE"' EXIT
+
+mkdir -p "$STAGE/$NAME"/{bin,repo,conf} "$OUT"
+python -m pip wheel "$REPO" --no-deps --no-build-isolation -q -w "$STAGE/$NAME/repo"
+install -m 0755 "$REPO/bin/kafka-assignment-generator.sh" "$STAGE/$NAME/bin/"
+install -m 0644 "$REPO/conf/logging.env.example" "$STAGE/$NAME/conf/"
+install -m 0644 "$REPO/README.md" "$STAGE/$NAME/"
+
+tar -C "$STAGE" -cf "$OUT/$NAME.tar" "$NAME"
+echo "built $OUT/$NAME.tar:"
+tar -tf "$OUT/$NAME.tar"
