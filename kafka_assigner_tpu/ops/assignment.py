@@ -473,12 +473,14 @@ def _place_one_topic(
 ) -> Tuple[AssignState, jnp.ndarray]:
     """One topic's *placement* (sticky fill → wave spread).
 
-    Placement is independent of the leadership counters, so in principle a
-    batched caller could vmap it; measured on CPU that loses badly (the
-    chained-fallback lax.cond lowers to select under vmap and runs every leg
-    for every topic), so today every caller goes through _solve_one_topic's
-    sequential pipeline. Re-evaluate with real-chip numbers before wiring a
-    vmapped path.
+    Placement is independent of the leadership counters, so callers come in
+    two shapes: the sequential scan pipeline (``_solve_one_topic``) and the
+    vmapped fast-wave stage (``place_batched``, ``KA_STAGED_SOLVE=1``).
+    Under vmap only single-leg wave modes are safe — the chained-fallback
+    ``lax.cond`` lowers to ``select`` and runs every leg for every topic
+    (measured 10x CPU regression in round 1) — which is why ``place_batched``
+    is fast-only with a host rescue, and why any change here must keep the
+    staged-vs-sequential equality pin green (``tests/test_staged_solve.py``).
 
     Capacity ``ceil(P*RF/N_alive)`` (``KafkaAssignmentStrategy.java:65-71``),
     the rotation start ``abs(hash) % N_alive`` (``:188-200``) and the rotated
@@ -503,7 +505,8 @@ def _place_one_topic(
 
 def _order_one_topic(
     counters: jnp.ndarray,
-    state: AssignState,
+    acc_nodes: jnp.ndarray,
+    acc_count: jnp.ndarray,
     jhash: jnp.ndarray,
     rf: int,
     use_pallas: bool,
@@ -515,12 +518,8 @@ def _order_one_topic(
         # from the vmapped what-if path).
         from .pallas_leadership import leadership_order_pallas
 
-        return leadership_order_pallas(
-            state.acc_nodes, state.acc_count, counters, jhash, rf
-        )
-    ordered, counters = leadership_order(
-        state.acc_nodes, state.acc_count, counters, jhash, rf
-    )
+        return leadership_order_pallas(acc_nodes, acc_count, counters, jhash, rf)
+    ordered, counters = leadership_order(acc_nodes, acc_count, counters, jhash, rf)
     return ordered, counters
 
 
@@ -543,7 +542,9 @@ def _solve_one_topic(
     state, sticky_kept = _place_one_topic(
         current, jhash, p_real, rack_idx, alive, n, rf, wave_mode, rf_actual
     )
-    ordered, counters = _order_one_topic(counters, state, jhash, rf, use_pallas)
+    ordered, counters = _order_one_topic(
+        counters, state.acc_nodes, state.acc_count, jhash, rf, use_pallas
+    )
     return counters, (ordered, state.infeasible, state.deficit, sticky_kept)
 
 
@@ -623,6 +624,121 @@ def solve_batched(
 
 solve_batched_jit = jax.jit(
     solve_batched, static_argnames=("n", "rf", "wave_mode", "use_pallas")
+)
+
+
+def place_batched(
+    currents: jnp.ndarray,   # (B, P_pad, L)
+    rack_idx: jnp.ndarray,   # (N_pad,)
+    jhashes: jnp.ndarray,    # (B,)
+    p_reals: jnp.ndarray,    # (B,)
+    n: int,
+    rf: int,
+    wave_mode: str = "fast",
+    rfs: jnp.ndarray | None = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Stage 1 of the staged batched solve: *placement only*, vmapped across
+    topics.
+
+    Placement (sticky fill + wave spread) has no cross-topic dependency — only
+    leadership does, through the Context counters — so the per-topic scan the
+    reference's semantics force on leadership need not serialize placement.
+    Under ``vmap`` every topic's sticky fill and auction waves batch into one
+    wide tensor program (the MXU/VPU-friendly shape), instead of B small
+    sequential scan steps.
+
+    Runs the FAST wave only: the chained-fallback ``lax.cond`` lowers to
+    ``select`` under vmap and would execute every leg for every topic (the
+    measured 10x round-1 regression). Topics the fast packing strands are
+    flagged, and the caller re-places just those through the sequential
+    full-chain path (``tpu.py:assign_many_staged``) — same rescue pattern the
+    what-if sweep uses.
+
+    Returns (acc_nodes (B, P_pad, RF), acc_count (B, P_pad), infeasible (B,),
+    deficits (B, P_pad), sticky_kept (B,)).
+    """
+    alive = jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
+    if rfs is None:
+        rfs = jnp.full(currents.shape[0], rf, dtype=jnp.int32)
+
+    def one(current, jhash, p_real, rf_actual):
+        state, kept = _place_one_topic(
+            current, jhash, p_real, rack_idx, alive, n, rf, wave_mode, rf_actual
+        )
+        return (
+            state.acc_nodes, state.acc_count, state.infeasible, state.deficit,
+            kept,
+        )
+
+    return jax.vmap(one)(currents, jhashes, p_reals, rfs)
+
+
+place_batched_jit = jax.jit(
+    place_batched, static_argnames=("n", "rf", "wave_mode")
+)
+
+
+def place_scan(
+    currents: jnp.ndarray,   # (B, P_pad, L)
+    rack_idx: jnp.ndarray,
+    jhashes: jnp.ndarray,    # (B,)
+    p_reals: jnp.ndarray,    # (B,)
+    n: int,
+    rf: int,
+    wave_mode: str = "auto",
+    rfs: jnp.ndarray | None = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Placement-only scan over topics with the FULL fallback chain — the
+    rescue path for topics the vmapped fast wave strands. Sequential (scan,
+    not vmap) so the chained ``lax.cond`` legs stay real branches, but one
+    compiled dispatch covers the whole rescue subset — through a tunneled
+    chip that matters more than the serialization (~80-100 ms per dispatch)."""
+    alive = jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
+    if rfs is None:
+        rfs = jnp.full(currents.shape[0], rf, dtype=jnp.int32)
+
+    def step(carry, inp):
+        current, jhash, p_real, rf_actual = inp
+        state, kept = _place_one_topic(
+            current, jhash, p_real, rack_idx, alive, n, rf, wave_mode, rf_actual
+        )
+        return carry, (
+            state.acc_nodes, state.acc_count, state.infeasible, state.deficit,
+            kept,
+        )
+
+    _, outs = lax.scan(step, 0, (currents, jhashes, p_reals, rfs))
+    return outs
+
+
+place_scan_jit = jax.jit(place_scan, static_argnames=("n", "rf", "wave_mode"))
+
+
+def order_batched(
+    acc_nodes: jnp.ndarray,  # (B, P_pad, RF) placed replica sets
+    acc_count: jnp.ndarray,  # (B, P_pad)
+    counters: jnp.ndarray,   # (N_pad, RF) cross-topic Context slab
+    jhashes: jnp.ndarray,    # (B,)
+    rf: int,
+    use_pallas: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Stage 2: leadership ordering over already-placed topics, sequential in
+    topic order (the Context counter dependency is the one true serialization
+    in the whole solve, ``KafkaAssignmentStrategy.java:218-237``)."""
+
+    def step(counters, inp):
+        nodes, count, jh = inp
+        ordered, counters = _order_one_topic(
+            counters, nodes, count, jh, rf, use_pallas
+        )
+        return counters, ordered
+
+    counters, ordered = lax.scan(step, counters, (acc_nodes, acc_count, jhashes))
+    return ordered, counters
+
+
+order_batched_jit = jax.jit(
+    order_batched, static_argnames=("rf", "use_pallas")
 )
 
 

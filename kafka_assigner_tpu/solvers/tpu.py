@@ -23,6 +23,7 @@ path runs entirely on device.
 """
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Mapping, Sequence, Set
 
 import numpy as np
@@ -32,6 +33,7 @@ from ..models.problem import (
     batch_bucket,
     context_to_array,
     decode_assignment,
+    decode_assignments_batched,
     encode_cluster,
     encode_problem,
     group_pads,
@@ -54,6 +56,13 @@ def _fresh_solve(rack_idx, counters, jhash, p_real, p_pad, n, rf):
         wave_mode="fresh",
     )
     return ordered, counters, infeasible, deficit
+
+
+def staged_solve_enabled() -> bool:
+    """Staged (vmapped-placement) batched solve, opt-in via
+    ``KA_STAGED_SOLVE=1`` until real-chip numbers pick the default
+    (see ``TpuSolver._solve_staged``)."""
+    return os.environ.get("KA_STAGED_SOLVE") == "1"
 
 
 def _fresh_solve_jit(*args, **kwargs):
@@ -169,7 +178,7 @@ class TpuSolver:
             cluster = encode_cluster(rack_assignment, nodes)
             encs = [
                 encode_problem(
-                    topic, cur, rack_assignment, nodes, set(cur),
+                    topic, cur, rack_assignment, nodes, cur.keys(),
                     replication_factor,
                     p_pad_override=p_pad, width_override=width, cluster=cluster,
                 )
@@ -204,18 +213,28 @@ class TpuSolver:
             )
 
         with timers.phase("solve"):
-            ordered, counters_after, infeasible, deficits, _ = jax.device_get(
-                solve_batched_jit(
-                    jnp.asarray(currents),
-                    jnp.asarray(encs[0].rack_idx),
-                    jnp.asarray(counters_before),
-                    jnp.asarray(jhashes),
-                    jnp.asarray(p_reals),
-                    n=encs[0].n,
-                    rf=replication_factor,
-                    use_pallas=pallas_leadership_enabled(),
+            if staged_solve_enabled():
+                ordered, counters_after, infeasible, deficits = (
+                    self._solve_staged(
+                        currents, encs, counters_before, jhashes, p_reals,
+                        replication_factor, b_real,
+                    )
                 )
-            )
+            else:
+                ordered, counters_after, infeasible, deficits, _ = (
+                    jax.device_get(
+                        solve_batched_jit(
+                            jnp.asarray(currents),
+                            jnp.asarray(encs[0].rack_idx),
+                            jnp.asarray(counters_before),
+                            jnp.asarray(jhashes),
+                            jnp.asarray(p_reals),
+                            n=encs[0].n,
+                            rf=replication_factor,
+                            use_pallas=pallas_leadership_enabled(),
+                        )
+                    )
+                )
         if infeasible[:b_real].any():
             b = int(np.argmax(infeasible[:b_real]))
             bad = int(np.argmax(deficits[b] > 0))
@@ -227,11 +246,95 @@ class TpuSolver:
             apply_counter_updates(
                 context, encs[0], counters_before, counters_after
             )
+            decoded = decode_assignments_batched(encs, ordered[: len(encs)])
             result = [
-                (enc.topic, decode_assignment(enc, ordered[i]))
-                for i, enc in enumerate(encs)
+                (enc.topic, assignment)
+                for enc, assignment in zip(encs, decoded)
             ]
         return result
+
+    def _solve_staged(
+        self, currents, encs, counters_before, jhashes, p_reals,
+        replication_factor, b_real,
+    ):
+        """Staged batched solve: vmapped fast-wave placement across all
+        topics, host rescue of stranded topics through the full fallback
+        chain, then the sequential leadership scan — bit-identical output to
+        ``solve_batched`` (placement has no cross-topic dependency; the fast
+        leg is also ``auto``'s first leg, so non-stranded topics place
+        identically).
+
+        Why: ``lax.scan`` over topics serializes placement into B small
+        sequential steps; at 2048 headline topics the vmapped placement is
+        one wide tensor program instead. Opt-in via ``KA_STAGED_SOLVE=1``
+        until real-chip numbers pick the default (round-1 showed naive
+        vmap-with-fallback-chain loses 10x on CPU; this fast-only + rescue
+        design is the one the what-if sweep already validates).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.assignment import (
+            order_batched_jit,
+            place_batched_jit,
+            place_scan_jit,
+        )
+        from ..ops.pallas_leadership import pallas_leadership_enabled
+
+        n = encs[0].n
+        rack_idx = jnp.asarray(encs[0].rack_idx)
+        acc_nodes, acc_count, infeasible_d, deficits_d, _ = place_batched_jit(
+            jnp.asarray(currents), rack_idx, jnp.asarray(jhashes),
+            jnp.asarray(p_reals), n=n, rf=replication_factor,
+        )
+        infeasible = np.array(jax.device_get(infeasible_d))  # writable copy
+        deficits = deficits_d
+        flagged = [i for i in range(b_real) if infeasible[i]]
+        if flagged:
+            # A raised fast-wave flag can mean "fast packing stranded", not
+            # true infeasibility: re-place the whole flagged subset through
+            # the chained-fallback scan in ONE dispatch (per-topic dispatches
+            # would pay the tunnel round-trip per strand) and splice.
+            # np.array: device_get returns read-only views.
+            acc_nodes = np.array(jax.device_get(acc_nodes))
+            acc_count = np.array(jax.device_get(acc_count))
+            deficits = np.array(jax.device_get(deficits_d))
+            currents_h = np.asarray(currents)  # host copy once (mesh path)
+            sub_pad = batch_bucket(len(flagged))
+            sub_currents = np.full(
+                (sub_pad,) + currents_h.shape[1:], -1, dtype=np.int32
+            )
+            sub_jh = np.zeros(sub_pad, dtype=np.int32)
+            sub_pr = np.zeros(sub_pad, dtype=np.int32)
+            for k, i in enumerate(flagged):
+                sub_currents[k] = currents_h[i]
+                sub_jh[k] = jhashes[i]
+                sub_pr[k] = p_reals[i]
+            nodes_s, count_s, inf_s, def_s, _ = jax.device_get(
+                place_scan_jit(
+                    jnp.asarray(sub_currents), rack_idx, jnp.asarray(sub_jh),
+                    jnp.asarray(sub_pr), n=n, rf=replication_factor,
+                )
+            )
+            for k, i in enumerate(flagged):
+                acc_nodes[i], acc_count[i] = nodes_s[k], count_s[k]
+                infeasible[i], deficits[i] = bool(inf_s[k]), def_s[k]
+            acc_nodes = jnp.asarray(acc_nodes)
+            acc_count = jnp.asarray(acc_count)
+        if infeasible[:b_real].any():
+            return None, None, infeasible, np.asarray(jax.device_get(deficits))
+
+        ordered, counters_after = jax.device_get(
+            order_batched_jit(
+                acc_nodes, acc_count, jnp.asarray(counters_before),
+                jnp.asarray(jhashes), rf=replication_factor,
+                use_pallas=pallas_leadership_enabled(),
+            )
+        )
+        return (
+            ordered, counters_after, infeasible,
+            np.asarray(jax.device_get(deficits)),
+        )
 
     def fresh_assignment(
         self,

@@ -1,0 +1,69 @@
+"""Staged batched solve (vmapped placement + sequential leadership) must be
+bit-identical to the scan-over-topics solve — including when the fast wave
+strands a topic and the host rescue path re-places it through the full
+fallback chain.
+"""
+from __future__ import annotations
+
+import pytest
+
+from kafka_assigner_tpu.assigner import TopicAssigner
+
+from .test_invariants import make_cluster
+
+
+def _solve_both(monkeypatch, topics, live, rack_map, rf=-1):
+    monkeypatch.delenv("KA_STAGED_SOLVE", raising=False)
+    sequential = TopicAssigner("tpu").generate_assignments(
+        topics, live, rack_map, rf
+    )
+    monkeypatch.setenv("KA_STAGED_SOLVE", "1")
+    staged = TopicAssigner("tpu").generate_assignments(topics, live, rack_map, rf)
+    monkeypatch.delenv("KA_STAGED_SOLVE")
+    return sequential, staged
+
+
+def test_staged_matches_sequential(monkeypatch):
+    current, live, rack_map = make_cluster(0, 16, 32, 3, 4)
+    topics = [(f"t{i}", current) for i in range(5)]
+    sequential, staged = _solve_both(monkeypatch, topics, live, rack_map)
+    assert sequential == staged
+
+
+def test_staged_matches_on_decommission(monkeypatch):
+    current, live, rack_map = make_cluster(1, 20, 48, 3, 5, remove=2)
+    topics = [(f"topic-{i}", current) for i in range(3)]
+    sequential, staged = _solve_both(monkeypatch, topics, live, rack_map)
+    assert sequential == staged
+
+
+def test_staged_rescue_path_matches(monkeypatch):
+    # Rack-unaware striped 10 -> 8 decommission: the fast wave strands this
+    # (the balance fallback completes it), so in a mixed batch the staged
+    # solver must rescue exactly that topic and still match the sequential
+    # solve bit-for-bit.
+    n, p, rf = 10, 50, 3
+    base = list(range(n))
+    strander = {q: [base[(q + i) % n] for i in range(rf)] for q in range(p)}
+    live = set(base[2:])
+    # an easy same-broker-set topic: striped over the live set
+    lv = sorted(live)
+    easy = {q: [lv[(q + i) % len(lv)] for i in range(rf)] for q in range(p)}
+    topics = [("easy-0", easy), ("strander", strander), ("easy-1", easy)]
+    sequential, staged = _solve_both(monkeypatch, topics, live, {})
+    assert sequential == staged
+
+
+def test_staged_infeasible_raises_same_error(monkeypatch):
+    # Truly infeasible (RF == racks, singleton rack too small): both paths
+    # must raise the reference's error.
+    brokers = {1, 2, 3, 4}
+    racks = {1: "a", 2: "b", 3: "b", 4: "b"}
+    current = {q: [1 + (q + i) % 4 for i in range(2)] for q in range(10)}
+    topics = [("t", current)]
+    monkeypatch.setenv("KA_STAGED_SOLVE", "1")
+    with pytest.raises(ValueError, match="could not be fully assigned"):
+        TopicAssigner("tpu").generate_assignments(topics, brokers, racks, -1)
+    monkeypatch.delenv("KA_STAGED_SOLVE")
+    with pytest.raises(ValueError, match="could not be fully assigned"):
+        TopicAssigner("tpu").generate_assignments(topics, brokers, racks, -1)
