@@ -76,7 +76,7 @@ def evaluate_removal_scenarios(
     p_pad, width = group_pads([cur for _, cur in items])
     cluster = encode_cluster(rack_assignment, brokers)
     encs = [
-        encode_problem(t, cur, rack_assignment, brokers, set(cur), t_rf,
+        encode_problem(t, cur, rack_assignment, brokers, cur.keys(), t_rf,
                        p_pad_override=p_pad, width_override=width,
                        cluster=cluster)
         for (t, cur), t_rf in zip(items, topic_rfs)
