@@ -72,6 +72,12 @@ def main() -> None:
 
     from kafka_assigner_tpu.assigner import TopicAssigner
 
+    # The bench controls solver variants itself (KA_BENCH_STAGED/_PALLAS
+    # force-include them); ambient variant flags would silently turn the
+    # "default path" measurement into a variant measurement.
+    os.environ.pop("KA_STAGED_SOLVE", None)
+    os.environ.pop("KA_PALLAS_LEADERSHIP", None)
+
     topics, live, rack_map = build_headline()
 
     # --- native reference baseline (C++ greedy, single thread) -------------
@@ -85,11 +91,14 @@ def main() -> None:
     t0 = time.perf_counter()
     TopicAssigner("tpu").generate_assignments(topics, live, rack_map, -1)
     cold_ms = (time.perf_counter() - t0) * 1000.0
+    warm_assigner = TopicAssigner("tpu")
     t0 = time.perf_counter()
-    tpu_pairs = TopicAssigner("tpu").generate_assignments(
-        topics, live, rack_map, -1
-    )
+    tpu_pairs = warm_assigner.generate_assignments(topics, live, rack_map, -1)
     tpu_ms = (time.perf_counter() - t0) * 1000.0
+    phase_ms = {
+        k: round(v, 1)
+        for k, v in getattr(warm_assigner.solver, "last_timers", {}).items()
+    }
 
     # movement parity assertion (identical sticky phase => identical moves)
     def moved(pairs):
@@ -104,6 +113,48 @@ def main() -> None:
 
     m_base, m_tpu = moved(baseline_pairs), moved(tpu_pairs)
     assert m_tpu == m_base, f"movement parity broken: tpu={m_tpu} greedy={m_base}"
+
+    # --- staged-solve comparison (real chip only, or forced) ----------------
+    # KA_STAGED_SOLVE=1 swaps the scan-over-topics solve for vmapped
+    # placement + sequential leadership (known 8x slower on CPU, designed for
+    # the TPU cost model); measuring it here on hardware is what decides the
+    # default (VERDICT round 1 item 4).
+    def measure_variant(env_flag):
+        """Warm-time an opt-in solver variant; output must equal the default
+        path's exactly. Errors are recorded, never fatal — a broken variant
+        must not cost the round its bench artifact."""
+        os.environ[env_flag] = "1"
+        try:
+            TopicAssigner("tpu").generate_assignments(
+                topics, live, rack_map, -1
+            )  # cold
+            assigner = TopicAssigner("tpu")
+            t0 = time.perf_counter()
+            pairs = assigner.generate_assignments(topics, live, rack_map, -1)
+            ms = (time.perf_counter() - t0) * 1000.0
+            if pairs != tpu_pairs:
+                return None, "output mismatch vs default path", {}
+            return ms, None, getattr(assigner.solver, "last_timers", {})
+        except Exception as e:  # record, don't kill the bench
+            return None, f"{type(e).__name__}: {e}"[:200], {}
+        finally:
+            del os.environ[env_flag]
+
+    staged = {}
+    on_real_device = platform_note == ""
+    if on_real_device or os.environ.get("KA_BENCH_STAGED") == "1":
+        ms, err, ph = measure_variant("KA_STAGED_SOLVE")
+        staged = (
+            {"staged_warm_ms": round(ms, 1),
+             "staged_phase_ms": {k: round(v, 1) for k, v in ph.items()}}
+            if err is None else {"staged_error": err}
+        )
+    if on_real_device or os.environ.get("KA_BENCH_PALLAS") == "1":
+        ms, err, _ = measure_variant("KA_PALLAS_LEADERSHIP")
+        staged.update(
+            {"pallas_warm_ms": round(ms, 1)} if err is None
+            else {"pallas_error": err}
+        )
 
     # --- BASELINE config 5: 256-scenario what-if fleet (warm) ---------------
     # Single-device here (the driver benches one chip); the 8-way-sharded
@@ -141,6 +192,8 @@ def main() -> None:
                     "tpu_cold_ms": round(cold_ms, 1),
                     "moved_replicas": int(m_tpu),
                     "total_replicas": N_TOPICS * P_PER_TOPIC * RF,
+                    "phase_ms": phase_ms,
+                    **staged,
                     **config5,
                 },
             }

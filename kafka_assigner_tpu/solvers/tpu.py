@@ -94,6 +94,10 @@ class TpuSolver:
 
     def __init__(self, mesh=None) -> None:
         self._mesh = mesh
+        #: phase wall-clock of the most recent assign_many (encode/solve/
+        #: decode ms) — the observability the reference lacks entirely
+        #: (SURVEY.md §5); bench.py surfaces it in its JSON extras.
+        self.last_timers: Dict[str, float] = {}
 
     def assign(
         self,
@@ -169,6 +173,10 @@ class TpuSolver:
         from ..utils.timers import Timers
 
         timers = Timers()
+        # Live reference: phases land here as they complete, so a failed or
+        # partial solve reports its own (partial) timings, never a stale
+        # previous run's.
+        self.last_timers = timers.ms
         if context is None:
             context = Context()
         if not named_currents:
