@@ -67,3 +67,51 @@ def test_staged_infeasible_raises_same_error(monkeypatch):
     monkeypatch.delenv("KA_STAGED_SOLVE")
     with pytest.raises(ValueError, match="could not be fully assigned"):
         TopicAssigner("tpu").generate_assignments(topics, brokers, racks, -1)
+
+
+# Property: staged == sequential over randomized clusters. Shapes are pinned
+# to one compile bucket (brokers pad 16, partitions pad 32) so hypothesis
+# examples reuse the first compile instead of paying one per shape.
+try:
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=12, deadline=None)
+    @given(st.integers(0, 10**6))
+    def test_staged_equality_property(seed):
+        import random
+
+        rng = random.Random(seed)
+        n = rng.randint(9, 16)
+        p = rng.randint(17, 32)
+        rf = rng.randint(1, 3)
+        racks = rng.randint(max(rf, 2), 5)
+        current, live, rack_map = make_cluster(
+            seed, n, p, rf, racks, remove=rng.randint(0, 2)
+        )
+        topics = [(f"t{i}", current) for i in range(rng.randint(1, 3))]
+        import os
+
+        from kafka_assigner_tpu.assigner import TopicAssigner as TA
+
+        os.environ.pop("KA_STAGED_SOLVE", None)
+        try:
+            sequential = TA("tpu").generate_assignments(
+                topics, live, rack_map, -1
+            )
+            seq_err = None
+        except ValueError as e:
+            sequential, seq_err = None, str(e)
+        os.environ["KA_STAGED_SOLVE"] = "1"
+        try:
+            try:
+                staged = TA("tpu").generate_assignments(
+                    topics, live, rack_map, -1
+                )
+                st_err = None
+            except ValueError as e:
+                staged, st_err = None, str(e)
+        finally:
+            os.environ.pop("KA_STAGED_SOLVE", None)
+        assert sequential == staged and seq_err == st_err
+except ImportError:  # hypothesis is optional
+    pass
