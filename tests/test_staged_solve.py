@@ -37,6 +37,15 @@ def test_staged_matches_on_decommission(monkeypatch):
     assert sequential == staged
 
 
+def test_staged_matches_with_rf_override(monkeypatch):
+    # RF decrease (2 -> 1) and increase (2 -> 3) through both batched paths.
+    current, live, rack_map = make_cluster(5, 12, 32, 2, 4)
+    topics = [(f"t{i}", current) for i in range(3)]
+    for rf in (1, 3):
+        sequential, staged = _solve_both(monkeypatch, topics, live, rack_map, rf)
+        assert sequential == staged, rf
+
+
 def test_staged_rescue_path_matches(monkeypatch):
     # Rack-unaware striped 10 -> 8 decommission: the fast wave strands this
     # (the balance fallback completes it), so in a mixed batch the staged
