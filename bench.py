@@ -140,18 +140,18 @@ def main() -> None:
         finally:
             del os.environ[env_flag]
 
-    staged = {}
+    variants = {}
     on_real_device = platform_note == ""
     if on_real_device or os.environ.get("KA_BENCH_STAGED") == "1":
         ms, err, ph = measure_variant("KA_STAGED_SOLVE")
-        staged = (
+        variants.update(
             {"staged_warm_ms": round(ms, 1),
              "staged_phase_ms": {k: round(v, 1) for k, v in ph.items()}}
             if err is None else {"staged_error": err}
         )
     if on_real_device or os.environ.get("KA_BENCH_PALLAS") == "1":
         ms, err, _ = measure_variant("KA_PALLAS_LEADERSHIP")
-        staged.update(
+        variants.update(
             {"pallas_warm_ms": round(ms, 1)} if err is None
             else {"pallas_error": err}
         )
@@ -193,7 +193,7 @@ def main() -> None:
                     "moved_replicas": int(m_tpu),
                     "total_replicas": N_TOPICS * P_PER_TOPIC * RF,
                     "phase_ms": phase_ms,
-                    **staged,
+                    **variants,
                     **config5,
                 },
             }

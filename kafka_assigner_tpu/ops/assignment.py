@@ -50,6 +50,12 @@ class AssignState(NamedTuple):
     infeasible: jnp.ndarray  # ()     bool: some partition cannot be completed
 
 
+def default_alive(rack_idx: jnp.ndarray, n: int) -> jnp.ndarray:
+    """(N_pad,) liveness mask for the no-scenario case: the first n real
+    nodes are alive, padding rows are not."""
+    return jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
+
+
 def _requests_rank(pick: jnp.ndarray, valid: jnp.ndarray, sentinel: int) -> jnp.ndarray:
     """Rank of each valid request among requests for the same node, in
     ascending partition-row order — the vectorized stand-in for 'TreeMap
@@ -349,7 +355,7 @@ def spread_orphans(
             f"unknown wave_mode {wave_mode!r}; expected one of {sorted(WAVE_MODES)}"
         )
     if alive is None:
-        alive = jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
+        alive = default_alive(rack_idx, n)
     rf = state.acc_nodes.shape[1]
     n_pad = rack_idx.shape[0]
     # The fast/balance waves pack (pos, node) / (rack, pos) into int32 keys;
@@ -563,7 +569,7 @@ def solve_assignment(
     Returns (ordered (P, RF) broker indices, updated counters, infeasible
     flag, deficit vector for error reporting).
     """
-    alive = jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
+    alive = default_alive(rack_idx, n)
     counters, (ordered, infeasible, deficit, _) = _solve_one_topic(
         counters, current, jhash, p_real, rack_idx, alive, n, rf,
         use_pallas=use_pallas,
@@ -604,7 +610,7 @@ def solve_batched(
     no-ops: nothing to stick, no deficit, no counter updates.
     """
     if alive is None:
-        alive = jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
+        alive = default_alive(rack_idx, n)
     if rfs is None:
         rfs = jnp.full(currents.shape[0], rf, dtype=jnp.int32)
 
@@ -657,7 +663,7 @@ def place_batched(
     Returns (acc_nodes (B, P_pad, RF), acc_count (B, P_pad), infeasible (B,),
     deficits (B, P_pad), sticky_kept (B,)).
     """
-    alive = jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
+    alive = default_alive(rack_idx, n)
     if rfs is None:
         rfs = jnp.full(currents.shape[0], rf, dtype=jnp.int32)
 
@@ -693,7 +699,7 @@ def place_scan(
     not vmap) so the chained ``lax.cond`` legs stay real branches, but one
     compiled dispatch covers the whole rescue subset — through a tunneled
     chip that matters more than the serialization (~80-100 ms per dispatch)."""
-    alive = jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
+    alive = default_alive(rack_idx, n)
     if rfs is None:
         rfs = jnp.full(currents.shape[0], rf, dtype=jnp.int32)
 

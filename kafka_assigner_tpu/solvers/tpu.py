@@ -47,10 +47,10 @@ def _fresh_solve(rack_idx, counters, jhash, p_real, p_pad, n, rf):
     chain — capacity-greedy balance first, first-fit legs as fallback."""
     import jax.numpy as jnp
 
-    from ..ops.assignment import _solve_one_topic
+    from ..ops.assignment import _solve_one_topic, default_alive
 
     empty = jnp.full((p_pad, 2), -1, dtype=jnp.int32)
-    alive = jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
+    alive = default_alive(rack_idx, n)
     counters, (ordered, infeasible, deficit, _) = _solve_one_topic(
         counters, empty, jhash, p_real, rack_idx, alive, n, rf,
         wave_mode="fresh",
