@@ -135,12 +135,24 @@ def print_decommission_ranking(
     topic_list = list(topics) if topics is not None else backend.all_topics()
     initial = backend.partition_assignment(topic_list)
 
+    # Spread the sweep across every visible device (the scenario axis is
+    # embarrassingly parallel; sharded == unsharded is test-pinned). The
+    # library call stays explicit — only the CLI auto-meshes.
+    import jax
+
+    mesh = None
+    if len(jax.devices()) > 1:
+        from .parallel.mesh import build_mesh
+
+        mesh = build_mesh()
+
     ranked = rank_decommission_candidates(
         {t: initial[t] for t in topic_list},
         brokers,
         {k: v for k, v in rack_assignment.items() if k in brokers},
         sorted(candidate_brokers) if candidate_brokers else None,
         desired_replication_factor,
+        mesh=mesh,
     )
     print("DECOMMISSION RANKING:", file=out)
     print(
