@@ -262,3 +262,49 @@ def test_kafka_admin_kafka_python_branch(monkeypatch):
     }
     backend.close()
     assert closed == [True]
+
+
+def test_cli_end_to_end_with_fake_kazoo(monkeypatch, capsys):
+    # Full stack: run_tool -> open_backend("host:2181") -> ZkBackend -> fake
+    # kazoo — the reference's only operating mode, hermetically.
+    from kafka_assigner_tpu.cli import run_tool
+
+    znodes = {
+        "/brokers/ids": {
+            str(b): json.dumps(
+                {"host": f"host{b}", "port": 9092, "rack": f"r{b % 3}"}
+            )
+            for b in range(1, 7)
+        },
+        "/brokers/topics": {
+            "events": json.dumps(
+                {"partitions": {str(p): [1 + (p + i) % 5 for i in range(3)]
+                                for p in range(6)}}
+            ),
+        },
+    }
+    _install_fake_kazoo(monkeypatch, znodes)
+    rc = run_tool(["--zk_string", "zkhost:2181", "--mode", "PRINT_REASSIGNMENT",
+                   "--solver", "greedy"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "CURRENT ASSIGNMENT:" in out and "NEW ASSIGNMENT:" in out
+    from kafka_assigner_tpu.io.json_io import parse_reassignment_json
+
+    new = parse_reassignment_json(out.split("NEW ASSIGNMENT:\n", 1)[1].strip())
+    assert set(new["events"]) == set(range(6))
+
+
+def test_cli_end_to_end_with_fake_confluent(monkeypatch, capsys):
+    # kafka:// connect string through the CLI with the stub AdminClient.
+    from kafka_assigner_tpu.cli import run_tool
+
+    _install_fake_confluent(monkeypatch)
+    rc = run_tool(["--zk_string", "kafka://b1:9092", "--mode",
+                   "PRINT_CURRENT_BROKERS"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    header, payload = captured.out.strip().split("\n", 1)
+    assert header == "CURRENT BROKERS:"
+    assert json.loads(payload)[0]["id"] == 1
+    assert "rack" in captured.err  # rack-blind warning reached the operator
