@@ -196,6 +196,31 @@ def run_tool(argv: Optional[List[str]] = None) -> int:
 
 
 def main() -> None:
+    # Opt-in wedged-accelerator self-defense for the console entry point
+    # (KA_DEVICE_WATCHDOG_S=<seconds>): a wedged TPU tunnel hangs backend
+    # init forever, even under JAX_PLATFORMS=cpu while the plugin's site dir
+    # is importable (see utils/deviceprobe.py). Probe in a subprocess and
+    # fall back to the CPU backend — results are identical, just slower.
+    # Default off: on a healthy chip the probe would double backend init
+    # (~20-40s). Library callers (run_tool) are never probed.
+    import os
+
+    watchdog = float(os.environ.get("KA_DEVICE_WATCHDOG_S", "0") or 0)
+    if watchdog > 0 and os.environ.get("KA_CLI_CPU_FALLBACK") != "1":
+        from .utils.deviceprobe import probe_device_count, virtual_cpu_env
+
+        if probe_device_count(watchdog) < 1:
+            print(
+                "WARNING: accelerator backend failed to initialize within "
+                f"{watchdog:.0f}s (wedged tunnel?); continuing on the CPU "
+                "backend — output is identical, solve is slower.",
+                file=sys.stderr,
+            )
+            env = virtual_cpu_env()
+            env["KA_CLI_CPU_FALLBACK"] = "1"
+            os.execve(sys.executable, [sys.executable, "-m",
+                                       "kafka_assigner_tpu.cli"] + sys.argv[1:],
+                      env)
     sys.exit(run_tool())
 
 
