@@ -24,3 +24,21 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# One pytest process compiles every test module's XLA programs and jax's
+# compilation cache never evicts; each compiled executable holds LLVM JIT
+# code mappings, and near the end of the (ever-growing) suite the process
+# exhausts vm.max_map_count (65530 default) — LLVM reports "Cannot allocate
+# memory", then the next compile segfaults. Clearing the cache every 40
+# tests bounds the live-executable set; shapes shared across a window
+# recompile once per window (seconds), which beats a dead suite.
+_tests_since_clear = 0
+
+
+def pytest_runtest_teardown(item, nextitem):
+    global _tests_since_clear
+    _tests_since_clear += 1
+    if _tests_since_clear >= 40:
+        _tests_since_clear = 0
+        jax.clear_caches()
