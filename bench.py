@@ -30,6 +30,14 @@ P_PER_TOPIC = 100
 RF = 3
 REPLACED = 100
 DEVICE_WATCHDOG_S = 180
+#: Hard wall-clock budget for the on-chip attempt (init + compile + run).
+#: The axon plugin compiles REMOTELY (PALLAS_AXON_REMOTE_COMPILE=1 ships the
+#: program over the tunnel); a pathological remote compile can exceed any
+#: driver timeout, and a client killed mid-compile wedges the tunnel for
+#: every later process. The parent therefore runs the whole measurement in a
+#: child under this deadline and falls back to CPU with the plugin stripped,
+#: so the driver ALWAYS gets a JSON artifact.
+TPU_DEADLINE_S = float(os.environ.get("KA_BENCH_TPU_DEADLINE_S", "1200"))
 
 
 def build_headline():
@@ -51,24 +59,142 @@ def build_headline():
     return topics, live, rack_map
 
 
-def main() -> None:
-    from kafka_assigner_tpu.utils.deviceprobe import (
-        probe_device_count,
-        virtual_cpu_env,
+def _cpu_fallback_exec() -> None:
+    """Re-exec this script on the CPU backend with the TPU plugin's site dir
+    stripped (see utils/deviceprobe.py for the why). Never returns."""
+    from kafka_assigner_tpu.utils.deviceprobe import virtual_cpu_env
+
+    env = virtual_cpu_env(
+        prepend_path=[os.path.dirname(os.path.abspath(__file__))]
     )
+    env["KA_BENCH_CPU_FALLBACK"] = "1"
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def _supervise() -> None:
+    """Parent mode: run the real measurement in a child under TPU_DEADLINE_S.
+
+    The deadline covers EVERYTHING that can hang on a tunneled chip — device
+    init, the remote compile, execution — not just init like the round-1
+    probe did. The child inherits stdout, so on success its JSON line is the
+    process output. The child stashes the headline-only result to a partial
+    file the moment it exists, so a hang in the optional variant section
+    costs the variants, not the on-chip headline artifact."""
+    import subprocess
+    import tempfile
+
+    partial = tempfile.NamedTemporaryFile(
+        prefix="ka_bench_partial_", suffix=".json", delete=False
+    )
+    partial.close()
+    env = dict(os.environ)
+    env["KA_BENCH_CHILD"] = "1"
+    env["KA_BENCH_PARTIAL"] = partial.name
+    # Child stdout is CAPTURED (stderr inherits): the parent is the only
+    # writer to stdout, so the "prints ONE JSON line" contract holds no
+    # matter where the child dies (even printing-then-segfaulting at
+    # interpreter teardown, XLA's favorite exit).
+    timed_out = False
+    child_out = ""
+    try:
+        proc = subprocess.run(
+            [sys.executable] + sys.argv, env=env, timeout=TPU_DEADLINE_S,
+            stdout=subprocess.PIPE, text=True,
+        )
+        rc, child_out = proc.returncode, proc.stdout or ""
+    except subprocess.TimeoutExpired as e:
+        print(
+            f"bench: on-chip attempt exceeded {TPU_DEADLINE_S:.0f}s "
+            "(remote compile stuck?)",
+            file=sys.stderr,
+        )
+        timed_out, rc = True, -1
+        child_out = (e.stdout or b"").decode() if e.stdout else ""
+
+    def parse_last_json(text):
+        for line in reversed(text.strip().splitlines()):
+            try:
+                d = json.loads(line)
+                if isinstance(d, dict) and "metric" in d:
+                    return d
+            except ValueError:
+                continue
+        return None
+
+    final = parse_last_json(child_out)
+    if final is None:  # fall back to the stashed record
+        try:
+            with open(partial.name) as f:
+                stash = json.load(f)
+            final = stash["result"]
+            if not stash.get("complete"):
+                final["extra"]["variants_truncated"] = True
+        except Exception:
+            final = None
+    os.unlink(partial.name)
+
+    if rc == 0 and final is not None:
+        print(json.dumps(final))
+        sys.exit(0)
+    if final is not None:
+        # Child died after securing the headline (variant hang, config5
+        # assert, teardown crash): keep the on-chip number, tag the failure.
+        if timed_out:
+            final["extra"]["deadline_exceeded"] = True
+        else:
+            final["extra"]["child_rc"] = rc
+            print(
+                f"bench: on-chip child FAILED rc={rc} after securing the "
+                "headline — artifact tagged child_rc; see stderr above",
+                file=sys.stderr,
+            )
+        print(json.dumps(final))
+        sys.exit(0)
+    # Nothing salvageable: full CPU fallback, loudly tagged unless a hang.
+    if not timed_out:
+        print(
+            f"bench: on-chip child FAILED rc={rc} before any result — CPU "
+            "fallback artifact is tagged with child_rc",
+            file=sys.stderr,
+        )
+    os.environ["KA_BENCH_CHILD_RC"] = str(rc)
+    _cpu_fallback_exec()
+
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache shared across processes and rounds:
+    a successful (possibly very slow, remote) compile is paid once, then the
+    driver's end-of-round bench — a fresh process — reuses the executable."""
+    try:
+        import jax
+
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+        )
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # cache is an optimization, never fatal
+        print(f"bench: compile cache unavailable: {e}", file=sys.stderr)
+
+
+def main() -> None:
+    from kafka_assigner_tpu.utils.deviceprobe import probe_device_count
 
     platform_note = ""
-    if os.environ.get("KA_BENCH_CPU_FALLBACK") != "1":
-        if probe_device_count(DEVICE_WATCHDOG_S) < 1:
-            # Wedged tunnel: re-exec on the CPU backend with the TPU plugin's
-            # site dir stripped (see utils/deviceprobe.py for the why).
-            env = virtual_cpu_env(
-                prepend_path=[os.path.dirname(os.path.abspath(__file__))]
-            )
-            env["KA_BENCH_CPU_FALLBACK"] = "1"
-            os.execve(sys.executable, [sys.executable] + sys.argv, env)
-    else:
+    if os.environ.get("KA_BENCH_CPU_FALLBACK") == "1":
         platform_note = "_cpu_fallback"
+    elif os.environ.get("KA_BENCH_CHILD") != "1":
+        if probe_device_count(DEVICE_WATCHDOG_S) < 1:
+            _cpu_fallback_exec()
+        _supervise()  # never returns
+    _enable_compile_cache()
+    # Variant budget: only meaningful under the supervising parent, whose
+    # kill at TPU_DEADLINE_S we must pre-empt with slack. The unsupervised
+    # CPU fallback has no killer, so it never skips sections on time.
+    if os.environ.get("KA_BENCH_CHILD") == "1":
+        deadline = time.monotonic() + TPU_DEADLINE_S * 0.8
+    else:
+        deadline = float("inf")
 
     from kafka_assigner_tpu.assigner import TopicAssigner
 
@@ -114,6 +240,29 @@ def main() -> None:
     m_base, m_tpu = moved(baseline_pairs), moved(tpu_pairs)
     assert m_tpu == m_base, f"movement parity broken: tpu={m_tpu} greedy={m_base}"
 
+    result = {
+        "metric": "headline_5kbrokers_200kpartitions_rf3_replace100_solve"
+        + platform_note,
+        "value": round(tpu_ms, 1),
+        "unit": "ms",
+        "vs_baseline": round(greedy_ms / tpu_ms, 3),
+        "extra": {
+            "native_greedy_baseline_ms": round(greedy_ms, 1),
+            "tpu_cold_ms": round(cold_ms, 1),
+            "moved_replicas": int(m_tpu),
+            "total_replicas": N_TOPICS * P_PER_TOPIC * RF,
+            "phase_ms": phase_ms,
+        },
+    }
+    if os.environ.get("KA_BENCH_CHILD_RC"):
+        result["extra"]["child_rc"] = int(os.environ["KA_BENCH_CHILD_RC"])
+    # Headline secured: stash it so the supervising parent can salvage the
+    # on-chip number even if a variant's remote compile hangs past deadline.
+    partial_path = os.environ.get("KA_BENCH_PARTIAL")
+    if partial_path:
+        with open(partial_path, "w") as f:
+            json.dump({"complete": False, "result": result}, f)
+
     # --- staged-solve comparison (real chip only, or forced) ----------------
     # KA_STAGED_SOLVE=1 swaps the scan-over-topics solve for vmapped
     # placement + sequential leadership (known 8x slower on CPU, designed for
@@ -141,15 +290,28 @@ def main() -> None:
             del os.environ[env_flag]
 
     variants = {}
+    budget_skipped = []
     on_real_device = platform_note == ""
-    if on_real_device or os.environ.get("KA_BENCH_STAGED") == "1":
+    # Each variant pays its own (possibly slow, remote) cold compile; skip
+    # whatever no longer fits the deadline — the headline artifact above is
+    # already secured and must not be lost to a variant's compile. Skips are
+    # recorded in extra so a missing metric is attributable.
+    def budget_left(section: str) -> bool:
+        if time.monotonic() < deadline:
+            return True
+        budget_skipped.append(section)
+        return False
+
+    if os.environ.get("KA_BENCH_VARIANTS") == "0":
+        on_real_device = False  # explicit kill-switch for variant sections
+    if (on_real_device or os.environ.get("KA_BENCH_STAGED") == "1") and budget_left("staged"):
         ms, err, ph = measure_variant("KA_STAGED_SOLVE")
         variants.update(
             {"staged_warm_ms": round(ms, 1),
              "staged_phase_ms": {k: round(v, 1) for k, v in ph.items()}}
             if err is None else {"staged_error": err}
         )
-    if on_real_device or os.environ.get("KA_BENCH_PALLAS") == "1":
+    if (on_real_device or os.environ.get("KA_BENCH_PALLAS") == "1") and budget_left("pallas"):
         ms, err, _ = measure_variant("KA_PALLAS_LEADERSHIP")
         variants.update(
             {"pallas_warm_ms": round(ms, 1)} if err is None
@@ -160,7 +322,7 @@ def main() -> None:
     # Single-device here (the driver benches one chip); the 8-way-sharded
     # variant is pinned by tests/test_config5_fleet.py on the virtual mesh.
     config5 = {}
-    if os.environ.get("KA_BENCH_CONFIG5", "1") == "1":
+    if os.environ.get("KA_BENCH_CONFIG5", "1") == "1" and budget_left("config5"):
         from kafka_assigner_tpu.models.synthetic import build_config5
         from kafka_assigner_tpu.parallel.whatif import evaluate_removal_scenarios
 
@@ -179,26 +341,17 @@ def main() -> None:
             "config5_ms_per_scenario": round(c5_ms / 256, 2),
         }
 
-    print(
-        json.dumps(
-            {
-                "metric": "headline_5kbrokers_200kpartitions_rf3_replace100_solve"
-                + platform_note,
-                "value": round(tpu_ms, 1),
-                "unit": "ms",
-                "vs_baseline": round(greedy_ms / tpu_ms, 3),
-                "extra": {
-                    "native_greedy_baseline_ms": round(greedy_ms, 1),
-                    "tpu_cold_ms": round(cold_ms, 1),
-                    "moved_replicas": int(m_tpu),
-                    "total_replicas": N_TOPICS * P_PER_TOPIC * RF,
-                    "phase_ms": phase_ms,
-                    **variants,
-                    **config5,
-                },
-            }
-        )
-    )
+    result["extra"].update(variants)
+    result["extra"].update(config5)
+    if budget_skipped:
+        result["extra"]["budget_skipped"] = budget_skipped
+    # Refresh the stash with the COMPLETE record: child stdout does not
+    # survive a teardown hang (TimeoutExpired.stdout is None on POSIX), so
+    # the partial file is what the supervising parent actually salvages.
+    if partial_path:
+        with open(partial_path, "w") as f:
+            json.dump({"complete": True, "result": result}, f)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

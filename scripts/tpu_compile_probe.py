@@ -1,0 +1,104 @@
+"""Bisect what makes the headline solve slow to compile/run on the real chip.
+
+Stages print a timestamped line as they complete, so a hung stage is
+identifiable from partial output. Run with the TPU tunnel live:
+
+    python scripts/tpu_compile_probe.py [max_stage]
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+T0 = time.perf_counter()
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def stamp(msg: str) -> None:
+    print(f"[{time.perf_counter() - T0:8.1f}s] {msg}", flush=True)
+
+
+def main() -> None:
+    max_stage = int(sys.argv[1]) if len(sys.argv) > 1 else 99
+
+    import jax
+    import jax.numpy as jnp
+
+    stamp(f"jax imported, backend={jax.default_backend()}")
+    d = jax.devices()
+    stamp(f"devices: {d}")
+
+    # stage 0: trivial dispatch
+    x = jnp.arange(8)
+    jax.block_until_ready(x + 1)
+    stamp("stage0: trivial add ok")
+    if max_stage < 1:
+        return
+
+    from kafka_assigner_tpu.models.synthetic import rack_striped_cluster
+    from kafka_assigner_tpu.assigner import TopicAssigner
+
+    def solve(n_brokers, n_topics, p_per, rf, racks, replaced, tag):
+        topic_map, _, rack_arr = rack_striped_cluster(
+            n_brokers, n_topics, p_per, rf, racks,
+            name_fmt="topic-{:04d}", extra_brokers=replaced,
+        )
+        topics = list(topic_map.items())
+        live = set(range(replaced, n_brokers)) | set(
+            range(n_brokers, n_brokers + replaced)
+        )
+        rack_map = {b: rack_arr[b] for b in live}
+        t0 = time.perf_counter()
+        TopicAssigner("tpu").generate_assignments(topics, live, rack_map, -1)
+        cold = time.perf_counter() - t0
+        a = TopicAssigner("tpu")
+        t0 = time.perf_counter()
+        a.generate_assignments(topics, live, rack_map, -1)
+        warm = time.perf_counter() - t0
+        stamp(
+            f"{tag}: cold={cold:.1f}s warm={warm * 1000:.0f}ms "
+            f"phases={ {k: round(v, 1) for k, v in a.solver.last_timers.items()} }"
+        )
+
+    # stage 1: small cluster, small topic count
+    solve(64, 4, 16, 3, 4, 2, "stage1 N=64 B=4 P=16")
+    if max_stage < 2:
+        return
+    # stage 2: grow broker axis only
+    solve(5000, 4, 16, 3, 10, 2, "stage2 N=5000 B=4 P=16")
+    if max_stage < 3:
+        return
+    # stage 3: grow partitions per topic
+    solve(5000, 4, 100, 3, 10, 2, "stage3 N=5000 B=4 P=100")
+    if max_stage < 4:
+        return
+    # stage 4: grow topic count to 64 (scan length)
+    solve(5000, 64, 100, 3, 10, 4, "stage4 N=5000 B=64 P=100")
+    if max_stage < 5:
+        return
+    # stage 5: 512 topics (quarter headline)
+    solve(5000, 512, 100, 3, 10, 16, "stage5 N=5000 B=512 P=100")
+    if max_stage < 6:
+        return
+    # stage 6: full headline — the EXACT bench.py workload, imported so the
+    # bisect can never silently drift from the thing that is actually slow.
+    import bench
+
+    topics, live, rack_map = bench.build_headline()
+    t0 = time.perf_counter()
+    TopicAssigner("tpu").generate_assignments(topics, live, rack_map, -1)
+    cold = time.perf_counter() - t0
+    a = TopicAssigner("tpu")
+    t0 = time.perf_counter()
+    a.generate_assignments(topics, live, rack_map, -1)
+    stamp(
+        f"stage6 headline(bench.build_headline): cold={cold:.1f}s "
+        f"warm={(time.perf_counter() - t0) * 1000:.0f}ms"
+    )
+
+
+if __name__ == "__main__":
+    main()
