@@ -165,16 +165,9 @@ def _enable_compile_cache() -> None:
     """Persistent XLA compilation cache shared across processes and rounds:
     a successful (possibly very slow, remote) compile is paid once, then the
     driver's end-of-round bench — a fresh process — reuses the executable."""
-    try:
-        import jax
+    from kafka_assigner_tpu.utils.compilecache import enable_persistent_cache
 
-        cache_dir = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
-        )
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception as e:  # cache is an optimization, never fatal
-        print(f"bench: compile cache unavailable: {e}", file=sys.stderr)
+    enable_persistent_cache()
 
 
 def main() -> None:
@@ -203,6 +196,8 @@ def main() -> None:
     # "default path" measurement into a variant measurement.
     os.environ.pop("KA_STAGED_SOLVE", None)
     os.environ.pop("KA_PALLAS_LEADERSHIP", None)
+    os.environ.pop("KA_WAVE_MODE", None)      # ambient tuning knobs would
+    os.environ.pop("KA_LEADER_CHUNK", None)   # un-default the "default path"
 
     topics, live, rack_map = build_headline()
 
@@ -259,9 +254,17 @@ def main() -> None:
     # Headline secured: stash it so the supervising parent can salvage the
     # on-chip number even if a variant's remote compile hangs past deadline.
     partial_path = os.environ.get("KA_BENCH_PARTIAL")
+
+    def write_stash(payload):
+        # Atomic: the parent's deadline SIGKILL can land mid-write, and a
+        # truncated stash would destroy the secured headline it protects.
+        tmp = partial_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, partial_path)
+
     if partial_path:
-        with open(partial_path, "w") as f:
-            json.dump({"complete": False, "result": result}, f)
+        write_stash({"complete": False, "result": result})
 
     # --- staged-solve comparison (real chip only, or forced) ----------------
     # KA_STAGED_SOLVE=1 swaps the scan-over-topics solve for vmapped
@@ -349,8 +352,7 @@ def main() -> None:
     # survive a teardown hang (TimeoutExpired.stdout is None on POSIX), so
     # the partial file is what the supervising parent actually salvages.
     if partial_path:
-        with open(partial_path, "w") as f:
-            json.dump({"complete": True, "result": result}, f)
+        write_stash({"complete": True, "result": result})
     print(json.dumps(result))
 
 

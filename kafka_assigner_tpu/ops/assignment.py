@@ -329,6 +329,13 @@ WAVE_MODES = {
     "fast": ("fast",),
     "dense": ("dense",),
     "balance": ("balance",),
+    # Two-leg chains: identical output to "auto" whenever the fast leg (or
+    # the chain's fallback) succeeds — which is every non-saturated case —
+    # but compile one fewer while_loop body. Compile time is a first-class
+    # cost on the deployment target (remote compile over the chip tunnel),
+    # so the solver exposes the chain via KA_WAVE_MODE for measurement.
+    "fast_balance": ("fast", "balance"),
+    "fast_dense": ("fast", "dense"),
 }
 
 
@@ -401,6 +408,7 @@ def leadership_order(
     counters: jnp.ndarray,    # (N_pad, RF) Context slab
     jhash: jnp.ndarray,       # scalar: abs(java hash of topic)
     rf: int,
+    chunk: int | None = None,  # partitions per scan step (static unroll)
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Order each partition's replica set by leadership preference,
     reproducing ``computePreferenceLists`` (``:202-302``) exactly.
@@ -448,9 +456,15 @@ def leadership_order(
     # reads counters the previous one wrote), but a scan step costs fixed
     # overhead, so processing CHUNK partitions per step (inner static unroll,
     # same sequential semantics) cuts step count — at 200k partitions this is
-    # the difference between ~200k and ~25k device loop iterations.
+    # the difference between ~200k and ~25k device loop iterations. The
+    # unroll is also compile-time weight (remote compile on the deployment
+    # target), so it is overridable: callers thread a static value, and the
+    # sequential semantics are chunk-invariant (pinned by tests).
     p_pad = acc_nodes.shape[0]
-    chunk = 8 if p_pad % 8 == 0 else 1
+    if chunk is None:
+        chunk = 8
+    if p_pad % chunk != 0:
+        chunk = 1
     cand_chunks = acc_nodes.reshape(p_pad // chunk, chunk, rf)
     count_chunks = acc_count.reshape(p_pad // chunk, chunk)
 
@@ -516,6 +530,7 @@ def _order_one_topic(
     jhash: jnp.ndarray,
     rf: int,
     use_pallas: bool,
+    leader_chunk: int | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     if use_pallas:
         # Opt-in TPU kernel: VMEM-resident counters, no per-partition scan
@@ -525,7 +540,9 @@ def _order_one_topic(
         from .pallas_leadership import leadership_order_pallas
 
         return leadership_order_pallas(acc_nodes, acc_count, counters, jhash, rf)
-    ordered, counters = leadership_order(acc_nodes, acc_count, counters, jhash, rf)
+    ordered, counters = leadership_order(
+        acc_nodes, acc_count, counters, jhash, rf, leader_chunk
+    )
     return ordered, counters
 
 
@@ -541,6 +558,7 @@ def _solve_one_topic(
     wave_mode: str = "auto",
     use_pallas: bool = False,
     rf_actual: jnp.ndarray | None = None,
+    leader_chunk: int | None = None,
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
     """One topic's full pipeline (placement + leadership), shared by the
     single-topic, batched (scan over topics), fresh-placement, and what-if
@@ -549,7 +567,8 @@ def _solve_one_topic(
         current, jhash, p_real, rack_idx, alive, n, rf, wave_mode, rf_actual
     )
     ordered, counters = _order_one_topic(
-        counters, state.acc_nodes, state.acc_count, jhash, rf, use_pallas
+        counters, state.acc_nodes, state.acc_count, jhash, rf, use_pallas,
+        leader_chunk,
     )
     return counters, (ordered, state.infeasible, state.deficit, sticky_kept)
 
@@ -594,6 +613,7 @@ def solve_batched(
     wave_mode: str = "auto",
     use_pallas: bool = False,
     rfs: jnp.ndarray | None = None,  # (B,) per-topic RF for mixed-RF sweeps
+    leader_chunk: int | None = None,  # static leadership unroll (see leadership_order)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Solve B topics in one device dispatch.
 
@@ -618,7 +638,7 @@ def solve_batched(
         current, jhash, p_real, rf_actual = inp
         return _solve_one_topic(
             counters, current, jhash, p_real, rack_idx, alive, n, rf,
-            wave_mode, use_pallas, rf_actual,
+            wave_mode, use_pallas, rf_actual, leader_chunk,
         )
 
     counters, (ordered, infeasible, deficits, kept) = lax.scan(
@@ -629,7 +649,8 @@ def solve_batched(
 
 
 solve_batched_jit = jax.jit(
-    solve_batched, static_argnames=("n", "rf", "wave_mode", "use_pallas")
+    solve_batched,
+    static_argnames=("n", "rf", "wave_mode", "use_pallas", "leader_chunk"),
 )
 
 
@@ -727,6 +748,7 @@ def order_batched(
     jhashes: jnp.ndarray,    # (B,)
     rf: int,
     use_pallas: bool = False,
+    leader_chunk: int | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Stage 2: leadership ordering over already-placed topics, sequential in
     topic order (the Context counter dependency is the one true serialization
@@ -735,7 +757,7 @@ def order_batched(
     def step(counters, inp):
         nodes, count, jh = inp
         ordered, counters = _order_one_topic(
-            counters, nodes, count, jh, rf, use_pallas
+            counters, nodes, count, jh, rf, use_pallas, leader_chunk
         )
         return counters, ordered
 
@@ -744,7 +766,7 @@ def order_batched(
 
 
 order_batched_jit = jax.jit(
-    order_batched, static_argnames=("rf", "use_pallas")
+    order_batched, static_argnames=("rf", "use_pallas", "leader_chunk")
 )
 
 

@@ -58,6 +58,35 @@ def _fresh_solve(rack_idx, counters, jhash, p_real, p_pad, n, rf):
     return ordered, counters, infeasible, deficit
 
 
+def solver_tuning() -> tuple:
+    """(wave_mode, leader_chunk) for the batched solve, env-overridable:
+
+    - ``KA_WAVE_MODE``: which orphan-spread fallback chain to compile
+      (``ops/assignment.py:WAVE_MODES``). Chains that begin with the fast leg
+      produce identical output on any instance the fast leg solves; shorter
+      chains compile fewer while_loop bodies — a first-class cost when the
+      deployment target compiles remotely over the chip tunnel.
+    - ``KA_LEADER_CHUNK``: partitions per leadership scan step (static
+      unroll). Chunk choice is semantics-invariant (pinned by tests).
+
+    Both participate in the jit cache key as static arguments.
+    """
+    wave = os.environ.get("KA_WAVE_MODE", "auto")
+    raw = os.environ.get("KA_LEADER_CHUNK")
+    chunk = None
+    if raw:
+        try:
+            chunk = max(1, int(raw))
+        except ValueError:
+            import sys
+
+            print(
+                f"kafka-assigner: ignoring non-integer KA_LEADER_CHUNK={raw!r}",
+                file=sys.stderr,
+            )
+    return wave, chunk
+
+
 def staged_solve_enabled() -> bool:
     """Staged (vmapped-placement) batched solve, opt-in via
     ``KA_STAGED_SOLVE=1`` until real-chip numbers pick the default
@@ -229,6 +258,7 @@ class TpuSolver:
                     )
                 )
             else:
+                wave_mode, leader_chunk = solver_tuning()
                 ordered, counters_after, infeasible, deficits, _ = (
                     jax.device_get(
                         solve_batched_jit(
@@ -239,7 +269,9 @@ class TpuSolver:
                             jnp.asarray(p_reals),
                             n=encs[0].n,
                             rf=replication_factor,
+                            wave_mode=wave_mode,
                             use_pallas=pallas_leadership_enabled(),
+                            leader_chunk=leader_chunk,
                         )
                     )
                 )
@@ -337,6 +369,7 @@ class TpuSolver:
                 acc_nodes, acc_count, jnp.asarray(counters_before),
                 jnp.asarray(jhashes), rf=replication_factor,
                 use_pallas=pallas_leadership_enabled(),
+                leader_chunk=solver_tuning()[1],
             )
         )
         return (

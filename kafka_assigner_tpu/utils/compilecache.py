@@ -1,0 +1,48 @@
+"""Persistent XLA compilation-cache switch, shared by every on-chip tool.
+
+The deployment target compiles jit programs remotely (the PJRT plugin ships
+HLO over the device tunnel); the headline solve's cold compile is therefore
+the dominant — and least predictable — cost of any fresh process. Pointing
+every tool (bench.py, scripts/tpu_compile_probe.py,
+scripts/validate_pallas_tpu.py) at one on-disk cache means the first
+successful compile of each (program, shape) signature is paid exactly once
+per machine, not once per process: a probe run seeds the cache the
+end-of-round bench then hits.
+
+The reference has no analogue (a JVM CLI has no compile step); this is
+TPU-runtime infrastructure in the sense of SURVEY.md §5's build notes.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+#: Default cache location: sibling of this package, i.e. <repo>/.jax_cache
+#: (gitignored). Override with KA_COMPILE_CACHE_DIR; disable with
+#: KA_COMPILE_CACHE=0.
+_DEFAULT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    ".jax_cache",
+)
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> bool:
+    """Turn on jax's persistent compilation cache; returns success.
+
+    Never fatal: the cache is an optimization, and a tool must not lose its
+    measurement because the cache directory is unwritable.
+    """
+    if os.environ.get("KA_COMPILE_CACHE") == "0":
+        return False
+    try:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            cache_dir or os.environ.get("KA_COMPILE_CACHE_DIR", _DEFAULT_DIR),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        return True
+    except Exception as e:
+        print(f"compile cache unavailable: {e}", file=sys.stderr)
+        return False

@@ -27,6 +27,9 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from kafka_assigner_tpu.utils.compilecache import enable_persistent_cache
+
+    enable_persistent_cache()  # seed the cache bench.py reads
     stamp(f"jax imported, backend={jax.default_backend()}")
     d = jax.devices()
     stamp(f"devices: {d}")

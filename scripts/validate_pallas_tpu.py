@@ -12,10 +12,15 @@ times both at headline scale. All-PASS is the gate for flipping
 """
 from __future__ import annotations
 
+import os
 import sys
 import time
 
 import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 
 def main() -> int:
@@ -24,6 +29,9 @@ def main() -> int:
 
     from kafka_assigner_tpu.ops.assignment import leadership_order
     from kafka_assigner_tpu.ops.pallas_leadership import leadership_order_pallas
+    from kafka_assigner_tpu.utils.compilecache import enable_persistent_cache
+
+    enable_persistent_cache()
 
     backend = jax.default_backend()
     print(f"backend: {backend}, devices: {jax.devices()}")

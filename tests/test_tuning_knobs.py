@@ -1,0 +1,80 @@
+"""The solver's compile-burden knobs must be semantics-invariant.
+
+``KA_LEADER_CHUNK`` changes how many partitions each leadership scan step
+unrolls; ``KA_WAVE_MODE`` changes which orphan-spread fallback chain gets
+compiled. Both exist because compile time is a first-class cost on the
+deployment target (remote compile over the chip tunnel) — neither may change
+a single emitted byte on instances the default path solves.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kafka_assigner_tpu.assigner import TopicAssigner
+
+from .test_invariants import make_cluster
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@pytest.mark.parametrize("chunk", [1, 4, 8, 16])
+def test_leadership_chunk_invariant(chunk):
+    """leadership_order output is identical for every chunk size, including
+    chunks that do not divide P (fallback to 1)."""
+    import jax
+
+    from kafka_assigner_tpu.ops.assignment import leadership_order
+
+    jnp = _jnp()
+    rng = np.random.default_rng(7)
+    p, n, rf = 64, 32, 3
+    acc = np.stack([rng.choice(n, rf, replace=False) for _ in range(p)]).astype(
+        np.int32
+    )
+    cnt = np.full(p, rf, np.int32)
+    counters = rng.integers(0, 50, (n, rf)).astype(np.int32)
+
+    ref = jax.device_get(
+        leadership_order(
+            jnp.asarray(acc), jnp.asarray(cnt), jnp.asarray(counters),
+            jnp.int32(12345), rf,
+        )
+    )
+    got = jax.device_get(
+        leadership_order(
+            jnp.asarray(acc), jnp.asarray(cnt), jnp.asarray(counters),
+            jnp.int32(12345), rf, chunk,
+        )
+    )
+    assert np.array_equal(ref[0], got[0]) and np.array_equal(ref[1], got[1])
+
+
+def _solve_with_env(monkeypatch, topics, live, rack_map, **env):
+    for k in ("KA_WAVE_MODE", "KA_LEADER_CHUNK"):
+        monkeypatch.delenv(k, raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    return TopicAssigner("tpu").generate_assignments(topics, live, rack_map, -1)
+
+
+@pytest.mark.parametrize(
+    "env",
+    [
+        {"KA_WAVE_MODE": "fast_balance"},
+        {"KA_WAVE_MODE": "fast_dense"},
+        {"KA_LEADER_CHUNK": "1"},
+        {"KA_LEADER_CHUNK": "4"},
+        {"KA_WAVE_MODE": "fast_balance", "KA_LEADER_CHUNK": "1"},
+    ],
+)
+def test_solver_knobs_do_not_change_output(monkeypatch, env):
+    current, live, rack_map = make_cluster(3, 16, 32, 3, 4, remove=1)
+    topics = [(f"t{i}", current) for i in range(4)]
+    baseline = _solve_with_env(monkeypatch, topics, live, rack_map)
+    tuned = _solve_with_env(monkeypatch, topics, live, rack_map, **env)
+    assert tuned == baseline
