@@ -29,6 +29,7 @@ here falls back to the host inside ``jit``.
 """
 from __future__ import annotations
 
+import sys
 from typing import NamedTuple, Tuple
 
 import jax
@@ -461,10 +462,18 @@ def leadership_order(
     # target), so it is overridable: callers thread a static value, and the
     # sequential semantics are chunk-invariant (pinned by tests).
     p_pad = acc_nodes.shape[0]
+    default = 8 if p_pad % 8 == 0 else 1
     if chunk is None:
-        chunk = 8
-    if p_pad % chunk != 0:
-        chunk = 1
+        chunk = default
+    elif p_pad % chunk != 0:
+        # An explicitly requested chunk that cannot tile P would silently
+        # measure a different program than the caller asked for — say so.
+        print(
+            f"kafka-assigner: leader chunk {chunk} does not divide "
+            f"p_pad={p_pad}; using {default}",
+            file=sys.stderr,
+        )
+        chunk = default
     cand_chunks = acc_nodes.reshape(p_pad // chunk, chunk, rf)
     count_chunks = acc_count.reshape(p_pad // chunk, chunk)
 

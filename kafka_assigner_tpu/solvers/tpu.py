@@ -71,7 +71,18 @@ def solver_tuning() -> tuple:
 
     Both participate in the jit cache key as static arguments.
     """
+    from ..ops.assignment import WAVE_MODES
+
     wave = os.environ.get("KA_WAVE_MODE", "auto")
+    if wave not in WAVE_MODES:
+        import sys
+
+        print(
+            f"kafka-assigner: ignoring unknown KA_WAVE_MODE={wave!r} "
+            f"(expected one of {sorted(WAVE_MODES)})",
+            file=sys.stderr,
+        )
+        wave = "auto"
     raw = os.environ.get("KA_LEADER_CHUNK")
     chunk = None
     if raw:

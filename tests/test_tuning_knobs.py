@@ -22,7 +22,7 @@ def _jnp():
     return jnp
 
 
-@pytest.mark.parametrize("chunk", [1, 4, 8, 16])
+@pytest.mark.parametrize("chunk", [1, 4, 7, 8, 16])
 def test_leadership_chunk_invariant(chunk):
     """leadership_order output is identical for every chunk size, including
     chunks that do not divide P (fallback to 1)."""
@@ -69,6 +69,7 @@ def _solve_with_env(monkeypatch, topics, live, rack_map, **env):
         {"KA_WAVE_MODE": "fast_dense"},
         {"KA_LEADER_CHUNK": "1"},
         {"KA_LEADER_CHUNK": "4"},
+        {"KA_WAVE_MODE": "not-a-mode"},
         {"KA_WAVE_MODE": "fast_balance", "KA_LEADER_CHUNK": "1"},
     ],
 )
