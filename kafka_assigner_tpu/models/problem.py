@@ -58,6 +58,7 @@ class ClusterEncoding:
     broker_to_idx: Dict[int, int]
     n: int
     n_pad: int
+    n_racks: int                # distinct racks among the real brokers
 
 
 def encode_cluster(
@@ -84,7 +85,17 @@ def encode_cluster(
         broker_to_idx={int(b): i for i, b in enumerate(broker_ids)},
         n=n,
         n_pad=n_pad,
+        n_racks=len(uniq),
     )
+
+
+def rack_cap(n_racks: int) -> int:
+    """Static rack-id bound for the wave bodies' per-rack tensors.
+
+    floor=16: per-rack ops are trivial at this width, and a coarse bucket
+    keeps r_cap out of the compile-cache key for virtually every rack-aware
+    cluster (a tight bucket would recompile per rack-count)."""
+    return _next_bucket(n_racks + 1, floor=16)
 
 
 @dataclass
@@ -105,6 +116,14 @@ class ProblemEncoding:
     p: int                      # real partition count (P)
     n_pad: int
     p_pad: int
+    r_cap: int | None = None    # static rack-id bound: bucket over the real
+                                # rack count (+1 sentinel). The wave bodies
+                                # size every per-rack tensor by it (~16 for a
+                                # 10-rack cluster instead of the 2*n_pad
+                                # worst case); padded node rows whose encoded
+                                # rack ids exceed it are never read by the
+                                # solve (only rows reachable from a real
+                                # broker index are).
 
 
 def encode_problem(
@@ -203,7 +222,120 @@ def encode_problem(
         p=p,
         n_pad=n_pad,
         p_pad=p_pad,
+        r_cap=rack_cap(cluster.n_racks),
     )
+
+
+def encode_topic_group(
+    named_currents: Sequence[tuple],  # [(topic, {pid: [broker_id, ...]}), ...]
+    rack_assignment: Mapping[int, str],
+    nodes: Set[int],
+    rfs: int | Sequence[int],
+    cluster: ClusterEncoding | None = None,
+) -> tuple:
+    """One-pass batched encode of a topic group: the fused equivalent of
+    ``group_pads`` + per-topic :func:`encode_problem` + the caller's stacking
+    loop. Returns ``(encs, currents (B_pad, P_pad, W) int32, jhashes (B_pad,),
+    p_reals (B_pad,))`` with the batch axis bucketed (padding topics inert).
+
+    Why it exists: at the 2000-topic headline, ``group_pads`` re-scans every
+    replica list (200k ``len`` calls) only to compute two bucket sizes, and
+    each ``encode_problem`` pays its own ``np.array`` + ``searchsorted`` —
+    ~40% of the warm critical path was host encode overhead. Here every
+    topic's replica lists convert to one ndarray each (the same single C call
+    also detects raggedness), the id→index mapping is ONE ``searchsorted``
+    over the concatenation, and the group buckets come from the per-topic
+    shapes already in hand. Semantics are identical to the per-topic path
+    (dead brokers → -1, Integer.MIN_VALUE hash rejection, ragged lists via
+    the general fill).
+    """
+    if cluster is None:
+        cluster = encode_cluster(rack_assignment, nodes)
+    broker_ids = cluster.broker_ids
+    n = cluster.n
+    if isinstance(rfs, int):
+        rfs = [rfs] * len(named_currents)
+    elif len(rfs) != len(named_currents):
+        # zip truncation would silently drop the trailing topics from the
+        # solve (their batch rows would stay inert) — fail loudly instead.
+        raise ValueError(
+            f"rfs has {len(rfs)} entries for {len(named_currents)} topics"
+        )
+
+    per = []  # (topic, spids(np), ids(ndarray)|None, cur, jhash)
+    max_p, max_w = 0, 1
+    for topic, cur in named_currents:
+        h = java_string_hash(topic)
+        if h == -(2**31):
+            raise ValueError(
+                f"topic {topic!r} hashes to Integer.MIN_VALUE; the reference "
+                "tool crashes on this input (negative array index)"
+            )
+        spids = sorted(cur)
+        ids = None
+        width = 0
+        if spids and n > 0:
+            try:
+                ids = np.asarray([cur[p] for p in spids], dtype=np.int64)
+                if ids.ndim != 2:
+                    ids = None
+            except (ValueError, TypeError):
+                ids = None  # ragged replica lists: general fill below
+        if ids is not None:
+            width = ids.shape[1]
+        elif spids:
+            width = max((len(cur[p]) for p in spids), default=0)
+        max_p = max(max_p, len(spids))
+        max_w = max(max_w, width)
+        per.append((topic, spids, ids, cur, abs(h)))
+
+    p_pad = _next_bucket(max_p)
+    width = _next_bucket(max_w, floor=2)
+    b_pad = batch_bucket(len(per))
+    currents = np.full((b_pad, p_pad, width), -1, dtype=np.int32)
+    jhashes = np.zeros(b_pad, dtype=np.int32)
+    p_reals = np.zeros(b_pad, dtype=np.int32)
+
+    # One id→index mapping for every uniform topic at once.
+    flats = [ids.ravel() for _, _, ids, _, _ in per if ids is not None]
+    if flats:
+        all_ids = np.concatenate(flats) if len(flats) > 1 else flats[0]
+        idx = np.searchsorted(broker_ids, all_ids).clip(0, max(n - 1, 0))
+        mapped = np.where(broker_ids[idx] == all_ids, idx, -1).astype(np.int32)
+    off = 0
+    encs = []
+    for i, ((topic, spids, ids, cur, jh), rf) in enumerate(zip(per, rfs)):
+        p = len(spids)
+        if ids is not None:
+            size = ids.size
+            currents[i, :p, : ids.shape[1]] = mapped[off : off + size].reshape(
+                ids.shape
+            )
+            off += size
+        elif p:
+            b2i = cluster.broker_to_idx
+            for row, pid in enumerate(spids):
+                for s, b in enumerate(cur[pid]):
+                    currents[i, row, s] = b2i.get(int(b), -1)
+        jhashes[i] = jh
+        p_reals[i] = p
+        encs.append(
+            ProblemEncoding(
+                topic=topic,
+                broker_ids=broker_ids,
+                partition_ids=np.asarray(spids, dtype=np.int64),
+                rack_idx=cluster.rack_idx,
+                current=currents[i],
+                rf=rf,
+                jhash=jh,
+                n=n,
+                p=p,
+                n_pad=cluster.n_pad,
+                p_pad=p_pad,
+                r_cap=rack_cap(cluster.n_racks),
+            )
+        )
+    return encs, currents, jhashes, p_reals
 
 
 def decode_assignment(

@@ -170,6 +170,7 @@ def _wave_body_dense(
     cap: jnp.ndarray,
     n: int,
     alive: jnp.ndarray,
+    r_cap: int,
 ):
     """Dense-eligibility wave: every deficient partition bids for its best
     eligible node over an explicit (P × N) mask. O(P·N) per wave — the
@@ -188,11 +189,10 @@ def _wave_body_dense(
             .set(True)[:, :n]
         )
         acc_racks = _acc_racks(state, rack_idx)
-        n_racks = rack_idx.shape[0] + 1
         rack_used = (
-            jnp.zeros((p, n_racks + 1), dtype=bool)
+            jnp.zeros((p, r_cap + 1), dtype=bool)
             .at[jnp.broadcast_to(rows, acc_racks.shape),
-                jnp.where(acc_racks >= 0, acc_racks, n_racks)]
+                jnp.where(acc_racks >= 0, acc_racks, r_cap)]
             .set(True)
         )
         rack_blocked = jnp.take(rack_used, rack_idx[:n], axis=1)
@@ -214,13 +214,55 @@ def _wave_body_dense(
     return body
 
 
+class Segments(NamedTuple):
+    """Cluster-wide handout order for the fast/balance waves: live nodes
+    sorted by (rack, live-rank), with per-rack [start, end) segment bounds.
+
+    Depends only on (rack_idx, alive) — NOT on the topic or the wave — so it
+    is computed once per batched solve (or per what-if scenario) and shared
+    by every topic's wave loop. A topic's rotated probing order within a rack
+    is a *rotation* of that rack's segment (see ``_wave_body``), so no
+    per-topic or per-wave sort exists anywhere: the round-2 CPU profile
+    showed per-wave argsort + a 2*n_pad-wide top_k dominating the whole
+    solve (~1ms per wave at 5k brokers); this machinery replaces them with a
+    per-wave O(N) cumsum and O(r_cap) bookkeeping.
+    """
+
+    order: jnp.ndarray        # (n,) node indices, live sorted by (rack, rank)
+    sorted_key: jnp.ndarray   # (n,) rack * n_pad + live-rank (BIG for dead)
+    sorted_rank: jnp.ndarray  # (n,) live-rank in sorted order (BIG for dead)
+    seg_start: jnp.ndarray    # (r_cap,)
+    seg_end: jnp.ndarray      # (r_cap,)
+
+
+def cluster_segments(
+    rack_idx: jnp.ndarray, n: int, alive: jnp.ndarray, r_cap: int
+) -> Segments:
+    """Build :class:`Segments` for one (cluster, liveness) pair."""
+    n_pad = rack_idx.shape[0]
+    alive_rank = jnp.cumsum(alive[:n].astype(jnp.int32)) - 1
+    key = jnp.where(alive[:n], rack_idx[:n] * n_pad + alive_rank, BIG)
+    order = jnp.argsort(key).astype(jnp.int32)
+    sorted_key = key[order]
+    alive_s = alive[:n][order]
+    sorted_rack = jnp.where(alive_s, rack_idx[:n][order], jnp.int32(r_cap))
+    sorted_rank = jnp.where(alive_s, alive_rank[order], BIG)
+    rr = jnp.arange(r_cap, dtype=jnp.int32)
+    seg_start = jnp.searchsorted(sorted_rack, rr, side="left").astype(jnp.int32)
+    seg_end = jnp.searchsorted(sorted_rack, rr, side="right").astype(jnp.int32)
+    return Segments(order, sorted_key, sorted_rank, seg_start, seg_end)
+
+
 def _wave_body(
     rack_idx: jnp.ndarray,
-    pos: jnp.ndarray,
     cap: jnp.ndarray,
     n: int,
     alive: jnp.ndarray,
     rf: int,
+    r_cap: int,
+    seg: Segments,
+    start: jnp.ndarray,    # scalar: topic rotation start = abs(hash) % n_alive
+    n_alive: jnp.ndarray,  # scalar: live node count
     balance: bool = False,
 ):
     """One auction wave over all deficient partitions.
@@ -229,36 +271,49 @@ def _wave_body(
     (P × N) matrix: rack exclusivity already subsumes the node-duplicate check
     (a node holding p occupies its rack for p), so a partition's first-fit
     node is "the min-rotated-position available node of the best unblocked
-    rack". Per wave that needs one scatter-min over nodes (O(N)), a top-(RF+1)
-    over racks, and an O(P·RF²) candidate scan — at headline scale ~100x less
-    work than the dense formulation, on either CPU or TPU.
+    rack".
+
+    Rotation without sorting: within a rack's segment (live-rank ascending),
+    the topic-rotated probing order is the segment rotated at the cut where
+    live-rank reaches ``n_alive - start`` — every node at/after the cut has
+    rotated position ``rank + start - n_alive`` (all smaller than ``start``),
+    every node before it ``rank + start``. Both halves stay rank-ascending,
+    so "the j-th available node in rotated order" is two searchsorted probes
+    into the availability cumsum over the fixed segment order. Per wave the
+    whole auction is one O(N) cumsum plus O(r_cap + P) bookkeeping.
 
     ``balance=True`` ranks candidate racks by *remaining capacity* instead of
     first-fit position (ties → lowest rack id). Capacity-greedy rack choice
     keeps rack fill levels even, which solves saturated *fresh* placements
     where every first-fit order (the reference's included) dead-ends.
 
-    Correctness of top-(RF+1): a partition blocks at most RF racks, so among
-    the RF+1 globally-best rack candidates at least one is unblocked, and any
-    rack outside the candidates has a worse position than all of them.
+    Correctness of top-K (K = RF+1 capped at r_cap): a partition blocks at
+    most RF racks, so among the RF+1 globally-best rack candidates at least
+    one is unblocked, and any rack outside the candidates has a worse
+    position than all of them; when r_cap <= RF the candidate set is every
+    rack id outright.
     """
+    k = min(rf + 1, r_cap)
+    order, sorted_key, sorted_rank, seg_start, seg_end = seg
     n_pad = rack_idx.shape[0]
-    # Rack ids: reals < n, padded rows get n..2n_pad-ish; bound generously.
-    r_cap = 2 * n_pad
-    k = rf + 1
+    rr = jnp.arange(r_cap, dtype=jnp.int32)
+    # Per-rack rotation cut (loop-invariant per topic): first in-segment
+    # index whose live-rank >= n_alive - start.
+    cut = jnp.searchsorted(sorted_key, rr * n_pad + (n_alive - start)).astype(
+        jnp.int32
+    )
 
     def body(state: AssignState) -> AssignState:
         avail = alive[:n] & (state.node_load[:n] < cap)
-        # combo packs (pos, node) so a scatter-min yields both the best
-        # position and its node per rack.
-        combo = jnp.where(
-            avail, pos[:n] * n_pad + jnp.arange(n, dtype=jnp.int32), BIG
-        )
-        rack_min = (
-            jnp.full((r_cap,), BIG, dtype=jnp.int32)
-            .at[rack_idx[:n]]
-            .min(combo)
-        )
+        # Running count of available nodes in segment order: rack r's j-th
+        # available node (in any contiguous span) is where the count reaches
+        # span_base + j + 1.
+        ca = jnp.cumsum(avail[order].astype(jnp.int32))
+        ca_pad = jnp.concatenate([jnp.zeros(1, dtype=jnp.int32), ca])
+        base = ca_pad[seg_start]                  # (r_cap,)
+        seg_avail = ca_pad[seg_end] - base        # per-rack available count
+        cum_at_cut = ca_pad[cut]
+        a_after = ca_pad[seg_end] - cum_at_cut    # available at/after the cut
         if balance:
             headroom = jnp.where(avail, cap - state.node_load[:n], 0)
             rack_room = (
@@ -270,24 +325,17 @@ def _wave_body(
             cand_racks = cand_racks.astype(jnp.int32)
             cand_ok = rack_room[cand_racks] > 0
         else:
-            neg_top, cand_racks = lax.top_k(-rack_min, k)
+            # Best rotated position per rack: first available at/after the
+            # cut (wrapped half, positions rank+start-n_alive), else first
+            # available before it (positions rank+start).
+            t_first = jnp.where(a_after > 0, cum_at_cut + 1, base + 1)
+            i_first = jnp.clip(jnp.searchsorted(ca, t_first), 0, n - 1)
+            rack_best = jnp.where(
+                seg_avail > 0, (sorted_rank[i_first] + start) % n_alive, BIG
+            )
+            neg_top, cand_racks = lax.top_k(-rack_best, k)
             cand_racks = cand_racks.astype(jnp.int32)
             cand_ok = -neg_top < BIG              # rack has an available node
-
-        # Available nodes sorted by (rack, pos): the j-th same-rack requester
-        # this wave takes the rack's j-th best node, so placements stay
-        # parallel instead of serializing on each rack's single best node.
-        sort_key = jnp.where(
-            avail, rack_idx[:n] * n_pad + pos[:n], BIG
-        )
-        order = jnp.argsort(sort_key)             # node indices, avail first
-        sorted_racks = jnp.where(
-            avail[order], rack_idx[:n][order], jnp.int32(r_cap)
-        )
-        seg_start = jnp.searchsorted(sorted_racks, cand_racks, side="left")
-        seg_count = (
-            jnp.searchsorted(sorted_racks, cand_racks, side="right") - seg_start
-        ).astype(jnp.int32)
 
         acc_racks = _acc_racks(state, rack_idx)  # (P, RF)
         blocked = jnp.any(
@@ -302,12 +350,20 @@ def _wave_body(
         infeasible = state.infeasible | jnp.any((state.deficit > 0) & ~has_choice)
 
         # Rank among same-rack requesters (ascending partition rows), then
-        # hand out that rack's j-th best available node. Rank 0 always lands,
-        # so every requested rack places at least one replica per wave.
+        # hand out that rack's j-th best available node in rotated order.
+        # Rank 0 always lands, so every requested rack places at least one
+        # replica per wave.
         pick_rack = jnp.where(valid, cand_racks[first_ok], jnp.int32(r_cap))
         j = _requests_rank(pick_rack, valid, r_cap)
-        accept = valid & (j < seg_count[first_ok])
-        slot = jnp.clip(seg_start[first_ok] + j, 0, n - 1)
+        accept = valid & (j < seg_avail[cand_racks][first_ok])
+        pick = jnp.clip(pick_rack, 0, r_cap - 1)
+        wrapped = j >= a_after[pick]              # past the wrapped half
+        target = jnp.where(
+            wrapped,
+            base[pick] + (j - a_after[pick]) + 1,
+            cum_at_cut[pick] + j + 1,
+        )
+        slot = jnp.clip(jnp.searchsorted(ca, target), 0, n - 1)
         node = order[slot].astype(jnp.int32)
         state = _accept_batch(state, node, accept)
         return state._replace(infeasible=infeasible)
@@ -348,6 +404,12 @@ def spread_orphans(
     n: int,
     alive: jnp.ndarray | None = None,
     wave_mode: str = "auto",  # see WAVE_MODES
+    r_cap: int | None = None,  # static rack-id bound (ProblemEncoding.r_cap);
+                               # None = conservative 2*n_pad worst case
+    seg: Segments | None = None,  # precomputed cluster_segments (batched
+                                  # solves hoist it out of the topic scan)
+    start: jnp.ndarray | None = None,    # topic rotation start (scalar)
+    n_alive: jnp.ndarray | None = None,  # live node count (scalar)
 ) -> AssignState:
     """Wave-auction placement of all outstanding replicas
     (``getOrphanedReplicas`` + ``assignOrphans``, ``:133-186``).
@@ -357,6 +419,15 @@ def spread_orphans(
     balance packing solves saturated instances where *every* first-fit order
     (the reference's included, ``KafkaAssignmentStrategy.java:29-30``)
     dead-ends. The chained modes report infeasible only when every leg fails.
+
+    ``r_cap`` sizes every per-rack tensor. Placement decisions are invariant
+    to it (any bound above the real rack count yields byte-identical output);
+    the encoder's tight bucket (~16 for a 10-rack cluster) makes the per-rack
+    ops negligible next to the 2*n_pad = 16384 worst case.
+
+    ``start``/``n_alive`` drive the fast/balance rotation; callers that know
+    them (the placement pipeline) pass them, otherwise they are derived from
+    ``pos`` (the rotated-position array both were computed from).
     """
     if wave_mode not in WAVE_MODES:
         raise ValueError(
@@ -366,7 +437,10 @@ def spread_orphans(
         alive = default_alive(rack_idx, n)
     rf = state.acc_nodes.shape[1]
     n_pad = rack_idx.shape[0]
-    # The fast/balance waves pack (pos, node) / (rack, pos) into int32 keys;
+    if r_cap is None:
+        # Rack ids: reals < n, padded rows get n..2n_pad-ish; bound generously.
+        r_cap = 2 * n_pad
+    # The fast/balance waves sort on (rack, live-rank) packed into int32 keys;
     # beyond this bound the packing would overflow. First-fit modes degrade to
     # dense; balance has no dense equivalent, so fail loudly rather than
     # silently change algorithm (clusters this size exceed any known Kafka
@@ -375,18 +449,35 @@ def spread_orphans(
     if n_pad * n_pad >= BIG:
         if wave_mode == "balance":
             raise ValueError(
-                f"wave_mode 'balance' packs (rack, pos) into int32 keys, "
-                f"which overflows at n_pad={n_pad}"
+                f"wave_mode 'balance' packs (rack, live-rank) into int32 "
+                f"keys, which overflows at n_pad={n_pad}"
             )
         legs = ("dense",)
 
     def cond(state: AssignState) -> jnp.ndarray:
         return jnp.any(state.deficit > 0) & ~state.infeasible
 
+    if any(leg in ("fast", "balance") for leg in legs):
+        if seg is None:
+            seg = cluster_segments(rack_idx, n, alive, r_cap)
+        if n_alive is None:
+            n_alive = jnp.maximum(
+                jnp.sum(alive[: max(n, 1)].astype(jnp.int32)), 1
+            )
+        if start is None:
+            # pos = (alive_rank + start) % n_alive; the first live node has
+            # alive_rank 0, so its position IS the rotation start.
+            first_live = jnp.argmax(alive[:n]).astype(jnp.int32)
+            start = pos[first_live]
     bodies = {
-        "fast": lambda: _wave_body(rack_idx, pos, cap, n, alive, rf),
-        "dense": lambda: _wave_body_dense(rack_idx, pos, cap, n, alive),
-        "balance": lambda: _wave_body(rack_idx, pos, cap, n, alive, rf, balance=True),
+        "fast": lambda: _wave_body(
+            rack_idx, cap, n, alive, rf, r_cap, seg, start, n_alive
+        ),
+        "dense": lambda: _wave_body_dense(rack_idx, pos, cap, n, alive, r_cap),
+        "balance": lambda: _wave_body(
+            rack_idx, cap, n, alive, rf, r_cap, seg, start, n_alive,
+            balance=True,
+        ),
     }
 
     # Progress is ≥ 1 placement per wave while feasible (the rank-0 bid on any
@@ -401,6 +492,33 @@ def spread_orphans(
         )
 
     return run_chain(legs)
+
+
+def _hoisted_segments(
+    rack_idx: jnp.ndarray,
+    n: int,
+    alive: jnp.ndarray,
+    wave_mode: str,
+    r_cap: int | None,
+) -> Segments | None:
+    """``cluster_segments`` when the wave chain has a fast/balance leg (and
+    the key packing fits int32) — the batched solvers call this once outside
+    their topic scan/vmap. Must resolve ``r_cap`` exactly as
+    ``spread_orphans`` does, since the segment arrays are sized by it."""
+    if wave_mode not in WAVE_MODES:
+        # Same descriptive error spread_orphans raises; without this the
+        # batched entry points would surface a bare KeyError first.
+        raise ValueError(
+            f"unknown wave_mode {wave_mode!r}; expected one of {sorted(WAVE_MODES)}"
+        )
+    n_pad = rack_idx.shape[0]
+    if n_pad * n_pad >= BIG:
+        return None  # spread_orphans degrades to dense-only
+    if not any(leg in ("fast", "balance") for leg in WAVE_MODES[wave_mode]):
+        return None
+    return cluster_segments(
+        rack_idx, n, alive, r_cap if r_cap is not None else 2 * n_pad
+    )
 
 
 def leadership_order(
@@ -499,6 +617,8 @@ def _place_one_topic(
     rf: int,
     wave_mode: str = "auto",
     rf_actual: jnp.ndarray | None = None,  # traced per-topic RF (mixed-RF sweeps)
+    r_cap: int | None = None,
+    seg: Segments | None = None,  # hoisted cluster_segments (batched callers)
 ) -> Tuple[AssignState, jnp.ndarray]:
     """One topic's *placement* (sticky fill → wave spread).
 
@@ -528,7 +648,10 @@ def _place_one_topic(
 
     state = sticky_fill(current, rack_idx, rf, cap, n, p_real, alive, rf_actual)
     sticky_kept = jnp.sum(state.acc_count)
-    state = spread_orphans(state, rack_idx, pos, cap, n, alive, wave_mode)
+    state = spread_orphans(
+        state, rack_idx, pos, cap, n, alive, wave_mode, r_cap,
+        seg=seg, start=start, n_alive=n_alive,
+    )
     return state, sticky_kept
 
 
@@ -568,12 +691,15 @@ def _solve_one_topic(
     use_pallas: bool = False,
     rf_actual: jnp.ndarray | None = None,
     leader_chunk: int | None = None,
+    r_cap: int | None = None,
+    seg: Segments | None = None,
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
     """One topic's full pipeline (placement + leadership), shared by the
     single-topic, batched (scan over topics), fresh-placement, and what-if
     (vmap over ``alive``) entry points so their semantics cannot drift."""
     state, sticky_kept = _place_one_topic(
-        current, jhash, p_real, rack_idx, alive, n, rf, wave_mode, rf_actual
+        current, jhash, p_real, rack_idx, alive, n, rf, wave_mode, rf_actual,
+        r_cap, seg,
     )
     ordered, counters = _order_one_topic(
         counters, state.acc_nodes, state.acc_count, jhash, rf, use_pallas,
@@ -591,6 +717,7 @@ def solve_assignment(
     n: int,
     rf: int,
     use_pallas: bool = False,
+    r_cap: int | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Full single-topic solve.
 
@@ -600,13 +727,15 @@ def solve_assignment(
     alive = default_alive(rack_idx, n)
     counters, (ordered, infeasible, deficit, _) = _solve_one_topic(
         counters, current, jhash, p_real, rack_idx, alive, n, rf,
-        use_pallas=use_pallas,
+        use_pallas=use_pallas, r_cap=r_cap,
     )
     return ordered, counters, infeasible, deficit
 
 
 solve_assignment_jit = jax.jit(
-    solve_assignment, static_argnames=("n", "rf", "use_pallas"), donate_argnums=()
+    solve_assignment,
+    static_argnames=("n", "rf", "use_pallas", "r_cap"),
+    donate_argnums=(),
 )
 
 
@@ -623,6 +752,7 @@ def solve_batched(
     use_pallas: bool = False,
     rfs: jnp.ndarray | None = None,  # (B,) per-topic RF for mixed-RF sweeps
     leader_chunk: int | None = None,  # static leadership unroll (see leadership_order)
+    r_cap: int | None = None,         # static rack-id bound (ProblemEncoding.r_cap)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Solve B topics in one device dispatch.
 
@@ -642,12 +772,13 @@ def solve_batched(
         alive = default_alive(rack_idx, n)
     if rfs is None:
         rfs = jnp.full(currents.shape[0], rf, dtype=jnp.int32)
+    seg = _hoisted_segments(rack_idx, n, alive, wave_mode, r_cap)
 
     def per_topic(counters, inp):
         current, jhash, p_real, rf_actual = inp
         return _solve_one_topic(
             counters, current, jhash, p_real, rack_idx, alive, n, rf,
-            wave_mode, use_pallas, rf_actual, leader_chunk,
+            wave_mode, use_pallas, rf_actual, leader_chunk, r_cap, seg,
         )
 
     counters, (ordered, infeasible, deficits, kept) = lax.scan(
@@ -659,7 +790,7 @@ def solve_batched(
 
 solve_batched_jit = jax.jit(
     solve_batched,
-    static_argnames=("n", "rf", "wave_mode", "use_pallas", "leader_chunk"),
+    static_argnames=("n", "rf", "wave_mode", "use_pallas", "leader_chunk", "r_cap"),
 )
 
 
@@ -672,6 +803,7 @@ def place_batched(
     rf: int,
     wave_mode: str = "fast",
     rfs: jnp.ndarray | None = None,
+    r_cap: int | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Stage 1 of the staged batched solve: *placement only*, vmapped across
     topics.
@@ -696,10 +828,12 @@ def place_batched(
     alive = default_alive(rack_idx, n)
     if rfs is None:
         rfs = jnp.full(currents.shape[0], rf, dtype=jnp.int32)
+    seg = _hoisted_segments(rack_idx, n, alive, wave_mode, r_cap)
 
     def one(current, jhash, p_real, rf_actual):
         state, kept = _place_one_topic(
-            current, jhash, p_real, rack_idx, alive, n, rf, wave_mode, rf_actual
+            current, jhash, p_real, rack_idx, alive, n, rf, wave_mode,
+            rf_actual, r_cap, seg,
         )
         return (
             state.acc_nodes, state.acc_count, state.infeasible, state.deficit,
@@ -710,7 +844,7 @@ def place_batched(
 
 
 place_batched_jit = jax.jit(
-    place_batched, static_argnames=("n", "rf", "wave_mode")
+    place_batched, static_argnames=("n", "rf", "wave_mode", "r_cap")
 )
 
 
@@ -723,6 +857,7 @@ def place_scan(
     rf: int,
     wave_mode: str = "auto",
     rfs: jnp.ndarray | None = None,
+    r_cap: int | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Placement-only scan over topics with the FULL fallback chain — the
     rescue path for topics the vmapped fast wave strands. Sequential (scan,
@@ -732,11 +867,13 @@ def place_scan(
     alive = default_alive(rack_idx, n)
     if rfs is None:
         rfs = jnp.full(currents.shape[0], rf, dtype=jnp.int32)
+    seg = _hoisted_segments(rack_idx, n, alive, wave_mode, r_cap)
 
     def step(carry, inp):
         current, jhash, p_real, rf_actual = inp
         state, kept = _place_one_topic(
-            current, jhash, p_real, rack_idx, alive, n, rf, wave_mode, rf_actual
+            current, jhash, p_real, rack_idx, alive, n, rf, wave_mode,
+            rf_actual, r_cap, seg,
         )
         return carry, (
             state.acc_nodes, state.acc_count, state.infeasible, state.deficit,
@@ -747,7 +884,9 @@ def place_scan(
     return outs
 
 
-place_scan_jit = jax.jit(place_scan, static_argnames=("n", "rf", "wave_mode"))
+place_scan_jit = jax.jit(
+    place_scan, static_argnames=("n", "rf", "wave_mode", "r_cap")
+)
 
 
 def order_batched(
@@ -789,6 +928,7 @@ def whatif_sweep(
     rf: int,                   # static max RF (array width)
     wave_mode: str = "fast",
     rfs: jnp.ndarray | None = None,  # (B,) per-topic RF
+    r_cap: int | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Evaluate S broker-removal scenarios over the full cluster in parallel.
 
@@ -811,7 +951,7 @@ def whatif_sweep(
     def one_scenario(alive):
         ordered, _, infeasible, _, _ = solve_batched(
             currents, rack_idx, counters0, jhashes, p_reals, n, rf, alive,
-            wave_mode, False, rfs,
+            wave_mode, False, rfs, r_cap=r_cap,
         )
         # True moved-replica metric: membership diff of the final assignment
         # vs the current matrix. (The sticky_kept proxy over-counts: an orphan
@@ -831,5 +971,5 @@ def whatif_sweep(
 
 
 whatif_sweep_jit = jax.jit(
-    whatif_sweep, static_argnames=("n", "rf", "wave_mode")  # rfs traced
+    whatif_sweep, static_argnames=("n", "rf", "wave_mode", "r_cap")  # rfs traced
 )

@@ -16,8 +16,7 @@ import numpy as np
 from ..models.problem import (
     batch_bucket,
     encode_cluster,
-    encode_problem,
-    group_pads,
+    encode_topic_group,
 )
 
 
@@ -73,24 +72,12 @@ def evaluate_removal_scenarios(
     if not items:
         return []
     rf = max(topic_rfs)
-    p_pad, width = group_pads([cur for _, cur in items])
     cluster = encode_cluster(rack_assignment, brokers)
-    encs = [
-        encode_problem(t, cur, rack_assignment, brokers, cur.keys(), t_rf,
-                       p_pad_override=p_pad, width_override=width,
-                       cluster=cluster)
-        for (t, cur), t_rf in zip(items, topic_rfs)
-    ]
-    b_pad = batch_bucket(len(encs))
-    currents = np.full((b_pad, p_pad, width), -1, dtype=np.int32)
-    jhashes = np.zeros(b_pad, dtype=np.int32)
-    p_reals = np.zeros(b_pad, dtype=np.int32)
-    rfs = np.zeros(b_pad, dtype=np.int32)
-    for i, (e, t_rf) in enumerate(zip(encs, topic_rfs)):
-        currents[i] = e.current
-        jhashes[i] = e.jhash
-        p_reals[i] = e.p
-        rfs[i] = t_rf
+    encs, currents, jhashes, p_reals = encode_topic_group(
+        items, rack_assignment, brokers, topic_rfs, cluster=cluster
+    )
+    rfs = np.zeros(currents.shape[0], dtype=np.int32)
+    rfs[: len(topic_rfs)] = topic_rfs
 
     enc0 = encs[0]
     broker_to_idx = cluster.broker_to_idx
@@ -124,6 +111,7 @@ def evaluate_removal_scenarios(
                 n=enc0.n,
                 rf=rf,
                 rfs=jnp.asarray(rfs),
+                r_cap=enc0.r_cap,
             )
         ),
     )
@@ -148,6 +136,7 @@ def evaluate_removal_scenarios(
                 rf=rf,
                 wave_mode="auto",
                 rfs=jnp.asarray(rfs),
+                r_cap=enc0.r_cap,
             )
         )
         for i, s in enumerate(flagged):

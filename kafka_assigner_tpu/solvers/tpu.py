@@ -31,17 +31,16 @@ import numpy as np
 from ..models.problem import (
     apply_counter_updates,
     batch_bucket,
+    encode_topic_group,
     context_to_array,
     decode_assignment,
     decode_assignments_batched,
-    encode_cluster,
     encode_problem,
-    group_pads,
 )
 from .base import Context
 
 
-def _fresh_solve(rack_idx, counters, jhash, p_real, p_pad, n, rf):
+def _fresh_solve(rack_idx, counters, jhash, p_real, p_pad, n, rf, r_cap):
     """Jitted fresh-placement kernel: the shared per-topic pipeline with an
     empty current assignment (everything is an orphan) and the "fresh" wave
     chain — capacity-greedy balance first, first-fit legs as fallback."""
@@ -53,7 +52,7 @@ def _fresh_solve(rack_idx, counters, jhash, p_real, p_pad, n, rf):
     alive = default_alive(rack_idx, n)
     counters, (ordered, infeasible, deficit, _) = _solve_one_topic(
         counters, empty, jhash, p_real, rack_idx, alive, n, rf,
-        wave_mode="fresh",
+        wave_mode="fresh", r_cap=r_cap,
     )
     return ordered, counters, infeasible, deficit
 
@@ -112,7 +111,7 @@ def _fresh_solve_jit(*args, **kwargs):
     try:
         fn = _fresh_solve_jit_impl
     except NameError:
-        fn = jax.jit(_fresh_solve, static_argnames=("p_pad", "n", "rf"))
+        fn = jax.jit(_fresh_solve, static_argnames=("p_pad", "n", "rf", "r_cap"))
         _fresh_solve_jit_impl = fn
     return fn(*args, **kwargs)
 
@@ -175,6 +174,7 @@ class TpuSolver:
                 n=enc.n,
                 rf=enc.rf,
                 use_pallas=pallas_leadership_enabled(),
+                r_cap=enc.r_cap,
             )
         )
         if bool(infeasible):
@@ -222,30 +222,14 @@ class TpuSolver:
         if not named_currents:
             return []
         with timers.phase("encode"):
-            p_pad, width = group_pads([cur for _, cur in named_currents])
-            cluster = encode_cluster(rack_assignment, nodes)
-            encs = [
-                encode_problem(
-                    topic, cur, rack_assignment, nodes, cur.keys(),
-                    replication_factor,
-                    p_pad_override=p_pad, width_override=width, cluster=cluster,
-                )
-                for topic, cur in named_currents
-            ]
+            # Fused one-pass group encode; the batch axis is bucketed like
+            # every other axis (padding topics are inert: empty current,
+            # p_real 0), so topic-count changes reuse the compiled scan.
+            encs, currents, jhashes, p_reals = encode_topic_group(
+                named_currents, rack_assignment, nodes, replication_factor,
+            )
             counters_before = context_to_array(context, encs[0])
-
-        # The batch axis is bucketed like every other axis: padding topics are
-        # inert (empty current, p_real 0), so topic-count changes reuse the
-        # compiled scan instead of recompiling per B.
         b_real = len(encs)
-        b_pad = batch_bucket(b_real)
-        currents = np.full((b_pad, p_pad, width), -1, dtype=np.int32)
-        jhashes = np.zeros(b_pad, dtype=np.int32)
-        p_reals = np.zeros(b_pad, dtype=np.int32)
-        for i, e in enumerate(encs):
-            currents[i] = e.current
-            jhashes[i] = e.jhash
-            p_reals[i] = e.p
 
         from ..ops.pallas_leadership import pallas_leadership_enabled
 
@@ -283,6 +267,7 @@ class TpuSolver:
                             wave_mode=wave_mode,
                             use_pallas=pallas_leadership_enabled(),
                             leader_chunk=leader_chunk,
+                            r_cap=encs[0].r_cap,
                         )
                     )
                 )
@@ -337,6 +322,7 @@ class TpuSolver:
         acc_nodes, acc_count, infeasible_d, deficits_d, _ = place_batched_jit(
             jnp.asarray(currents), rack_idx, jnp.asarray(jhashes),
             jnp.asarray(p_reals), n=n, rf=replication_factor,
+            r_cap=encs[0].r_cap,
         )
         infeasible = np.array(jax.device_get(infeasible_d))  # writable copy
         deficits = deficits_d
@@ -365,6 +351,7 @@ class TpuSolver:
                 place_scan_jit(
                     jnp.asarray(sub_currents), rack_idx, jnp.asarray(sub_jh),
                     jnp.asarray(sub_pr), n=n, rf=replication_factor,
+                    r_cap=encs[0].r_cap,
                 )
             )
             for k, i in enumerate(flagged):
@@ -431,6 +418,7 @@ class TpuSolver:
                 p_pad=enc.p_pad,
                 n=enc.n,
                 rf=enc.rf,
+                r_cap=enc.r_cap,
             )
         )
         if bool(infeasible):
