@@ -60,9 +60,22 @@ def default_alive(rack_idx: jnp.ndarray, n: int) -> jnp.ndarray:
 def _requests_rank(pick: jnp.ndarray, valid: jnp.ndarray, sentinel: int) -> jnp.ndarray:
     """Rank of each valid request among requests for the same node, in
     ascending partition-row order — the vectorized stand-in for 'TreeMap
-    iteration order decides who hits the capacity gate first'."""
+    iteration order decides who hits the capacity gate first'.
+
+    Rank = count of earlier rows with the same key. For the common partition
+    buckets a (P, P) same-key-before-me count is several times cheaper than a
+    stable argsort (this runs once per sticky slot and once per wave); the
+    argsort path covers giant single-topic buckets where O(P^2) would blow
+    up. Both compute the identical quantity.
+    """
     p = pick.shape[0]
     keys = jnp.where(valid, pick, sentinel)
+    if p <= 256:
+        rows = jnp.arange(p, dtype=jnp.int32)
+        same_before = (keys[None, :] == keys[:, None]) & (
+            rows[None, :] < rows[:, None]
+        )
+        return jnp.sum(same_before, axis=1, dtype=jnp.int32)
     order = jnp.argsort(keys, stable=True)
     sorted_keys = keys[order]
     first = jnp.searchsorted(sorted_keys, sorted_keys, side="left")
