@@ -106,7 +106,11 @@ class ProblemEncoding:
     broker_ids: np.ndarray      # (N,) int64, ascending — index -> broker id
     partition_ids: np.ndarray   # (P,) int64, ascending — row -> partition id
     rack_idx: np.ndarray        # (N_pad,) int32; rack index per node, unique for padded rows
-    current: np.ndarray         # (P_pad, L) int32; broker *index* or -1 (dead/absent)
+    current: np.ndarray         # (P_pad, L) int32; broker *index* or -1 (dead/absent).
+                                # From encode_topic_group this is a VIEW into the
+                                # shared (B_pad, P_pad, L) batch array (sibling
+                                # encodings alias it) — treat as read-only; copy
+                                # before mutating.
     rf: int                     # replication factor to assign
     jhash: int                  # abs(java hash); drives the topic rotation start
                                 # abs(hash) % N (KafkaAssignmentStrategy.java:188-200)
@@ -255,7 +259,7 @@ def encode_topic_group(
     n = cluster.n
     if isinstance(rfs, int):
         rfs = [rfs] * len(named_currents)
-    elif len(rfs) != len(named_currents):
+    elif len(rfs := list(rfs)) != len(named_currents):
         # zip truncation would silently drop the trailing topics from the
         # solve (their batch rows would stay inert) — fail loudly instead.
         raise ValueError(

@@ -57,6 +57,14 @@ def default_alive(rack_idx: jnp.ndarray, n: int) -> jnp.ndarray:
     return jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
 
 
+# Below this partition-bucket size the (P, P) same-key-before-me count beats a
+# stable argsort in _requests_rank (CPU-XLA microbench, round 1: ~3x at P=128,
+# crossover between 256 and 512; a 256x256 bool matrix is 64KB — L2-resident —
+# while argsort pays fixed sort overhead per call). Revisit if bucket sizes or
+# backends change; both paths compute the identical quantity.
+RANK_QUADRATIC_MAX_P = 256
+
+
 def _requests_rank(pick: jnp.ndarray, valid: jnp.ndarray, sentinel: int) -> jnp.ndarray:
     """Rank of each valid request among requests for the same node, in
     ascending partition-row order — the vectorized stand-in for 'TreeMap
@@ -70,7 +78,7 @@ def _requests_rank(pick: jnp.ndarray, valid: jnp.ndarray, sentinel: int) -> jnp.
     """
     p = pick.shape[0]
     keys = jnp.where(valid, pick, sentinel)
-    if p <= 256:
+    if p <= RANK_QUADRATIC_MAX_P:
         rows = jnp.arange(p, dtype=jnp.int32)
         same_before = (keys[None, :] == keys[:, None]) & (
             rows[None, :] < rows[:, None]
@@ -409,6 +417,37 @@ WAVE_MODES = {
 }
 
 
+def _resolve_wave_plan(
+    wave_mode: str, n_pad: int, r_cap: int | None
+) -> tuple[tuple[str, ...], int]:
+    """Single source of truth for the wave chain's (legs, r_cap): validates
+    ``wave_mode``, defaults ``r_cap`` (rack ids: reals < n, padded rows get
+    n..2n_pad-ish; bound generously), and handles the int32 key-packing bound.
+    ``spread_orphans`` and ``_hoisted_segments`` both resolve through here so
+    the hoisted segment arrays can never be sized or gated differently from
+    what the wave bodies expect."""
+    if wave_mode not in WAVE_MODES:
+        raise ValueError(
+            f"unknown wave_mode {wave_mode!r}; expected one of {sorted(WAVE_MODES)}"
+        )
+    if r_cap is None:
+        r_cap = 2 * n_pad
+    legs = WAVE_MODES[wave_mode]
+    # The fast/balance waves sort on (rack, live-rank) packed into int32 keys;
+    # beyond this bound the packing would overflow. First-fit modes degrade to
+    # dense; balance has no dense equivalent, so fail loudly rather than
+    # silently change algorithm (clusters this size exceed any known Kafka
+    # deployment — revisit with int64 keys if one appears).
+    if n_pad * n_pad >= BIG:
+        if wave_mode == "balance":
+            raise ValueError(
+                f"wave_mode 'balance' packs (rack, live-rank) into int32 "
+                f"keys, which overflows at n_pad={n_pad}"
+            )
+        legs = ("dense",)
+    return legs, r_cap
+
+
 def spread_orphans(
     state: AssignState,
     rack_idx: jnp.ndarray,
@@ -442,30 +481,11 @@ def spread_orphans(
     them (the placement pipeline) pass them, otherwise they are derived from
     ``pos`` (the rotated-position array both were computed from).
     """
-    if wave_mode not in WAVE_MODES:
-        raise ValueError(
-            f"unknown wave_mode {wave_mode!r}; expected one of {sorted(WAVE_MODES)}"
-        )
     if alive is None:
         alive = default_alive(rack_idx, n)
     rf = state.acc_nodes.shape[1]
     n_pad = rack_idx.shape[0]
-    if r_cap is None:
-        # Rack ids: reals < n, padded rows get n..2n_pad-ish; bound generously.
-        r_cap = 2 * n_pad
-    # The fast/balance waves sort on (rack, live-rank) packed into int32 keys;
-    # beyond this bound the packing would overflow. First-fit modes degrade to
-    # dense; balance has no dense equivalent, so fail loudly rather than
-    # silently change algorithm (clusters this size exceed any known Kafka
-    # deployment — revisit with int64 keys if one appears).
-    legs = WAVE_MODES[wave_mode]
-    if n_pad * n_pad >= BIG:
-        if wave_mode == "balance":
-            raise ValueError(
-                f"wave_mode 'balance' packs (rack, live-rank) into int32 "
-                f"keys, which overflows at n_pad={n_pad}"
-            )
-        legs = ("dense",)
+    legs, r_cap = _resolve_wave_plan(wave_mode, n_pad, r_cap)
 
     def cond(state: AssignState) -> jnp.ndarray:
         return jnp.any(state.deficit > 0) & ~state.infeasible
@@ -516,22 +536,13 @@ def _hoisted_segments(
 ) -> Segments | None:
     """``cluster_segments`` when the wave chain has a fast/balance leg (and
     the key packing fits int32) — the batched solvers call this once outside
-    their topic scan/vmap. Must resolve ``r_cap`` exactly as
-    ``spread_orphans`` does, since the segment arrays are sized by it."""
-    if wave_mode not in WAVE_MODES:
-        # Same descriptive error spread_orphans raises; without this the
-        # batched entry points would surface a bare KeyError first.
-        raise ValueError(
-            f"unknown wave_mode {wave_mode!r}; expected one of {sorted(WAVE_MODES)}"
-        )
-    n_pad = rack_idx.shape[0]
-    if n_pad * n_pad >= BIG:
-        return None  # spread_orphans degrades to dense-only
-    if not any(leg in ("fast", "balance") for leg in WAVE_MODES[wave_mode]):
+    their topic scan/vmap. Resolves (legs, r_cap) through the same
+    ``_resolve_wave_plan`` as ``spread_orphans``, since the segment arrays are
+    sized by r_cap and gated by the resolved legs."""
+    legs, r_cap = _resolve_wave_plan(wave_mode, rack_idx.shape[0], r_cap)
+    if not any(leg in ("fast", "balance") for leg in legs):
         return None
-    return cluster_segments(
-        rack_idx, n, alive, r_cap if r_cap is not None else 2 * n_pad
-    )
+    return cluster_segments(rack_idx, n, alive, r_cap)
 
 
 def leadership_order(
