@@ -83,5 +83,18 @@ def load_native_library() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_int64),  # ordered_offsets
             ctypes.POINTER(ctypes.c_int32),  # fail_part
         ]
+        order = lib.ka_order_many
+        order.restype = None
+        order.argtypes = [
+            ctypes.c_int32,                  # n_topics
+            ctypes.c_int32,                  # p_pad
+            ctypes.c_int32,                  # rf
+            ctypes.POINTER(ctypes.c_int32),  # acc_nodes
+            ctypes.POINTER(ctypes.c_int32),  # acc_count
+            ctypes.POINTER(ctypes.c_int64),  # jhashes
+            ctypes.POINTER(ctypes.c_int32),  # p_reals
+            ctypes.POINTER(ctypes.c_int32),  # counters (in/out)
+            ctypes.POINTER(ctypes.c_int32),  # out_ordered
+        ]
         _cached = lib
         return lib

@@ -67,6 +67,45 @@ inline void accept(const Topic& t, State& s, int node, int part) {
     s.acc_nodes[static_cast<size_t>(part) * t.rf + c] = node;
 }
 
+// One partition's preference-list ordering (computePreferenceLists,
+// KafkaAssignmentStrategy.java:202-302): for slot r over m remaining
+// candidates, take the first strict minimum of counter[node][r] scanning the
+// remaining set in rotated order == argmin of (count * m + rotated_pos).
+// Shared by the full native solve and the standalone ka_order_many pass run
+// over device-placed batches; counters stride is rf.
+inline void order_partition(
+    const int32_t* cand, int m_all, int rf, int64_t jhash_abs,
+    int32_t* counters, int* remaining, int32_t* out_row) {
+    int n_rem = 0;
+    for (int i = 0; i < m_all; ++i) remaining[n_rem++] = cand[i];
+    for (int r = 0; r < m_all; ++r) {
+        int m = n_rem;
+        int rot_start = static_cast<int>(jhash_abs % m);
+        int64_t best_key = INT64_MAX;
+        int best_i = -1;
+        for (int i = 0; i < n_rem; ++i) {
+            int node = remaining[i];
+            // rank among remaining by node index ascending
+            int k = 0;
+            for (int j = 0; j < n_rem; ++j)
+                if (remaining[j] < node) ++k;
+            int pos = (k + rot_start) % m;
+            int64_t key =
+                static_cast<int64_t>(counters[static_cast<size_t>(node) * rf + r]) * m + pos;
+            if (key < best_key) {
+                best_key = key;
+                best_i = i;
+            }
+        }
+        int chosen = remaining[best_i];
+        remaining[best_i] = remaining[--n_rem];
+        out_row[r] = chosen;
+    }
+    for (int r = m_all; r < rf; ++r) out_row[r] = -1;
+    for (int r = 0; r < m_all; ++r)
+        ++counters[static_cast<size_t>(out_row[r]) * rf + r];
+}
+
 }  // namespace
 
 extern "C" {
@@ -121,44 +160,50 @@ int32_t ka_solve_topic(
         if (deficit != 0) return part + 1;
     }
 
-    // Leadership ordering: for slot r over m remaining candidates, take the
-    // first strict minimum of counter[node][r] scanning the remaining set in
-    // rotated order == argmin of (count * m + rotated_pos).
+    // Leadership ordering (shared helper; see order_partition above).
     std::vector<int> remaining(rf);
     for (int part = 0; part < p; ++part) {
-        const int32_t* cand = &s.acc_nodes[static_cast<size_t>(part) * rf];
-        int m_all = s.acc_count[part];
-        int n_rem = 0;
-        for (int i = 0; i < m_all; ++i) remaining[n_rem++] = cand[i];
-        for (int r = 0; r < m_all; ++r) {
-            int m = n_rem;
-            int rot_start = static_cast<int>(jhash_abs % m);
-            int64_t best_key = INT64_MAX;
-            int best_i = -1;
-            for (int i = 0; i < n_rem; ++i) {
-                int node = remaining[i];
-                // rank among remaining by node index ascending
-                int k = 0;
-                for (int j = 0; j < n_rem; ++j)
-                    if (remaining[j] < node) ++k;
-                int pos = (k + rot_start) % m;
-                int64_t key =
-                    static_cast<int64_t>(counters[static_cast<size_t>(node) * rf + r]) * m + pos;
-                if (key < best_key) {
-                    best_key = key;
-                    best_i = i;
-                }
-            }
-            int chosen = remaining[best_i];
-            remaining[best_i] = remaining[--n_rem];
-            out_ordered[static_cast<size_t>(part) * rf + r] = chosen;
-        }
-        for (int r = m_all; r < rf; ++r)
-            out_ordered[static_cast<size_t>(part) * rf + r] = -1;
-        for (int r = 0; r < m_all; ++r)
-            ++counters[static_cast<size_t>(out_ordered[static_cast<size_t>(part) * rf + r]) * rf + r];
+        order_partition(
+            &s.acc_nodes[static_cast<size_t>(part) * rf], s.acc_count[part],
+            rf, jhash_abs, counters, remaining.data(),
+            out_ordered + static_cast<size_t>(part) * rf);
     }
     return 0;
+}
+
+// Standalone leadership pass over device-placed batches: the heterogeneous
+// split the TPU solver uses by default. Placement (sticky + waves) is the
+// parallel tensor phase and runs on the accelerator; this ordering pass is an
+// inherently sequential 200k-step scalar chain (each partition reads counters
+// the previous one wrote, across topics via the shared Context slab) whose
+// consumers — decode and Context updates — live on the host anyway. A scalar
+// chain runs at ~ns/step here vs ~us/step as an XLA scan
+// (KafkaAssignmentStrategy.java:202-302 for the semantics being reproduced).
+//
+// acc_nodes: (n_topics, p_pad, rf) node index or -1, acceptance order.
+// acc_count: (n_topics, p_pad); rows past p_reals[i] must be 0 (inert).
+// counters:  (*, rf) leadership slab, updated in place; row stride rf.
+// out_ordered: (n_topics, p_pad, rf) preference lists; -1 for empty slots
+// and padded rows — byte-identical to the device leadership_order output.
+void ka_order_many(
+    int32_t n_topics, int32_t p_pad, int32_t rf,
+    const int32_t* acc_nodes, const int32_t* acc_count,
+    const int64_t* jhashes, const int32_t* p_reals,
+    int32_t* counters, int32_t* out_ordered) {
+    std::vector<int> remaining(rf);
+    for (int32_t t = 0; t < n_topics; ++t) {
+        const size_t base = static_cast<size_t>(t) * p_pad;
+        for (int32_t part = 0; part < p_pad; ++part) {
+            const size_t row = (base + part) * rf;
+            if (part < p_reals[t]) {
+                order_partition(
+                    acc_nodes + row, acc_count[base + part], rf, jhashes[t],
+                    counters, remaining.data(), out_ordered + row);
+            } else {
+                for (int r = 0; r < rf; ++r) out_ordered[row + r] = -1;
+            }
+        }
+    }
 }
 
 // Multi-topic entry: the reference's serial topic loop

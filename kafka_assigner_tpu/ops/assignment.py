@@ -187,7 +187,9 @@ def sticky_fill(
 
 def _wave_body_dense(
     rack_idx: jnp.ndarray,
-    pos: jnp.ndarray,
+    pos_fn,  # () -> (N_pad,) rotated positions; evaluated INSIDE the body so
+             # the O(N) rank/where ops only run when a dense wave actually
+             # iterates (rare — it's the fallback leg), not once per topic
     cap: jnp.ndarray,
     n: int,
     alive: jnp.ndarray,
@@ -200,6 +202,7 @@ def _wave_body_dense(
     dense one is too slow to be the common path at 5k-broker scale)."""
 
     def body(state: AssignState) -> AssignState:
+        pos = pos_fn()
         p = state.acc_nodes.shape[0]
         rows = jnp.arange(p, dtype=jnp.int32)[:, None]
 
@@ -479,7 +482,10 @@ def spread_orphans(
 
     ``start``/``n_alive`` drive the fast/balance rotation; callers that know
     them (the placement pipeline) pass them, otherwise they are derived from
-    ``pos`` (the rotated-position array both were computed from).
+    ``pos`` (the rotated-position array both were computed from). ``pos`` may
+    be None when ``start``/``n_alive`` are given — the dense leg then derives
+    the rotated positions lazily inside its wave body, so the O(N) rank ops
+    only execute when a dense wave actually iterates.
     """
     if alive is None:
         alive = default_alive(rack_idx, n)
@@ -490,6 +496,8 @@ def spread_orphans(
     def cond(state: AssignState) -> jnp.ndarray:
         return jnp.any(state.deficit > 0) & ~state.infeasible
 
+    if pos is None and (start is None or n_alive is None):
+        raise ValueError("spread_orphans needs pos, or start + n_alive")
     if any(leg in ("fast", "balance") for leg in legs):
         if seg is None:
             seg = cluster_segments(rack_idx, n, alive, r_cap)
@@ -502,11 +510,18 @@ def spread_orphans(
             # alive_rank 0, so its position IS the rotation start.
             first_live = jnp.argmax(alive[:n]).astype(jnp.int32)
             start = pos[first_live]
+
+    def pos_fn():
+        if pos is not None:
+            return pos
+        alive_rank = jnp.cumsum(alive.astype(jnp.int32)) - 1
+        return jnp.where(alive, (alive_rank + start) % n_alive, BIG)
+
     bodies = {
         "fast": lambda: _wave_body(
             rack_idx, cap, n, alive, rf, r_cap, seg, start, n_alive
         ),
-        "dense": lambda: _wave_body_dense(rack_idx, pos, cap, n, alive, r_cap),
+        "dense": lambda: _wave_body_dense(rack_idx, pos_fn, cap, n, alive, r_cap),
         "balance": lambda: _wave_body(
             rack_idx, cap, n, alive, rf, r_cap, seg, start, n_alive,
             balance=True,
@@ -665,15 +680,14 @@ def _place_one_topic(
     n_alive = jnp.maximum(jnp.sum(alive[: max(n, 1)].astype(jnp.int32)), 1)
     cap = (p_real * rf_actual + n_alive - 1) // n_alive
     start = jhash % n_alive
-    # Rotated position: rank among live nodes (ascending id), shifted by
-    # start with wraparound; dead/padded nodes sort last.
-    alive_rank = jnp.cumsum(alive.astype(jnp.int32)) - 1
-    pos = jnp.where(alive, (alive_rank + start) % n_alive, BIG)
 
     state = sticky_fill(current, rack_idx, rf, cap, n, p_real, alive, rf_actual)
     sticky_kept = jnp.sum(state.acc_count)
+    # pos=None: the dense fallback leg derives rotated positions lazily
+    # inside its wave body (start/n_alive carry the rotation), saving an
+    # O(N_pad) cumsum+where per topic on the common no-dense-wave path.
     state = spread_orphans(
-        state, rack_idx, pos, cap, n, alive, wave_mode, r_cap,
+        state, rack_idx, None, cap, n, alive, wave_mode, r_cap,
         seg=seg, start=start, n_alive=n_alive,
     )
     return state, sticky_kept

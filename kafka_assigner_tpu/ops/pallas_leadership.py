@@ -105,17 +105,26 @@ def leadership_order_pallas(
     if interpret is None:
         interpret = should_interpret()
     p = acc_nodes.shape[0]
-    block = min(BLOCK_P, p)  # both powers of two -> block divides p
+    block = min(BLOCK_P, p)
+    # Pad the partition axis up to a block multiple (p_pad is a multiple of
+    # 8, not necessarily of BLOCK_P): padded rows carry count 0, so every
+    # slot is masked (out = -1, counter writes add 0) — same inertness
+    # contract as the solver's own padded rows.
+    p_grid = -(-p // block) * block
     # -1 padding rows index counters row 0 harmlessly (valid_slot masks the
     # write); clamp for safety.
     cand = jnp.maximum(acc_nodes, 0).astype(jnp.int32)
+    count_col = acc_count.astype(jnp.int32).reshape(p, 1)
+    if p_grid != p:
+        cand = jnp.pad(cand, ((0, p_grid - p), (0, 0)))
+        count_col = jnp.pad(count_col, ((0, p_grid - p), (0, 0)))
     jh = jnp.asarray(jhash, jnp.int32).reshape(1)
 
     ordered, counters_out = pl.pallas_call(
         _kernel,
-        grid=(p // block,),
+        grid=(p_grid // block,),
         out_shape=(
-            jax.ShapeDtypeStruct((p, rf), jnp.int32),         # out
+            jax.ShapeDtypeStruct((p_grid, rf), jnp.int32),    # out
             jax.ShapeDtypeStruct(counters.shape, jnp.int32),  # counters alias
         ),
         in_specs=[
@@ -136,10 +145,10 @@ def leadership_order_pallas(
     )(
         jh,
         cand,
-        acc_count.astype(jnp.int32).reshape(p, 1),
+        count_col,
         counters.astype(jnp.int32),
     )
-    return ordered, counters_out
+    return ordered[:p], counters_out
 
 
 def pallas_leadership_enabled() -> bool:

@@ -97,6 +97,29 @@ def solver_tuning() -> tuple:
     return wave, chunk
 
 
+def _resolve_native_order(use_pallas: bool) -> bool:
+    """Pick host-native vs on-device leadership for the batched solve.
+
+    The pallas kernel runs leadership ON device, so it and the host-native
+    pass are mutually exclusive; when both are requested explicitly the
+    conflict is resolved loudly (pallas wins — it is the narrower opt-in).
+    """
+    from ..native.leadership import leadership_backend
+
+    if use_pallas:
+        if os.environ.get("KA_LEADERSHIP") == "native":
+            import sys
+
+            print(
+                "kafka-assigner: KA_PALLAS_LEADERSHIP=1 overrides "
+                "KA_LEADERSHIP=native (the pallas kernel runs the leadership "
+                "pass on device)",
+                file=sys.stderr,
+            )
+        return False
+    return leadership_backend() == "native"
+
+
 def staged_solve_enabled() -> bool:
     """Staged (vmapped-placement) batched solve, opt-in via
     ``KA_STAGED_SOLVE=1`` until real-chip numbers pick the default
@@ -244,14 +267,45 @@ class TpuSolver:
                 currents, self._mesh, PartitionSpec(None, "part", None)
             )
 
+        use_pallas = pallas_leadership_enabled()
+        native_order = _resolve_native_order(use_pallas)
         with timers.phase("solve"):
             if staged_solve_enabled():
                 ordered, counters_after, infeasible, deficits = (
                     self._solve_staged(
                         currents, encs, counters_before, jhashes, p_reals,
-                        replication_factor, b_real,
+                        replication_factor, b_real, native_order,
                     )
                 )
+            elif native_order:
+                # Heterogeneous split (native/leadership.py): placement — the
+                # parallel tensor phase — on device; the sequential leadership
+                # chain in host C++, where its consumers (decode, Context)
+                # already live. Also the smaller compiled program: the scan
+                # body drops the ~P_pad-step leadership unroll that round 2's
+                # remote compile choked on.
+                from ..ops.assignment import place_scan_jit
+
+                wave_mode, _ = solver_tuning()
+                acc_nodes, acc_count, infeasible, deficits, _ = jax.device_get(
+                    place_scan_jit(
+                        jnp.asarray(currents),
+                        jnp.asarray(encs[0].rack_idx),
+                        jnp.asarray(jhashes),
+                        jnp.asarray(p_reals),
+                        n=encs[0].n,
+                        rf=replication_factor,
+                        wave_mode=wave_mode,
+                        r_cap=encs[0].r_cap,
+                    )
+                )
+                if infeasible[:b_real].any():
+                    ordered = counters_after = None
+                else:
+                    ordered, counters_after = self._order_placed(
+                        acc_nodes, acc_count, counters_before, jhashes,
+                        p_reals, replication_factor, native_order,
+                    )
             else:
                 wave_mode, leader_chunk = solver_tuning()
                 ordered, counters_after, infeasible, deficits, _ = (
@@ -265,7 +319,7 @@ class TpuSolver:
                             n=encs[0].n,
                             rf=replication_factor,
                             wave_mode=wave_mode,
-                            use_pallas=pallas_leadership_enabled(),
+                            use_pallas=use_pallas,
                             leader_chunk=leader_chunk,
                             r_cap=encs[0].r_cap,
                         )
@@ -291,7 +345,7 @@ class TpuSolver:
 
     def _solve_staged(
         self, currents, encs, counters_before, jhashes, p_reals,
-        replication_factor, b_real,
+        replication_factor, b_real, native_order=False,
     ):
         """Staged batched solve: vmapped fast-wave placement across all
         topics, host rescue of stranded topics through the full fallback
@@ -310,12 +364,7 @@ class TpuSolver:
         import jax
         import jax.numpy as jnp
 
-        from ..ops.assignment import (
-            order_batched_jit,
-            place_batched_jit,
-            place_scan_jit,
-        )
-        from ..ops.pallas_leadership import pallas_leadership_enabled
+        from ..ops.assignment import place_batched_jit, place_scan_jit
 
         n = encs[0].n
         rack_idx = jnp.asarray(encs[0].rack_idx)
@@ -362,17 +411,43 @@ class TpuSolver:
         if infeasible[:b_real].any():
             return None, None, infeasible, np.asarray(jax.device_get(deficits))
 
-        ordered, counters_after = jax.device_get(
-            order_batched_jit(
-                acc_nodes, acc_count, jnp.asarray(counters_before),
-                jnp.asarray(jhashes), rf=replication_factor,
-                use_pallas=pallas_leadership_enabled(),
-                leader_chunk=solver_tuning()[1],
-            )
+        ordered, counters_after = self._order_placed(
+            acc_nodes, acc_count, counters_before, jhashes, p_reals,
+            replication_factor, native_order,
         )
         return (
             ordered, counters_after, infeasible,
             np.asarray(jax.device_get(deficits)),
+        )
+
+    def _order_placed(
+        self, acc_nodes, acc_count, counters_before, jhashes, p_reals, rf,
+        native_order,
+    ):
+        """Leadership ordering over already-placed topics — the one shared
+        tail of the default-scan and staged paths (placement arrays may live
+        on device or host). Returns ``(ordered, counters_after)``."""
+        import jax
+        import jax.numpy as jnp
+
+        if native_order:
+            from ..native.leadership import order_many
+
+            return order_many(
+                np.asarray(jax.device_get(acc_nodes)),
+                np.asarray(jax.device_get(acc_count)),
+                jhashes, p_reals, counters_before,
+            )
+        from ..ops.assignment import order_batched_jit
+        from ..ops.pallas_leadership import pallas_leadership_enabled
+
+        return jax.device_get(
+            order_batched_jit(
+                jnp.asarray(acc_nodes), jnp.asarray(acc_count),
+                jnp.asarray(counters_before), jnp.asarray(jhashes), rf=rf,
+                use_pallas=pallas_leadership_enabled(),
+                leader_chunk=solver_tuning()[1],
+            )
         )
 
     def fresh_assignment(

@@ -121,3 +121,31 @@ def test_kernel_multiblock_grid_matches_xla():
     )
     np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
     np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+
+
+@pytest.mark.parametrize("p", [520, 8, 1000])
+def test_kernel_non_block_multiple_p_matches_xla(p):
+    # p_pad is a multiple of 8 (models/problem.py:_pad8), NOT of BLOCK_P:
+    # the grid must ceil-divide and mask the tail rows, or the final
+    # p % BLOCK_P partitions silently get garbage orderings and skipped
+    # counter updates (the round-3 review finding this test pins).
+    rng = np.random.default_rng(11)
+    n, rf = 32, 3
+    acc = np.full((p, rf), -1, np.int32)
+    cnt = np.full(p, rf, np.int32)
+    for i in range(p):
+        acc[i] = rng.choice(n, rf, replace=False)
+    counters = rng.integers(0, 5, (n, rf)).astype(np.int32)
+    jh = int(rng.integers(0, 2**30))
+
+    o1, c1 = leadership_order(
+        jnp.asarray(acc), jnp.asarray(cnt), jnp.asarray(counters),
+        jnp.int32(jh), rf,
+    )
+    o2, c2 = leadership_order_pallas(
+        jnp.asarray(acc), jnp.asarray(cnt), jnp.asarray(counters),
+        jnp.int32(jh), rf, interpret=True,
+    )
+    assert o2.shape == (p, rf)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
