@@ -10,32 +10,44 @@ import os
 import subprocess
 import threading
 
-_SRC = os.path.join(os.path.dirname(__file__), "greedy.cpp")
-_LIB = os.path.join(os.path.dirname(__file__), "libkagreedy.so")
+_DIR = os.path.dirname(__file__)
+_SRC = os.path.join(_DIR, "greedy.cpp")
+_LIB = os.path.join(_DIR, "libkagreedy.so")
+_CODEC_SRC = os.path.join(_DIR, "hostcodec.c")
+_CODEC_LIB = os.path.join(_DIR, "ka_hostcodec.so")
 _lock = threading.Lock()
 _cached: ctypes.CDLL | None = None
+_codec_cached = None
 
 
 class NativeBuildError(RuntimeError):
     pass
 
 
-def _build() -> None:
+def _compile(compiler_cmd: list, lib_path: str) -> None:
     # Compile to a temp file and os.replace into place: concurrent processes
     # (pytest workers, bench + CLI) must never dlopen a half-written .so, and
     # the loser of the race just overwrites with identical bits.
-    tmp = f"{_LIB}.tmp.{os.getpid()}"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC]
+    tmp = f"{lib_path}.tmp.{os.getpid()}"
     try:
-        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        proc = subprocess.run(
+            compiler_cmd + ["-o", tmp], capture_output=True, text=True,
+            timeout=120,
+        )
     except (OSError, subprocess.TimeoutExpired) as e:
-        raise NativeBuildError(f"g++ unavailable or timed out: {e}") from e
+        raise NativeBuildError(f"compiler unavailable or timed out: {e}") from e
     if proc.returncode != 0:
         raise NativeBuildError(f"native build failed:\n{proc.stderr}")
     try:
-        os.replace(tmp, _LIB)
+        os.replace(tmp, lib_path)
     except OSError as e:
         raise NativeBuildError(f"cannot install native library: {e}") from e
+
+
+def _build() -> None:
+    _compile(
+        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC], _LIB
+    )
 
 
 def load_native_library() -> ctypes.CDLL:
@@ -98,3 +110,53 @@ def load_native_library() -> ctypes.CDLL:
         ]
         _cached = lib
         return lib
+
+
+def load_hostcodec():
+    """Compile (if stale) and import the ``ka_hostcodec`` CPython extension —
+    the dict<->tensor boundary codec (``hostcodec.c``). Raises
+    NativeBuildError when the toolchain or Python headers are missing;
+    callers fall back to the numpy path (``KA_HOSTCODEC=0`` forces that).
+    Failures are cached: the codec sits on every solve's encode AND decode,
+    so a broken toolchain must cost one compile attempt, not one per call."""
+    global _codec_cached
+    with _lock:
+        if isinstance(_codec_cached, NativeBuildError):
+            raise _codec_cached
+        if _codec_cached is not None:
+            return _codec_cached
+        try:
+            if (
+                not os.path.exists(_CODEC_LIB)
+                or os.path.getmtime(_CODEC_LIB) < os.path.getmtime(_CODEC_SRC)
+            ):
+                import sysconfig
+
+                inc = sysconfig.get_paths().get("include")
+                if not inc or not os.path.exists(
+                    os.path.join(inc, "Python.h")
+                ):
+                    raise NativeBuildError(
+                        "Python.h not found; cannot build codec"
+                    )
+                _compile(
+                    ["gcc", "-O2", "-shared", "-fPIC", f"-I{inc}", _CODEC_SRC],
+                    _CODEC_LIB,
+                )
+            import importlib.machinery
+            import importlib.util
+
+            loader = importlib.machinery.ExtensionFileLoader(
+                "ka_hostcodec", _CODEC_LIB
+            )
+            spec = importlib.util.spec_from_loader("ka_hostcodec", loader)
+            mod = importlib.util.module_from_spec(spec)
+            loader.exec_module(mod)
+        except NativeBuildError as e:
+            _codec_cached = e
+            raise
+        except Exception as e:  # ImportError (missing symbol), OSError, ...
+            _codec_cached = NativeBuildError(f"codec unusable: {e}")
+            raise _codec_cached from e
+        _codec_cached = mod
+        return mod

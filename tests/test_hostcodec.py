@@ -1,0 +1,164 @@
+"""Differential tests: the C boundary codec (native/hostcodec.c) against the
+numpy reference paths in models/problem.py. Every property the solver relies
+on at the dict<->tensor boundary — sorted partition rows, dead-broker -1
+mapping, ragged-list fills, incomplete-row decode — must be byte-identical
+between the two implementations (KA_HOSTCODEC=0 selects numpy)."""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from kafka_assigner_tpu.models.problem import (
+    decode_assignments_batched,
+    encode_topic_group,
+)
+
+try:
+    from kafka_assigner_tpu.native.build import load_hostcodec
+
+    load_hostcodec()
+    HAVE_CODEC = True
+except Exception:  # toolchain-less environment: numpy path only
+    HAVE_CODEC = False
+
+pytestmark = pytest.mark.skipif(
+    not HAVE_CODEC, reason="hostcodec unbuildable in this environment"
+)
+
+
+def _random_group(rng, n_topics, max_p, brokers, ragged=False):
+    topics = []
+    for i in range(n_topics):
+        p = rng.randint(0, max_p)
+        cur = {}
+        # shuffled, sparse partition ids: the codec must sort them
+        pids = rng.sample(range(max_p * 3), p)
+        for pid in pids:
+            w = rng.randint(0, 4) if ragged else 3
+            # include ids outside the live set (dead brokers -> -1)
+            cur[pid] = [
+                rng.choice(list(brokers) + [99999, -5]) for _ in range(w)
+            ]
+        topics.append((f"topic-{i:03d}", cur))
+    return topics
+
+
+def _encode_both(monkeypatch, topics, racks, brokers, rf):
+    # an ambient KA_HOSTCODEC=0 would silently make this numpy-vs-numpy
+    monkeypatch.delenv("KA_HOSTCODEC", raising=False)
+    out_c = encode_topic_group(topics, racks, brokers, rf)
+    monkeypatch.setenv("KA_HOSTCODEC", "0")
+    out_np = encode_topic_group(topics, racks, brokers, rf)
+    monkeypatch.delenv("KA_HOSTCODEC")
+    return out_c, out_np
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("ragged", [False, True])
+def test_encode_matches_numpy(monkeypatch, seed, ragged):
+    rng = random.Random(seed)
+    brokers = set(range(10, 40))
+    racks = {b: f"r{b % 4}" for b in brokers}
+    topics = _random_group(rng, 9, 12, brokers, ragged=ragged)
+    (encs_c, cur_c, jh_c, pr_c), (encs_n, cur_n, jh_n, pr_n) = _encode_both(
+        monkeypatch, topics, racks, brokers, 3
+    )
+    np.testing.assert_array_equal(cur_c, cur_n)
+    np.testing.assert_array_equal(jh_c, jh_n)
+    np.testing.assert_array_equal(pr_c, pr_n)
+    assert len(encs_c) == len(encs_n)
+    for ec, en in zip(encs_c, encs_n):
+        assert ec.topic == en.topic and ec.p == en.p and ec.jhash == en.jhash
+        assert ec.p_pad == en.p_pad and ec.rf == en.rf
+        np.testing.assert_array_equal(ec.partition_ids, en.partition_ids)
+        np.testing.assert_array_equal(ec.current, en.current)
+
+
+def test_decode_matches_numpy(monkeypatch):
+    monkeypatch.delenv("KA_HOSTCODEC", raising=False)
+    rng = random.Random(3)
+    brokers = set(range(1, 25))
+    racks = {b: f"r{b % 5}" for b in brokers}
+    topics = _random_group(rng, 7, 10, brokers)
+    encs, currents, _, _ = encode_topic_group(topics, racks, brokers, 3)
+    # synthesize an "ordered" tensor with complete, partial and empty rows
+    ordered = np.full((len(encs), encs[0].p_pad, 3), -1, dtype=np.int32)
+    n = encs[0].n
+    for i, e in enumerate(encs):
+        for row in range(e.p):
+            kind = rng.randint(0, 3)
+            if kind == 0:
+                continue  # empty row
+            picks = rng.sample(range(n), 3 if kind > 1 else 2)
+            ordered[i, row, : len(picks)] = picks
+    out_c = decode_assignments_batched(encs, ordered)
+    monkeypatch.setenv("KA_HOSTCODEC", "0")
+    out_np = decode_assignments_batched(encs, ordered)
+    monkeypatch.delenv("KA_HOSTCODEC")
+    assert out_c == out_np
+
+
+def test_codec_error_paths():
+    codec = load_hostcodec()
+    with pytest.raises(TypeError):
+        codec.scan_dims("not a list")
+    with pytest.raises(TypeError):
+        codec.scan_dims([1])
+    brokers = np.arange(4, dtype=np.int64)
+    cur = np.full((1, 2, 2), -1, np.int32)
+    pre = np.zeros(1, np.int32)
+    pid = np.full((1, 2), -1, np.int64)
+    with pytest.raises(ValueError):
+        # replica list longer than width
+        codec.encode_rows([{0: [1, 2, 3]}], brokers, cur, pre, pid)
+    with pytest.raises(ValueError):
+        # more partitions than p_pad
+        codec.encode_rows([{0: [1], 1: [2], 2: [3]}], brokers, cur, pre, pid)
+    with pytest.raises(TypeError):
+        # non-int replica entry
+        codec.encode_rows([{0: ["x"]}], brokers, cur, pre, pid)
+
+
+def test_numpy_int_keys_and_values(monkeypatch):
+    # np.int64 partition keys and replica ids flow through PyNumber_Index
+    brokers = set(range(1, 9))
+    racks = {b: "r1" for b in brokers}
+    cur = {np.int64(3): [np.int64(1), np.int64(2)], np.int64(0): [3, 4]}
+    topics = [("t", cur)]
+    (encs_c, cur_c, _, _), (encs_n, cur_n, _, _) = _encode_both(
+        monkeypatch, topics, racks, brokers, 2
+    )
+    np.testing.assert_array_equal(cur_c, cur_n)
+    np.testing.assert_array_equal(
+        encs_c[0].partition_ids, encs_n[0].partition_ids
+    )
+
+
+def test_decode_rows_rejects_out_of_range_p_reals():
+    codec = load_hostcodec()
+    brokers = np.arange(4, dtype=np.int64)
+    ordered = np.zeros((1, 2, 2), np.int32)
+    pid = np.zeros((1, 2), np.int64)
+    with pytest.raises(ValueError):
+        codec.decode_rows(
+            ordered, brokers, pid, np.array([1000000], np.int32), 1
+        )
+    with pytest.raises(ValueError):
+        codec.decode_rows(ordered, brokers, pid, np.array([-1], np.int32), 1)
+
+
+def test_non_dict_mapping_takes_numpy_path(monkeypatch):
+    # MappingProxyType currents must keep working whether or not the C codec
+    # is buildable (the codec only accepts real dicts).
+    from types import MappingProxyType
+
+    monkeypatch.delenv("KA_HOSTCODEC", raising=False)
+    brokers = set(range(1, 9))
+    racks = {b: f"r{b % 3}" for b in brokers}
+    cur = MappingProxyType({0: [1, 2], 1: [2, 3]})
+    out = encode_topic_group([("t", cur)], racks, brokers, 2)
+    monkeypatch.setenv("KA_HOSTCODEC", "0")
+    ref = encode_topic_group([("t", dict(cur))], racks, brokers, 2)
+    np.testing.assert_array_equal(out[1], ref[1])
