@@ -1,0 +1,114 @@
+"""Differential tests for the leadership-ordering backends.
+
+The batched solve defaults to the host-native C++ pass
+(``native/leadership.py:order_many``) while the on-device scan
+(``ops/assignment.py:leadership_order`` / ``order_batched``) remains the
+jit-internal implementation (what-if sweep, single-topic assign) and the
+no-toolchain fallback. The two must stay byte-identical — including the
+cross-topic Context counter carry — or the solver's output would depend on
+which backend happened to be selected."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kafka_assigner_tpu.ops.assignment import leadership_order, order_batched
+
+try:
+    from kafka_assigner_tpu.native.leadership import order_many
+
+    from kafka_assigner_tpu.native.build import load_native_library
+
+    load_native_library()
+    HAVE_NATIVE = True
+except Exception:
+    HAVE_NATIVE = False
+
+pytestmark = pytest.mark.skipif(
+    not HAVE_NATIVE, reason="native library unbuildable in this environment"
+)
+
+
+def _random_batch(rng, b, p_pad, n, rf):
+    """Placed batches with mixed real sizes and mixed per-row counts (padded
+    rows count 0, exactly as placement emits them)."""
+    acc = np.full((b, p_pad, rf), -1, np.int32)
+    cnt = np.zeros((b, p_pad), np.int32)
+    p_reals = np.zeros(b, np.int32)
+    jhashes = np.zeros(b, np.int64)
+    for t in range(b):
+        p = int(rng.integers(0, p_pad + 1))
+        p_reals[t] = p
+        jhashes[t] = int(rng.integers(0, 2**31 - 1))
+        for row in range(p):
+            m = int(rng.integers(1, rf + 1))
+            acc[t, row, :m] = rng.choice(n, m, replace=False)
+            cnt[t, row] = m
+    return acc, cnt, jhashes, p_reals
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_order_many_matches_device_scan(seed):
+    rng = np.random.default_rng(seed)
+    b, p_pad, n, rf = 7, 24, 16, 3
+    acc, cnt, jhashes, p_reals = _random_batch(rng, b, p_pad, n, rf)
+    counters0 = rng.integers(0, 6, (n, rf)).astype(np.int32)
+
+    got_o, got_c = order_many(acc, cnt, jhashes, p_reals, counters0)
+
+    # Reference: the device scan, topic by topic, carrying the counter slab
+    # (order_batched is the jit equivalent; drive leadership_order directly
+    # so a bug in order_batched's scan plumbing can't mask one here).
+    c = jnp.asarray(counters0)
+    for t in range(b):
+        o, c = leadership_order(
+            jnp.asarray(acc[t]), jnp.asarray(cnt[t]), c,
+            jnp.int32(jhashes[t] % (2**31)), rf,
+        )
+        np.testing.assert_array_equal(
+            got_o[t], np.asarray(o), err_msg=f"topic {t} ordering diverged"
+        )
+    np.testing.assert_array_equal(got_c, np.asarray(c))
+    # input slab must not be mutated (order_many takes a private copy)
+    assert counters0.max() <= 6
+
+
+def test_order_many_matches_order_batched():
+    rng = np.random.default_rng(9)
+    b, p_pad, n, rf = 4, 16, 12, 3
+    acc, cnt, jhashes, p_reals = _random_batch(rng, b, p_pad, n, rf)
+    counters0 = rng.integers(0, 3, (n, rf)).astype(np.int32)
+    got_o, got_c = order_many(acc, cnt, jhashes, p_reals, counters0)
+    ref_o, ref_c = order_batched(
+        jnp.asarray(acc), jnp.asarray(cnt), jnp.asarray(counters0),
+        jnp.asarray(jhashes.astype(np.int32)), rf=rf,
+    )
+    np.testing.assert_array_equal(got_o, np.asarray(ref_o))
+    np.testing.assert_array_equal(got_c, np.asarray(ref_c))
+
+
+def test_device_backend_env_matches_native(monkeypatch):
+    # End-to-end: the same multi-topic solve through KA_LEADERSHIP=device
+    # must reproduce the native default byte-for-byte (incl. leader order).
+    from kafka_assigner_tpu.assigner import TopicAssigner
+
+    topics = [
+        (f"t{i}", {p: [1 + (p + i) % 8, 1 + (p + i + 3) % 8] for p in range(6)})
+        for i in range(4)
+    ]
+    live = set(range(1, 21))  # cap slack: 48 replicas, 20 brokers
+    racks = {b: f"r{b % 4}" for b in live}
+    monkeypatch.delenv("KA_LEADERSHIP", raising=False)
+    default = TopicAssigner("tpu").generate_assignments(topics, live, racks, -1)
+    monkeypatch.setenv("KA_LEADERSHIP", "device")
+    device = TopicAssigner("tpu").generate_assignments(topics, live, racks, -1)
+    assert default == device
+
+
+def test_unknown_backend_value_warns_and_defaults(monkeypatch, capsys):
+    from kafka_assigner_tpu.native.leadership import leadership_backend
+
+    monkeypatch.setenv("KA_LEADERSHIP", "gpu")
+    assert leadership_backend() in ("native", "device")
+    assert "KA_LEADERSHIP" in capsys.readouterr().err
