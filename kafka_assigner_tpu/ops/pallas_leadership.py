@@ -13,17 +13,19 @@ wrote (``KafkaAssignmentStrategy.java:218-237``) — so under XLA it runs as a
 - the grid walks partition *blocks* sequentially, so only one
   (BLOCK_P, RF) tile of candidates/outputs is VMEM-resident at a time —
   arbitrarily large topics never exceed VMEM;
-- within a block, a ``fori_loop`` walks partitions, and the RF² candidate
-  scan is fully unrolled scalar code on the TPU's scalar core — no per-step
-  XLA dispatch, no buffer shuffling.
+- within a block, a ``fori_loop`` walks partitions; the RF² candidate scan
+  is fully unrolled (1, RF) row-vector math (Mosaic rejects scalar VMEM
+  stores — see the kernel comment) — no per-step XLA dispatch, no buffer
+  shuffling.
 
 Semantics are bit-identical to ``leadership_order`` (differential-tested in
 interpret mode). Engaged only when the solver passes ``use_pallas=True``
 (TpuSolver reads ``KA_PALLAS_LEADERSHIP=1`` per call; the flag participates
 in the jit cache key as a static argument). The vmapped what-if sweep never
-engages it (batching aliased pallas buffers is not exercised). Kept opt-in
-until validated on real hardware — this container's chip tunnel was down
-when the kernel was written, so only interpret-mode correctness is proven.
+engages it (batching aliased pallas buffers is not exercised). Round-3
+status: compiles through real Mosaic chipless (``TPU_AOT_r03.log`` stage 6);
+kept opt-in pending on-chip execution timing — the host-native leadership
+pass (``native/leadership.py``) is the production default.
 """
 from __future__ import annotations
 
@@ -42,49 +44,72 @@ def _kernel(jhash_ref, cand_ref, count_ref, counters_in_ref, out_ref, counters_r
     # counters_in_ref and counters_ref (the output) are aliased — one VMEM
     # buffer persisting across the sequential partition-block grid; all
     # reads/writes go through the output ref.
+    #
+    # Mosaic constraint (found by the round-3 chipless AOT compile,
+    # TPU_AOT_r03.log): scalar stores to VMEM are rejected, and scalar
+    # element loads are fragile. Everything here therefore moves in (1, RF)
+    # ROW vectors — dynamic-row loads/stores via pl.ds — with scalars only
+    # as register values extracted by masked reductions. Interpret mode runs
+    # the identical formulation.
     del counters_in_ref
+    from jax.experimental import pallas as pl
+
     p_block, rf = cand_ref.shape
     jh = jhash_ref[0]
+    iota = jnp.arange(rf, dtype=jnp.int32)  # (RF,) register vector
 
     def per_partition(p, _):
-        count = count_ref[p, 0]
-        cands = [cand_ref[p, r] for r in range(rf)]
-        alive = [jnp.int32(r) < count for r in range(rf)]
+        count_row = count_ref[pl.ds(p, 1), :]  # (1, 1)
+        count = jnp.sum(count_row.astype(jnp.int32))
+        cand_row = cand_ref[pl.ds(p, 1), :][0]  # (RF,)
+        alive = iota < count  # (RF,) bool
+        out_vec = jnp.full((rf,), -1, jnp.int32)
 
         for r in range(rf):  # slot loop, static
             # per-partition m = count - r (reference semantics; see
             # ops/assignment.py order_one)
             m = jnp.maximum(count - jnp.int32(r), 1)
             start = jh % m
-            # key_i = counter[cand_i, r] * m + rotated_rank_i, BIG if taken
-            best_key = jnp.int32(BIG)
-            best_i = jnp.int32(-1)
+            # rank of cand_i among remaining candidates (ascending ids):
+            # (RF, RF) broadcast compare, row-sum — all register math
+            less = alive[None, :] & (cand_row[None, :] < cand_row[:, None])
+            k = jnp.sum(less.astype(jnp.int32), axis=1)
+            rot = (k + start) % m
+            # counters[cand_i, r] for each i: RF dynamic-row loads, static
+            # column r extracted by masked sum (no scalar element access)
+            cnt = jnp.zeros((rf,), jnp.int32)
+            col = (iota == r).astype(jnp.int32)  # (RF,) one-hot column mask
             for i in range(rf):
-                # rank of cand_i among remaining candidates (ascending ids)
-                k = jnp.int32(0)
-                for j in range(rf):
-                    k = k + jnp.where(
-                        alive[j] & (cands[j] < cands[i]), 1, 0
-                    ).astype(jnp.int32)
-                rot = (k + start) % m
-                cnt = counters_ref[cands[i], r]
-                key = jnp.where(alive[i], cnt * m + rot, jnp.int32(BIG))
-                take = key < best_key
-                best_key = jnp.where(take, key, best_key)
-                best_i = jnp.where(take, jnp.int32(i), best_i)
-
+                ci = jnp.sum(jnp.where(iota == i, cand_row, 0))
+                row = counters_ref[pl.ds(ci, 1), :][0]
+                cnt = jnp.where(iota == i, jnp.sum(row * col), cnt)
+            key = jnp.where(alive, cnt * m + rot, jnp.int32(BIG))
+            # int argmin via min + first-matching-index (mosaic's argmin
+            # lowers float-only). Keys are distinct among alive candidates
+            # (ranks are a permutation and cnt*m+rot < BIG by the
+            # context_to_array counter bound), so when any candidate is
+            # alive the minimum is unique. When none is (padding row or
+            # slot r >= count) every key is BIG and best_i lands on 0,
+            # selecting cand_row[0]; that is safe NOT because of the index
+            # but because every effect below is masked: the out_vec write
+            # and the counter bump are both gated on valid_slot (the RMW
+            # adds 0), and `alive` is already all-false.
+            min_key = jnp.min(key)
+            first = jnp.min(jnp.where(key == min_key, iota, jnp.int32(rf)))
+            best_i = first.astype(jnp.int32)
             valid_slot = jnp.int32(r) < count
-            chosen = jnp.int32(0)
-            for i in range(rf):
-                chosen = jnp.where(best_i == i, cands[i], chosen)
-            out_ref[p, r] = jnp.where(valid_slot, chosen, jnp.int32(-1))
-            counters_ref[chosen, r] = counters_ref[chosen, r] + jnp.where(
-                valid_slot, 1, 0
-            ).astype(jnp.int32)
-            new_alive = []
-            for i in range(rf):
-                new_alive.append(alive[i] & (best_i != i))
-            alive = new_alive
+            chosen = jnp.sum(jnp.where(iota == best_i, cand_row, 0))
+            out_vec = jnp.where(
+                (iota == r) & valid_slot, chosen, out_vec
+            )
+            # counter RMW as a whole-row vector op; bump is 0 when the slot
+            # is padding, so whichever row `chosen` names is left unchanged
+            crow = counters_ref[pl.ds(chosen, 1), :]
+            bump = (col * jnp.where(valid_slot, 1, 0))[None, :]
+            counters_ref[pl.ds(chosen, 1), :] = crow + bump
+            alive = alive & (iota != best_i)
+
+        out_ref[pl.ds(p, 1), :] = out_vec[None, :]
         return 0
 
     lax.fori_loop(0, p_block, per_partition, 0)
