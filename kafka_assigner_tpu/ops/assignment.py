@@ -842,6 +842,7 @@ def place_batched(
     wave_mode: str = "fast",
     rfs: jnp.ndarray | None = None,
     r_cap: int | None = None,
+    alive: jnp.ndarray | None = None,  # (N_pad,) scenario liveness
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Stage 1 of the staged batched solve: *placement only*, vmapped across
     topics.
@@ -863,7 +864,8 @@ def place_batched(
     Returns (acc_nodes (B, P_pad, RF), acc_count (B, P_pad), infeasible (B,),
     deficits (B, P_pad), sticky_kept (B,)).
     """
-    alive = default_alive(rack_idx, n)
+    if alive is None:
+        alive = default_alive(rack_idx, n)
     if rfs is None:
         rfs = jnp.full(currents.shape[0], rf, dtype=jnp.int32)
     seg = _hoisted_segments(rack_idx, n, alive, wave_mode, r_cap)
@@ -896,13 +898,15 @@ def place_scan(
     wave_mode: str = "auto",
     rfs: jnp.ndarray | None = None,
     r_cap: int | None = None,
+    alive: jnp.ndarray | None = None,  # (N_pad,) scenario liveness
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Placement-only scan over topics with the FULL fallback chain — the
     rescue path for topics the vmapped fast wave strands. Sequential (scan,
     not vmap) so the chained ``lax.cond`` legs stay real branches, but one
     compiled dispatch covers the whole rescue subset — through a tunneled
     chip that matters more than the serialization (~80-100 ms per dispatch)."""
-    alive = default_alive(rack_idx, n)
+    if alive is None:
+        alive = default_alive(rack_idx, n)
     if rfs is None:
         rfs = jnp.full(currents.shape[0], rf, dtype=jnp.int32)
     seg = _hoisted_segments(rack_idx, n, alive, wave_mode, r_cap)
@@ -975,11 +979,13 @@ def whatif_sweep(
     ``vmap`` over the liveness mask, embarrassingly parallel, and shards
     across a device mesh (``parallel/whatif.py``) — BASELINE config 5.
 
-    Each scenario starts from a fresh leadership Context (independent runs).
-    Returns per-scenario (moved_replicas (S,), any_infeasible (S,),
-    max_node_load (S,)).
+    Every metric is SET-based (replica membership, node loads), so the
+    scenario body runs *placement only* — the leadership ordering merely
+    permutes each partition's replica row and cannot change any output; at
+    config-5 scale dropping its sequential scan from the vmapped body is a
+    multi-x saving. Returns per-scenario (moved_replicas (S,),
+    any_infeasible (S,), max_node_load (S,)).
     """
-    counters0 = jnp.zeros((rack_idx.shape[0], rf), dtype=jnp.int32)
     if rfs is None:
         rfs = jnp.full(currents.shape[0], rf, dtype=jnp.int32)
 
@@ -987,9 +993,15 @@ def whatif_sweep(
     # lowers to select and both branches would execute for every scenario.
     # Stranded scenarios are re-run in dense mode by the caller.
     def one_scenario(alive):
-        ordered, _, infeasible, _, _ = solve_batched(
-            currents, rack_idx, counters0, jhashes, p_reals, n, rf, alive,
-            wave_mode, False, rfs, r_cap=r_cap,
+        # Topic-axis scan (NOT vmap): under the scenario vmap a topic-vmapped
+        # placement would run every wave body max-wave-count times across all
+        # (scenario, topic) pairs at once — measured 1.6x slower at config-5
+        # scale — while the scan keeps each topic's while_loop trip count
+        # scenario-batched only, and keeps the compiled program one
+        # chain-body instead of a topic-vmapped copy of every leg.
+        placed, _, infeasible, _, _ = place_scan(
+            currents, rack_idx, jhashes, p_reals, n, rf, wave_mode, rfs,
+            r_cap=r_cap, alive=alive,
         )
         # True moved-replica metric: membership diff of the final assignment
         # vs the current matrix. (The sticky_kept proxy over-counts: an orphan
@@ -997,11 +1009,11 @@ def whatif_sweep(
         # old replica list is not a move.) XLA fuses the (B,P,RF,L) compare
         # into the reduction, so nothing big materializes.
         in_old = jnp.any(
-            ordered[:, :, :, None] == currents[:, :, None, :], axis=-1
+            placed[:, :, :, None] == currents[:, :, None, :], axis=-1
         )
-        moved = jnp.sum((ordered >= 0) & ~in_old)
+        moved = jnp.sum((placed >= 0) & ~in_old)
         # Node loads across every topic's final assignment.
-        safe = jnp.where(ordered >= 0, ordered, rack_idx.shape[0])
+        safe = jnp.where(placed >= 0, placed, rack_idx.shape[0])
         loads = jnp.zeros(rack_idx.shape[0] + 1, dtype=jnp.int32).at[safe].add(1)
         return moved, jnp.any(infeasible), jnp.max(loads[: rack_idx.shape[0]])
 
@@ -1010,4 +1022,50 @@ def whatif_sweep(
 
 whatif_sweep_jit = jax.jit(
     whatif_sweep, static_argnames=("n", "rf", "wave_mode", "r_cap")  # rfs traced
+)
+
+
+def whatif_subset_sweep(
+    currents: jnp.ndarray,   # (S, T_pad, P_pad, L) per-scenario AFFECTED topics
+    rack_idx: jnp.ndarray,   # (N_pad,)
+    jhashes: jnp.ndarray,    # (S, T_pad)
+    p_reals: jnp.ndarray,    # (S, T_pad); padded topic rows are 0 (inert)
+    alive_masks: jnp.ndarray,  # (S, N_pad)
+    n: int,
+    rf: int,
+    rfs: jnp.ndarray | None = None,  # (S, T_pad)
+    r_cap: int | None = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The full sweep restricted to each scenario's own affected-topic
+    subset — the device half of the INCREMENTAL what-if sweep
+    (``parallel/whatif.py``). Identical program structure to
+    ``whatif_sweep`` (per-scenario hoisted segments, topic-axis scan, waves
+    batched across scenarios only), so per-topic cost matches the dense
+    sweep while total work shrinks to the affected fraction.
+
+    Returns per-scenario (moved (S,), any_infeasible (S,),
+    node_load (S, n)) over the subset topics only — the caller composes
+    them with the host-side baseline loads of unaffected topics.
+    """
+    if rfs is None:
+        rfs = jnp.full(currents.shape[:2], rf, dtype=jnp.int32)
+
+    def one_scenario(currents_s, jh_s, pr_s, rfs_s, alive):
+        placed, _, infeasible, _, _ = place_scan(
+            currents_s, rack_idx, jh_s, pr_s, n, rf, "fast", rfs_s,
+            r_cap=r_cap, alive=alive,
+        )
+        in_old = jnp.any(
+            placed[:, :, :, None] == currents_s[:, :, None, :], axis=-1
+        )
+        moved = jnp.sum((placed >= 0) & ~in_old)
+        safe = jnp.where(placed >= 0, placed, rack_idx.shape[0])
+        loads = jnp.zeros(rack_idx.shape[0] + 1, dtype=jnp.int32).at[safe].add(1)
+        return moved, jnp.any(infeasible), loads[:n]
+
+    return jax.vmap(one_scenario)(currents, jhashes, p_reals, rfs, alive_masks)
+
+
+whatif_subset_sweep_jit = jax.jit(
+    whatif_subset_sweep, static_argnames=("n", "rf", "r_cap")
 )
