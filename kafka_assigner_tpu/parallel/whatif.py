@@ -117,7 +117,7 @@ def _rescue_flagged(
 
 def _evaluate_incremental(
     currents, jhashes, p_reals, rfs, cluster, alive, scenarios, s_real,
-    rf, r_cap, b_real,
+    rf, r_cap, b_real, mesh=None,
 ):
     """Incremental sweep: solve only the (scenario, topic) pairs whose
     outcome can differ from the input.
@@ -181,16 +181,37 @@ def _evaluate_incremental(
             sj[s, : len(tops)] = jhashes[tops]
             sp[s, : len(tops)] = p_reals[tops]
             srf[s, : len(tops)] = rfs[tops]
-    moved_s, infeas_s, loads_s = map(
-        np.asarray,
-        jax.device_get(
-            whatif_subset_sweep_jit(
-                jnp.asarray(sc), jnp.asarray(cluster.rack_idx),
-                jnp.asarray(sj), jnp.asarray(sp), jnp.asarray(alive),
-                n=n, rf=rf, rfs=jnp.asarray(srf), r_cap=r_cap,
-            )
-        ),
-    )
+    if mesh is not None:
+        # Scenario-axis sharding, exactly like the dense fleet path: each
+        # device solves its scenarios' affected topics; host composition is
+        # unchanged. (The caller only offers a mesh whose scenario axis
+        # divides s_pad.)
+        from jax.sharding import PartitionSpec
+
+        from .mesh import fetch_global, put_sharded
+
+        def shard(a, spec):
+            return put_sharded(np.asarray(a), mesh, spec)
+
+        s4 = PartitionSpec("scenarios", None, None, None)
+        s2 = PartitionSpec("scenarios", None)
+        outs = whatif_subset_sweep_jit(
+            shard(sc, s4), jnp.asarray(cluster.rack_idx),
+            shard(sj, s2), shard(sp, s2), shard(alive, s2),
+            n=n, rf=rf, rfs=shard(srf, s2), r_cap=r_cap,
+        )
+        moved_s, infeas_s, loads_s = map(np.asarray, fetch_global(outs))
+    else:
+        moved_s, infeas_s, loads_s = map(
+            np.asarray,
+            jax.device_get(
+                whatif_subset_sweep_jit(
+                    jnp.asarray(sc), jnp.asarray(cluster.rack_idx),
+                    jnp.asarray(sj), jnp.asarray(sp), jnp.asarray(alive),
+                    n=n, rf=rf, rfs=jnp.asarray(srf), r_cap=r_cap,
+                )
+            ),
+        )
     moved = np.zeros(s_real, dtype=np.int64)
     infeasible = np.zeros(s_real, dtype=bool)
     load_vec = np.repeat(base_load[None, :], s_real, axis=0)
@@ -268,10 +289,17 @@ def evaluate_removal_scenarios(
 
     import os
 
-    if mesh is None and os.environ.get("KA_WHATIF_INCREMENTAL", "1") != "0":
+    if os.environ.get("KA_WHATIF_INCREMENTAL", "1") != "0":
+        # With a mesh, offer it to the incremental path only when its
+        # scenario axis divides the padded batch (same constraint the dense
+        # sharded path has); otherwise run the incremental sweep unsharded —
+        # at ~1/8th the device work it usually still wins.
+        inc_mesh = mesh
+        if mesh is not None and s_pad % mesh.shape.get("scenarios", 1) != 0:
+            inc_mesh = None
         res = _evaluate_incremental(
             currents, jhashes, p_reals, rfs, cluster, alive, scenarios,
-            s_real, rf, enc0.r_cap, len(items),
+            s_real, rf, enc0.r_cap, len(items), mesh=inc_mesh,
         )
         if res is not None:
             return res
