@@ -1,4 +1,5 @@
-"""Randomized differential soak: sequential vs staged TPU solve vs greedy.
+"""Randomized differential soak: sequential vs staged TPU solve vs greedy,
+plus incremental vs dense what-if sweeps.
 
 Usage:  python scripts/differential_soak.py [seconds]   (default 600)
 
@@ -7,7 +8,11 @@ expansion), solves it three ways, and checks:
 - staged (KA_STAGED_SOLVE=1) output and error behavior EQUAL the sequential
   batched solve, byte-for-byte;
 - when both the tpu and greedy solvers succeed, moved-replica counts are
-  identical (movement parity, the BASELINE contract).
+  identical (movement parity, the BASELINE contract);
+- a random broker-removal scenario set evaluated through the incremental
+  what-if sweep equals the dense sweep (KA_WHATIF_INCREMENTAL=0), including
+  error behavior — on every case, whichever path the profitability gate
+  picks.
 
 Shapes are confined to a handful of compile buckets and the JAX compilation
 cache is cleared periodically — an unbounded shape stream compiles a new
@@ -87,6 +92,39 @@ def main(budget_s: float) -> int:
                       f"rf={rf} racks={racks} rm={remove} add={add} "
                       f"tpu={m_t} greedy={m_g}")
                 return 1
+
+        # What-if sweep differential on the same cluster: random scenario
+        # set through the incremental path vs the dense oracle.
+        from kafka_assigner_tpu.parallel.whatif import (
+            evaluate_removal_scenarios,
+        )
+
+        topic_map = dict(topics)
+        scen = [
+            r.sample(sorted(live), r.randint(0, min(2, len(live) - 1)))
+            for _ in range(r.randint(1, 4))
+        ]
+
+        def sweep(force_dense):
+            if force_dense:
+                os.environ["KA_WHATIF_INCREMENTAL"] = "0"
+            try:
+                try:
+                    return (
+                        evaluate_removal_scenarios(
+                            topic_map, live, rack_map, scen, -1
+                        ),
+                        None,
+                    )
+                except ValueError as e:
+                    return None, str(e)
+            finally:
+                os.environ.pop("KA_WHATIF_INCREMENTAL", None)
+
+        if sweep(False) != sweep(True):
+            print(f"REPRO whatif divergence: seed={seed} n={n} p={p} "
+                  f"rf={rf} racks={racks} rm={remove} add={add} scen={scen}")
+            return 1
         n_cases += 1
         if n_cases % 40 == 0:
             jax.clear_caches()  # see module docstring
