@@ -124,6 +124,31 @@ class TopicAssigner:
         output to the serial loop (the scan carries the leadership counters in
         topic order).
         """
+        import contextlib
+        import os
+
+        trace_ctx = contextlib.nullcontext()
+        profile_dir = os.environ.get("KA_PROFILE")
+        if profile_dir:
+            # One device trace per batched solve (SURVEY.md §5: the
+            # reference has no profiling at all; solve latency is our
+            # headline metric). View with TensorBoard/XProf.
+            from .utils.timers import device_trace
+
+            trace_ctx = device_trace(profile_dir)
+        with trace_ctx:
+            return self._generate_assignments(
+                topic_assignments, brokers, rack_assignment,
+                desired_replication_factor,
+            )
+
+    def _generate_assignments(
+        self,
+        topic_assignments,
+        brokers: Set[int],
+        rack_assignment: Mapping[int, str],
+        desired_replication_factor: int = -1,
+    ) -> List[Tuple[str, Dict[int, List[int]]]]:
         items = (
             list(topic_assignments.items())
             if isinstance(topic_assignments, Mapping)

@@ -79,3 +79,18 @@ def test_solver_knobs_do_not_change_output(monkeypatch, env):
     baseline = _solve_with_env(monkeypatch, topics, live, rack_map)
     tuned = _solve_with_env(monkeypatch, topics, live, rack_map, **env)
     assert tuned == baseline
+
+
+def test_ka_profile_emits_device_trace(monkeypatch, tmp_path):
+    # SURVEY §5 observability: KA_PROFILE=<dir> captures a device trace
+    # around the batched solve (the reference has no profiling at all).
+    from kafka_assigner_tpu.assigner import TopicAssigner
+
+    monkeypatch.setenv("KA_PROFILE", str(tmp_path))
+    topics = [("t", {p: [1 + p % 8, 1 + (p + 3) % 8] for p in range(4)})]
+    live = set(range(1, 17))
+    racks = {b: f"r{b % 4}" for b in live}
+    out = TopicAssigner("tpu").generate_assignments(topics, live, racks, -1)
+    assert len(out) == 1
+    traces = list(tmp_path.rglob("*.xplane.pb"))
+    assert traces, f"no xplane trace under {tmp_path}"
