@@ -119,10 +119,12 @@ class TopicAssigner:
         pairs may repeat a topic name, in which case every occurrence is
         solved and advances the leadership Context, exactly like the
         reference's topic loop (``KafkaAssignmentGenerator.java:173-176``).
-        When the backend supports batching (``assign_many``), consecutive
-        same-RF topics are solved in a single device dispatch with identical
-        output to the serial loop (the scan carries the leadership counters in
-        topic order).
+        When the backend supports batching (``assign_many``), the topics are
+        solved in a single device dispatch with identical output to the
+        serial loop (the scan carries the leadership counters in topic
+        order) — mixed replication factors included for backends that
+        declare ``supports_mixed_rf`` (the TPU solver does); other batching
+        backends get one dispatch per run of consecutive same-RF topics.
         """
         import contextlib
         import os
@@ -175,9 +177,17 @@ class TopicAssigner:
                 )
             return out
 
-        # Batch runs of consecutive topics sharing an RF (almost always one
-        # run); order across runs stays the CLI topic order so the Context
-        # evolves exactly as in the serial loop.
+        # A mixed-RF-capable backend takes the whole list in ONE dispatch
+        # (per-topic rfs ride the same lane the what-if sweeps use);
+        # otherwise batch runs of consecutive topics sharing an RF. Order is
+        # the CLI topic order either way, so the Context evolves exactly as
+        # in the serial loop.
+        if items and getattr(self.solver, "supports_mixed_rf", False):
+            return list(
+                assign_many(
+                    items, rack_assignment, set(brokers), rfs, self.context
+                )
+            )
         i = 0
         while i < len(items):
             j = i

@@ -466,18 +466,32 @@ def decode_assignments_batched(
             np.ascontiguousarray(broker_ids, dtype=np.int64),
             part_ids, p_reals32, len(encs),
         )
-    # Per-topic completeness over *real* rows only (padding is always -1):
-    # one vectorized pass instead of 2000 per-topic reductions.
+    # Per-topic completeness over *real* rows and *this topic's* slots only
+    # (padding rows are always -1, and in a mixed-RF batch a narrower
+    # topic's trailing slots are legitimately -1): one vectorized pass
+    # instead of 2000 per-topic reductions.
     p_reals = np.fromiter((e.p for e in encs), dtype=np.int64, count=len(encs))
+    rfs = np.fromiter((e.rf for e in encs), dtype=np.int64, count=len(encs))
     valid = np.arange(ordered.shape[1])[None, :] < p_reals[:, None]
-    incomplete = ((ordered < 0) & valid[:, :, None]).any(axis=(1, 2))
-    ids_all = broker_ids[np.maximum(ordered, 0)]
-    lists_all = ids_all.tolist()
+    slot_ok = np.arange(ordered.shape[2])[None, None, :] < rfs[:, None, None]
+    incomplete = (
+        (ordered < 0) & valid[:, :, None] & slot_ok
+    ).any(axis=(1, 2))
+    # Bulk tolist per distinct RF so narrow topics' lists carry exactly
+    # their own rf entries (one group in the uniform-RF common case).
+    lists_by_topic: Dict[int, list] = {}
+    for r in np.unique(rfs):
+        idx = np.where(rfs == r)[0]
+        sub = broker_ids[np.maximum(ordered[idx][:, :, :r], 0)].tolist()
+        for k, i in enumerate(idx):
+            lists_by_topic[int(i)] = sub[k]
     out: List[Dict[int, List[int]]] = []
     for i, enc in enumerate(encs):
         if not incomplete[i] and enc.p:
             out.append(
-                dict(zip(enc.partition_ids.tolist(), lists_all[i][: enc.p]))
+                dict(
+                    zip(enc.partition_ids.tolist(), lists_by_topic[i][: enc.p])
+                )
             )
         else:
             out.append(decode_assignment(enc, ordered[i]))

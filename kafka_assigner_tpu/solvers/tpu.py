@@ -209,17 +209,26 @@ class TpuSolver:
         apply_counter_updates(context, enc, counters_before, counters_after)
         return decode_assignment(enc, ordered)
 
+    #: generate_assignments may hand this solver one batch spanning multiple
+    #: replication factors (a Sequence in ``replication_factor``) instead of
+    #: splitting into per-RF runs.
+    supports_mixed_rf = True
+
     def assign_many(
         self,
         named_currents: Sequence[tuple],  # [(topic, current_assignment), ...]
         rack_assignment: Mapping[int, str],
         nodes: Set[int],
-        replication_factor: int,
+        replication_factor,  # int, or Sequence[int] per topic (mixed RF)
         context: Context | None = None,
     ) -> List[tuple]:
-        """Solve a group of same-RF topics in ONE device dispatch, returning
+        """Solve a group of topics in ONE device dispatch, returning
         ``[(topic, assignment), ...]`` in input order (duplicate topic names
         are solved per occurrence, like the reference's topic loop).
+        ``replication_factor`` may be a per-topic sequence — mixed-RF
+        clusters batch into the same dispatch (the per-topic ``rfs`` lane the
+        what-if sweeps already use); output is identical to solving the
+        topics serially in the given order.
 
         The topic loop the reference runs on the host
         (``KafkaAssignmentGenerator.java:173-176``) becomes a ``lax.scan``
@@ -229,6 +238,8 @@ class TpuSolver:
         once per run instead of once per topic. Every topic is padded to the
         group-wide (P, L) bucket; padded rows are inert.
         """
+        import dataclasses
+
         import jax
         import jax.numpy as jnp
 
@@ -244,15 +255,27 @@ class TpuSolver:
             context = Context()
         if not named_currents:
             return []
+        if isinstance(replication_factor, int):
+            rf_list = [replication_factor] * len(named_currents)
+        else:
+            rf_list = [int(r) for r in replication_factor]
+        rf_max = max(rf_list)
         with timers.phase("encode"):
             # Fused one-pass group encode; the batch axis is bucketed like
             # every other axis (padding topics are inert: empty current,
             # p_real 0), so topic-count changes reuse the compiled scan.
             encs, currents, jhashes, p_reals = encode_topic_group(
-                named_currents, rack_assignment, nodes, replication_factor,
+                named_currents, rack_assignment, nodes, rf_list,
             )
-            counters_before = context_to_array(context, encs[0])
+            # The counter slab spans the widest RF in the group; a narrower
+            # topic touches only its own leading slots (same semantics as
+            # the reference's per-slot counter map).
+            enc_slab = dataclasses.replace(encs[0], rf=rf_max)
+            counters_before = context_to_array(context, enc_slab)
         b_real = len(encs)
+        rfs_arr = np.full(currents.shape[0], rf_max, dtype=np.int32)
+        rfs_arr[:b_real] = rf_list
+        replication_factor = rf_max
 
         from ..ops.pallas_leadership import pallas_leadership_enabled
 
@@ -274,7 +297,7 @@ class TpuSolver:
                 ordered, counters_after, infeasible, deficits = (
                     self._solve_staged(
                         currents, encs, counters_before, jhashes, p_reals,
-                        replication_factor, b_real, native_order,
+                        replication_factor, b_real, native_order, rfs_arr,
                     )
                 )
             elif native_order:
@@ -296,6 +319,7 @@ class TpuSolver:
                         n=encs[0].n,
                         rf=replication_factor,
                         wave_mode=wave_mode,
+                        rfs=jnp.asarray(rfs_arr),
                         r_cap=encs[0].r_cap,
                     )
                 )
@@ -320,6 +344,7 @@ class TpuSolver:
                             rf=replication_factor,
                             wave_mode=wave_mode,
                             use_pallas=use_pallas,
+                            rfs=jnp.asarray(rfs_arr),
                             leader_chunk=leader_chunk,
                             r_cap=encs[0].r_cap,
                         )
@@ -334,7 +359,7 @@ class TpuSolver:
             )
         with timers.phase("decode"):
             apply_counter_updates(
-                context, encs[0], counters_before, counters_after
+                context, enc_slab, counters_before, counters_after
             )
             decoded = decode_assignments_batched(encs, ordered[: len(encs)])
             result = [
@@ -345,7 +370,7 @@ class TpuSolver:
 
     def _solve_staged(
         self, currents, encs, counters_before, jhashes, p_reals,
-        replication_factor, b_real, native_order=False,
+        replication_factor, b_real, native_order=False, rfs_arr=None,
     ):
         """Staged batched solve: vmapped fast-wave placement across all
         topics, host rescue of stranded topics through the full fallback
@@ -367,11 +392,15 @@ class TpuSolver:
         from ..ops.assignment import place_batched_jit, place_scan_jit
 
         n = encs[0].n
+        if rfs_arr is None:
+            rfs_arr = np.full(
+                currents.shape[0], replication_factor, np.int32
+            )
         rack_idx = jnp.asarray(encs[0].rack_idx)
         acc_nodes, acc_count, infeasible_d, deficits_d, _ = place_batched_jit(
             jnp.asarray(currents), rack_idx, jnp.asarray(jhashes),
             jnp.asarray(p_reals), n=n, rf=replication_factor,
-            r_cap=encs[0].r_cap,
+            rfs=jnp.asarray(rfs_arr), r_cap=encs[0].r_cap,
         )
         infeasible = np.array(jax.device_get(infeasible_d))  # writable copy
         deficits = deficits_d
@@ -392,15 +421,17 @@ class TpuSolver:
             )
             sub_jh = np.zeros(sub_pad, dtype=np.int32)
             sub_pr = np.zeros(sub_pad, dtype=np.int32)
+            sub_rf = np.full(sub_pad, replication_factor, dtype=np.int32)
             for k, i in enumerate(flagged):
                 sub_currents[k] = currents_h[i]
                 sub_jh[k] = jhashes[i]
                 sub_pr[k] = p_reals[i]
+                sub_rf[k] = rfs_arr[i]
             nodes_s, count_s, inf_s, def_s, _ = jax.device_get(
                 place_scan_jit(
                     jnp.asarray(sub_currents), rack_idx, jnp.asarray(sub_jh),
                     jnp.asarray(sub_pr), n=n, rf=replication_factor,
-                    r_cap=encs[0].r_cap,
+                    rfs=jnp.asarray(sub_rf), r_cap=encs[0].r_cap,
                 )
             )
             for k, i in enumerate(flagged):
