@@ -4,8 +4,9 @@ and the index-space tensors the TPU solver operates on.
 
 Everything downstream of this module works on int32 arrays over *index* space
 (broker row 0..N-1, rack 0..R-1, partition row 0..P-1); ids appear only here.
-Shapes are padded to power-of-two buckets so XLA compiles one kernel per
-bucket instead of one per topic.
+Shapes are bucketed so XLA compiles one kernel per bucket instead of one per
+topic: multiples of 8 on the partition/node axes (``_pad8``), exact replica
+width, powers of two on the batch axis (``batch_bucket``).
 """
 from __future__ import annotations
 
