@@ -17,9 +17,10 @@ Divergence (documented): on an RF decrease the solver emits exactly RF
 replicas per partition instead of the reference's unbounded sticky retention
 (see ``greedy.py`` header).
 
-Shapes are padded to power-of-two buckets, so XLA compiles one kernel per
-(P-bucket, N-bucket, L, RF) signature and reuses it across topics — the warm
-path runs entirely on device.
+Shapes are bucketed (multiples of 8 on the partition/node axes, exact
+replica width, powers of two on the batch axis), so XLA compiles one kernel
+per (P-bucket, N-bucket, L, RF) signature and reuses it across topics — the
+warm path runs entirely on device.
 """
 from __future__ import annotations
 
