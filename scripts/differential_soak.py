@@ -76,6 +76,15 @@ def main(budget_s: float) -> int:
         except Exception:
             continue
         topics = [(f"t{i}", current) for i in range(r.randint(1, 3))]
+        if rf > 1 and r.random() < 0.5:
+            # Mixed-RF batch: interleave a truncated-RF variant of the same
+            # cluster so the single-dispatch mixed path (TpuSolver
+            # supports_mixed_rf) differentials against greedy's serial loop.
+            narrow = {p: list(reps[: rf - 1]) for p, reps in current.items()}
+            topics = [
+                (f"t{i}", current if i % 2 == 0 else narrow)
+                for i in range(len(topics) + 1)
+            ]
 
         seq, seq_err = run(topics, live, rack_map, "tpu")
         stg, stg_err = run(topics, live, rack_map, "tpu", "KA_STAGED_SOLVE")
@@ -85,8 +94,9 @@ def main(budget_s: float) -> int:
             return 1
         gre, _ = run(topics, live, rack_map, "greedy")
         if seq is not None and gre is not None:
-            m_t = sum(moved_replicas(current, a) for _, a in seq)
-            m_g = sum(moved_replicas(current, a) for _, a in gre)
+            by_name = dict(topics)
+            m_t = sum(moved_replicas(by_name[t], a) for t, a in seq)
+            m_g = sum(moved_replicas(by_name[t], a) for t, a in gre)
             if m_t != m_g:
                 print(f"REPRO movement divergence: seed={seed} n={n} p={p} "
                       f"rf={rf} racks={racks} rm={remove} add={add} "
