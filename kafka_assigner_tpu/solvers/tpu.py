@@ -274,8 +274,14 @@ class TpuSolver:
             enc_slab = dataclasses.replace(encs[0], rf=rf_max)
             counters_before = context_to_array(context, enc_slab)
         b_real = len(encs)
-        rfs_arr = np.full(currents.shape[0], rf_max, dtype=np.int32)
-        rfs_arr[:b_real] = rf_list
+        # Uniform batches (the common case) keep rfs out of the program:
+        # a constant per-topic RF folds inside the compiled scan (measured
+        # ~10% placement cost for the traced form at the headline).
+        if all(r == rf_max for r in rf_list):
+            rfs_arr = None
+        else:
+            rfs_arr = np.full(currents.shape[0], rf_max, dtype=np.int32)
+            rfs_arr[:b_real] = rf_list
         replication_factor = rf_max
 
         from ..ops.pallas_leadership import pallas_leadership_enabled
@@ -320,7 +326,7 @@ class TpuSolver:
                         n=encs[0].n,
                         rf=replication_factor,
                         wave_mode=wave_mode,
-                        rfs=jnp.asarray(rfs_arr),
+                        rfs=None if rfs_arr is None else jnp.asarray(rfs_arr),
                         r_cap=encs[0].r_cap,
                     )
                 )
@@ -345,7 +351,8 @@ class TpuSolver:
                             rf=replication_factor,
                             wave_mode=wave_mode,
                             use_pallas=use_pallas,
-                            rfs=jnp.asarray(rfs_arr),
+                            rfs=None if rfs_arr is None
+                            else jnp.asarray(rfs_arr),
                             leader_chunk=leader_chunk,
                             r_cap=encs[0].r_cap,
                         )
@@ -393,15 +400,12 @@ class TpuSolver:
         from ..ops.assignment import place_batched_jit, place_scan_jit
 
         n = encs[0].n
-        if rfs_arr is None:
-            rfs_arr = np.full(
-                currents.shape[0], replication_factor, np.int32
-            )
         rack_idx = jnp.asarray(encs[0].rack_idx)
+        rfs_dev = None if rfs_arr is None else jnp.asarray(rfs_arr)
         acc_nodes, acc_count, infeasible_d, deficits_d, _ = place_batched_jit(
             jnp.asarray(currents), rack_idx, jnp.asarray(jhashes),
             jnp.asarray(p_reals), n=n, rf=replication_factor,
-            rfs=jnp.asarray(rfs_arr), r_cap=encs[0].r_cap,
+            rfs=rfs_dev, r_cap=encs[0].r_cap,
         )
         infeasible = np.array(jax.device_get(infeasible_d))  # writable copy
         deficits = deficits_d
@@ -422,17 +426,21 @@ class TpuSolver:
             )
             sub_jh = np.zeros(sub_pad, dtype=np.int32)
             sub_pr = np.zeros(sub_pad, dtype=np.int32)
-            sub_rf = np.full(sub_pad, replication_factor, dtype=np.int32)
+            sub_rf = None
+            if rfs_arr is not None:
+                sub_rf = np.full(sub_pad, replication_factor, dtype=np.int32)
             for k, i in enumerate(flagged):
                 sub_currents[k] = currents_h[i]
                 sub_jh[k] = jhashes[i]
                 sub_pr[k] = p_reals[i]
-                sub_rf[k] = rfs_arr[i]
+                if sub_rf is not None:
+                    sub_rf[k] = rfs_arr[i]
             nodes_s, count_s, inf_s, def_s, _ = jax.device_get(
                 place_scan_jit(
                     jnp.asarray(sub_currents), rack_idx, jnp.asarray(sub_jh),
                     jnp.asarray(sub_pr), n=n, rf=replication_factor,
-                    rfs=jnp.asarray(sub_rf), r_cap=encs[0].r_cap,
+                    rfs=None if sub_rf is None else jnp.asarray(sub_rf),
+                    r_cap=encs[0].r_cap,
                 )
             )
             for k, i in enumerate(flagged):
