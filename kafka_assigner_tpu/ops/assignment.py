@@ -663,12 +663,11 @@ def _place_one_topic(
 
     Placement is independent of the leadership counters, so callers come in
     two shapes: the sequential scan pipeline (``_solve_one_topic``) and the
-    vmapped fast-wave stage (``place_batched``, ``KA_STAGED_SOLVE=1``).
+    vmapped what-if sweep (``whatif_sweep``, vmap over scenario liveness).
     Under vmap only single-leg wave modes are safe — the chained-fallback
     ``lax.cond`` lowers to ``select`` and runs every leg for every topic
-    (measured 10x CPU regression in round 1) — which is why ``place_batched``
-    is fast-only with a host rescue, and why any change here must keep the
-    staged-vs-sequential equality pin green (``tests/test_staged_solve.py``).
+    (measured 10x CPU regression in round 1) — which is why the sweep runs
+    fast-only with a host rescue of stranded scenarios.
 
     Capacity ``ceil(P*RF/N_alive)`` (``KafkaAssignmentStrategy.java:65-71``),
     the rotation start ``abs(hash) % N_alive`` (``:188-200``) and the rotated
@@ -829,62 +828,6 @@ def solve_batched(
 solve_batched_jit = jax.jit(
     solve_batched,
     static_argnames=("n", "rf", "wave_mode", "use_pallas", "leader_chunk", "r_cap"),
-)
-
-
-def place_batched(
-    currents: jnp.ndarray,   # (B, P_pad, L)
-    rack_idx: jnp.ndarray,   # (N_pad,)
-    jhashes: jnp.ndarray,    # (B,)
-    p_reals: jnp.ndarray,    # (B,)
-    n: int,
-    rf: int,
-    wave_mode: str = "fast",
-    rfs: jnp.ndarray | None = None,
-    r_cap: int | None = None,
-    alive: jnp.ndarray | None = None,  # (N_pad,) scenario liveness
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Stage 1 of the staged batched solve: *placement only*, vmapped across
-    topics.
-
-    Placement (sticky fill + wave spread) has no cross-topic dependency — only
-    leadership does, through the Context counters — so the per-topic scan the
-    reference's semantics force on leadership need not serialize placement.
-    Under ``vmap`` every topic's sticky fill and auction waves batch into one
-    wide tensor program (the MXU/VPU-friendly shape), instead of B small
-    sequential scan steps.
-
-    Runs the FAST wave only: the chained-fallback ``lax.cond`` lowers to
-    ``select`` under vmap and would execute every leg for every topic (the
-    measured 10x round-1 regression). Topics the fast packing strands are
-    flagged, and the caller re-places just those through the sequential
-    full-chain path (``tpu.py:assign_many_staged``) — same rescue pattern the
-    what-if sweep uses.
-
-    Returns (acc_nodes (B, P_pad, RF), acc_count (B, P_pad), infeasible (B,),
-    deficits (B, P_pad), sticky_kept (B,)).
-    """
-    if alive is None:
-        alive = default_alive(rack_idx, n)
-    if rfs is None:
-        rfs = jnp.full(currents.shape[0], rf, dtype=jnp.int32)
-    seg = _hoisted_segments(rack_idx, n, alive, wave_mode, r_cap)
-
-    def one(current, jhash, p_real, rf_actual):
-        state, kept = _place_one_topic(
-            current, jhash, p_real, rack_idx, alive, n, rf, wave_mode,
-            rf_actual, r_cap, seg,
-        )
-        return (
-            state.acc_nodes, state.acc_count, state.infeasible, state.deficit,
-            kept,
-        )
-
-    return jax.vmap(one)(currents, jhashes, p_reals, rfs)
-
-
-place_batched_jit = jax.jit(
-    place_batched, static_argnames=("n", "rf", "wave_mode", "r_cap")
 )
 
 

@@ -31,7 +31,6 @@ import numpy as np
 
 from ..models.problem import (
     apply_counter_updates,
-    batch_bucket,
     encode_topic_group,
     context_to_array,
     decode_assignment,
@@ -119,13 +118,6 @@ def _resolve_native_order(use_pallas: bool) -> bool:
             )
         return False
     return leadership_backend() == "native"
-
-
-def staged_solve_enabled() -> bool:
-    """Staged (vmapped-placement) batched solve, opt-in via
-    ``KA_STAGED_SOLVE=1`` until real-chip numbers pick the default
-    (see ``TpuSolver._solve_staged``)."""
-    return os.environ.get("KA_STAGED_SOLVE") == "1"
 
 
 def _fresh_solve_jit(*args, **kwargs):
@@ -300,14 +292,7 @@ class TpuSolver:
         use_pallas = pallas_leadership_enabled()
         native_order = _resolve_native_order(use_pallas)
         with timers.phase("solve"):
-            if staged_solve_enabled():
-                ordered, counters_after, infeasible, deficits = (
-                    self._solve_staged(
-                        currents, encs, counters_before, jhashes, p_reals,
-                        replication_factor, b_real, native_order, rfs_arr,
-                    )
-                )
-            elif native_order:
+            if native_order:
                 # Heterogeneous split (native/leadership.py): placement — the
                 # parallel tensor phase — on device; the sequential leadership
                 # chain in host C++, where its consumers (decode, Context)
@@ -376,97 +361,12 @@ class TpuSolver:
             ]
         return result
 
-    def _solve_staged(
-        self, currents, encs, counters_before, jhashes, p_reals,
-        replication_factor, b_real, native_order=False, rfs_arr=None,
-    ):
-        """Staged batched solve: vmapped fast-wave placement across all
-        topics, host rescue of stranded topics through the full fallback
-        chain, then the sequential leadership scan — bit-identical output to
-        ``solve_batched`` (placement has no cross-topic dependency; the fast
-        leg is also ``auto``'s first leg, so non-stranded topics place
-        identically).
-
-        Why: ``lax.scan`` over topics serializes placement into B small
-        sequential steps; at 2048 headline topics the vmapped placement is
-        one wide tensor program instead. Opt-in via ``KA_STAGED_SOLVE=1``
-        until real-chip numbers pick the default (round-1 showed naive
-        vmap-with-fallback-chain loses 10x on CPU; this fast-only + rescue
-        design is the one the what-if sweep already validates).
-        """
-        import jax
-        import jax.numpy as jnp
-
-        from ..ops.assignment import place_batched_jit, place_scan_jit
-
-        n = encs[0].n
-        rack_idx = jnp.asarray(encs[0].rack_idx)
-        rfs_dev = None if rfs_arr is None else jnp.asarray(rfs_arr)
-        acc_nodes, acc_count, infeasible_d, deficits_d, _ = place_batched_jit(
-            jnp.asarray(currents), rack_idx, jnp.asarray(jhashes),
-            jnp.asarray(p_reals), n=n, rf=replication_factor,
-            rfs=rfs_dev, r_cap=encs[0].r_cap,
-        )
-        infeasible = np.array(jax.device_get(infeasible_d))  # writable copy
-        deficits = deficits_d
-        flagged = [i for i in range(b_real) if infeasible[i]]
-        if flagged:
-            # A raised fast-wave flag can mean "fast packing stranded", not
-            # true infeasibility: re-place the whole flagged subset through
-            # the chained-fallback scan in ONE dispatch (per-topic dispatches
-            # would pay the tunnel round-trip per strand) and splice.
-            # np.array: device_get returns read-only views.
-            acc_nodes = np.array(jax.device_get(acc_nodes))
-            acc_count = np.array(jax.device_get(acc_count))
-            deficits = np.array(jax.device_get(deficits_d))
-            currents_h = np.asarray(currents)  # host copy once (mesh path)
-            sub_pad = batch_bucket(len(flagged))
-            sub_currents = np.full(
-                (sub_pad,) + currents_h.shape[1:], -1, dtype=np.int32
-            )
-            sub_jh = np.zeros(sub_pad, dtype=np.int32)
-            sub_pr = np.zeros(sub_pad, dtype=np.int32)
-            sub_rf = None
-            if rfs_arr is not None:
-                sub_rf = np.full(sub_pad, replication_factor, dtype=np.int32)
-            for k, i in enumerate(flagged):
-                sub_currents[k] = currents_h[i]
-                sub_jh[k] = jhashes[i]
-                sub_pr[k] = p_reals[i]
-                if sub_rf is not None:
-                    sub_rf[k] = rfs_arr[i]
-            nodes_s, count_s, inf_s, def_s, _ = jax.device_get(
-                place_scan_jit(
-                    jnp.asarray(sub_currents), rack_idx, jnp.asarray(sub_jh),
-                    jnp.asarray(sub_pr), n=n, rf=replication_factor,
-                    rfs=None if sub_rf is None else jnp.asarray(sub_rf),
-                    r_cap=encs[0].r_cap,
-                )
-            )
-            for k, i in enumerate(flagged):
-                acc_nodes[i], acc_count[i] = nodes_s[k], count_s[k]
-                infeasible[i], deficits[i] = bool(inf_s[k]), def_s[k]
-            acc_nodes = jnp.asarray(acc_nodes)
-            acc_count = jnp.asarray(acc_count)
-        if infeasible[:b_real].any():
-            return None, None, infeasible, np.asarray(jax.device_get(deficits))
-
-        ordered, counters_after = self._order_placed(
-            acc_nodes, acc_count, counters_before, jhashes, p_reals,
-            replication_factor, native_order,
-        )
-        return (
-            ordered, counters_after, infeasible,
-            np.asarray(jax.device_get(deficits)),
-        )
-
     def _order_placed(
         self, acc_nodes, acc_count, counters_before, jhashes, p_reals, rf,
         native_order,
     ):
-        """Leadership ordering over already-placed topics — the one shared
-        tail of the default-scan and staged paths (placement arrays may live
-        on device or host). Returns ``(ordered, counters_after)``."""
+        """Leadership ordering over already-placed topics (placement arrays
+        may live on device or host). Returns ``(ordered, counters_after)``."""
         import jax
         import jax.numpy as jnp
 

@@ -1,12 +1,12 @@
-"""Randomized differential soak: sequential vs staged TPU solve vs greedy,
-plus incremental vs dense what-if sweeps.
+"""Randomized differential soak: TPU solve (host-native vs on-device
+leadership) vs greedy, plus incremental vs dense what-if sweeps.
 
 Usage:  python scripts/differential_soak.py [seconds]   (default 600)
 
 Every case builds a random cluster (brokers/partitions/RF/racks/decommission/
 expansion), solves it three ways, and checks:
-- staged (KA_STAGED_SOLVE=1) output and error behavior EQUAL the sequential
-  batched solve, byte-for-byte;
+- on-device leadership (KA_LEADERSHIP=device) output and error behavior
+  EQUAL the default host-native-leadership solve, byte-for-byte;
 - when both the tpu and greedy solvers succeed, moved-replica counts are
   identical (movement parity, the BASELINE contract);
 - a random broker-removal scenario set evaluated through the incremental
@@ -43,9 +43,22 @@ def main(budget_s: float) -> int:
     n_cases = 0
     rng = random.Random(int(os.environ.get("KA_SOAK_SEED", "20260729")))
 
-    def run(topics, live, rack_map, solver, env=None):
+    # The device-leadership lane is only a differential when the default
+    # resolves to host-native leadership; if the C++ library failed to build
+    # the default already IS device and the lane would compare a path
+    # against itself, reporting vacuous zero-divergence.
+    from kafka_assigner_tpu.native.leadership import leadership_backend
+
+    if leadership_backend() != "native":
+        print(
+            "SOAK SKIP: native leadership unavailable — the "
+            "KA_LEADERSHIP=device lane would differential against itself"
+        )
+        return 1
+
+    def run(topics, live, rack_map, solver, env=None, value="1"):
         if env:
-            os.environ[env] = "1"
+            os.environ[env] = value
         try:
             try:
                 return (
@@ -87,10 +100,12 @@ def main(budget_s: float) -> int:
             ]
 
         seq, seq_err = run(topics, live, rack_map, "tpu")
-        stg, stg_err = run(topics, live, rack_map, "tpu", "KA_STAGED_SOLVE")
-        if (seq, seq_err) != (stg, stg_err):
-            print(f"REPRO staged divergence: seed={seed} n={n} p={p} rf={rf} "
-                  f"racks={racks} rm={remove} add={add}")
+        dev, dev_err = run(
+            topics, live, rack_map, "tpu", "KA_LEADERSHIP", "device"
+        )
+        if (seq, seq_err) != (dev, dev_err):
+            print(f"REPRO leadership divergence: seed={seed} n={n} p={p} "
+                  f"rf={rf} racks={racks} rm={remove} add={add}")
             return 1
         gre, _ = run(topics, live, rack_map, "greedy")
         if seq is not None and gre is not None:

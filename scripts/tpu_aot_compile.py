@@ -78,7 +78,6 @@ def main() -> None:
     from kafka_assigner_tpu.models.synthetic import rack_striped_cluster
     from kafka_assigner_tpu.ops.assignment import (
         order_batched,
-        place_batched,
         place_scan,
         solve_batched,
         whatif_sweep,
@@ -140,16 +139,11 @@ def main() -> None:
         cur, rk, counters, jh, pr, n=n, rf=3, wave_mode="auto",
         leader_chunk=None, r_cap=r_cap,
     )
-    if max_stage < 5:
-        return
-
-    # stage 5: staged-path vmapped placement at headline
-    compile_stage(
-        "stage5 place_batched(vmap fast) HEADLINE", place_batched,
-        cur, rk, jh, pr, n=n, rf=3, r_cap=r_cap,
-    )
     if max_stage < 6:
         return
+    # (stage 5 retired round 4: the staged place_batched fork was deleted —
+    #  its 336.6 s headline compile vs place_scan's 5.0 s, TPU_AOT_r03.log,
+    #  decided the pre-registered keep-or-kill rule.)
 
     # stage 6: pallas leadership kernel, REAL mosaic lowering (not interpret)
     from kafka_assigner_tpu.ops.pallas_leadership import leadership_order_pallas

@@ -59,9 +59,8 @@ def test_mixed_rf_one_dispatch(monkeypatch):
     assert calls == [len(topics)], calls
 
 
-def test_mixed_rf_staged_and_device_backends_agree(monkeypatch):
+def test_mixed_rf_device_leadership_agrees(monkeypatch):
     topics, brokers, racks = _cluster()
-    monkeypatch.delenv("KA_STAGED_SOLVE", raising=False)
     monkeypatch.delenv("KA_LEADERSHIP", raising=False)
     default = TopicAssigner("tpu").generate_assignments(
         topics, brokers, racks, -1
@@ -70,12 +69,7 @@ def test_mixed_rf_staged_and_device_backends_agree(monkeypatch):
     device = TopicAssigner("tpu").generate_assignments(
         topics, brokers, racks, -1
     )
-    monkeypatch.delenv("KA_LEADERSHIP")
-    monkeypatch.setenv("KA_STAGED_SOLVE", "1")
-    staged = TopicAssigner("tpu").generate_assignments(
-        topics, brokers, racks, -1
-    )
-    assert default == device == staged
+    assert default == device
 
 
 def test_mixed_rf_movement_parity_with_greedy():
