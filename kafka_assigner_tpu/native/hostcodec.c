@@ -25,8 +25,18 @@
 
 /* Exported by CPython (3.12 ships it in the internal headers only, but the
  * symbol is public in libpython): presizing the per-partition result dicts
- * skips ~5 rehash-grow cycles per 100-entry dict on the decode path. */
-extern PyObject *_PyDict_NewPresized(Py_ssize_t minused);
+ * skips ~5 rehash-grow cycles per 100-entry dict on the decode path.
+ * Declared WEAK so the module still imports if a future CPython hides the
+ * private symbol — the loader then leaves the address NULL and we fall back
+ * to PyDict_New() instead of failing the import (and silently losing the
+ * whole codec, which is much more than the presize win). */
+extern PyObject *_PyDict_NewPresized(Py_ssize_t minused)
+    __attribute__((weak));
+
+static inline PyObject *dict_new_presized(Py_ssize_t minused) {
+    return _PyDict_NewPresized ? _PyDict_NewPresized(minused)
+                               : PyDict_New();
+}
 
 /* ---- helpers ---------------------------------------------------------- */
 
@@ -385,7 +395,7 @@ static PyObject *decode_rows(PyObject *self, PyObject *args) {
                          p_pad);
             goto fail;
         }
-        PyObject *d = _PyDict_NewPresized(p);
+        PyObject *d = dict_new_presized(p);
         if (!d) goto fail;
         PyList_SET_ITEM(out, t, d);
         const int32_t *rows = ordered + (size_t)t * p_pad * rf;
@@ -393,14 +403,27 @@ static PyObject *decode_rows(PyObject *self, PyObject *args) {
         for (Py_ssize_t j = 0; j < p; ++j) {
             const int32_t *slot = rows + (size_t)j * rf;
             Py_ssize_t count = 0;
-            for (Py_ssize_t s = 0; s < rf; ++s)
-                if (slot[s] >= 0 && slot[s] < n_brokers) ++count;
+            for (Py_ssize_t s = 0; s < rf; ++s) {
+                if (slot[s] >= n_brokers) {
+                    /* Corrupt solver output must fail as loudly as the numpy
+                     * decode path (which raises IndexError on the broker-id
+                     * gather); silently dropping the slot would mask a
+                     * solver bug as a short replica list. idx < 0 stays a
+                     * skip — it is the legitimate padding encoding. */
+                    PyErr_Format(PyExc_ValueError,
+                                 "decode: broker index %d out of range "
+                                 "(n_brokers=%zd) at topic %zd partition %zd",
+                                 (int)slot[s], (Py_ssize_t)n_brokers, t, j);
+                    goto fail;
+                }
+                if (slot[s] >= 0) ++count;
+            }
             PyObject *lst = PyList_New(count);
             if (!lst) goto fail;
             Py_ssize_t w = 0;
             for (Py_ssize_t s = 0; s < rf; ++s) {
                 int32_t idx = slot[s];
-                if (idx < 0 || idx >= n_brokers) continue;
+                if (idx < 0) continue;
                 PyObject *bid = bid_cache[idx];
                 Py_INCREF(bid);
                 PyList_SET_ITEM(lst, w++, bid);

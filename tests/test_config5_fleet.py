@@ -5,6 +5,7 @@ only answer one process run at a time.
 """
 from __future__ import annotations
 
+import os
 import time
 
 import jax
@@ -52,10 +53,19 @@ def test_config5_256_scenarios_on_8dev_mesh():
     # config5_ms_per_scenario); 40 ms (~10 s for the 256-scenario sweep) keeps
     # ~6x headroom for a loaded shared box yet still fails on any 2x
     # algorithmic regression, unlike the round-1 placeholder bound of 120 s.
-    assert warm_s / 256 < 0.040, (
-        f"config-5 per-scenario budget blown: {warm_s / 256 * 1000:.1f} ms "
-        f"({warm_s:.1f}s warm for 256 scenarios)"
-    )
+    # Hard-gating wall-clock on a shared box makes functional CI flake under
+    # co-tenancy (ADVICE r3), so the assert is opt-in: KA_PERF_ASSERT=1 turns
+    # the measurement into a pass/fail perf gate; default runs just report.
+    if os.environ.get("KA_PERF_ASSERT") == "1":
+        assert warm_s / 256 < 0.040, (
+            f"config-5 per-scenario budget blown: {warm_s / 256 * 1000:.1f} "
+            f"ms ({warm_s:.1f}s warm for 256 scenarios)"
+        )
+    elif warm_s / 256 >= 0.040:
+        print(
+            f"\nWARNING config-5 per-scenario budget exceeded (not fatal "
+            f"without KA_PERF_ASSERT=1): {warm_s / 256 * 1000:.1f} ms"
+        )
     print(
         f"\nconfig5: 256 scenarios cold={cold_s:.1f}s warm={warm_s:.1f}s "
         f"({warm_s / 256 * 1000:.0f} ms/scenario)"

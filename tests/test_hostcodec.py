@@ -149,6 +149,20 @@ def test_decode_rows_rejects_out_of_range_p_reals():
         codec.decode_rows(ordered, brokers, pid, np.array([-1], np.int32), 1)
 
 
+def test_decode_rows_rejects_out_of_range_broker_index():
+    # A solver bug emitting a broker index past the broker table must fail
+    # as loudly as the numpy decode path (IndexError there), not be masked
+    # as a silently shorter replica list (ADVICE r3). idx == -1 stays the
+    # legitimate padding skip.
+    codec = load_hostcodec()
+    brokers = np.arange(4, dtype=np.int64)
+    ordered = np.full((1, 2, 2), -1, np.int32)
+    ordered[0, 0] = [0, 4]  # 4 >= n_brokers
+    pid = np.zeros((1, 2), np.int64)
+    with pytest.raises(ValueError, match="broker index 4 out of range"):
+        codec.decode_rows(ordered, brokers, pid, np.array([2], np.int32), 1)
+
+
 def test_non_dict_mapping_takes_numpy_path(monkeypatch):
     # MappingProxyType currents must keep working whether or not the C codec
     # is buildable (the codec only accepts real dicts).
