@@ -1,47 +1,5 @@
 #!/bin/bash
-# Round-3 on-chip runbook: run when the tunnel answers (tpu_wait.log shows
-# TUNNEL-ALIVE). Produces TPU_PROBE_r03.log — the committed artifact VERDICT
-# round 2 item 1 demands — staging small -> headline so a hang identifies
-# the wall instead of hiding it.
-#
-# Key change vs round 2's attempts: stage A runs with LOCAL compilation
-# (PALLAS_AXON_REMOTE_COMPILE=0 -> axon register(remote_compile=False) ->
-# libtpu.so AOT compile on this box, executable shipped to the terminal).
-# The round-2 wedge was a REMOTE compile that never returned and, when the
-# client was killed, left the terminal busy for >1h. Local compile is
-# observable (it's our CPU), cacheable, and killing it cannot wedge the
-# terminal. Stage B repeats the probe under remote compile for comparison —
-# strictly after A has banked its artifact.
-set -u
-cd /root/repo
-LOG=TPU_PROBE_r03.log
-stamp() { echo "[$(date -u +%H:%M:%S)] $*" | tee -a "$LOG"; }
-
-stamp "=== round-3 on-chip probe; devices first ==="
-timeout 300 python -c "
-import time, jax
-t0 = time.time()
-print('devices (%.1fs):' % (time.time() - t0), jax.devices(), flush=True)
-import jax.numpy as jnp
-y = jax.jit(lambda x: x * 2 + 1)(jnp.arange(8.0)).block_until_ready()
-print('trivial jit ok:', y, flush=True)
-" 2>&1 | tee -a "$LOG"
-rc=${PIPESTATUS[0]}
-stamp "device probe rc=$rc"
-[ "$rc" != 0 ] && { stamp "tunnel not answering; aborting"; exit 1; }
-
-stamp "=== stage A: LOCAL compile (PALLAS_AXON_REMOTE_COMPILE=0), staged shapes ==="
-PALLAS_AXON_REMOTE_COMPILE=0 timeout 1800 python scripts/tpu_compile_probe.py 2>&1 | tee -a "$LOG"
-stamp "stage A rc=${PIPESTATUS[0]}"
-
-stamp "=== stage B: remote compile (default env), staged shapes ==="
-timeout 1800 python scripts/tpu_compile_probe.py 2>&1 | tee -a "$LOG"
-stamp "stage B rc=${PIPESTATUS[0]}"
-
-stamp "=== bench on chip (default env; bench.py self-supervises) ==="
-timeout 2400 python bench.py 2>&1 | tee -a "$LOG"
-stamp "bench rc=${PIPESTATUS[0]}"
-
-stamp "=== pallas leadership validation ==="
-timeout 900 python scripts/validate_pallas_tpu.py 2>&1 | tee -a "$LOG"
-stamp "pallas rc=${PIPESTATUS[0]}; done"
+# Round-3 runbook retired; the long-running tunnel watcher (/tmp/tpu_wait2.sh,
+# started during round 3) invokes this path on first chip contact, so it now
+# execs the current round's runbook.
+exec bash /root/repo/scripts/tpu_onchip_r04.sh "$@"
