@@ -128,6 +128,29 @@ def run_tool(argv: Optional[List[str]] = None) -> int:
         rack_assignment = build_rack_assignment(
             live_brokers, args.disable_rack_awareness
         )
+        # A rack-BLIND backend (one that structurally cannot report racks,
+        # e.g. confluent-kafka's AdminClient) must not silently produce a
+        # rack-unsafe plan from a tool whose headline feature is rack
+        # awareness: plan-producing modes refuse unless the operator opts
+        # out explicitly. Inspection-only modes keep the stderr warning.
+        plan_modes = (
+            "PRINT_REASSIGNMENT", "RANK_DECOMMISSION", "PRINT_FRESH_ASSIGNMENT"
+        )
+        if (
+            args.mode in plan_modes
+            and getattr(backend, "rack_blind", False)
+            and not args.disable_rack_awareness
+        ):
+            print(
+                "error: this metadata backend cannot supply broker rack info "
+                "(confluent-kafka's AdminClient is rack-blind), so a "
+                "rack-aware assignment cannot be guaranteed. Re-run with "
+                "--disable_rack_awareness to explicitly opt out of rack "
+                "diversity, or use the zk:// or file:// backend (or install "
+                "kafka-python, whose AdminClient carries racks).",
+                file=sys.stderr,
+            )
+            return 1
         if args.mode == "PRINT_CURRENT_ASSIGNMENT":
             print_current_assignment(backend, topics)
         elif args.mode == "PRINT_CURRENT_BROKERS":

@@ -34,7 +34,15 @@ class BrokerInfo:
 
 class MetadataBackend(Protocol):
     """The metadata reads L4 performs, lifted verbatim from the reference's
-    ZkUtils usage (``KafkaAssignmentGenerator.java:106,114,163``)."""
+    ZkUtils usage (``KafkaAssignmentGenerator.java:106,114,163``).
+
+    ``rack_blind``: True when the backend structurally CANNOT report broker
+    racks (as opposed to a cluster that genuinely has none configured — a
+    rackless ZK cluster reports ``rack=None`` per broker and is not blind).
+    Plan-producing CLI modes refuse to run on a blind backend unless
+    ``--disable_rack_awareness`` makes the opt-out explicit."""
+
+    rack_blind: bool = False
 
     def brokers(self) -> List[BrokerInfo]: ...
 

@@ -308,3 +308,46 @@ def test_cli_end_to_end_with_fake_confluent(monkeypatch, capsys):
     assert header == "CURRENT BROKERS:"
     assert json.loads(payload)[0]["id"] == 1
     assert "rack" in captured.err  # rack-blind warning reached the operator
+
+
+def test_cli_refuses_rack_blind_plan_modes(monkeypatch, capsys):
+    # VERDICT r3 item 7: a backend that structurally cannot report racks
+    # (confluent AdminClient) must not silently produce a rack-unsafe plan;
+    # every plan-producing mode refuses with a clear remedy.
+    from kafka_assigner_tpu.cli import run_tool
+
+    _install_fake_confluent(monkeypatch)
+    for extra in (
+        ["--mode", "PRINT_REASSIGNMENT"],
+        ["--mode", "RANK_DECOMMISSION"],
+        ["--mode", "PRINT_FRESH_ASSIGNMENT", "--topics", "t",
+         "--partition_count", "2", "--desired_replication_factor", "1"],
+    ):
+        rc = run_tool(["--zk_string", "kafka://b1:9092"] + extra)
+        captured = capsys.readouterr()
+        assert rc == 1, extra
+        assert "rack-blind" in captured.err, extra
+        assert "ASSIGNMENT" not in captured.out, extra  # no partial plan
+
+
+def test_cli_rack_blind_plan_allowed_with_explicit_optout(monkeypatch, capsys):
+    from kafka_assigner_tpu.cli import run_tool
+
+    _install_fake_confluent(monkeypatch)
+    rc = run_tool(["--zk_string", "kafka://b1:9092", "--mode",
+                   "PRINT_REASSIGNMENT", "--disable_rack_awareness"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "NEW ASSIGNMENT:" in captured.out
+
+
+def test_cli_rack_blind_inspection_modes_still_warn(monkeypatch, capsys):
+    from kafka_assigner_tpu.cli import run_tool
+
+    _install_fake_confluent(monkeypatch)
+    rc = run_tool(["--zk_string", "kafka://b1:9092", "--mode",
+                   "PRINT_CURRENT_ASSIGNMENT"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "CURRENT ASSIGNMENT:" in captured.out
+    assert "WARNING" in captured.err and "rack" in captured.err
