@@ -73,6 +73,7 @@ def load_native_library() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_int32),  # current
             ctypes.c_int32,                  # width
             ctypes.c_int32,                  # rf
+            ctypes.c_int32,                  # out_width
             ctypes.c_int64,                  # jhash_abs
             ctypes.POINTER(ctypes.c_int32),  # counters (in/out)
             ctypes.POINTER(ctypes.c_int32),  # out_ordered
@@ -90,6 +91,7 @@ def load_native_library() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_int32),  # currents_concat
             ctypes.POINTER(ctypes.c_int64),  # current_offsets
             ctypes.c_int32,                  # rf
+            ctypes.c_int32,                  # out_width
             ctypes.POINTER(ctypes.c_int32),  # counters (in/out)
             ctypes.POINTER(ctypes.c_int32),  # ordered_concat
             ctypes.POINTER(ctypes.c_int64),  # ordered_offsets

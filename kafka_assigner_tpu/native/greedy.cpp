@@ -27,7 +27,11 @@ namespace {
 struct Topic {
     int n;           // nodes
     int p;           // partitions
-    int rf;          // replicas to place
+    int rf;          // replicas to place (deficit target, capacity input)
+    int out_w;       // slot width of acc/ordered rows; == rf clamps sticky
+                     // retention to rf (default), > rf (== historical width)
+                     // reproduces the reference's unbounded retention on an
+                     // RF decrease (KafkaAssignmentStrategy.java:320-324)
     int cap;         // per-node capacity
     const int32_t* rack_of;  // (n) factorized rack id per node
     int n_racks;
@@ -45,7 +49,7 @@ struct State {
         : node_parts(t.n),
           rack_has(static_cast<size_t>(t.n_racks) * t.p, 0),
           acc_count(t.p, 0),
-          acc_nodes(static_cast<size_t>(t.p) * t.rf, -1) {}
+          acc_nodes(static_cast<size_t>(t.p) * t.out_w, -1) {}
 };
 
 inline bool node_holds(const State& s, int node, int part) {
@@ -64,7 +68,7 @@ inline void accept(const Topic& t, State& s, int node, int part) {
     s.node_parts[node].push_back(part);
     s.rack_has[static_cast<size_t>(t.rack_of[node]) * t.p + part] = 1;
     int c = s.acc_count[part]++;
-    s.acc_nodes[static_cast<size_t>(part) * t.rf + c] = node;
+    s.acc_nodes[static_cast<size_t>(part) * t.out_w + c] = node;
 }
 
 // One partition's preference-list ordering (computePreferenceLists,
@@ -113,17 +117,21 @@ extern "C" {
 // Returns 0 on success; (partition_row + 1) when that partition cannot be
 // fully assigned (the reference's hard failure, :183-184).
 //
-// current: (p x width) node indices or -1. counters: (n x rf) leadership
-// counters, updated in place. out_ordered: (p x rf) preference lists.
+// current: (p x width) node indices or -1. counters: (n x out_width)
+// leadership counters, updated in place. out_ordered: (p x out_width)
+// preference lists. out_width == rf clamps sticky retention to rf (the
+// documented default divergence); out_width == max(rf, width) reproduces
+// the reference's unbounded RF-decrease retention (KA_RF_DECREASE_COMPAT).
 int32_t ka_solve_topic(
     int32_t n, const int32_t* rack_of, int32_t n_racks,
     int32_t p, const int32_t* current, int32_t width,
-    int32_t rf, int64_t jhash_abs,
+    int32_t rf, int32_t out_width, int64_t jhash_abs,
     int32_t* counters, int32_t* out_ordered) {
     Topic t;
     t.n = n;
     t.p = p;
     t.rf = rf;
+    t.out_w = out_width;
     t.cap = static_cast<int>((static_cast<int64_t>(p) * rf + n - 1) / n);
     t.rack_of = rack_of;
     t.n_racks = n_racks;
@@ -132,13 +140,13 @@ int32_t ka_solve_topic(
 
     // Sticky fill: slot-major round-robin, ascending partitions within a
     // pass — replica i of every partition is offered before any replica i+1.
-    // NOTE: unlike the reference (no per-partition limit, see greedy.py
-    // header on the RF-decrease quirk), acceptance is clamped to rf, matching
-    // the TPU solver's documented divergence.
+    // The retention bound is the slot width: == rf clamps (the TPU solver's
+    // documented default divergence), > rf never binds (the reference's
+    // canAccept has no per-partition limit, :320-324).
     for (int s_idx = 0; s_idx < width; ++s_idx) {
         for (int part = 0; part < p; ++part) {
             int cand = current[static_cast<size_t>(part) * width + s_idx];
-            if (cand < 0 || s.acc_count[part] >= rf) continue;
+            if (cand < 0 || s.acc_count[part] >= t.out_w) continue;
             if (can_accept(t, s, cand, part)) accept(t, s, cand, part);
         }
     }
@@ -161,12 +169,13 @@ int32_t ka_solve_topic(
     }
 
     // Leadership ordering (shared helper; see order_partition above).
-    std::vector<int> remaining(rf);
+    std::vector<int> remaining(t.out_w);
     for (int part = 0; part < p; ++part) {
         order_partition(
-            &s.acc_nodes[static_cast<size_t>(part) * rf], s.acc_count[part],
-            rf, jhash_abs, counters, remaining.data(),
-            out_ordered + static_cast<size_t>(part) * rf);
+            &s.acc_nodes[static_cast<size_t>(part) * t.out_w],
+            s.acc_count[part], t.out_w, jhash_abs, counters,
+            remaining.data(),
+            out_ordered + static_cast<size_t>(part) * t.out_w);
     }
     return 0;
 }
@@ -210,7 +219,8 @@ void ka_order_many(
 // (KafkaAssignmentGenerator.java:173-176) run entirely in native code with
 // the leadership counters shared across topics. Topics are concatenated:
 // currents at current_offsets[i] with shape (p_counts[i] x widths[i]),
-// outputs at ordered_offsets[i] with shape (p_counts[i] x rf).
+// outputs at ordered_offsets[i] with shape (p_counts[i] x out_width).
+// counters stride is out_width (== rf by default; see ka_solve_topic).
 //
 // Returns 0 on success; on infeasibility returns (topic_index + 1) and
 // writes the failing partition row to *fail_part.
@@ -219,7 +229,7 @@ int32_t ka_solve_many(
     int32_t n_topics,
     const int32_t* p_counts, const int32_t* widths, const int64_t* jhashes,
     const int32_t* currents_concat, const int64_t* current_offsets,
-    int32_t rf,
+    int32_t rf, int32_t out_width,
     int32_t* counters,
     int32_t* ordered_concat, const int64_t* ordered_offsets,
     int32_t* fail_part) {
@@ -227,7 +237,7 @@ int32_t ka_solve_many(
         int32_t rc = ka_solve_topic(
             n, rack_of, n_racks,
             p_counts[i], currents_concat + current_offsets[i], widths[i],
-            rf, jhashes[i],
+            rf, out_width, jhashes[i],
             counters, ordered_concat + ordered_offsets[i]);
         if (rc != 0) {
             *fail_part = rc - 1;

@@ -145,6 +145,8 @@ def sticky_fill(
     p_real: jnp.ndarray | None = None,  # real partition count; padded rows get no deficit
     alive: jnp.ndarray | None = None,   # (N_pad,) scenario liveness; default: first n
     rf_actual: jnp.ndarray | None = None,  # traced per-topic RF <= rf (mixed-RF sweeps)
+    width: int | None = None,  # static slot width > rf = reference-compat
+                               # unbounded sticky retention (RF decrease)
 ) -> AssignState:
     """Vectorized sticky fill (``fillNodesFromAssignment``, ``:101-131``).
 
@@ -152,12 +154,17 @@ def sticky_fill(
     offered before any slot 1, so leader replicas win sticky capacity before
     followers); within a slot, ascending partition rows win capacity ties.
 
-    Divergence from the reference, on purpose: a partition never keeps more
-    than ``rf`` replicas. The reference's sticky fill has no per-partition
-    limit (``:320-324``), which on an RF decrease emits non-uniform replica
-    lists (see greedy.py header); the TPU solver clamps to the requested RF.
+    Default divergence from the reference, on purpose: a partition never
+    keeps more than ``rf`` replicas. The reference's sticky fill has no
+    per-partition limit (``:320-324``), which on an RF decrease emits
+    non-uniform replica lists (see greedy.py header); by default the TPU
+    solver clamps to the requested RF. Passing ``width`` (>= max(rf, L))
+    opts into the reference's exact unbounded retention
+    (``KA_RF_DECREASE_COMPAT=1``): acceptance is bounded only by the slot
+    array — physically <= L current entries per partition — reproducing the
+    reference byte-for-byte on RF-decrease inputs too.
     """
-    p, width = current.shape
+    p, hist_width = current.shape
     n_pad = rack_idx.shape[0]
     if p_real is None:
         p_real = jnp.int32(p)
@@ -165,19 +172,23 @@ def sticky_fill(
         alive = jnp.arange(n_pad, dtype=jnp.int32) < n
     if rf_actual is None:
         rf_actual = jnp.int32(rf)
+    w = rf if width is None else width
+    # Retention bound: requested RF (default clamp) or the slot width, which
+    # never binds (compat: the reference has no per-partition limit at all).
+    retain = rf_actual if width is None else jnp.int32(w)
     deficit = jnp.where(
         jnp.arange(p, dtype=jnp.int32) < p_real, rf_actual, 0
     ).astype(jnp.int32)
     state = AssignState(
-        acc_nodes=jnp.full((p, rf), -1, dtype=jnp.int32),
+        acc_nodes=jnp.full((p, w), -1, dtype=jnp.int32),
         acc_count=jnp.zeros(p, dtype=jnp.int32),
         node_load=jnp.zeros(n + 1, dtype=jnp.int32),
         deficit=deficit,
         infeasible=jnp.asarray(False),
     )
-    for s in range(width):  # static unroll: width == historical RF, small
+    for s in range(hist_width):  # static unroll: historical RF, small
         cand = current[:, s]
-        ok = _candidate_ok(state, cand, rack_idx, rf_actual, alive)
+        ok = _candidate_ok(state, cand, rack_idx, retain, alive)
         rank = _requests_rank(cand, ok, n)
         load = state.node_load[jnp.maximum(cand, 0)]
         accept = ok & (load + rank < cap)
@@ -658,6 +669,7 @@ def _place_one_topic(
     rf_actual: jnp.ndarray | None = None,  # traced per-topic RF (mixed-RF sweeps)
     r_cap: int | None = None,
     seg: Segments | None = None,  # hoisted cluster_segments (batched callers)
+    width: int | None = None,  # static compat slot width (see sticky_fill)
 ) -> Tuple[AssignState, jnp.ndarray]:
     """One topic's *placement* (sticky fill → wave spread).
 
@@ -680,7 +692,9 @@ def _place_one_topic(
     cap = (p_real * rf_actual + n_alive - 1) // n_alive
     start = jhash % n_alive
 
-    state = sticky_fill(current, rack_idx, rf, cap, n, p_real, alive, rf_actual)
+    state = sticky_fill(
+        current, rack_idx, rf, cap, n, p_real, alive, rf_actual, width
+    )
     sticky_kept = jnp.sum(state.acc_count)
     # pos=None: the dense fallback leg derives rotated positions lazily
     # inside its wave body (start/n_alive carry the rotation), saving an
@@ -730,17 +744,18 @@ def _solve_one_topic(
     leader_chunk: int | None = None,
     r_cap: int | None = None,
     seg: Segments | None = None,
+    width: int | None = None,  # static compat slot width (see sticky_fill)
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
     """One topic's full pipeline (placement + leadership), shared by the
     single-topic, batched (scan over topics), fresh-placement, and what-if
     (vmap over ``alive``) entry points so their semantics cannot drift."""
     state, sticky_kept = _place_one_topic(
         current, jhash, p_real, rack_idx, alive, n, rf, wave_mode, rf_actual,
-        r_cap, seg,
+        r_cap, seg, width,
     )
     ordered, counters = _order_one_topic(
-        counters, state.acc_nodes, state.acc_count, jhash, rf, use_pallas,
-        leader_chunk,
+        counters, state.acc_nodes, state.acc_count, jhash,
+        rf if width is None else width, use_pallas, leader_chunk,
     )
     return counters, (ordered, state.infeasible, state.deficit, sticky_kept)
 
@@ -755,23 +770,25 @@ def solve_assignment(
     rf: int,
     use_pallas: bool = False,
     r_cap: int | None = None,
+    width: int | None = None,  # static compat slot width (see sticky_fill)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Full single-topic solve.
 
     Returns (ordered (P, RF) broker indices, updated counters, infeasible
-    flag, deficit vector for error reporting).
+    flag, deficit vector for error reporting). With ``width`` the ordered
+    array and counter slab are ``width`` wide instead.
     """
     alive = default_alive(rack_idx, n)
     counters, (ordered, infeasible, deficit, _) = _solve_one_topic(
         counters, current, jhash, p_real, rack_idx, alive, n, rf,
-        use_pallas=use_pallas, r_cap=r_cap,
+        use_pallas=use_pallas, r_cap=r_cap, width=width,
     )
     return ordered, counters, infeasible, deficit
 
 
 solve_assignment_jit = jax.jit(
     solve_assignment,
-    static_argnames=("n", "rf", "use_pallas", "r_cap"),
+    static_argnames=("n", "rf", "use_pallas", "r_cap", "width"),
     donate_argnums=(),
 )
 
@@ -790,6 +807,7 @@ def solve_batched(
     rfs: jnp.ndarray | None = None,  # (B,) per-topic RF for mixed-RF sweeps
     leader_chunk: int | None = None,  # static leadership unroll (see leadership_order)
     r_cap: int | None = None,         # static rack-id bound (ProblemEncoding.r_cap)
+    width: int | None = None,         # static compat slot width (see sticky_fill)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Solve B topics in one device dispatch.
 
@@ -815,7 +833,7 @@ def solve_batched(
         current, jhash, p_real, rf_actual = inp
         return _solve_one_topic(
             counters, current, jhash, p_real, rack_idx, alive, n, rf,
-            wave_mode, use_pallas, rf_actual, leader_chunk, r_cap, seg,
+            wave_mode, use_pallas, rf_actual, leader_chunk, r_cap, seg, width,
         )
 
     counters, (ordered, infeasible, deficits, kept) = lax.scan(
@@ -827,7 +845,9 @@ def solve_batched(
 
 solve_batched_jit = jax.jit(
     solve_batched,
-    static_argnames=("n", "rf", "wave_mode", "use_pallas", "leader_chunk", "r_cap"),
+    static_argnames=(
+        "n", "rf", "wave_mode", "use_pallas", "leader_chunk", "r_cap", "width"
+    ),
 )
 
 
@@ -842,6 +862,7 @@ def place_scan(
     rfs: jnp.ndarray | None = None,
     r_cap: int | None = None,
     alive: jnp.ndarray | None = None,  # (N_pad,) scenario liveness
+    width: int | None = None,          # static compat slot width (sticky_fill)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Placement-only scan over topics with the FULL fallback chain — the
     rescue path for topics the vmapped fast wave strands. Sequential (scan,
@@ -858,7 +879,7 @@ def place_scan(
         current, jhash, p_real, rf_actual = inp
         state, kept = _place_one_topic(
             current, jhash, p_real, rack_idx, alive, n, rf, wave_mode,
-            rf_actual, r_cap, seg,
+            rf_actual, r_cap, seg, width,
         )
         return carry, (
             state.acc_nodes, state.acc_count, state.infeasible, state.deficit,
@@ -870,7 +891,7 @@ def place_scan(
 
 
 place_scan_jit = jax.jit(
-    place_scan, static_argnames=("n", "rf", "wave_mode", "r_cap")
+    place_scan, static_argnames=("n", "rf", "wave_mode", "r_cap", "width")
 )
 
 

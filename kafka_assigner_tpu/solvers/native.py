@@ -3,14 +3,17 @@ the same Solver protocol.
 
 Semantics match the Python greedy oracle exactly (same five phases, same
 tie-breaks — differential-tested), except the documented RF-decrease clamp it
-shares with the TPU backend (see ``native/greedy.cpp`` header). Exists as the
-honest single-thread *native* baseline for BASELINE timing at headline scale,
-where interpreted Python would distort the comparison in the TPU solver's
-favor.
+shares with the TPU backend (see ``native/greedy.cpp`` header) —
+``KA_RF_DECREASE_COMPAT=1`` lifts that clamp to the reference's unbounded
+retention, like the TPU backend (``solvers/tpu.py:rf_compat_enabled``).
+Exists as the honest single-thread *native* baseline for BASELINE timing at
+headline scale, where interpreted Python would distort the comparison in the
+TPU solver's favor.
 """
 from __future__ import annotations
 
 import ctypes
+import dataclasses
 from typing import Dict, List, Mapping, Sequence, Set, Tuple
 
 import numpy as np
@@ -24,6 +27,18 @@ from ..models.problem import (
 )
 from ..native.build import load_native_library
 from .base import Context
+
+
+def _out_width(rf: int, hist_width: int) -> int:
+    """Slot width of the C solve's acc/ordered/counter rows: rf by default
+    (the documented RF-decrease clamp), widened to the historical replica
+    width under ``KA_RF_DECREASE_COMPAT=1`` so the reference's unbounded
+    sticky retention survives verbatim (see solvers/tpu.py:rf_compat_enabled)."""
+    from .tpu import rf_compat_enabled
+
+    if rf_compat_enabled() and hist_width > rf:
+        return hist_width
+    return rf
 
 
 class NativeGreedySolver:
@@ -48,11 +63,15 @@ class NativeGreedySolver:
             topic, current_assignment, rack_assignment, nodes, partitions,
             replication_factor,
         )
-        counters = np.ascontiguousarray(context_to_array(context, enc))
+        out_w = _out_width(enc.rf, enc.current.shape[1])
+        enc_slab = enc if out_w == enc.rf else dataclasses.replace(
+            enc, rf=out_w
+        )
+        counters = np.ascontiguousarray(context_to_array(context, enc_slab))
         before = counters.copy()
         rack_of = np.ascontiguousarray(enc.rack_idx[: enc.n])
         current = np.ascontiguousarray(enc.current[: enc.p])
-        ordered = np.full((enc.p, enc.rf), -1, dtype=np.int32)
+        ordered = np.full((enc.p, out_w), -1, dtype=np.int32)
         counters_live = np.ascontiguousarray(counters[: enc.n])
 
         rc = self._lib.ka_solve_topic(
@@ -63,6 +82,7 @@ class NativeGreedySolver:
             current.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             current.shape[1],
             enc.rf,
+            out_w,
             enc.jhash,
             counters_live.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             ordered.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
@@ -73,8 +93,8 @@ class NativeGreedySolver:
                 "fully assigned!"
             )
         counters[: enc.n] = counters_live
-        apply_counter_updates(context, enc, before, counters)
-        full = np.full((enc.p_pad, enc.rf), -1, dtype=np.int32)
+        apply_counter_updates(context, enc_slab, before, counters)
+        full = np.full((enc.p_pad, out_w), -1, dtype=np.int32)
         full[: enc.p] = ordered
         return decode_assignment(enc, full)
 
@@ -105,6 +125,7 @@ class NativeGreedySolver:
 
         p_counts = np.array([e.p for e in encs], dtype=np.int32)
         widths = np.array([e.current.shape[1] for e in encs], dtype=np.int32)
+        out_w = _out_width(rf, int(widths.max()) if len(encs) else rf)
         jhashes = np.array([e.jhash for e in encs], dtype=np.int64)
         cur_sizes = p_counts.astype(np.int64) * widths
         cur_offsets = np.zeros(len(encs), dtype=np.int64)
@@ -112,12 +133,15 @@ class NativeGreedySolver:
         currents = np.concatenate(
             [np.ascontiguousarray(e.current[: e.p]).ravel() for e in encs]
         ).astype(np.int32)
-        ord_sizes = p_counts.astype(np.int64) * rf
+        ord_sizes = p_counts.astype(np.int64) * out_w
         ord_offsets = np.zeros(len(encs), dtype=np.int64)
         np.cumsum(ord_sizes[:-1], out=ord_offsets[1:])
         ordered = np.full(int(ord_sizes.sum()), -1, dtype=np.int32)
 
-        counters = np.ascontiguousarray(context_to_array(context, encs[0]))
+        enc_slab = encs[0] if out_w == encs[0].rf else dataclasses.replace(
+            encs[0], rf=out_w
+        )
+        counters = np.ascontiguousarray(context_to_array(context, enc_slab))
         before = counters.copy()
         counters_live = np.ascontiguousarray(counters[:n])
         fail_part = np.zeros(1, dtype=np.int32)
@@ -128,7 +152,8 @@ class NativeGreedySolver:
             n, as_i32(rack_of), n_racks, len(encs),
             as_i32(p_counts), as_i32(widths), as_i64(jhashes),
             as_i32(currents), as_i64(cur_offsets),
-            rf, as_i32(counters_live), as_i32(ordered), as_i64(ord_offsets),
+            rf, out_w,
+            as_i32(counters_live), as_i32(ordered), as_i64(ord_offsets),
             as_i32(fail_part),
         )
         if rc != 0:
@@ -138,12 +163,12 @@ class NativeGreedySolver:
                 "not be fully assigned!"
             )
         counters[:n] = counters_live
-        apply_counter_updates(context, encs[0], before, counters)
+        apply_counter_updates(context, enc_slab, before, counters)
         out: List[Tuple[str, Dict[int, List[int]]]] = []
         for i, enc in enumerate(encs):
-            full = np.full((enc.p_pad, rf), -1, dtype=np.int32)
+            full = np.full((enc.p_pad, out_w), -1, dtype=np.int32)
             full[: enc.p] = ordered[
                 ord_offsets[i]: ord_offsets[i] + ord_sizes[i]
-            ].reshape(enc.p, rf)
+            ].reshape(enc.p, out_w)
             out.append((enc.topic, decode_assignment(enc, full)))
         return out

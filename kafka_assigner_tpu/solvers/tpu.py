@@ -15,7 +15,10 @@ Honors the same interface and invariants as the greedy oracle
 
 Divergence (documented): on an RF decrease the solver emits exactly RF
 replicas per partition instead of the reference's unbounded sticky retention
-(see ``greedy.py`` header).
+(see ``greedy.py`` header) — unless ``KA_RF_DECREASE_COMPAT=1`` opts into
+the reference's exact bug-compatible behavior (``rf_compat_enabled``), which
+widens the slot arrays to the historical replica width so every retained
+replica survives and the emitted lists go non-uniform like the reference's.
 
 Shapes are bucketed (multiples of 8 on the partition/node axes, exact
 replica width, powers of two on the batch axis), so XLA compiles one kernel
@@ -24,6 +27,7 @@ warm path runs entirely on device.
 """
 from __future__ import annotations
 
+import dataclasses
 import os
 from typing import Dict, List, Mapping, Sequence, Set
 
@@ -95,6 +99,35 @@ def solver_tuning() -> tuple:
                 file=sys.stderr,
             )
     return wave, chunk
+
+
+def rf_compat_enabled() -> bool:
+    """Opt-in reference bug-compat RF-decrease retention
+    (``KA_RF_DECREASE_COMPAT=1``): the sticky fill keeps every current
+    replica that passes the node/rack/capacity gates with no per-partition
+    RF bound — exactly the reference's ``canAccept``
+    (``KafkaAssignmentStrategy.java:320-324``) — so lowering RF emits the
+    reference's non-uniform replica lists (VERDICT r3 item 6). Under compat
+    ``--solver native`` is byte-equal with the greedy oracle on every input
+    class; the tpu solver keeps its usual contract (bit-faithful sticky
+    retention and movement parity, with the documented wave-auction freedom
+    in which eligible node takes an orphan)."""
+    return os.environ.get("KA_RF_DECREASE_COMPAT") == "1"
+
+
+def _resolve_pallas(use_pallas: bool, width: int | None) -> bool:
+    """The pallas leadership kernel assumes RF-wide rows; the compat wide
+    slots (``width``) are mutually exclusive with it — resolve loudly."""
+    if use_pallas and width is not None:
+        import sys
+
+        print(
+            "kafka-assigner: KA_PALLAS_LEADERSHIP=1 ignored under "
+            "KA_RF_DECREASE_COMPAT=1 (the kernel assumes RF-wide rows)",
+            file=sys.stderr,
+        )
+        return False
+    return use_pallas
 
 
 def _resolve_native_order(use_pallas: bool) -> bool:
@@ -174,7 +207,11 @@ class TpuSolver:
             topic, current_assignment, rack_assignment, nodes, partitions,
             replication_factor,
         )
-        counters_before = context_to_array(context, enc)
+        width = None
+        if rf_compat_enabled() and enc.current.shape[1] > enc.rf:
+            width = enc.current.shape[1]
+        enc_slab = enc if width is None else dataclasses.replace(enc, rf=width)
+        counters_before = context_to_array(context, enc_slab)
 
         import jax
 
@@ -189,8 +226,11 @@ class TpuSolver:
                 jnp.int32(enc.p),
                 n=enc.n,
                 rf=enc.rf,
-                use_pallas=pallas_leadership_enabled(),
+                use_pallas=_resolve_pallas(
+                    pallas_leadership_enabled(), width
+                ),
                 r_cap=enc.r_cap,
+                width=width,
             )
         )
         if bool(infeasible):
@@ -199,7 +239,7 @@ class TpuSolver:
                 f"Partition {int(enc.partition_ids[bad])} could not be fully "
                 "assigned!"
             )
-        apply_counter_updates(context, enc, counters_before, counters_after)
+        apply_counter_updates(context, enc_slab, counters_before, counters_after)
         return decode_assignment(enc, ordered)
 
     #: generate_assignments may hand this solver one batch spanning multiple
@@ -231,8 +271,6 @@ class TpuSolver:
         once per run instead of once per topic. Every topic is padded to the
         group-wide (P, L) bucket; padded rows are inert.
         """
-        import dataclasses
-
         import jax
         import jax.numpy as jnp
 
@@ -260,10 +298,18 @@ class TpuSolver:
             encs, currents, jhashes, p_reals = encode_topic_group(
                 named_currents, rack_assignment, nodes, rf_list,
             )
-            # The counter slab spans the widest RF in the group; a narrower
-            # topic touches only its own leading slots (same semantics as
-            # the reference's per-slot counter map).
-            enc_slab = dataclasses.replace(encs[0], rf=rf_max)
+            # Compat slot width: on an RF decrease with KA_RF_DECREASE_COMPAT
+            # the historical replica width exceeds rf_max and every slot can
+            # survive sticky; the whole pipeline (placement, leadership,
+            # counter slab, decode) runs `width` wide. None = default clamp.
+            width = None
+            if rf_compat_enabled() and currents.shape[2] > rf_max:
+                width = currents.shape[2]
+            # The counter slab spans the widest RF in the group (the widest
+            # retained slot under compat); a narrower topic touches only its
+            # own leading slots (same semantics as the reference's per-slot
+            # counter map).
+            enc_slab = dataclasses.replace(encs[0], rf=width or rf_max)
             counters_before = context_to_array(context, enc_slab)
         b_real = len(encs)
         # Uniform batches (the common case) keep rfs out of the program:
@@ -289,7 +335,7 @@ class TpuSolver:
                 currents, self._mesh, PartitionSpec(None, "part", None)
             )
 
-        use_pallas = pallas_leadership_enabled()
+        use_pallas = _resolve_pallas(pallas_leadership_enabled(), width)
         native_order = _resolve_native_order(use_pallas)
         with timers.phase("solve"):
             if native_order:
@@ -313,6 +359,7 @@ class TpuSolver:
                         wave_mode=wave_mode,
                         rfs=None if rfs_arr is None else jnp.asarray(rfs_arr),
                         r_cap=encs[0].r_cap,
+                        width=width,
                     )
                 )
                 if infeasible[:b_real].any():
@@ -320,7 +367,7 @@ class TpuSolver:
                 else:
                     ordered, counters_after = self._order_placed(
                         acc_nodes, acc_count, counters_before, jhashes,
-                        p_reals, replication_factor, native_order,
+                        p_reals, width or replication_factor, native_order,
                     )
             else:
                 wave_mode, leader_chunk = solver_tuning()
@@ -340,6 +387,7 @@ class TpuSolver:
                             else jnp.asarray(rfs_arr),
                             leader_chunk=leader_chunk,
                             r_cap=encs[0].r_cap,
+                            width=width,
                         )
                     )
                 )
@@ -354,7 +402,14 @@ class TpuSolver:
             apply_counter_updates(
                 context, enc_slab, counters_before, counters_after
             )
-            decoded = decode_assignments_batched(encs, ordered[: len(encs)])
+            # Compat: decode sees the wide slot count so a partition's extra
+            # retained replicas aren't truncated to rf (rows shorter than
+            # `width` carry -1s and take the variable-length decode path).
+            encs_dec = (
+                encs if width is None
+                else [dataclasses.replace(e, rf=width) for e in encs]
+            )
+            decoded = decode_assignments_batched(encs_dec, ordered[: len(encs)])
             result = [
                 (enc.topic, assignment)
                 for enc, assignment in zip(encs, decoded)

@@ -1,0 +1,161 @@
+"""RF-decrease bug-compat mode (``KA_RF_DECREASE_COMPAT=1``, VERDICT r3
+item 6): the reference's sticky fill has no per-partition replica limit
+(``KafkaAssignmentStrategy.java:320-324``), so lowering the replication
+factor retains every current replica that passes the node/rack/capacity
+gates and the emitted lists go non-uniform. By default the tpu and native
+backends clamp retention to the requested RF (documented divergence); the
+compat env var lifts the clamp so all three backends can be differentially
+pinned on RF-decrease inputs too.
+
+Contracts (mirroring the general tpu-vs-greedy contract in
+``tests/test_tpu_parity.py``):
+- native == greedy BYTE-for-byte under compat, including error behavior —
+  ``--solver native`` is the byte-equal drop-in replacement on every input
+  class, RF decreases now included;
+- tpu == greedy on moved-replica count, per-partition replica counts, and
+  error behavior (the wave auction may pick a different eligible node for
+  an orphan under multi-orphan contention — the same documented freedom as
+  on non-decrease inputs, solvers/tpu.py header);
+- tpu == greedy byte-for-byte when the decrease leaves no orphans (sticky
+  retention is bit-faithful, and with no wave there is no freedom);
+- without the env var, the default clamp stands: uniform lists at the
+  requested RF.
+"""
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from kafka_assigner_tpu.assigner import TopicAssigner
+
+from .helpers import moved_replicas
+
+
+def _solve(solver, topics, brokers, racks, rf):
+    try:
+        return (
+            TopicAssigner(solver).generate_assignments(
+                topics, brokers, racks, rf
+            ),
+            None,
+        )
+    except ValueError as e:
+        return None, str(e)
+
+
+def _random_decrease_case(rng):
+    n = rng.choice([8, 12, 16])
+    brokers = set(range(1, n + 1))
+    racks = {b: f"r{b % 4}" for b in brokers}
+    old_rf = rng.randint(3, 4)
+    new_rf = rng.randint(1, old_rf - 1)
+    p = rng.randint(3, 9)
+    topics = [
+        (
+            f"t{t}",
+            {q: rng.sample(sorted(brokers), old_rf) for q in range(p)},
+        )
+        for t in range(rng.randint(1, 3))
+    ]
+    return topics, brokers, racks, new_rf
+
+
+def test_default_mode_still_clamps_to_rf(monkeypatch):
+    monkeypatch.delenv("KA_RF_DECREASE_COMPAT", raising=False)
+    rng = random.Random(11)
+    topics, brokers, racks, new_rf = _random_decrease_case(rng)
+    for solver in ("tpu", "native"):
+        out, err = _solve(solver, topics, brokers, racks, new_rf)
+        if out is None:
+            continue  # infeasible decrease: error path, nothing to clamp
+        for _, a in out:
+            assert all(len(r) == new_rf for r in a.values()), (solver, a)
+
+
+def test_compat_emits_reference_nonuniform_lists(monkeypatch):
+    # The signature reference behavior: partitions retain MORE than the
+    # requested RF. Crafted so every current replica survives (each broker
+    # appears in exactly cap=2 lists, all lists rack-diverse): no orphans,
+    # so tpu (any wave mode) must ALSO match greedy byte-for-byte.
+    monkeypatch.setenv("KA_RF_DECREASE_COMPAT", "1")
+    brokers = set(range(1, 7))
+    racks = {b: f"r{b % 3}" for b in brokers}
+    cur = {
+        0: [1, 2, 3],
+        1: [4, 5, 6],
+        2: [1, 5, 6],
+        3: [2, 3, 4],
+    }
+    topics = [("t0", cur)]
+    gre, _ = _solve("greedy", topics, brokers, racks, 2)
+    tpu, _ = _solve("tpu", topics, brokers, racks, 2)
+    nat, _ = _solve("native", topics, brokers, racks, 2)
+    assert gre is not None
+    assert all(len(r) == 3 for r in gre[0][1].values())  # all retained
+    assert tpu == gre == nat  # steady decrease: exact output parity
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_compat_three_backend_differential(monkeypatch, seed):
+    monkeypatch.setenv("KA_RF_DECREASE_COMPAT", "1")
+    rng = random.Random(100 + seed)
+    topics, brokers, racks, new_rf = _random_decrease_case(rng)
+
+    gre = _solve("greedy", topics, brokers, racks, new_rf)
+    nat = _solve("native", topics, brokers, racks, new_rf)
+    assert nat == gre  # byte parity incl. error behavior
+
+    tpu, terr = _solve("tpu", topics, brokers, racks, new_rf)
+    if gre[0] is None or tpu is None:
+        assert terr == gre[1]
+        return
+    by = dict(topics)
+    m_g = sum(moved_replicas(by[t], a) for t, a in gre[0])
+    m_t = sum(moved_replicas(by[t], a) for t, a in tpu)
+    assert m_g == m_t
+    # Sticky retention is bit-faithful, so per-partition replica counts
+    # match even where the orphan node choice differs.
+    for (tg, ag), (tt, at) in zip(gre[0], tpu):
+        assert {q: len(r) for q, r in ag.items()} == {
+            q: len(r) for q, r in at.items()
+        }, (tg, tt)
+
+
+def test_compat_is_noop_without_decrease(monkeypatch):
+    # Same historical and requested RF: the compat flag must not change the
+    # program or the output (width stays None -> identical jit signature).
+    brokers = set(range(1, 13))
+    racks = {b: f"r{b % 4}" for b in brokers}
+    rng = random.Random(5)
+    topics = [
+        ("t0", {q: rng.sample(sorted(brokers), 3) for q in range(8)})
+    ]
+    monkeypatch.delenv("KA_RF_DECREASE_COMPAT", raising=False)
+    base = _solve("tpu", topics, brokers, racks, -1)
+    monkeypatch.setenv("KA_RF_DECREASE_COMPAT", "1")
+    compat = _solve("tpu", topics, brokers, racks, -1)
+    assert base == compat
+
+
+def test_compat_single_topic_assign_path(monkeypatch):
+    # TpuSolver.assign (non-batched) and NativeGreedySolver.assign must honor
+    # compat identically to the greedy oracle.
+    monkeypatch.setenv("KA_RF_DECREASE_COMPAT", "1")
+    brokers = set(range(1, 13))
+    racks = {b: f"r{b % 4}" for b in brokers}
+    rng = random.Random(9)
+    cur = {q: rng.sample(sorted(brokers), 4) for q in range(5)}
+    g = TopicAssigner("greedy").generate_assignment("t", cur, brokers, racks, 2)
+    n = TopicAssigner("native").generate_assignment("t", cur, brokers, racks, 2)
+    assert g == n
+    from kafka_assigner_tpu.solvers.tpu import TpuSolver
+    from kafka_assigner_tpu.solvers.base import Context
+
+    t = TpuSolver().assign("t", cur, racks, brokers, set(cur), 2, Context())
+    assert {p: len(r) for p, r in t.items()} == {
+        p: len(r) for p, r in g.items()
+    }
+    m_t = sum(1 for p, r in t.items() for b in r if b not in cur[p])
+    m_g = sum(1 for p, r in g.items() for b in r if b not in cur[p])
+    assert m_t == m_g
