@@ -84,6 +84,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--partition_count", type=int, default=None,
                    help="PRINT_FRESH_ASSIGNMENT: number of partitions to "
                         "place for each --topics entry")
+    p.add_argument("--scenario_file", default=None, metavar="PATH",
+                   help="RANK_DECOMMISSION: JSON array of removal scenarios "
+                        "(arrays of broker ids and/or hostnames, e.g. "
+                        '[[1,2],["host7"]]) ranked in one batched sweep '
+                        "instead of the default per-broker singleton sweep")
     p.add_argument("--leadership_context", default=None, metavar="PATH",
                    help="persist cross-run leadership counters to PATH "
                         "(loaded if present, saved after PRINT_REASSIGNMENT) "
@@ -200,6 +205,7 @@ def run_tool(argv: Optional[List[str]] = None) -> int:
                 backend, topics, (broker_ids - excluded) or None,
                 {k: v for k, v in rack_assignment.items() if k not in excluded},
                 args.desired_replication_factor, live_brokers=live,
+                scenario_file=args.scenario_file,
             )
         else:
             print_least_disruptive_reassignment(

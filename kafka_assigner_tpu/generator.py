@@ -109,6 +109,55 @@ def print_current_brokers(
     print(format_brokers_json(live_brokers), file=out)
 
 
+def load_scenario_file(
+    path: str, live_brokers: Sequence[BrokerInfo]
+) -> List[List[int]]:
+    """Parse a ``--scenario_file``: a JSON array of removal scenarios, each
+    an array of broker ids (integers) and/or hostnames (strings), e.g.
+    ``[[1,2],[3],["kafka7.example.com","kafka8.example.com"]]``.
+
+    Hostnames resolve strictly against the live broker list (same contract
+    as ``--broker_hosts``, ``KafkaAssignmentGenerator.java:189-204``);
+    unknown ids or hosts are errors — a silently dropped broker would rank
+    a different scenario than the operator asked about.
+    """
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, list) or not all(
+        isinstance(s, list) for s in data
+    ):
+        raise ValueError(
+            f"scenario file {path!r} must be a JSON array of arrays of "
+            "broker ids or hostnames"
+        )
+    by_host = {b.host: b.id for b in live_brokers}
+    known = {b.id for b in live_brokers}
+    scenarios: List[List[int]] = []
+    for s in data:
+        ids: List[int] = []
+        for entry in s:
+            if isinstance(entry, bool) or not isinstance(entry, (int, str)):
+                raise ValueError(
+                    f"scenario file {path!r}: invalid broker entry {entry!r}"
+                )
+            if isinstance(entry, str):
+                if entry not in by_host:
+                    raise ValueError(
+                        f"scenario file {path!r}: unknown broker host "
+                        f"{entry!r}"
+                    )
+                ids.append(by_host[entry])
+            else:
+                if entry not in known:
+                    raise ValueError(
+                        f"scenario file {path!r}: unknown broker id {entry}"
+                    )
+                ids.append(int(entry))
+        deduped = sorted(set(ids))
+        scenarios.append(deduped)
+    return scenarios
+
+
 def print_decommission_ranking(
     backend: MetadataBackend,
     topics: Optional[Sequence[str]],
@@ -117,16 +166,23 @@ def print_decommission_ranking(
     desired_replication_factor: int,
     out: Optional[TextIO] = None,
     live_brokers: Optional[Sequence[BrokerInfo]] = None,
+    scenario_file: Optional[str] = None,
 ) -> None:
     """RANK_DECOMMISSION: one batched what-if sweep over candidate
-    single-broker removals (every live broker by default), printed
-    least-disruptive-first as a JSON array on stdout.
+    broker removals, printed least-disruptive-first as a JSON array on
+    stdout. Default: every live broker as a singleton scenario;
+    ``--scenario_file`` ranks arbitrary removal SETS (pairs, whole racks,
+    ...) in the same single sweep — ``evaluate_removal_scenarios`` always
+    took arbitrary sets; this exposes it (VERDICT r3 item 10).
 
     The reference can only answer this one process run at a time
     (``--broker_hosts_to_remove`` + eyeballing the JSON); the sweep solves
     all candidates at once (BASELINE config 5).
     """
-    from .parallel.whatif import rank_decommission_candidates
+    from .parallel.whatif import (
+        evaluate_removal_scenarios,
+        rank_decommission_candidates,
+    )
 
     out = out if out is not None else sys.stdout
     if live_brokers is None:
@@ -146,30 +202,44 @@ def print_decommission_ranking(
 
         mesh = build_mesh()
 
-    ranked = rank_decommission_candidates(
-        {t: initial[t] for t in topic_list},
-        brokers,
-        {k: v for k, v in rack_assignment.items() if k in brokers},
-        sorted(candidate_brokers) if candidate_brokers else None,
-        desired_replication_factor,
-        mesh=mesh,
-    )
+    topic_map = {t: initial[t] for t in topic_list}
+    racks = {k: v for k, v in rack_assignment.items() if k in brokers}
+    if scenario_file is not None:
+        scenarios = load_scenario_file(scenario_file, live_brokers)
+        results = evaluate_removal_scenarios(
+            topic_map, brokers, racks, scenarios,
+            desired_replication_factor, mesh=mesh,
+        )
+        ranked = sorted(
+            results,
+            key=lambda r: (not r.feasible, r.moved_replicas, r.removed),
+        )
+        rows = [
+            {
+                "brokers": list(r.removed),
+                "moved_replicas": r.moved_replicas,
+                "feasible": r.feasible,
+                "max_node_load": r.max_node_load,
+            }
+            for r in ranked
+        ]
+    else:
+        ranked = rank_decommission_candidates(
+            topic_map, brokers, racks,
+            sorted(candidate_brokers) if candidate_brokers else None,
+            desired_replication_factor, mesh=mesh,
+        )
+        rows = [
+            {
+                "broker": r.removed[0],
+                "moved_replicas": r.moved_replicas,
+                "feasible": r.feasible,
+                "max_node_load": r.max_node_load,
+            }
+            for r in ranked
+        ]
     print("DECOMMISSION RANKING:", file=out)
-    print(
-        json.dumps(
-            [
-                {
-                    "broker": r.removed[0],
-                    "moved_replicas": r.moved_replicas,
-                    "feasible": r.feasible,
-                    "max_node_load": r.max_node_load,
-                }
-                for r in ranked
-            ],
-            separators=(",", ":"),
-        ),
-        file=out,
-    )
+    print(json.dumps(rows, separators=(",", ":")), file=out)
 
 
 def print_fresh_assignment(

@@ -278,6 +278,13 @@ def evaluate_removal_scenarios(
     broker_to_idx = cluster.broker_to_idx
     s_real = len(scenarios)
     s_pad = batch_bucket(s_real)
+    if mesh is not None:
+        # The sharded sweep splits the scenario axis across the mesh, so the
+        # padded batch must tile it (a 4-scenario bucket on an 8-way mesh is
+        # otherwise a hard jax error). Padding rows are all-alive no-op
+        # solves; results past s_real are discarded.
+        m = mesh.shape.get("scenarios", 1)
+        s_pad = ((s_pad + m - 1) // m) * m
     alive = np.zeros((s_pad, enc0.n_pad), dtype=bool)
     alive[:, : enc0.n] = True
     for s, removed in enumerate(scenarios):
