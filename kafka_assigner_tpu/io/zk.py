@@ -7,8 +7,10 @@ Reads the same znodes Kafka's ZkUtils reads:
   - ``/brokers/topics``        → topic list
   - ``/brokers/topics/<name>`` → ``{"partitions": {"0": [ids...]}}``
 
-Gated on ``kazoo`` (pure-python ZK client). When it is not installed the
-backend raises a clear error at construction — the hermetic snapshot backend
+Client selection: ``kazoo`` when installed (battle-tested session handling),
+else the in-tree minimal wire client (``io/zkwire.py`` — the read-only jute
+subset this tool needs), so live-ZK runs need no third-party dependency at
+all. ``KA_ZK_CLIENT={auto,kazoo,wire}`` overrides. The snapshot backend
 covers every offline use.
 """
 from __future__ import annotations
@@ -51,14 +53,22 @@ def _resolve_endpoint(meta: dict, broker_id: str) -> tuple:
 
 class ZkBackend:
     def __init__(self, connect_string: str) -> None:
-        try:
-            from kazoo.client import KazooClient
-        except ImportError as e:
-            raise RuntimeError(
-                "live ZooKeeper access requires the 'kazoo' package; use a "
-                "file://cluster.json snapshot for offline runs"
-            ) from e
-        self._zk = KazooClient(hosts=connect_string, timeout=ZK_TIMEOUT_S)
+        import os
+
+        choice = os.environ.get("KA_ZK_CLIENT", "auto")
+        client_cls = None
+        if choice in ("auto", "kazoo"):
+            try:
+                from kazoo.client import KazooClient as client_cls
+            except ImportError:
+                if choice == "kazoo":
+                    raise RuntimeError(
+                        "KA_ZK_CLIENT=kazoo but the 'kazoo' package is not "
+                        "installed"
+                    ) from None
+        if client_cls is None:
+            from .zkwire import MiniZkClient as client_cls
+        self._zk = client_cls(hosts=connect_string, timeout=ZK_TIMEOUT_S)
         self._zk.start(timeout=ZK_TIMEOUT_S)
 
     def brokers(self) -> List[BrokerInfo]:

@@ -1,0 +1,262 @@
+"""Minimal pure-python ZooKeeper wire client — the read-only jute subset the
+metadata layer needs (``get_children`` + ``get``), used by ``io/zk.py`` as a
+dependency-free fallback when ``kazoo`` is not installed.
+
+The reference tool cannot run at all without a live ZK quorum AND the full
+ZkClient stack on the classpath (``KafkaAssignmentGenerator.java:273-276``,
+``pom.xml:50-58``). Here the preferred client is still kazoo (battle-tested
+reconnects/SASL/watches), but the assignment generator only ever performs
+three read RPCs over an open session, which is a small, stable corner of the
+protocol (ZooKeeper's jute serialization, unchanged since 3.0):
+
+- frames: 4-byte big-endian length prefix;
+- session handshake: ``ConnectRequest``/``ConnectResponse``;
+- ``getChildren`` (type 8) and ``getData`` (type 4) with
+  ``ReplyHeader{xid, zxid, err}`` responses;
+- ``closeSession`` (type -11).
+
+No watches, no ephemerals, no writes, no reconnects: the CLI opens a
+session, reads the broker/topic znodes, and closes — all inside the
+reference's own 10 s timeout envelope. ``tests/test_zk_socket.py`` runs this
+client against an in-process jute server over a real TCP socket (and runs
+kazoo against the same server when it is installed).
+"""
+from __future__ import annotations
+
+import socket
+import struct
+from typing import List, NamedTuple, Optional, Tuple
+
+#: ZooKeeper opcodes (zookeeper.ZooDefs.OpCode).
+OP_GET_DATA = 4
+OP_GET_CHILDREN = 8
+OP_PING = 11
+OP_CLOSE = -11
+
+#: KeeperException codes.
+ERR_NONODE = -101
+
+PING_XID = -2
+
+
+class ZkWireError(RuntimeError):
+    """Connection-level or server-reported failure of the wire client."""
+
+
+class NoNodeError(ZkWireError):
+    """The requested znode does not exist (KeeperException.NoNode)."""
+
+
+class ZnodeStat(NamedTuple):
+    czxid: int
+    mzxid: int
+    ctime: int
+    mtime: int
+    version: int
+    cversion: int
+    aversion: int
+    ephemeralOwner: int
+    dataLength: int
+    numChildren: int
+    pzxid: int
+
+
+def _pack_buffer(data: Optional[bytes]) -> bytes:
+    if data is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(data)) + data
+
+
+def _pack_str(s: str) -> bytes:
+    return _pack_buffer(s.encode("utf-8"))
+
+
+class _Reader:
+    """Sequential jute decoder over one reply frame."""
+
+    def __init__(self, data: bytes) -> None:
+        self.data = data
+        self.off = 0
+
+    def _take(self, n: int) -> bytes:
+        if self.off + n > len(self.data):
+            raise ZkWireError("truncated ZooKeeper reply frame")
+        out = self.data[self.off:self.off + n]
+        self.off += n
+        return out
+
+    def read_int(self) -> int:
+        return struct.unpack(">i", self._take(4))[0]
+
+    def read_long(self) -> int:
+        return struct.unpack(">q", self._take(8))[0]
+
+    def read_buffer(self) -> Optional[bytes]:
+        n = self.read_int()
+        if n < 0:
+            return None
+        return self._take(n)
+
+    def read_str(self) -> str:
+        buf = self.read_buffer()
+        return "" if buf is None else buf.decode("utf-8")
+
+    def read_stat(self) -> ZnodeStat:
+        return ZnodeStat(*struct.unpack(">qqqqiiiqiiq", self._take(68)))
+
+
+def parse_hosts(connect_string: str) -> Tuple[List[Tuple[str, int]], str]:
+    """``host:port,host:port[/chroot]`` → (endpoints, chroot). Kafka connect
+    strings routinely carry a chroot suffix (``zk1:2181,zk2:2181/kafka``)."""
+    hosts_part, slash, chroot = connect_string.partition("/")
+    chroot = (slash + chroot).rstrip("/") if slash else ""
+    endpoints = []
+    for tok in hosts_part.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        host, _, port = tok.rpartition(":")
+        if not host:
+            host, port = tok, "2181"
+        endpoints.append((host, int(port)))
+    if not endpoints:
+        raise ZkWireError(f"no ZooKeeper endpoints in {connect_string!r}")
+    return endpoints, chroot
+
+
+class MiniZkClient:
+    """Duck-type of the ``kazoo.client.KazooClient`` surface ``ZkBackend``
+    uses: ``start`` / ``get_children`` / ``get`` / ``stop`` / ``close``."""
+
+    def __init__(self, hosts: str, timeout: float = 10.0) -> None:
+        self._endpoints, self._chroot = parse_hosts(hosts)
+        self._timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._xid = 0
+
+    # -- session ----------------------------------------------------------
+
+    def start(self, timeout: Optional[float] = None) -> None:
+        deadline_t = timeout if timeout is not None else self._timeout
+        last_err: Optional[Exception] = None
+        for host, port in self._endpoints:
+            try:
+                sock = socket.create_connection((host, port), deadline_t)
+                sock.settimeout(deadline_t)
+                self._sock = sock
+                self._handshake(int(deadline_t * 1000))
+                return
+            except (OSError, ZkWireError) as e:
+                last_err = e
+                if self._sock is not None:
+                    self._sock.close()
+                    self._sock = None
+        raise ZkWireError(
+            f"could not establish a ZooKeeper session with any of "
+            f"{self._endpoints}: {last_err}"
+        )
+
+    def _handshake(self, timeout_ms: int) -> None:
+        # ConnectRequest: protocolVersion, lastZxidSeen, timeOut, sessionId,
+        # passwd, readOnly (3.4+; servers without it ignore the extra byte).
+        req = (
+            struct.pack(">iqiq", 0, 0, timeout_ms, 0)
+            + _pack_buffer(b"\x00" * 16)
+            + b"\x00"
+        )
+        self._send_frame(req)
+        r = _Reader(self._recv_frame())
+        r.read_int()            # protocolVersion
+        negotiated = r.read_int()  # timeOut
+        session_id = r.read_long()
+        if negotiated <= 0 or session_id == 0 and negotiated == 0:
+            raise ZkWireError("ZooKeeper session expired during handshake")
+
+    # -- rpc --------------------------------------------------------------
+
+    def _send_frame(self, payload: bytes) -> None:
+        assert self._sock is not None
+        self._sock.sendall(struct.pack(">i", len(payload)) + payload)
+
+    def _recv_frame(self) -> bytes:
+        assert self._sock is not None
+        header = self._recv_exact(4)
+        (n,) = struct.unpack(">i", header)
+        if n < 0 or n > (64 << 20):
+            raise ZkWireError(f"invalid ZooKeeper frame length {n}")
+        return self._recv_exact(n)
+
+    def _recv_exact(self, n: int) -> bytes:
+        assert self._sock is not None
+        chunks = []
+        while n:
+            chunk = self._sock.recv(n)
+            if not chunk:
+                raise ZkWireError("ZooKeeper connection closed mid-reply")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def _call(self, op: int, payload: bytes) -> _Reader:
+        if self._sock is None:
+            raise ZkWireError("ZooKeeper session is not started")
+        self._xid += 1
+        xid = self._xid
+        self._send_frame(struct.pack(">ii", xid, op) + payload)
+        while True:
+            r = _Reader(self._recv_frame())
+            rxid = r.read_int()
+            r.read_long()  # zxid
+            err = r.read_int()
+            if rxid == PING_XID:  # stray ping reply; not ours
+                continue
+            if rxid != xid:
+                raise ZkWireError(
+                    f"ZooKeeper reply xid {rxid} does not match request {xid}"
+                )
+            if err == ERR_NONODE:
+                raise NoNodeError(f"znode does not exist (err {err})")
+            if err != 0:
+                raise ZkWireError(f"ZooKeeper error {err}")
+            return r
+
+    def _path(self, path: str) -> str:
+        return (self._chroot + path) if self._chroot else path
+
+    # -- reads ------------------------------------------------------------
+
+    def get_children(self, path: str) -> List[str]:
+        r = self._call(
+            OP_GET_CHILDREN, _pack_str(self._path(path)) + b"\x00"
+        )
+        count = r.read_int()
+        if count < 0:
+            return []
+        return [r.read_str() for _ in range(count)]
+
+    def get(self, path: str) -> Tuple[bytes, ZnodeStat]:
+        r = self._call(OP_GET_DATA, _pack_str(self._path(path)) + b"\x00")
+        data = r.read_buffer() or b""
+        return data, r.read_stat()
+
+    # -- teardown ---------------------------------------------------------
+
+    def stop(self) -> None:
+        if self._sock is None:
+            return
+        try:
+            self._xid += 1
+            self._send_frame(struct.pack(">ii", self._xid, OP_CLOSE))
+            # best effort: read the close ack so the server sees a clean end
+            self._sock.settimeout(1.0)
+            try:
+                self._recv_frame()
+            except (OSError, ZkWireError):
+                pass
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None

@@ -15,11 +15,25 @@ def test_open_backend_dispatch(tmp_path):
     path.write_text(json.dumps({"brokers": [], "topics": {}}))
     assert isinstance(open_backend(f"file://{path}"), SnapshotBackend)
     assert isinstance(open_backend(str(path)), SnapshotBackend)
-    # Gated live backends fail with actionable errors when client libs are absent.
-    with pytest.raises(RuntimeError, match="kazoo"):
-        open_backend("zkhost:2181")
+    # Without kazoo, the zk path falls back to the in-tree wire client
+    # (io/zkwire.py), which fails with a clear session error on an
+    # unreachable quorum instead of a missing-dependency error.
+    with pytest.raises(RuntimeError, match="ZooKeeper session|kazoo"):
+        open_backend("zkhost-does-not-resolve:2181")
+    # The AdminClient bridge stays gated on its client libraries.
     with pytest.raises(RuntimeError, match="confluent-kafka|kafka-python"):
         open_backend("kafka://broker:9092")
+    # Forcing kazoo when it is not installed is a loud error, not a silent
+    # fallback.
+    import os
+
+    if "kazoo" not in __import__("sys").modules:
+        os.environ["KA_ZK_CLIENT"] = "kazoo"
+        try:
+            with pytest.raises(RuntimeError, match="kazoo"):
+                open_backend("zkhost:2181")
+        finally:
+            del os.environ["KA_ZK_CLIENT"]
 
 
 def test_snapshot_round_trip(tmp_path):
