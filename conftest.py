@@ -25,6 +25,16 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent on-disk compile cache (same one bench.py and the scripts/ probes
+# share): the vm.max_map_count workaround below clears jax's in-memory cache
+# every 40 tests, which used to force full recompiles of shapes the window
+# boundary split; with the disk cache those become deserializations. Only
+# compiles >= 1 s are persisted (jax's default floor), which is exactly the
+# expensive set. KA_COMPILE_CACHE=0 disables.
+from kafka_assigner_tpu.utils.compilecache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
 
 # One pytest process compiles every test module's XLA programs and jax's
 # compilation cache never evicts; each compiled executable holds LLVM JIT

@@ -57,6 +57,13 @@ def default_alive(rack_idx: jnp.ndarray, n: int) -> jnp.ndarray:
     return jnp.arange(rack_idx.shape[0], dtype=jnp.int32) < n
 
 
+#: Above this (P_pad * N_pad) product the dense wave leg is demoted to last
+#: resort in multi-leg chains (see spread_orphans): 2^27 elements ~ 128M —
+#: an order of magnitude above any per-topic mask the 2000-topic headline
+#: builds (104 x 5120 ~ 0.5M), an order below the giant-topic shape where
+#: the dense wave measured 355 s warm (1e9-element masks per wave).
+DENSE_MASK_BUDGET = 1 << 27
+
 # Below this partition-bucket size the (P, P) same-key-before-me count beats a
 # stable argsort in _requests_rank (CPU-XLA microbench, round 1: ~3x at P=128,
 # crossover between 256 and 512; a 256x256 bool matrix is 64KB — L2-resident —
@@ -503,6 +510,19 @@ def spread_orphans(
     rf = state.acc_nodes.shape[1]
     n_pad = rack_idx.shape[0]
     legs, r_cap = _resolve_wave_plan(wave_mode, n_pad, r_cap)
+    # Giant-single-topic guard (static, shape-derived): the dense leg's
+    # per-wave (P x N) eligibility/score is ~1e9 elements at the 200k x 5k
+    # long-axis shape — measured 355 s warm on CPU when the exactly-
+    # saturated replace-N instance strands the fast leg and dense burns its
+    # wave budget before balance rescues (the reference's own first-fit
+    # PROVABLY dead-ends on that instance, KafkaAssignmentStrategy.java:29-30,
+    # so dense was doomed to strand too). Past the budget, dense demotes to
+    # last resort; rack-factored legs are O(N + P) per wave. Leg ORDER is
+    # within the solver's documented orphan-choice freedom (movement parity
+    # is leg-invariant); normal shapes keep the reference-faithful order.
+    p_pad = state.acc_nodes.shape[0]
+    if len(legs) > 1 and "dense" in legs and p_pad * n_pad > DENSE_MASK_BUDGET:
+        legs = tuple(l for l in legs if l != "dense") + ("dense",)
 
     def cond(state: AssignState) -> jnp.ndarray:
         return jnp.any(state.deficit > 0) & ~state.infeasible

@@ -1,6 +1,8 @@
-"""Real multi-process coverage: two OS processes form a global mesh over
-jax.distributed (the DCN-analogue on CPU), shard a what-if sweep across it,
-and must reproduce the single-process results exactly.
+"""Real multi-process coverage: multiple OS processes form a global mesh
+over jax.distributed (the DCN-analogue on CPU), shard a what-if sweep across
+it, and must reproduce the single-process results exactly — at 2 processes
+and at 4 (VERDICT r3 item 9: ``put_sharded``'s ``make_array_from_callback``
+path beyond 2 processes).
 
 The reference has no multi-process story at all (one JVM, one thread —
 ``KafkaAssignmentGenerator.java:301-303``); this is the framework's
@@ -25,9 +27,11 @@ _WORKER = textwrap.dedent(
     import json, sys, time
     import jax
     jax.config.update("jax_platforms", "cpu")
-    port, pid = sys.argv[1], int(sys.argv[2])
-    n_brokers, n_topics, n_scenarios = map(int, sys.argv[3:6])
-    jax.distributed.initialize(f"localhost:{port}", num_processes=2, process_id=pid)
+    port, pid, n_procs = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    n_brokers, n_topics, n_scenarios = map(int, sys.argv[4:7])
+    jax.distributed.initialize(
+        f"localhost:{port}", num_processes=n_procs, process_id=pid
+    )
 
     import numpy as np
     from kafka_assigner_tpu.parallel.mesh import build_mesh
@@ -58,10 +62,11 @@ def _free_port() -> int:
     return port
 
 
-def _run_two_process_sweep(
-    tmp_path, n_brokers, n_topics, n_scenarios, devs_per_proc, timeout_s
+def _run_multi_process_sweep(
+    tmp_path, n_procs, n_brokers, n_topics, n_scenarios, devs_per_proc,
+    timeout_s,
 ):
-    """Launch 2 workers, return their parsed RESULT payloads."""
+    """Launch ``n_procs`` workers, return their parsed RESULT payloads."""
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)
     port = _free_port()
@@ -72,11 +77,11 @@ def _run_two_process_sweep(
     env["PYTHONPATH"] = os.getcwd()
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(port), str(i),
+            [sys.executable, str(script), str(port), str(i), str(n_procs),
              str(n_brokers), str(n_topics), str(n_scenarios)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
         )
-        for i in range(2)
+        for i in range(n_procs)
     ]
     outs = []
     try:
@@ -112,7 +117,7 @@ def _expected_payload(n_brokers, n_topics, n_scenarios):
 @pytest.mark.slow
 def test_two_process_mesh_matches_single_process(tmp_path):
     expected = _expected_payload(16, 2, 4)
-    for got in _run_two_process_sweep(tmp_path, 16, 2, 4, 2, 150):
+    for got in _run_multi_process_sweep(tmp_path, 2, 16, 2, 4, 2, 150):
         assert got["results"] == expected, got
 
 
@@ -124,5 +129,18 @@ def test_two_process_fleet_scale(tmp_path):
     # single-process result bit-for-bit, all scenarios feasible.
     expected = _expected_payload(128, 8, 32)
     assert all(row[2] for row in expected)  # all feasible
-    for got in _run_two_process_sweep(tmp_path, 128, 8, 32, 4, 300):
+    for got in _run_multi_process_sweep(tmp_path, 2, 128, 8, 32, 4, 300):
+        assert got["results"] == expected, got
+
+
+@pytest.mark.slow
+def test_four_process_mesh_matches_single_process(tmp_path):
+    # VERDICT r3 item 9: the make_array_from_callback feeding path beyond 2
+    # processes — 4 processes x 2 devices (8 global), 16 scenarios over a
+    # 64-broker cluster; every process must agree with the single-process
+    # result bit-for-bit.
+    expected = _expected_payload(64, 4, 16)
+    got_all = _run_multi_process_sweep(tmp_path, 4, 64, 4, 16, 2, 420)
+    assert len(got_all) == 4
+    for got in got_all:
         assert got["results"] == expected, got
