@@ -413,21 +413,97 @@ def _wave_body(
     return body
 
 
+def _seq_fill(
+    state: AssignState,
+    rack_idx: jnp.ndarray,
+    pos_fn,  # () -> (N_pad,) rotated positions (BIG for dead nodes)
+    cap: jnp.ndarray,
+    n: int,
+    alive: jnp.ndarray,
+) -> AssignState:
+    """The reference's ``assignOrphans`` replicated exactly
+    (``KafkaAssignmentStrategy.java:162-186``): partitions in ascending row
+    order, each one filled COMPLETELY — probing nodes in topic-rotated order
+    and taking the first acceptable — before the next partition starts.
+
+    This is deliberately sequential (a ``lax.scan`` over partition rows with
+    a static slot unroll), unlike the auction legs: one replica per
+    partition per wave can dead-end on exactly-tight instances that
+    sequential packing threads through, and vice versa — which is why BOTH
+    families are in the chain. As the final leg it guarantees the chain
+    solves every instance the reference solves, with the reference's own
+    placements when reached.
+    """
+    pos_n = pos_fn()[:n]
+    w = state.acc_nodes.shape[1]
+    rows = jnp.arange(n, dtype=jnp.int32)
+
+    def per_row(node_load, inp):
+        nodes, count, deficit = inp
+        infeasible = jnp.asarray(False)
+        st = (nodes, count, deficit, node_load, infeasible)
+        for _ in range(w):  # static: a row's deficit <= its slot width
+            nodes, count, deficit, node_load, infeasible = st
+            acc_racks = jnp.where(
+                nodes >= 0, rack_idx[jnp.maximum(nodes, 0)], -1
+            )
+            rack_blocked = jnp.any(
+                rack_idx[:n][:, None] == acc_racks[None, :], axis=1
+            )
+            dup = jnp.any(rows[:, None] == nodes[None, :], axis=1)
+            eligible = (
+                alive[:n] & (node_load[:n] < cap) & ~rack_blocked & ~dup
+            )
+            any_e = jnp.any(eligible)
+            pick = jnp.argmin(jnp.where(eligible, pos_n, BIG)).astype(
+                jnp.int32
+            )
+            ok = (deficit > 0) & any_e
+            infeasible = infeasible | ((deficit > 0) & ~any_e)
+            slot_onehot = jnp.arange(w, dtype=jnp.int32) == count
+            nodes = jnp.where(slot_onehot & ok, pick, nodes)
+            count = count + ok.astype(jnp.int32)
+            node_load = node_load.at[jnp.where(ok, pick, jnp.int32(n))].add(1)
+            deficit = deficit - ok.astype(jnp.int32)
+            st = (nodes, count, deficit, node_load, infeasible)
+        nodes, count, deficit, node_load, infeasible = st
+        return node_load, (nodes, count, deficit, infeasible)
+
+    node_load, (nodes, counts, deficits, infs) = lax.scan(
+        per_row, state.node_load,
+        (state.acc_nodes, state.acc_count, state.deficit),
+    )
+    return AssignState(
+        acc_nodes=nodes, acc_count=counts, node_load=node_load,
+        deficit=deficits, infeasible=state.infeasible | jnp.any(infs),
+    )
+
+
 #: Legal wave modes and the packing chain each one runs. Every leg restarts
 #: from the post-sticky state; a later leg runs only if the previous stranded.
-#:   "auto"    — fast → dense → balance  (reassignments; maximal robustness)
-#:   "fresh"   — balance → fast → dense  (from-scratch placements)
+#:   "auto"    — fast → dense → balance → seq  (reassignments)
+#:   "fresh"   — balance → fast → dense → seq  (from-scratch placements)
 #:   "fast"    — fast only   (vmapped sweeps: lax.cond under vmap lowers to
 #:               select and would run fallback legs for every batch element;
 #:               callers re-run stranded elements in "auto")
-#:   "dense"   — dense only  (reference-faithful first-fit probing order)
+#:   "dense"   — dense only  (first-fit probing order, simultaneous waves)
 #:   "balance" — balance only (capacity-greedy rack choice)
+#:   "seq"     — the reference's ``assignOrphans`` VERBATIM: partitions
+#:               ascending, each filled completely via rotated first-fit
+#:               before the next starts. Every other leg is a simultaneous
+#:               auction (one replica per partition per wave), and on
+#:               exactly-tight instances every auction order can strand
+#:               where sequential packing succeeds — so "seq" as the final
+#:               leg is what makes the default chains a TRUE superset of
+#:               the reference: any instance greedy solves, the chain
+#:               solves (identically, when it falls through to this leg).
 WAVE_MODES = {
-    "auto": ("fast", "dense", "balance"),
-    "fresh": ("balance", "fast", "dense"),
+    "auto": ("fast", "dense", "balance", "seq"),
+    "fresh": ("balance", "fast", "dense", "seq"),
     "fast": ("fast",),
     "dense": ("dense",),
     "balance": ("balance",),
+    "seq": ("seq",),
     # Two-leg chains: identical output to "auto" whenever the fast leg (or
     # the chain's fallback) succeeds — which is every non-saturated case —
     # but compile one fewer while_loop body. Compile time is a first-class
@@ -465,7 +541,7 @@ def _resolve_wave_plan(
                 f"wave_mode 'balance' packs (rack, live-rank) into int32 "
                 f"keys, which overflows at n_pad={n_pad}"
             )
-        legs = ("dense",)
+        legs = ("dense", "seq") if len(legs) > 1 else ("dense",)
     return legs, r_cap
 
 
@@ -561,9 +637,13 @@ def spread_orphans(
 
     # Progress is ≥ 1 placement per wave while feasible (the rank-0 bid on any
     # requested rack/node always lands), so P*RF waves is a hard upper bound;
-    # while_loop exits early via cond.
+    # while_loop exits early via cond. The "seq" leg is a single sequential
+    # pass, not a wave loop.
     def run_chain(chain) -> AssignState:
-        result = lax.while_loop(cond, bodies[chain[0]](), state)
+        if chain[0] == "seq":
+            result = _seq_fill(state, rack_idx, pos_fn, cap, n, alive)
+        else:
+            result = lax.while_loop(cond, bodies[chain[0]](), state)
         if len(chain) == 1:
             return result
         return lax.cond(

@@ -279,3 +279,26 @@ def test_oversized_context_counter_refused():
     enc = encode_problem("t", {0: [1, 2, 3]}, {}, {1, 2, 3}, {0}, 3)
     with pytest.raises(ValueError, match="key space"):
         context_to_array(ctx, enc)
+
+
+def test_seq_leg_rescues_auction_strand_byte_equal():
+    # Found by hypothesis (round 4): cap == 1 with an exactly-tight orphan
+    # matching — every simultaneous-auction leg (fast/dense/balance) dead-
+    # ends, but the reference's sequential first-fit threads through. The
+    # final "seq" leg reproduces assignOrphans verbatim, so the rescue is
+    # BYTE-equal to greedy, keeping the strict-superset contract real.
+    inter = list(range(100, 115))
+    racks = {100 + i: f"r{i % 5}" for i in range(15)}
+    racks[115] = "r0"
+    live = set(range(101, 116))
+    rack_map = {b: racks[b] for b in live}
+    current = {
+        p: [inter[(5 + p + i) % 15] for i in range(3)] for p in range(5)
+    }
+    g = TopicAssigner("greedy").generate_assignment(
+        "__consumer_offsets", current, live, rack_map, -1
+    )
+    t = TopicAssigner("tpu").generate_assignment(
+        "__consumer_offsets", current, live, rack_map, -1
+    )
+    assert g == t
