@@ -56,14 +56,14 @@ def main(budget_s: float) -> int:
         )
         return 1
 
-    def run(topics, live, rack_map, solver, env=None, value="1"):
+    def run(topics, live, rack_map, solver, env=None, value="1", rf=-1):
         if env:
             os.environ[env] = value
         try:
             try:
                 return (
                     TopicAssigner(solver).generate_assignments(
-                        topics, live, rack_map, -1
+                        topics, live, rack_map, rf
                     ),
                     None,
                 )
@@ -116,6 +116,24 @@ def main(budget_s: float) -> int:
                 print(f"REPRO movement divergence: seed={seed} n={n} p={p} "
                       f"rf={rf} racks={racks} rm={remove} add={add} "
                       f"tpu={m_t} greedy={m_g}")
+                return 1
+
+        # RF-decrease compat lane (round 4): lowering RF with
+        # KA_RF_DECREASE_COMPAT=1 must keep native byte-equal with the
+        # greedy oracle (including error behavior) — the reference's
+        # unbounded sticky retention reproduced through the C path.
+        if rf >= 2 and r.random() < 0.4:
+            os.environ["KA_RF_DECREASE_COMPAT"] = "1"
+            try:
+                dec = rf - 1
+                g_dec = run(topics, live, rack_map, "greedy", rf=dec)
+                n_dec = run(topics, live, rack_map, "native", rf=dec)
+            finally:
+                os.environ.pop("KA_RF_DECREASE_COMPAT", None)
+            if g_dec != n_dec:
+                print(f"REPRO rf-decrease compat divergence: seed={seed} "
+                      f"n={n} p={p} rf={rf}->{dec} racks={racks} "
+                      f"rm={remove} add={add}")
                 return 1
 
         # What-if sweep differential on the same cluster: random scenario
