@@ -431,8 +431,51 @@ def main() -> None:
             "config5_ms_per_scenario": round(c5_ms / 256, 2),
         }
 
+    # --- giant single topic (long-axis shape): 200k partitions, 5.1k brokers
+    # The sequence-parallel-analogue flagship shape (BASELINE round-4
+    # section). Expansion instance (greedy-feasible, fast-leg path). Opt-out
+    # with KA_BENCH_GIANT=0; budget-guarded like every optional section.
+    giant = {}
+    if os.environ.get("KA_BENCH_GIANT", "1") == "1" and budget_left("giant"):
+        from kafka_assigner_tpu.models.synthetic import rack_striped_cluster
+
+        g_map, _, g_racks = rack_striped_cluster(
+            N_BROKERS, 1, 200000, RF, N_RACKS,
+            name_fmt="giant-{:04d}", extra_brokers=REPLACED,
+        )
+        g_topics = list(g_map.items())
+        g_live = set(range(N_BROKERS + REPLACED))  # expansion: nothing removed
+        g_rm = {b: g_racks[b] for b in g_live}
+        TopicAssigner("tpu").generate_assignments(g_topics, g_live, g_rm, -1)
+        t0 = time.perf_counter()
+        g_pairs = TopicAssigner("tpu").generate_assignments(
+            g_topics, g_live, g_rm, -1
+        )
+        g_ms = (time.perf_counter() - t0) * 1000.0
+        t0 = time.perf_counter()
+        gn_pairs = TopicAssigner("native").generate_assignments(
+            g_topics, g_live, g_rm, -1
+        )
+        gn_ms = (time.perf_counter() - t0) * 1000.0
+        g_moved, gn_moved = (
+            sum(
+                1
+                for t, a in pairs
+                for p, r in a.items()
+                for b in r
+                if b not in dict(g_topics)[t][p]
+            )
+            for pairs in (g_pairs, gn_pairs)
+        )
+        giant = {
+            "giant_200k_1topic_warm_ms": round(g_ms, 1),
+            "giant_200k_native_baseline_ms": round(gn_ms, 1),
+            "giant_movement_parity": g_moved == gn_moved,
+        }
+
     result["extra"].update(variants)
     result["extra"].update(config5)
+    result["extra"].update(giant)
     if budget_skipped:
         result["extra"]["budget_skipped"] = budget_skipped
     # Refresh the stash with the COMPLETE record: child stdout does not

@@ -121,13 +121,15 @@ def main(budget_s: float) -> int:
         # RF-decrease compat lane (round 4): lowering RF with
         # KA_RF_DECREASE_COMPAT=1 must keep native byte-equal with the
         # greedy oracle (including error behavior) — the reference's
-        # unbounded sticky retention reproduced through the C path.
+        # unbounded sticky retention reproduced through the C path — and
+        # the tpu backend movement-par with greedy where both solve.
         if rf >= 2 and r.random() < 0.4:
             os.environ["KA_RF_DECREASE_COMPAT"] = "1"
             try:
                 dec = rf - 1
                 g_dec = run(topics, live, rack_map, "greedy", rf=dec)
                 n_dec = run(topics, live, rack_map, "native", rf=dec)
+                t_dec = run(topics, live, rack_map, "tpu", rf=dec)
             finally:
                 os.environ.pop("KA_RF_DECREASE_COMPAT", None)
             if g_dec != n_dec:
@@ -135,6 +137,19 @@ def main(budget_s: float) -> int:
                       f"n={n} p={p} rf={rf}->{dec} racks={racks} "
                       f"rm={remove} add={add}")
                 return 1
+            if g_dec[0] is not None and t_dec[0] is not None:
+                by_name = dict(topics)
+                m_g = sum(
+                    moved_replicas(by_name[t], a) for t, a in g_dec[0]
+                )
+                m_t = sum(
+                    moved_replicas(by_name[t], a) for t, a in t_dec[0]
+                )
+                if m_g != m_t:
+                    print(f"REPRO rf-decrease tpu movement divergence: "
+                          f"seed={seed} n={n} p={p} rf={rf}->{dec} "
+                          f"racks={racks} rm={remove} add={add}")
+                    return 1
 
         # What-if sweep differential on the same cluster: random scenario
         # set through the incremental path vs the dense oracle.
