@@ -313,27 +313,61 @@ def evaluate_removal_scenarios(
 
     from .mesh import fetch_global, put_sharded
 
-    if mesh is not None:
-        alive_dev = put_sharded(alive, mesh, PartitionSpec("scenarios", None))
-    else:
-        alive_dev = jnp.asarray(alive)
-
-    moved, infeasible, max_load = map(
-        np.array,  # forced copy: the rescue pass below mutates these rows
-        fetch_global(
-            whatif_sweep_jit(
-                jnp.asarray(currents),
-                jnp.asarray(enc0.rack_idx),
-                jnp.asarray(jhashes),
-                jnp.asarray(p_reals),
-                alive_dev,
-                n=enc0.n,
-                rf=rf,
-                rfs=jnp.asarray(rfs),
-                r_cap=enc0.r_cap,
-            )
-        ),
+    # Scenario-axis memory chunking: the vmapped sweep materializes
+    # per-scenario solver state (S, B, P_pad, RF)-shaped — at the giant
+    # 200k-partition topic a 256-scenario sweep would be multi-GB. Chunk S
+    # so one dispatch's state stays under ~KA_WHATIF_MEMBUDGET int32
+    # elements (default 2^28 = 1 GiB of int32); each chunk reuses one
+    # compiled program (chunks share the padded shape).
+    per_scenario = max(
+        1, currents.shape[0] * currents.shape[1] * max(rf, 1)
     )
+    budget = int(os.environ.get("KA_WHATIF_MEMBUDGET", str(1 << 28)))
+    s_chunk = max(1, budget // per_scenario)
+    if mesh is not None:
+        m = mesh.shape.get("scenarios", 1)
+        s_chunk = max(m, (s_chunk // m) * m)  # keep chunks mesh-tileable
+
+    def sweep_block(alive_block):
+        if mesh is not None:
+            alive_dev = put_sharded(
+                alive_block, mesh, PartitionSpec("scenarios", None)
+            )
+        else:
+            alive_dev = jnp.asarray(alive_block)
+        return map(
+            np.array,  # forced copy: the rescue pass below mutates rows
+            fetch_global(
+                whatif_sweep_jit(
+                    jnp.asarray(currents),
+                    jnp.asarray(enc0.rack_idx),
+                    jnp.asarray(jhashes),
+                    jnp.asarray(p_reals),
+                    alive_dev,
+                    n=enc0.n,
+                    rf=rf,
+                    rfs=jnp.asarray(rfs),
+                    r_cap=enc0.r_cap,
+                )
+            ),
+        )
+
+    if s_pad <= s_chunk:
+        moved, infeasible, max_load = sweep_block(alive)
+    else:
+        # Fixed-size blocks (last one padded all-alive) so every dispatch
+        # hits the same compiled program.
+        blocks = []
+        for lo in range(0, s_pad, s_chunk):
+            block = np.ones((s_chunk, alive.shape[1]), dtype=bool)
+            block[:, enc0.n:] = False
+            chunk_rows = alive[lo:lo + s_chunk]
+            block[: len(chunk_rows)] = chunk_rows
+            blocks.append(tuple(sweep_block(block)))
+        moved, infeasible, max_load = (
+            np.concatenate([b[i] for b in blocks])[:s_pad]
+            for i in range(3)
+        )
     # The sweep runs the fast wave only (an in-graph fallback would execute
     # for every vmapped scenario); a raised flag can mean "fast packing
     # stranded" rather than true infeasibility — the shared rescue re-runs

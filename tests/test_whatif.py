@@ -88,3 +88,27 @@ def test_whatif_nonuniform_rf_raises(cluster):
     bad["ragged"] = {0: [100, 101, 102], 1: [100, 101]}
     with pytest.raises(ValueError, match="unexpected replication factor"):
         evaluate_removal_scenarios(bad, live, rack_map, [[]], -1)
+
+
+def test_whatif_scenario_chunking_matches_unchunked(cluster, monkeypatch):
+    # Memory chunking of the dense sweep's scenario axis (round 4: the
+    # giant-topic shape makes one (S, B, P_pad, RF) dispatch multi-GB):
+    # forcing a tiny budget splits the sweep into many fixed-size blocks,
+    # which must be bit-identical to the single-dispatch sweep — including
+    # through a mesh (blocks stay mesh-tileable).
+    topics, live, rack_map = cluster
+    scenarios = [[b] for b in sorted(live)[:12]]
+    monkeypatch.setenv("KA_WHATIF_INCREMENTAL", "0")  # pin the dense path
+    expected = evaluate_removal_scenarios(
+        topics, live, rack_map, scenarios, 3
+    )
+    monkeypatch.setenv("KA_WHATIF_MEMBUDGET", "1")  # 1 scenario per block
+    chunked = evaluate_removal_scenarios(
+        topics, live, rack_map, scenarios, 3
+    )
+    assert chunked == expected
+    mesh = build_mesh()
+    chunked_mesh = evaluate_removal_scenarios(
+        topics, live, rack_map, scenarios, 3, mesh=mesh
+    )
+    assert chunked_mesh == expected
