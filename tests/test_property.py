@@ -53,7 +53,7 @@ def clusters(draw):
 
 
 @pytest.mark.skipif(not native_available(), reason="no C++ toolchain")
-@settings(max_examples=20, deadline=None)
+@settings(max_examples=35, deadline=None)
 @given(clusters())
 def test_greedy_native_byte_equality(case):
     topic, current, live, rack_map, rf = case
@@ -69,7 +69,7 @@ def test_greedy_native_byte_equality(case):
     assert g == n
 
 
-@settings(max_examples=15, deadline=None)
+@settings(max_examples=30, deadline=None)
 @given(clusters())
 def test_tpu_invariants_and_movement(case):
     topic, current, live, rack_map, rf = case
@@ -89,7 +89,7 @@ def test_tpu_invariants_and_movement(case):
         assert moved_replicas(current, t) == moved_replicas(current, g)
 
 
-@settings(max_examples=10, deadline=None)
+@settings(max_examples=20, deadline=None)
 @given(clusters())
 def test_determinism(case):
     topic, current, live, rack_map, rf = case
