@@ -658,7 +658,19 @@ def spread_orphans(
             rack_idx, cap, n, alive, rf, r_cap, seg, start, n_alive,
             balance=True,
         ),
+        "balance_slots": lambda: _wave_body(
+            rack_idx, cap, n, alive, rf, r_cap, seg, start, n_alive,
+            balance=True, slot_pack=True,
+        ),
     }
+    # Giant FRESH placements: everything is an orphan and the leading
+    # balance leg's node-per-wave hand-out needs ~cap waves (measured 151 s
+    # for 200k x RF3 from scratch). A slot-packed balance tries first —
+    # uniform fresh loads are exactly where packing a rack densely is safe —
+    # with the node-per-wave balance (and the rest of the chain) unchanged
+    # behind it for anything it strands.
+    if slot_pack and legs and legs[0] == "balance":
+        legs = ("balance_slots",) + legs
 
     # Progress is ≥ 1 placement per wave while feasible (the rank-0 bid on any
     # requested rack/node always lands), so P*RF waves is a hard upper bound;

@@ -479,6 +479,45 @@ class TpuSolver:
         )
         counters_before = context_to_array(context, enc)
 
+        if _resolve_native_order(use_pallas=False):
+            # Heterogeneous split, same as assign_many: placement (the
+            # parallel tensor phase, "fresh" wave chain) on device; the
+            # inherently sequential leadership chain in host C++. The fused
+            # device path below runs the ~P-step leadership scan on device,
+            # which at giant partition counts is the whole wall-clock
+            # (measured 133 s of a 200k-partition fresh placement).
+            from ..native.leadership import order_many
+            from ..ops.assignment import place_scan_jit
+
+            acc_nodes, acc_count, infeasible, deficits, _ = jax.device_get(
+                place_scan_jit(
+                    jnp.asarray(enc.current)[None],
+                    jnp.asarray(enc.rack_idx),
+                    jnp.asarray(np.array([enc.jhash], dtype=np.int32)),
+                    jnp.asarray(np.array([enc.p], dtype=np.int32)),
+                    n=enc.n,
+                    rf=enc.rf,
+                    wave_mode="fresh",
+                    r_cap=enc.r_cap,
+                )
+            )
+            if bool(infeasible[0]):
+                bad = int(np.argmax(deficits[0] > 0))
+                raise ValueError(
+                    f"Partition {int(enc.partition_ids[bad])} could not be "
+                    "fully assigned!"
+                )
+            ordered_b, counters_after = order_many(
+                np.asarray(acc_nodes), np.asarray(acc_count),
+                np.array([enc.jhash], dtype=np.int64),
+                np.array([enc.p], dtype=np.int32),
+                counters_before,
+            )
+            apply_counter_updates(
+                context, enc, counters_before, counters_after
+            )
+            return decode_assignment(enc, ordered_b[0])
+
         ordered, counters_after, infeasible, deficit = jax.device_get(
             _fresh_solve_jit(
                 jnp.asarray(enc.rack_idx),
