@@ -1,0 +1,82 @@
+"""Time the saturated-giant showcase instance (VERDICT r4 item 4).
+
+The instance: one 200k-partition topic over 5k brokers, replace-100
+(brokers 0..99 out, 5000..5099 in) — EXACTLY saturated (orphans == free
+slots). The reference's first-fit provably dead-ends here
+("Partition 196691 could not be fully assigned!",
+KafkaAssignmentStrategy.java:29-30 caveat at headline scale); our balance
+wave solves it, historically via the pathological fast-strand -> balance
+rescue path (~107-133 s warm on the 1-core box). The expansion instance
+(+100 brokers, greedy-feasible) is timed alongside as the non-saturated
+yardstick.
+
+Run standalone on any platform (CPU fallback or on-chip via the r05
+runbook stage D). Emits one JSON line per instance so the runbook log
+banks machine-readable timings.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+from kafka_assigner_tpu.utils.compilecache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax  # noqa: E402
+
+from kafka_assigner_tpu.assigner import TopicAssigner  # noqa: E402
+from kafka_assigner_tpu.models.synthetic import rack_striped_cluster  # noqa: E402
+from kafka_assigner_tpu.solvers.tpu import TpuSolver  # noqa: E402
+
+
+def _moved(topics, pairs):
+    cur = dict(topics)
+    return sum(
+        1 for t, a in pairs for p, r in a.items() for x in r if x not in cur[t][p]
+    )
+
+
+def _time_instance(name, topics, live, racks):
+    rack_map = {b: racks[b] for b in live}
+    t0 = time.perf_counter()
+    TopicAssigner(TpuSolver()).generate_assignments(topics, live, rack_map, -1)
+    cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = TopicAssigner(TpuSolver()).generate_assignments(
+        topics, live, rack_map, -1
+    )
+    warm = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "instance": name,
+                "platform": jax.default_backend(),
+                "cold_s": round(cold, 2),
+                "warm_s": round(warm, 2),
+                "moved": _moved(topics, out),
+            }
+        ),
+        flush=True,
+    )
+
+
+def main():
+    topic_map, _, racks = rack_striped_cluster(
+        5000, 1, 200000, 3, 10, name_fmt="giant-{:04d}", extra_brokers=100
+    )
+    topics = list(topic_map.items())
+
+    # Expansion first: smaller program, warms shared cache entries, and a
+    # hang in the saturated instance then identifies itself.
+    _time_instance("giant_expansion_plus100", topics, set(range(5100)), racks)
+    _time_instance(
+        "giant_saturated_replace100",
+        topics,
+        set(range(100, 5100)),
+        racks,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -2,4 +2,4 @@
 # Round-3 runbook retired; the long-running tunnel watcher (/tmp/tpu_wait2.sh,
 # started during round 3) invokes this path on first chip contact, so it now
 # execs the current round's runbook.
-exec bash /root/repo/scripts/tpu_onchip_r04.sh "$@"
+exec bash /root/repo/scripts/tpu_onchip_r05.sh "$@"

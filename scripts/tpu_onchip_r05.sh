@@ -1,0 +1,67 @@
+#!/bin/bash
+# Round-5 on-chip runbook: fired by the tunnel watcher on first contact (the
+# watcher invokes tpu_onchip_r03.sh, which execs this). Produces
+# TPU_PROBE_r05.log + BENCH_onchip_r05.json — the on-chip execution artifact
+# VERDICT r4 item 1 demands — staging small -> headline so a hang identifies
+# the wall instead of hiding it.
+#
+# All stages force LOCAL compilation (PALLAS_AXON_REMOTE_COMPILE=0 ->
+# axon register(remote_compile=False) -> libtpu AOT on this box, executable
+# shipped to the terminal): the round-2/3 postmortem showed remote compiles
+# can hang unboundedly and a killed remote compile wedges the terminal for
+# hours, while every production program local-compiles in 5-18 s and the
+# persistent cache (.jax_cache) already holds warm v5e entries from the
+# chipless AOT runs. bench.py self-supervises (headline secured before any
+# variant runs; variants include KA_PALLAS_LEADERSHIP and the
+# KA_LEADER_CHUNK down-probe — the measurements the pallas keep-or-kill rule
+# and the leader-chunk default are waiting on).
+set -u
+cd /root/repo
+LOG=TPU_PROBE_r05.log
+stamp() { echo "[$(date -u +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+stamp "=== round-5 on-chip probe; devices first ==="
+PALLAS_AXON_REMOTE_COMPILE=0 timeout 300 python -c "
+import time, jax
+t0 = time.time()
+print('devices (%.1fs):' % (time.time() - t0), jax.devices(), flush=True)
+import jax.numpy as jnp
+y = jax.jit(lambda x: x * 2 + 1)(jnp.arange(8.0)).block_until_ready()
+print('trivial jit ok:', y, flush=True)
+" 2>&1 | tee -a "$LOG"
+rc=${PIPESTATUS[0]}
+stamp "device probe rc=$rc"
+[ "$rc" != 0 ] && { stamp "tunnel not answering; aborting"; exit 1; }
+
+stamp "=== stage A: staged-shape compile/run probe (local compile) ==="
+PALLAS_AXON_REMOTE_COMPILE=0 timeout 1800 python scripts/tpu_compile_probe.py 2>&1 | tee -a "$LOG"
+stamp "stage A rc=${PIPESTATUS[0]}"
+
+stamp "=== stage B: bench.py (headline + pallas + chunk sweep + config5) ==="
+# stderr goes straight to the log; only stdout (whose last line is the JSON
+# contract) feeds the banked artifact.
+timeout 2400 python bench.py 2>>"$LOG" | tee -a "$LOG" | tail -1 > /tmp/bench_r05_last_line
+rc=${PIPESTATUS[0]}
+# Bank only a valid JSON contract line: a timeout/kill can leave a partial
+# progress line (or nothing) as the last stdout, which must not masquerade
+# as the round-5 artifact of record.
+if python -c "import json,sys; json.load(open('/tmp/bench_r05_last_line'))" 2>/dev/null; then
+  cp /tmp/bench_r05_last_line BENCH_onchip_r05.json
+  stamp "bench rc=$rc; banked BENCH_onchip_r05.json"
+else
+  stamp "bench rc=$rc; last line is NOT valid JSON — nothing banked"
+fi
+
+stamp "=== stage C: pallas leadership on-chip validation (keep-or-kill input) ==="
+PALLAS_AXON_REMOTE_COMPILE=0 timeout 900 python scripts/validate_pallas_tpu.py 2>&1 | tee -a "$LOG"
+stamp "stage C rc=${PIPESTATUS[0]}"
+
+stamp "=== stage D: saturated-giant on-chip timing (VERDICT r4 item 4) ==="
+PALLAS_AXON_REMOTE_COMPILE=0 timeout 1800 python scripts/bench_saturated_giant.py 2>&1 | tee -a "$LOG"
+stamp "stage D rc=${PIPESTATUS[0]}"
+
+stamp "=== stage E: commit the artifacts ==="
+git add TPU_PROBE_r05.log BENCH_onchip_r05.json 2>/dev/null
+git commit -q -m "On-chip round-5 artifacts: probe log + banked bench JSON" \
+  && stamp "committed" || stamp "nothing to commit / commit failed"
+stamp "done"
