@@ -96,19 +96,9 @@ def dense_mask_budget() -> int:
     ``jax.clear_caches()`` to take effect (tests do; production sets it at
     process start or never).
     """
-    import os
+    from ..utils.env import env_int
 
-    raw = os.environ.get("KA_DENSE_MASK_BUDGET")
-    if raw:
-        try:
-            return max(1, int(raw))
-        except ValueError:
-            print(
-                f"kafka-assigner: ignoring non-integer "
-                f"KA_DENSE_MASK_BUDGET={raw!r}",
-                file=sys.stderr,
-            )
-    return DENSE_MASK_BUDGET
+    return env_int("KA_DENSE_MASK_BUDGET", DENSE_MASK_BUDGET)
 
 # Below this partition-bucket size the (P, P) same-key-before-me count beats a
 # stable argsort in _requests_rank (CPU-XLA microbench, round 1: ~3x at P=128,
@@ -1071,6 +1061,7 @@ def solve_assignment(
     use_pallas: bool = False,
     r_cap: int | None = None,
     width: int | None = None,  # static compat slot width (see sticky_fill)
+    wave_mode: str = "auto",
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Full single-topic solve.
 
@@ -1081,14 +1072,14 @@ def solve_assignment(
     alive = default_alive(rack_idx, n)
     counters, (ordered, infeasible, deficit, _) = _solve_one_topic(
         counters, current, jhash, p_real, rack_idx, alive, n, rf,
-        use_pallas=use_pallas, r_cap=r_cap, width=width,
+        wave_mode=wave_mode, use_pallas=use_pallas, r_cap=r_cap, width=width,
     )
     return ordered, counters, infeasible, deficit
 
 
 solve_assignment_jit = jax.jit(
     solve_assignment,
-    static_argnames=("n", "rf", "use_pallas", "r_cap", "width"),
+    static_argnames=("n", "rf", "use_pallas", "r_cap", "width", "wave_mode"),
     donate_argnums=(),
 )
 

@@ -68,15 +68,24 @@ def solver_tuning() -> tuple:
       (``ops/assignment.py:WAVE_MODES``). Chains that begin with the fast leg
       produce identical output on any instance the fast leg solves; shorter
       chains compile fewer while_loop bodies — a first-class cost when the
-      deployment target compiles remotely over the chip tunnel.
+      deployment target compiles remotely over the chip tunnel. Unset, the
+      default is ``auto`` — except under ``KA_RF_DECREASE_COMPAT=1``, where
+      it is ``seq``: bug-compat mode exists to reproduce the reference
+      byte-for-byte, and the seq leg IS the reference's ``assignOrphans``,
+      so compat + seq makes all three backends byte-equal on every input
+      class including RF decreases that leave orphans (VERDICT r4 item 7).
+      An explicit KA_WAVE_MODE still wins (movement parity remains the
+      auction legs' contract).
     - ``KA_LEADER_CHUNK``: partitions per leadership scan step (static
       unroll). Chunk choice is semantics-invariant (pinned by tests).
 
     Both participate in the jit cache key as static arguments.
     """
     from ..ops.assignment import WAVE_MODES
+    from ..utils.env import env_int
 
-    wave = os.environ.get("KA_WAVE_MODE", "auto")
+    default = "seq" if rf_compat_enabled() else "auto"
+    wave = os.environ.get("KA_WAVE_MODE") or default
     if wave not in WAVE_MODES:
         import sys
 
@@ -85,20 +94,8 @@ def solver_tuning() -> tuple:
             f"(expected one of {sorted(WAVE_MODES)})",
             file=sys.stderr,
         )
-        wave = "auto"
-    raw = os.environ.get("KA_LEADER_CHUNK")
-    chunk = None
-    if raw:
-        try:
-            chunk = max(1, int(raw))
-        except ValueError:
-            import sys
-
-            print(
-                f"kafka-assigner: ignoring non-integer KA_LEADER_CHUNK={raw!r}",
-                file=sys.stderr,
-            )
-    return wave, chunk
+        wave = default  # keep the compat byte-parity default intact
+    return wave, env_int("KA_LEADER_CHUNK")
 
 
 def rf_compat_enabled() -> bool:
@@ -109,9 +106,10 @@ def rf_compat_enabled() -> bool:
     (``KafkaAssignmentStrategy.java:320-324``) — so lowering RF emits the
     reference's non-uniform replica lists (VERDICT r3 item 6). Under compat
     ``--solver native`` is byte-equal with the greedy oracle on every input
-    class; the tpu solver keeps its usual contract (bit-faithful sticky
-    retention and movement parity, with the documented wave-auction freedom
-    in which eligible node takes an orphan)."""
+    class, and the tpu solver defaults its wave chain to ``seq`` (the
+    reference's ``assignOrphans`` verbatim — see ``solver_tuning``), making
+    all THREE backends byte-equal, orphaned decreases included; an explicit
+    ``KA_WAVE_MODE`` restores the auction legs' movement-parity contract."""
     return os.environ.get("KA_RF_DECREASE_COMPAT") == "1"
 
 
@@ -231,6 +229,7 @@ class TpuSolver:
                 ),
                 r_cap=enc.r_cap,
                 width=width,
+                wave_mode=solver_tuning()[0],
             )
         )
         if bool(infeasible):

@@ -1,0 +1,24 @@
+"""Shared parsing for integer environment knobs (``KA_LEADER_CHUNK``,
+``KA_DENSE_MASK_BUDGET``, ...): invalid values are ignored LOUDLY on stderr
+— the house rule for every tuning knob (mis-set knobs must never silently
+change the measured configuration)."""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def env_int(name: str, default: int | None = None, floor: int = 1):
+    """``int(os.environ[name])`` clamped to ``floor``; ``default`` when the
+    variable is unset or non-integer (the latter with a stderr warning)."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return max(floor, int(raw))
+    except ValueError:
+        print(
+            f"kafka-assigner: ignoring non-integer {name}={raw!r}",
+            file=sys.stderr,
+        )
+        return default

@@ -1,0 +1,127 @@
+"""Pin BOTH jute wire endpoints to spec-derived byte goldens (VERDICT r4
+item 3 / missing #1): the in-tree client (``io/zkwire.py``) and the in-tree
+test server (``tests/test_zk_socket.py``) were previously only ever tested
+against each other, so a shared misunderstanding of the wire format would
+have passed every test. ``tests/golden/zk_jute_frames.json`` holds frames
+hand-derived field-by-field from Apache ZooKeeper's ``zookeeper.jute``
+record definitions (see its ``_derivation`` key) — each side is asserted
+byte-for-byte against that third artifact, not against the other side.
+
+Client request bytes are captured with a scripted in-memory socket; server
+reply bytes are read off a real TCP connection driven by raw golden frames
+(no client code in the loop).
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+import socket
+import struct
+
+from kafka_assigner_tpu.io.zkwire import MiniZkClient
+
+from .test_zk_socket import JuteZkServer
+
+GOLDEN = json.loads(
+    (pathlib.Path(__file__).parent / "golden" / "zk_jute_frames.json")
+    .read_text()
+)
+
+
+def _g(name: str) -> bytes:
+    return bytes.fromhex("".join(GOLDEN[name]["hex"].split()))
+
+
+class ScriptedSock:
+    """Duck-type of the socket surface MiniZkClient uses: records sent
+    bytes, replays queued reply frames."""
+
+    def __init__(self, replies):
+        self.sent = b""
+        self._rx = b"".join(replies)
+
+    def sendall(self, data):
+        self.sent += data
+
+    def recv(self, n):
+        out, self._rx = self._rx[:n], self._rx[n:]
+        return out
+
+    def settimeout(self, t):
+        pass
+
+    def close(self):
+        pass
+
+
+def test_client_frames_match_spec_goldens():
+    client = MiniZkClient("127.0.0.1:2181", timeout=10.0)
+    sock = ScriptedSock(
+        [
+            _g("connect_response"),
+            _g("get_children_response"),
+            _g("get_data_response"),
+            _g("close_response"),
+        ]
+    )
+    client._sock = sock
+    client._handshake(10_000)
+    assert sock.sent == _g("connect_request")
+
+    sock.sent = b""
+    assert client.get_children("/brokers/ids") == ["1", "2"]
+    assert sock.sent == _g("get_children_request")
+
+    sock.sent = b""
+    data, stat = client.get("/brokers/ids/1")
+    assert data == b"DATA1"
+    assert (stat.czxid, stat.dataLength, stat.numChildren) == (1, 5, 0)
+    assert sock.sent == _g("get_data_request")
+
+    sock.sent = b""
+    client.stop()
+    assert sock.sent == _g("close_request")
+
+
+def test_server_frames_match_spec_goldens():
+    server = JuteZkServer(
+        {"/brokers/ids/1": b"DATA1", "/brokers/ids/2": b"DATA2"}
+    )
+    server.start()
+    try:
+        conn = socket.create_connection(("127.0.0.1", server.port), 5.0)
+        conn.settimeout(5.0)
+
+        def roundtrip(frame: bytes) -> bytes:
+            conn.sendall(frame)
+            head = b""
+            while len(head) < 4:
+                head += conn.recv(4 - len(head))
+            (n,) = struct.unpack(">i", head)
+            body = b""
+            while len(body) < n:
+                body += conn.recv(n - len(body))
+            return head + body
+
+        assert roundtrip(_g("connect_request")) == _g("connect_response")
+        assert (
+            roundtrip(_g("get_children_request"))
+            == _g("get_children_response")
+        )
+        assert roundtrip(_g("get_data_request")) == _g("get_data_response")
+        assert roundtrip(_g("close_request")) == _g("close_response")
+        conn.close()
+    finally:
+        server.shutdown()
+
+
+def test_goldens_are_self_consistent():
+    """Frame length prefixes inside the golden file itself are coherent —
+    a guard against fixture typos (this is how a one-byte miscount in the
+    hand derivation was caught)."""
+    for name in GOLDEN:
+        if name.startswith("_"):
+            continue
+        raw = _g(name)
+        (n,) = struct.unpack(">i", raw[:4])
+        assert len(raw) == 4 + n, name
