@@ -21,13 +21,27 @@ import sys
 from typing import Dict, Optional, Sequence
 
 
-def probe_device_count(timeout_s: float) -> int:
+def probe_device_count(timeout_s: float, allow_cpu: bool = False) -> int:
     """Device count a fresh interpreter sees with the current env, -1 on
-    wedge/failure. Init can legitimately take ~20-40s on first TPU contact;
-    pick ``timeout_s`` above that."""
+    wedge/failure. With ``allow_cpu=False`` (the bench's setting) a
+    successfully-initialized ``cpu`` backend reports 0 — a CPU platform
+    (e.g. an ambient ``JAX_PLATFORMS=cpu``) must never make the bench
+    artifact drop its ``_cpu_fallback`` tag. ``allow_cpu=True`` counts any
+    platform's devices (the multichip dryrun runs on a forced CPU mesh by
+    design). Init can legitimately take ~20-40s on first TPU contact; pick
+    ``timeout_s`` above that."""
+    expr = (
+        "len(ds)"
+        if allow_cpu
+        else "0 if jax.default_backend() == 'cpu' else len(ds)"
+    )
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            [
+                sys.executable,
+                "-c",
+                f"import jax; ds = jax.devices(); print({expr})",
+            ],
             timeout=timeout_s, capture_output=True, text=True,
         )
         if proc.returncode == 0:
