@@ -72,7 +72,21 @@ DENSE_MASK_BUDGET = 1 << 27
 #: property that lets the balance family solve exactly-saturated instances)
 #: while the wave count collapses from O(orphans / racks) to
 #: ~O(log(cap) / log(T/(T-1))) ≈ 25 at the giant replace-100 shape (T=4).
+#: Env-overridable for measurement (KA_QUOTA_WAVE_TARGET, trace-time read
+#: like dense_mask_budget).
 QUOTA_WAVE_TARGET = 4
+
+
+def quota_wave_target() -> int:
+    from ..utils.env import env_int
+
+    return env_int("KA_QUOTA_WAVE_TARGET", QUOTA_WAVE_TARGET)
+
+
+def quota_endgame_headroom() -> int:
+    from ..utils.env import env_int
+
+    return env_int("KA_QUOTA_ENDGAME", QUOTA_ENDGAME_HEADROOM)
 
 #: Endgame handoff for the quota-balance leg: once every rack's headroom is
 #: at or below this, the hybrid body switches (lax.cond on the traced
@@ -82,7 +96,8 @@ QUOTA_WAVE_TARGET = 4
 #: slots into a rack-exclusivity corner that the cautious node-per-wave
 #: endgame (empirically corner-free on the saturated instances) avoids; the
 #: tail it hands over is <= r_cap * QUOTA_ENDGAME_HEADROOM slots, so the
-#: node-per-wave waves it costs are bounded and small.
+#: node-per-wave waves it costs are bounded and small. Env-overridable for
+#: measurement (KA_QUOTA_ENDGAME, trace-time read like dense_mask_budget).
 QUOTA_ENDGAME_HEADROOM = 32
 
 
@@ -425,9 +440,8 @@ def _wave_body(
             # throughput alive) and racks drain proportionally (keeping
             # rack fills even — the saturated-instance property).
             headroom_n = jnp.where(avail, cap - state.node_load[:n], 0)
-            units = (
-                headroom_n + QUOTA_WAVE_TARGET - 1
-            ) // QUOTA_WAVE_TARGET
+            t_div = quota_wave_target()
+            units = (headroom_n + t_div - 1) // t_div
         elif slot_pack:
             units = jnp.where(avail, cap - state.node_load[:n], 0)
         else:
@@ -550,7 +564,7 @@ def _hybrid_quota_body(
             .at[rack_idx[:n]]
             .add(headroom)
         )
-        bulk = jnp.max(rack_room) > QUOTA_ENDGAME_HEADROOM
+        bulk = jnp.max(rack_room) > quota_endgame_headroom()
         return lax.cond(bulk, quota_body, endgame_body, state)
 
     return body

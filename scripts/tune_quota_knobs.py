@@ -1,0 +1,124 @@
+"""Measure QUOTA_WAVE_TARGET / QUOTA_ENDGAME_HEADROOM candidates on the
+saturated-giant showcase instance so the defaults are chosen from numbers,
+not guesses (the KA_LEADER_CHUNK treatment).
+
+Two measurements per (T, E) candidate:
+- wave count via the eager replay harness (platform-invariant, immune to
+  box contention — the number that matters on chip, where per-wave latency
+  dominates);
+- end-to-end warm solve on this box (sanity check; contention-noisy).
+
+Every candidate changes traced programs, so each runs in a fresh
+subprocess (the jit cache does not key on the env knobs).
+
+Run:  python scripts/tune_quota_knobs.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import json, os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+sys.path.insert(0, "__REPO__")
+from kafka_assigner_tpu.models.problem import encode_problem
+from kafka_assigner_tpu.models.synthetic import rack_striped_cluster
+from kafka_assigner_tpu.ops import assignment as A
+from kafka_assigner_tpu.assigner import TopicAssigner
+from kafka_assigner_tpu.solvers.tpu import TpuSolver
+from kafka_assigner_tpu.utils.compilecache import enable_persistent_cache
+enable_persistent_cache()
+
+topic_map, _, racks = rack_striped_cluster(
+    5000, 1, 200000, 3, 10, name_fmt="giant-{:04d}", extra_brokers=100
+)
+name, tmap = next(iter(topic_map.items()))
+live = set(range(100, 5100))
+rack_map = {b: racks[b] for b in live}
+
+# wave count (eager replay of the production chain: fast_slots strand then
+# the hybrid leg, both restarting from post-sticky)
+enc = encode_problem(name, tmap, rack_map, live, set(tmap), 3)
+rack_idx = jnp.asarray(enc.rack_idx)
+alive = A.default_alive(rack_idx, enc.n)
+n_alive = jnp.maximum(jnp.sum(alive[: enc.n].astype(jnp.int32)), 1)
+cap = (jnp.int32(enc.p) * 3 + n_alive - 1) // n_alive
+start = jnp.int32(enc.jhash) % n_alive
+seg = A.cluster_segments(rack_idx, enc.n, alive, enc.r_cap)
+post = A.sticky_fill(
+    jnp.asarray(enc.current), rack_idx, 3, cap, enc.n, jnp.int32(enc.p),
+    alive, jnp.int32(3), None,
+)
+trips = {}
+state = post
+for kind in ("fast_slots", "hybrid"):
+    state = post
+    if kind == "hybrid":
+        body = A._hybrid_quota_body(
+            rack_idx, cap, enc.n, alive, 3, enc.r_cap, seg, start, n_alive
+        )
+    else:
+        body = A._wave_body(
+            rack_idx, cap, enc.n, alive, 3, enc.r_cap, seg, start, n_alive,
+            slot_pack=True,
+        )
+    body = jax.jit(body)
+    t = 0
+    while int(jnp.sum(state.deficit)) > 0 and not bool(state.infeasible):
+        state = body(state)
+        t += 1
+    trips[kind] = t
+    if not bool(state.infeasible):
+        break
+solved = not bool(state.infeasible) and int(jnp.sum(state.deficit)) == 0
+
+# end-to-end warm (full pipeline through the solver)
+topics = list(topic_map.items())
+TopicAssigner(TpuSolver()).generate_assignments(topics, live, rack_map, -1)
+t0 = time.perf_counter()
+TopicAssigner(TpuSolver()).generate_assignments(topics, live, rack_map, -1)
+warm_s = time.perf_counter() - t0
+print(json.dumps({"trips": trips, "solved": solved,
+                  "warm_s": round(warm_s, 2)}))
+""".replace("__REPO__", _REPO)
+
+
+def main() -> None:
+    results = []
+    for t_div, endgame in [
+        (4, 32), (2, 32), (8, 32), (4, 16), (4, 64), (2, 16), (2, 64),
+    ]:
+        env = dict(os.environ)
+        env["KA_QUOTA_WAVE_TARGET"] = str(t_div)
+        env["KA_QUOTA_ENDGAME"] = str(endgame)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = _REPO
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, "-c", CHILD], env=env, capture_output=True,
+            text=True, timeout=1800,
+        )
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout else "{}"
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            rec = {"error": proc.stderr[-500:]}
+        rec.update(T=t_div, E=endgame, wall_s=round(time.time() - t0, 1))
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+    with open(os.path.join(_REPO, "QUOTA_TUNING_r05.json"), "w") as f:
+        json.dump(results, f, indent=1)
+    print("wrote QUOTA_TUNING_r05.json")
+
+
+if __name__ == "__main__":
+    main()
