@@ -1,0 +1,98 @@
+"""Widened hypothesis fuzz (the round-4 "~17x in-suite budget" treatment,
+re-run for round 5's wave-machinery changes): the tests/test_property.py
+cluster strategy at a much larger example budget, asserting the cross-
+solver contracts — greedy/native byte equality, tpu strict-superset +
+movement parity + structural invariants — and, with
+``KA_DENSE_MASK_BUDGET=1`` in the environment, the same contracts through
+the giant-shape wave chain (slot-packed fast + balance_quota hybrid).
+
+Usage:  python scripts/widened_fuzz.py [examples_per_contract]  (default 300)
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main(n_examples: int) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from hypothesis import given, settings
+
+    from kafka_assigner_tpu.assigner import TopicAssigner
+    from tests.helpers import (
+        moved_replicas,
+        native_available,
+        verify_full_invariants,
+    )
+    from tests.test_property import clusters
+
+    t0 = time.time()
+    counts = {"byte": 0, "tpu": 0}
+
+    @settings(max_examples=n_examples, deadline=None)
+    @given(clusters())
+    def fuzz_greedy_native_byte_equality(case):
+        topic, current, live, rack_map, rf = case
+        counts["byte"] += 1
+        try:
+            g = TopicAssigner("greedy").generate_assignment(
+                topic, current, live, rack_map, -1
+            )
+        except ValueError:
+            try:
+                TopicAssigner("native").generate_assignment(
+                    topic, current, live, rack_map, -1
+                )
+            except ValueError:
+                return
+            raise AssertionError("native succeeded where greedy failed")
+        n = TopicAssigner("native").generate_assignment(
+            topic, current, live, rack_map, -1
+        )
+        assert g == n
+
+    @settings(max_examples=n_examples, deadline=None)
+    @given(clusters())
+    def fuzz_tpu_superset_parity_invariants(case):
+        topic, current, live, rack_map, rf = case
+        counts["tpu"] += 1
+        try:
+            g = TopicAssigner("greedy").generate_assignment(
+                topic, current, live, rack_map, -1
+            )
+            greedy_ok = True
+        except ValueError:
+            greedy_ok = False
+        try:
+            t = TopicAssigner("tpu").generate_assignment(
+                topic, current, live, rack_map, -1
+            )
+        except ValueError:
+            assert not greedy_ok  # strict superset
+            return
+        verify_full_invariants(t, rack_map, sorted(live), rf)
+        if greedy_ok:
+            assert moved_replicas(current, t) == moved_replicas(current, g)
+
+    budget = os.environ.get("KA_DENSE_MASK_BUDGET", "<default>")
+    print(f"widened fuzz: {n_examples}/contract, budget={budget}", flush=True)
+    if native_available():
+        fuzz_greedy_native_byte_equality()
+        print(f"  byte-equality contract: {counts['byte']} examples OK",
+              flush=True)
+    fuzz_tpu_superset_parity_invariants()
+    print(f"  tpu superset/parity/invariants: {counts['tpu']} examples OK",
+          flush=True)
+    print(f"FUZZ OK in {time.time() - t0:.0f}s", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 300))
