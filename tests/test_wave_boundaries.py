@@ -109,6 +109,27 @@ def test_expansion_movement_parity_across_budget_flip(budget_flip):
     assert _moved(topics, flipped) == m_base
 
 
+def test_saturated_part_sharded_equals_unsharded_on_quota_chain(budget_flip):
+    """The 8-way partition-sharded solve through the GIANT chain (slot-
+    packed fast + balance_quota hybrid) is bit-identical to the unsharded
+    one on the saturated instance — the round-4 sharded-saturated proof
+    predates the quota leg, so the new wave bodies' cumsum/rank ops under
+    GSPMD need their own equality pin."""
+    from kafka_assigner_tpu.parallel.mesh import build_mesh
+
+    topics, live, rack_map = _saturated_instance()
+    budget_flip(50_000)
+    unsharded = TopicAssigner(TpuSolver()).generate_assignments(
+        topics, live, rack_map, -1
+    )
+    mesh = build_mesh(1, 8)  # all 8 devices on the partition axis
+    sharded = TopicAssigner(TpuSolver(mesh=mesh)).generate_assignments(
+        topics, live, rack_map, -1
+    )
+    assert sharded == unsharded
+    assert _moved(topics, sharded) == 600
+
+
 def test_quota_leg_solves_saturated_alone(budget_flip, monkeypatch):
     """The balance_quota hybrid (proportional drain + node-per-wave endgame)
     completes the saturated instance BY ITSELF — no rescue legs behind it —
