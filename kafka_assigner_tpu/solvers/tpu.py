@@ -113,6 +113,26 @@ def rf_compat_enabled() -> bool:
     return os.environ.get("KA_RF_DECREASE_COMPAT") == "1"
 
 
+def pallas_removed() -> bool:
+    """``KA_PALLAS_LEADERSHIP`` acceptor for the kernel DELETED at the end
+    of round 5 under its pre-registered keep-or-kill rule (BASELINE.md):
+    compile-proven since round 3 but never executed on hardware, never the
+    default, no timing. The knob is still recognized so setting it fails
+    LOUDLY instead of silently changing nothing; the kernel is restorable
+    from git history (``ops/pallas_leadership.py`` @ ``b44d623``) the day
+    an on-chip measurement argues for it."""
+    if os.environ.get("KA_PALLAS_LEADERSHIP") == "1":
+        import sys
+
+        print(
+            "kafka-assigner: KA_PALLAS_LEADERSHIP=1 ignored — the pallas "
+            "leadership kernel was removed under the round-5 keep-or-kill "
+            "rule (BASELINE.md); restorable from git history",
+            file=sys.stderr,
+        )
+    return False
+
+
 def _resolve_pallas(use_pallas: bool, width: int | None) -> bool:
     """The pallas leadership kernel assumes RF-wide rows; the compat wide
     slots (``width``) are mutually exclusive with it — resolve loudly."""
@@ -213,8 +233,6 @@ class TpuSolver:
 
         import jax
 
-        from ..ops.pallas_leadership import pallas_leadership_enabled
-
         ordered, counters_after, infeasible, deficit = jax.device_get(
             solve_assignment_jit(
                 jnp.asarray(enc.current),
@@ -224,9 +242,7 @@ class TpuSolver:
                 jnp.int32(enc.p),
                 n=enc.n,
                 rf=enc.rf,
-                use_pallas=_resolve_pallas(
-                    pallas_leadership_enabled(), width
-                ),
+                use_pallas=_resolve_pallas(pallas_removed(), width),
                 r_cap=enc.r_cap,
                 width=width,
                 wave_mode=solver_tuning()[0],
@@ -321,8 +337,6 @@ class TpuSolver:
             rfs_arr[:b_real] = rf_list
         replication_factor = rf_max
 
-        from ..ops.pallas_leadership import pallas_leadership_enabled
-
         if self._mesh is not None:
             from jax.sharding import PartitionSpec
 
@@ -334,7 +348,7 @@ class TpuSolver:
                 currents, self._mesh, PartitionSpec(None, "part", None)
             )
 
-        use_pallas = _resolve_pallas(pallas_leadership_enabled(), width)
+        use_pallas = _resolve_pallas(pallas_removed(), width)
         native_order = _resolve_native_order(use_pallas)
         with timers.phase("solve"):
             if native_order:
@@ -433,13 +447,12 @@ class TpuSolver:
                 jhashes, p_reals, counters_before,
             )
         from ..ops.assignment import order_batched_jit
-        from ..ops.pallas_leadership import pallas_leadership_enabled
 
         return jax.device_get(
             order_batched_jit(
                 jnp.asarray(acc_nodes), jnp.asarray(acc_count),
                 jnp.asarray(counters_before), jnp.asarray(jhashes), rf=rf,
-                use_pallas=pallas_leadership_enabled(),
+                use_pallas=pallas_removed(),
                 leader_chunk=solver_tuning()[1],
             )
         )
