@@ -454,13 +454,14 @@ def main() -> None:
             g_topics, g_live, g_rm, -1
         )
         gn_ms = (time.perf_counter() - t0) * 1000.0
+        g_cur = dict(g_topics)
         g_moved, gn_moved = (
             sum(
                 1
                 for t, a in pairs
                 for p, r in a.items()
                 for b in r
-                if b not in dict(g_topics)[t][p]
+                if b not in g_cur[t][p]
             )
             for pairs in (g_pairs, gn_pairs)
         )
@@ -490,7 +491,7 @@ def main() -> None:
                 for t, a in s_pairs
                 for p, r in a.items()
                 for b in r
-                if b not in dict(g_topics)[t][p]
+                if b not in g_cur[t][p]
             )
             assert s_moved == REPLACED * (200000 * RF // N_BROKERS)
             giant["giant_saturated_warm_ms"] = round(s_ms, 1)

@@ -238,7 +238,10 @@ def main() -> None:
     if watchdog > 0 and os.environ.get("KA_CLI_CPU_FALLBACK") != "1":
         from .utils.deviceprobe import probe_device_count, virtual_cpu_env
 
-        if probe_device_count(watchdog) < 1:
+        # allow_cpu: the watchdog exists to detect a WEDGED accelerator, not
+        # to re-exec on a healthy CPU-only environment (which initializes
+        # fine and would otherwise pay interpreter+JAX startup twice).
+        if probe_device_count(watchdog, allow_cpu=True) < 1:
             print(
                 "WARNING: accelerator backend failed to initialize within "
                 f"{watchdog:.0f}s (wedged tunnel?); continuing on the CPU "

@@ -704,7 +704,11 @@ def _resolve_wave_plan(
                 f"wave_mode {wave_mode!r} packs (rack, live-rank) into int32 "
                 f"keys, which overflows at n_pad={n_pad}"
             )
-        legs = ("dense", "seq") if len(legs) > 1 else ("dense",)
+        if wave_mode != "seq":
+            # seq does no key packing and must NOT degrade: it is the
+            # reference-verbatim leg the RF-decrease compat mode's
+            # three-backend byte parity rides on (solver_tuning).
+            legs = ("dense", "seq") if len(legs) > 1 else ("dense",)
     return legs, r_cap
 
 

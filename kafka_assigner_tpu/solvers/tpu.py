@@ -113,15 +113,20 @@ def rf_compat_enabled() -> bool:
     return os.environ.get("KA_RF_DECREASE_COMPAT") == "1"
 
 
+_pallas_warned = False
+
+
 def pallas_removed() -> bool:
     """``KA_PALLAS_LEADERSHIP`` acceptor for the kernel DELETED at the end
     of round 5 under its pre-registered keep-or-kill rule (BASELINE.md):
     compile-proven since round 3 but never executed on hardware, never the
-    default, no timing. The knob is still recognized so setting it fails
-    LOUDLY instead of silently changing nothing; the kernel is restorable
-    from git history (``ops/pallas_leadership.py`` @ ``b44d623``) the day
-    an on-chip measurement argues for it."""
-    if os.environ.get("KA_PALLAS_LEADERSHIP") == "1":
+    default, no timing. Setting the knob warns ONCE per process on stderr
+    and the solve proceeds on the default path (output-identical — the
+    kernel was bit-equal where it existed); the kernel is restorable from
+    git history (``ops/pallas_leadership.py`` @ ``b44d623``) the day an
+    on-chip measurement argues for it. Always returns False."""
+    global _pallas_warned
+    if os.environ.get("KA_PALLAS_LEADERSHIP") == "1" and not _pallas_warned:
         import sys
 
         print(
@@ -130,44 +135,14 @@ def pallas_removed() -> bool:
             "rule (BASELINE.md); restorable from git history",
             file=sys.stderr,
         )
+        _pallas_warned = True
     return False
 
 
-def _resolve_pallas(use_pallas: bool, width: int | None) -> bool:
-    """The pallas leadership kernel assumes RF-wide rows; the compat wide
-    slots (``width``) are mutually exclusive with it — resolve loudly."""
-    if use_pallas and width is not None:
-        import sys
-
-        print(
-            "kafka-assigner: KA_PALLAS_LEADERSHIP=1 ignored under "
-            "KA_RF_DECREASE_COMPAT=1 (the kernel assumes RF-wide rows)",
-            file=sys.stderr,
-        )
-        return False
-    return use_pallas
-
-
-def _resolve_native_order(use_pallas: bool) -> bool:
-    """Pick host-native vs on-device leadership for the batched solve.
-
-    The pallas kernel runs leadership ON device, so it and the host-native
-    pass are mutually exclusive; when both are requested explicitly the
-    conflict is resolved loudly (pallas wins — it is the narrower opt-in).
-    """
+def _resolve_native_order() -> bool:
+    """Host-native vs on-device leadership for the batched solve."""
     from ..native.leadership import leadership_backend
 
-    if use_pallas:
-        if os.environ.get("KA_LEADERSHIP") == "native":
-            import sys
-
-            print(
-                "kafka-assigner: KA_PALLAS_LEADERSHIP=1 overrides "
-                "KA_LEADERSHIP=native (the pallas kernel runs the leadership "
-                "pass on device)",
-                file=sys.stderr,
-            )
-        return False
     return leadership_backend() == "native"
 
 
@@ -242,7 +217,7 @@ class TpuSolver:
                 jnp.int32(enc.p),
                 n=enc.n,
                 rf=enc.rf,
-                use_pallas=_resolve_pallas(pallas_removed(), width),
+                use_pallas=pallas_removed(),
                 r_cap=enc.r_cap,
                 width=width,
                 wave_mode=solver_tuning()[0],
@@ -348,8 +323,8 @@ class TpuSolver:
                 currents, self._mesh, PartitionSpec(None, "part", None)
             )
 
-        use_pallas = _resolve_pallas(pallas_removed(), width)
-        native_order = _resolve_native_order(use_pallas)
+        use_pallas = pallas_removed()
+        native_order = _resolve_native_order()
         with timers.phase("solve"):
             if native_order:
                 # Heterogeneous split (native/leadership.py): placement — the
@@ -491,7 +466,7 @@ class TpuSolver:
         )
         counters_before = context_to_array(context, enc)
 
-        if _resolve_native_order(use_pallas=False):
+        if _resolve_native_order():
             # Heterogeneous split, same as assign_many: placement (the
             # parallel tensor phase, "fresh" wave chain) on device; the
             # inherently sequential leadership chain in host C++. The fused

@@ -12,9 +12,9 @@
 # hours, while every production program local-compiles in 5-18 s and the
 # persistent cache (.jax_cache) already holds warm v5e entries from the
 # chipless AOT runs. bench.py self-supervises (headline secured before any
-# variant runs; variants include KA_PALLAS_LEADERSHIP and the
-# KA_LEADER_CHUNK down-probe — the measurements the pallas keep-or-kill rule
-# and the leader-chunk default are waiting on).
+# variant runs; variants = the KA_LEADER_CHUNK down-probe the leader-chunk
+# default is waiting on. The pallas variant was retired with the kernel
+# when its pre-registered keep-or-kill rule executed — BASELINE.md).
 set -u
 cd /root/repo
 LOG=TPU_PROBE_r05.log
@@ -59,7 +59,11 @@ PALLAS_AXON_REMOTE_COMPILE=0 timeout 1800 python scripts/bench_saturated_giant.p
 stamp "stage D rc=${PIPESTATUS[0]}"
 
 stamp "=== stage E: commit the artifacts ==="
-git add TPU_PROBE_r05.log BENCH_onchip_r05.json 2>/dev/null
+# Separate adds: `git add a b` is atomic and stages NOTHING if one path is
+# missing (e.g. the bench JSON failed its validity guard) — the probe log
+# must be banked regardless.
+git add TPU_PROBE_r05.log 2>/dev/null
+git add BENCH_onchip_r05.json 2>/dev/null
 git commit -q -m "On-chip round-5 artifacts: probe log + banked bench JSON" \
   && stamp "committed" || stamp "nothing to commit / commit failed"
 stamp "done"

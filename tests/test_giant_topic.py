@@ -33,16 +33,12 @@ from kafka_assigner_tpu.models.synthetic import rack_striped_cluster
 from kafka_assigner_tpu.parallel.mesh import build_mesh
 from kafka_assigner_tpu.solvers.tpu import TpuSolver
 
+from .helpers import moved_replicas
+
 
 def _moved(topics, pairs):
     cur = dict(topics)
-    return sum(
-        1
-        for t, a in pairs
-        for p, r in a.items()
-        for x in r
-        if x not in cur[t][p]
-    )
+    return sum(moved_replicas(cur[t], a) for t, a in pairs)
 
 
 @pytest.mark.slow

@@ -26,16 +26,12 @@ from kafka_assigner_tpu.models.synthetic import rack_striped_cluster
 from kafka_assigner_tpu.ops import assignment as A
 from kafka_assigner_tpu.solvers.tpu import TpuSolver
 
+from .helpers import moved_replicas
+
 
 def _moved(topics, pairs):
     cur = dict(topics)
-    return sum(
-        1
-        for t, a in pairs
-        for p, r in a.items()
-        for x in r
-        if x not in cur[t][p]
-    )
+    return sum(moved_replicas(cur[t], a) for t, a in pairs)
 
 
 @pytest.fixture
@@ -155,6 +151,10 @@ def test_huge_npad_wave_plan_degradation():
     assert legs == ("dense", "seq")
     legs, _ = A._resolve_wave_plan("fast", big_n, 16)
     assert legs == ("dense",)
+    # seq does no key packing and must NOT degrade — the RF-decrease compat
+    # mode's three-backend byte parity rides on it at every scale.
+    legs, _ = A._resolve_wave_plan("seq", big_n, 16)
+    assert legs == ("seq",)
     for mode in ("balance", "balance_quota"):
         with pytest.raises(ValueError, match="int32"):
             A._resolve_wave_plan(mode, big_n, 16)
