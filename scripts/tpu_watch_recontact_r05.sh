@@ -1,0 +1,44 @@
+#!/bin/bash
+# Round-5 tunnel-recontact watcher. The first on-chip contact (03:46 UTC,
+# banked in BENCH_onchip_r05.json + TPU_PROBE_r05.log) ended with the
+# terminal wedged by a deadline SIGKILL landing mid-remote-compile — the
+# round-2/3 postmortem failure mode. This loop waits for the terminal to
+# answer again and then reruns bench.py UNCONTENDED with a deadline sized
+# so no kill can land mid-compile (3000 s against observed 3-7 s remote
+# compiles and a ~20 min full run), banking a cleaner on-chip artifact
+# than the contended 710.3 ms first-contact number.
+#
+# Probe is a SUBPROCESS with its own timeout: a wedged terminal hangs
+# jax.devices() indefinitely, and the hang must cost the probe child, not
+# the watcher.
+set -u
+cd /root/repo
+LOG=TPU_RECONTACT_r05.log
+stamp() { echo "[$(date -u +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+stamp "watcher start (probe every 120 s)"
+while true; do
+  if timeout 60 python -c "
+import jax
+assert len(jax.devices()) >= 1 and jax.default_backend() != 'cpu'
+" 2>/dev/null; then
+    stamp "tunnel answering; running uncontended bench"
+    KA_BENCH_REMOTE_COMPILE=1 KA_BENCH_TPU_DEADLINE_S=3000 \
+      timeout 3300 python bench.py 2>>"$LOG" > /tmp/bench_recontact.json
+    rc=$?
+    stamp "bench rc=$rc"
+    if python -c "
+import json, sys
+d = json.load(open('/tmp/bench_recontact.json'))
+sys.exit(1 if '_cpu_fallback' in d['metric'] else 0)
+" 2>/dev/null; then
+      cp /tmp/bench_recontact.json BENCH_onchip_r05.json
+      git add BENCH_onchip_r05.json "$LOG"
+      git commit -q -m "Recontact on-chip bench: uncontended headline + full variant matrix" \
+        && stamp "banked + committed" || stamp "commit failed"
+      exit 0
+    fi
+    stamp "run fell back to CPU (tunnel dropped mid-run?); keep watching"
+  fi
+  sleep 120
+done
