@@ -266,6 +266,12 @@ def main() -> None:
     os.environ.pop("KA_WAVE_MODE", None)      # ambient tuning knobs would
     os.environ.pop("KA_LEADER_CHUNK", None)   # un-default the "default path"
     os.environ.pop("KA_LEADERSHIP", None)
+    os.environ.pop("KA_PLACE_MODE", None)
+    os.environ.pop("KA_PLACE_CHUNK", None)
+    # Ambient compat mode flips the wave-chain default to "seq", which both
+    # changes the measured default path AND silently degrades the vmap
+    # variant — the bench measures the stock configuration only.
+    os.environ.pop("KA_RF_DECREASE_COMPAT", None)
 
     topics, live, rack_map = build_headline()
 
@@ -345,10 +351,13 @@ def main() -> None:
         write_stash({"complete": False, "result": result})
 
     # --- opt-in variant comparison (real chip only, or forced) --------------
-    def measure_variant(env_flag, value="1"):
+    def measure_variant(env_flag, value="1", verify=None):
         """Warm-time an opt-in solver variant; output must equal the default
         path's exactly. Errors are recorded, never fatal — a broken variant
-        must not cost the round its bench artifact."""
+        must not cost the round its bench artifact. ``verify`` (solver ->
+        error-string | None) rejects measurements where the variant silently
+        degraded to another path (outputs are identical by design, so output
+        equality cannot catch that)."""
         os.environ[env_flag] = value
         try:
             TopicAssigner("tpu").generate_assignments(
@@ -360,6 +369,10 @@ def main() -> None:
             ms = (time.perf_counter() - t0) * 1000.0
             if pairs != tpu_pairs:
                 return None, "output mismatch vs default path"
+            if verify is not None:
+                bad = verify(assigner.solver)
+                if bad:
+                    return None, bad
             return ms, None
         except Exception as e:  # record, don't kill the bench
             return None, f"{type(e).__name__}: {e}"[:200]
@@ -404,6 +417,26 @@ def main() -> None:
                     variants[f"device_leadership_chunk{chunk}_error"] = err
         finally:
             os.environ.pop("KA_LEADERSHIP", None)
+
+    # Topic-vmapped placement (KA_PLACE_MODE=vmap, round 5): trades the
+    # scan's 471 sequential headline waves for ~3 batched waves per chunk —
+    # the trip-count-bound trade that should favor the chip (scan stays the
+    # default until an on-chip number says otherwise; measured 1.6x SLOWER
+    # on CPU for the analogous topic-vmap at config-5 scale, so this
+    # variant only runs on real hardware).
+    if (on_real_device or os.environ.get("KA_BENCH_PLACE_VMAP") == "1") \
+            and budget_left("place_vmap"):
+        ms, err = measure_variant(
+            "KA_PLACE_MODE", "vmap",
+            verify=lambda s: None
+            if getattr(s, "last_place_mode", None) == "vmap"
+            else "degraded to "
+            + str(getattr(s, "last_place_mode", "unknown")),
+        )
+        if err is None:
+            variants["place_vmap_warm_ms"] = round(ms, 1)
+        else:
+            variants["place_vmap_error"] = err
 
     # --- BASELINE config 5: 256-scenario what-if fleet (warm) ---------------
     # Single-device here (the driver benches one chip); the 8-way-sharded

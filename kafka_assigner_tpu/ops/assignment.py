@@ -1211,6 +1211,140 @@ place_scan_jit = jax.jit(
 )
 
 
+def _narrow_placed(acc_nodes, acc_count, infeasible, deficit, kept):
+    """Device-side downcasts at the placement→host boundary.
+
+    The production pipeline fetches the placement arrays to the host (the
+    leadership chain and the JSON decode both live there), and on the
+    deployment target that fetch crosses the chip tunnel — measured round 5:
+    the (B, P_pad, RF)+2×(B, P_pad) int32 pull was ~3 MB of the headline
+    solve phase's wall clock. Broker indices fit int16 whenever the padded
+    broker axis does (value range [-1, n_pad)); per-partition accept/deficit
+    counts are bounded by the slot width (≤ the replica-list width, ~≤10 in
+    any real cluster) and fit int8. Casts are trace-time no-ops when the
+    bound doesn't hold, so callers never change semantics by calling this.
+    """
+    if acc_nodes.shape[-1] < (1 << 7):  # slot width bounds count/deficit
+        acc_count = acc_count.astype(jnp.int8)
+        deficit = deficit.astype(jnp.int8)
+    return acc_nodes, acc_count, infeasible, deficit, kept
+
+
+def _narrow_nodes(acc_nodes, n_pad: int):
+    return acc_nodes.astype(jnp.int16) if n_pad < (1 << 15) else acc_nodes
+
+
+def place_scan_narrow(
+    currents, rack_idx, jhashes, p_reals, n, rf, wave_mode="auto",
+    rfs=None, r_cap=None, alive=None, width=None,
+):
+    """``place_scan`` with the host-boundary downcasts fused into the same
+    compiled program (the cast runs on device; the fetch moves fewer bytes).
+    Output values are identical to ``place_scan``'s, only dtypes narrow."""
+    outs = place_scan(
+        currents, rack_idx, jhashes, p_reals, n, rf, wave_mode, rfs,
+        r_cap=r_cap, alive=alive, width=width,
+    )
+    acc_nodes, rest = outs[0], outs[1:]
+    return _narrow_placed(_narrow_nodes(acc_nodes, rack_idx.shape[0]), *rest)
+
+
+place_scan_narrow_jit = jax.jit(
+    place_scan_narrow, static_argnames=("n", "rf", "wave_mode", "r_cap", "width")
+)
+
+
+def place_chunked(
+    currents: jnp.ndarray,   # (B, P_pad, L)
+    rack_idx: jnp.ndarray,
+    jhashes: jnp.ndarray,    # (B,)
+    p_reals: jnp.ndarray,    # (B,)
+    n: int,
+    rf: int,
+    chunk: int,              # topics per vmapped block (static)
+    rfs: jnp.ndarray | None = None,
+    r_cap: int | None = None,
+    alive: jnp.ndarray | None = None,  # (N_pad,) scenario liveness
+    width: int | None = None,          # static compat slot width (sticky_fill)
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Topic-axis VMAPPED fast-leg placement, chunked, in one dispatch.
+
+    ``place_scan`` serializes topics so the chained fallback ``lax.cond``
+    legs stay real branches — the right trade on a 1-core host, where total
+    work bounds wall clock. On the chip the trade inverts: the round-5
+    headline trip counts (``TPU_TRIP_COUNTS_r05.json``) measured 471
+    *sequential* while_loop waves across the 2048-topic scan, each wave a
+    sliver of tensor work that cannot saturate anything, and the first
+    on-chip run spent most of its solve phase stepping them. Placement is
+    per-topic independent (the reference solves topics one at a time,
+    ``KafkaAssignmentGenerator.java:173-176``; only leadership ordering
+    carries cross-topic state), so this entry vmaps the single-leg fast
+    wave over topics instead: the batched while_loop runs
+    max-waves-per-chunk trips (3 at the headline, vs 471), each trip wide
+    enough to fill the vector units. Fast-only keeps the body single-leg
+    (vmap-safe, same reasoning as ``whatif_sweep``); topics the fast leg
+    strands come back flagged ``infeasible`` and the caller rescues them
+    through the full ``place_scan`` chain — byte-identical overall, since a
+    stranded leg restarts the next from the post-sticky state anyway
+    (``spread_orphans``).
+
+    ``chunk`` bounds memory: the batch reshapes to (B/chunk, chunk, ...) and
+    an outer ``lax.map`` runs the vmapped block per chunk — still ONE
+    dispatch (one tunnel round-trip), with live intermediates scaled by
+    ``chunk``, not B. Output contract and dtypes match
+    ``place_scan_narrow``; padded rows (added when ``chunk`` ∤ B) are inert
+    topics, sliced off before returning.
+    """
+    if alive is None:
+        alive = default_alive(rack_idx, n)
+    if rfs is None:
+        rfs = jnp.full(currents.shape[0], rf, dtype=jnp.int32)
+    b = currents.shape[0]
+    chunk = max(1, min(chunk, b))
+    n_chunks = -(-b // chunk)
+    pad = n_chunks * chunk - b
+    if pad:
+        currents = jnp.concatenate(
+            [currents, jnp.full((pad,) + currents.shape[1:], -1, currents.dtype)]
+        )
+        jhashes = jnp.concatenate([jhashes, jnp.zeros(pad, jhashes.dtype)])
+        p_reals = jnp.concatenate([p_reals, jnp.zeros(pad, p_reals.dtype)])
+        rfs = jnp.concatenate([rfs, jnp.full(pad, rf, rfs.dtype)])
+    seg = _hoisted_segments(rack_idx, n, alive, "fast", r_cap)
+
+    def one(current, jhash, p_real, rf_actual):
+        state, kept = _place_one_topic(
+            current, jhash, p_real, rack_idx, alive, n, rf, "fast",
+            rf_actual, r_cap, seg, width,
+        )
+        return state.acc_nodes, state.acc_count, state.infeasible, state.deficit, kept
+
+    def per_chunk(blk):
+        return jax.vmap(one)(*blk)
+
+    outs = lax.map(
+        per_chunk,
+        (
+            currents.reshape(n_chunks, chunk, *currents.shape[1:]),
+            jhashes.reshape(n_chunks, chunk),
+            p_reals.reshape(n_chunks, chunk),
+            rfs.reshape(n_chunks, chunk),
+        ),
+    )
+    acc_nodes, acc_count, infeasible, deficit, kept = (
+        o.reshape(n_chunks * chunk, *o.shape[2:])[:b] for o in outs
+    )
+    return _narrow_placed(
+        _narrow_nodes(acc_nodes, rack_idx.shape[0]),
+        acc_count, infeasible, deficit, kept,
+    )
+
+
+place_chunked_jit = jax.jit(
+    place_chunked, static_argnames=("n", "rf", "chunk", "r_cap", "width")
+)
+
+
 def order_batched(
     acc_nodes: jnp.ndarray,  # (B, P_pad, RF) placed replica sets
     acc_count: jnp.ndarray,  # (B, P_pad)

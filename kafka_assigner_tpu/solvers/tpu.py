@@ -98,6 +98,35 @@ def solver_tuning() -> tuple:
     return wave, env_int("KA_LEADER_CHUNK")
 
 
+def place_tuning() -> tuple:
+    """(mode, chunk) for the batched placement stage, env-overridable:
+
+    - ``KA_PLACE_MODE``: ``"scan"`` (default) serializes topics through the
+      full fallback chain (``ops/assignment.py:place_scan``) — total work
+      bounds wall clock, the right trade on a host CPU. ``"vmap"`` batches
+      the single-leg fast wave across topics (``place_chunked``) and
+      rescues stranded topics through the scan chain — trip count bounds
+      wall clock, the trade that favors the chip (measured round 5: 471
+      sequential waves at the headline under scan). Byte-identical output
+      either way; tests pin it.
+    - ``KA_PLACE_CHUNK``: topics per vmapped block (memory bound; default
+      256 ≈ low hundreds of MB of live wave state at the headline bucket).
+    """
+    mode = os.environ.get("KA_PLACE_MODE") or "scan"
+    if mode not in ("scan", "vmap"):
+        import sys
+
+        print(
+            f"kafka-assigner: ignoring unknown KA_PLACE_MODE={mode!r} "
+            "(expected 'scan' or 'vmap')",
+            file=sys.stderr,
+        )
+        mode = "scan"
+    from ..utils.env import env_int as _env_int
+
+    return mode, _env_int("KA_PLACE_CHUNK", 256)
+
+
 def rf_compat_enabled() -> bool:
     """Opt-in reference bug-compat RF-decrease retention
     (``KA_RF_DECREASE_COMPAT=1``): the sticky fill keeps every current
@@ -179,6 +208,11 @@ class TpuSolver:
         #: decode ms) — the observability the reference lacks entirely
         #: (SURVEY.md §5); bench.py surfaces it in its JSON extras.
         self.last_timers: Dict[str, float] = {}
+        #: which placement stage the most recent assign_many actually ran
+        #: ("scan" | "vmap" | "fused") — lets callers (bench.py's place_vmap
+        #: variant) detect a silently-degraded KA_PLACE_MODE request instead
+        #: of mislabeling a scan timing as a vmap measurement.
+        self.last_place_mode: str | None = None
 
     def assign(
         self,
@@ -333,22 +367,10 @@ class TpuSolver:
                 # already live. Also the smaller compiled program: the scan
                 # body drops the ~P_pad-step leadership unroll that round 2's
                 # remote compile choked on.
-                from ..ops.assignment import place_scan_jit
-
                 wave_mode, _ = solver_tuning()
-                acc_nodes, acc_count, infeasible, deficits, _ = jax.device_get(
-                    place_scan_jit(
-                        jnp.asarray(currents),
-                        jnp.asarray(encs[0].rack_idx),
-                        jnp.asarray(jhashes),
-                        jnp.asarray(p_reals),
-                        n=encs[0].n,
-                        rf=replication_factor,
-                        wave_mode=wave_mode,
-                        rfs=None if rfs_arr is None else jnp.asarray(rfs_arr),
-                        r_cap=encs[0].r_cap,
-                        width=width,
-                    )
+                acc_nodes, acc_count, infeasible, deficits = self._place(
+                    currents, encs[0], jhashes, p_reals, replication_factor,
+                    wave_mode, rfs_arr, width, b_real,
                 )
                 if infeasible[:b_real].any():
                     ordered = counters_after = None
@@ -359,6 +381,16 @@ class TpuSolver:
                     )
             else:
                 wave_mode, leader_chunk = solver_tuning()
+                self.last_place_mode = "fused"
+                if place_tuning()[0] == "vmap":
+                    import sys
+
+                    print(
+                        "kafka-assigner: KA_PLACE_MODE=vmap degraded to the "
+                        "fused scan solve (device leadership path has no "
+                        "split placement stage)",
+                        file=sys.stderr,
+                    )
                 ordered, counters_after, infeasible, deficits, _ = (
                     jax.device_get(
                         solve_batched_jit(
@@ -403,6 +435,120 @@ class TpuSolver:
                 for enc, assignment in zip(encs, decoded)
             ]
         return result
+
+    def _place(
+        self, currents, enc, jhashes, p_reals, rf, wave_mode, rfs_arr, width,
+        b_real,
+    ):
+        """Placement stage dispatch: sequential scan chain (default) or the
+        topic-vmapped fast leg with a scan-chain rescue of stranded topics
+        (``KA_PLACE_MODE=vmap`` — see ``place_tuning``). Returns host arrays
+        ``(acc_nodes, acc_count, infeasible, deficits)``; output values are
+        byte-identical across modes (pinned by tests/test_place_vmap.py)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.assignment import place_chunked_jit, place_scan_narrow_jit
+
+        mode, chunk = place_tuning()
+        # The vmapped fast leg assumes the default chained semantics behind
+        # it ("auto": fast first, rescue legs after) and unsharded inputs;
+        # explicit wave modes (incl. the compat "seq" default) and the mesh
+        # path keep the scan, whose compiled program honors both. Degrading
+        # a REQUESTED vmap is announced loudly (house rule, utils/env.py):
+        # a silently-substituted path must never masquerade as a vmap
+        # measurement.
+        if mode != "vmap" or wave_mode != "auto" or self._mesh is not None:
+            self.last_place_mode = "scan"
+            if mode == "vmap":
+                import sys
+
+                why = (
+                    f"wave mode {wave_mode!r} needs the scan chain"
+                    if wave_mode != "auto" else "mesh-sharded inputs"
+                )
+                print(
+                    f"kafka-assigner: KA_PLACE_MODE=vmap degraded to scan "
+                    f"({why})",
+                    file=sys.stderr,
+                )
+            return jax.device_get(
+                place_scan_narrow_jit(
+                    jnp.asarray(currents),
+                    jnp.asarray(enc.rack_idx),
+                    jnp.asarray(jhashes),
+                    jnp.asarray(p_reals),
+                    n=enc.n,
+                    rf=rf,
+                    wave_mode=wave_mode,
+                    rfs=None if rfs_arr is None else jnp.asarray(rfs_arr),
+                    r_cap=enc.r_cap,
+                    width=width,
+                )
+            )[:4]
+        self.last_place_mode = "vmap"
+        acc_nodes, acc_count, infeasible, deficits, _ = jax.device_get(
+            place_chunked_jit(
+                jnp.asarray(currents),
+                jnp.asarray(enc.rack_idx),
+                jnp.asarray(jhashes),
+                jnp.asarray(p_reals),
+                n=enc.n,
+                rf=rf,
+                chunk=chunk,
+                rfs=None if rfs_arr is None else jnp.asarray(rfs_arr),
+                r_cap=enc.r_cap,
+                width=width,
+            )
+        )
+        bad = np.flatnonzero(np.asarray(infeasible)[:b_real])
+        if bad.size:
+            # np.array (copy) only now: device_get hands back read-only
+            # views, and the rescue merge below writes rows in place — the
+            # common no-strand case skips the memcpy entirely.
+            acc_nodes, acc_count, infeasible, deficits = (
+                np.array(a) for a in (acc_nodes, acc_count, infeasible, deficits)
+            )
+            # Full-chain rescue, one scan dispatch over the stranded subset,
+            # padded to a power-of-two bucket so rescue-set size changes
+            # reuse the compiled program. Identical to what place_scan would
+            # have computed for these topics: a stranded leg restarts the
+            # next from the post-sticky state (spread_orphans), and the
+            # scan chain's first leg is the same fast leg that just ran.
+            from ..ops.assignment import place_scan_jit
+
+            k = int(bad.size)
+            bucket = 1 << (k - 1).bit_length()
+            cur_np = np.asarray(currents)
+            sub_cur = np.full((bucket,) + cur_np.shape[1:], -1, cur_np.dtype)
+            sub_cur[:k] = cur_np[bad]
+            sub_jh = np.zeros(bucket, dtype=np.asarray(jhashes).dtype)
+            sub_jh[:k] = np.asarray(jhashes)[bad]
+            sub_pr = np.zeros(bucket, dtype=np.int32)
+            sub_pr[:k] = np.asarray(p_reals)[bad]
+            sub_rfs = None
+            if rfs_arr is not None:
+                sub_rfs = np.full(bucket, rf, dtype=np.int32)
+                sub_rfs[:k] = np.asarray(rfs_arr)[bad]
+            r_nodes, r_count, r_inf, r_def, _ = jax.device_get(
+                place_scan_jit(
+                    jnp.asarray(sub_cur),
+                    jnp.asarray(enc.rack_idx),
+                    jnp.asarray(sub_jh),
+                    jnp.asarray(sub_pr),
+                    n=enc.n,
+                    rf=rf,
+                    wave_mode=wave_mode,
+                    rfs=None if sub_rfs is None else jnp.asarray(sub_rfs),
+                    r_cap=enc.r_cap,
+                    width=width,
+                )
+            )
+            acc_nodes[bad] = r_nodes[:k]
+            acc_count[bad] = r_count[:k]
+            infeasible[bad] = r_inf[:k]
+            deficits[bad] = r_def[:k]
+        return acc_nodes, acc_count, infeasible, deficits
 
     def _order_placed(
         self, acc_nodes, acc_count, counters_before, jhashes, p_reals, rf,

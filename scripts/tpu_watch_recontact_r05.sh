@@ -27,18 +27,33 @@ assert len(jax.devices()) >= 1 and jax.default_backend() != 'cpu'
       timeout 3300 python bench.py 2>>"$LOG" > /tmp/bench_recontact.json
     rc=$?
     stamp "bench rc=$rc"
-    if python -c "
+    # Bank only a COMPLETE on-chip run: rc 0, on-chip metric, and none of
+    # the salvage markers (deadline_exceeded / variants_truncated /
+    # child_rc) — a truncated rerun must not overwrite the first-contact
+    # artifact under a commit message claiming a full matrix.
+    if [ "$rc" = 0 ] && python -c "
 import json, sys
 d = json.load(open('/tmp/bench_recontact.json'))
-sys.exit(1 if '_cpu_fallback' in d['metric'] else 0)
+bad = '_cpu_fallback' in d['metric'] or any(
+    k in d.get('extra', {})
+    for k in ('deadline_exceeded', 'variants_truncated', 'child_rc'))
+sys.exit(1 if bad else 0)
 " 2>/dev/null; then
       cp /tmp/bench_recontact.json BENCH_onchip_r05.json
       git add BENCH_onchip_r05.json "$LOG"
-      git commit -q -m "Recontact on-chip bench: uncontended headline + full variant matrix" \
-        && stamp "banked + committed" || stamp "commit failed"
-      exit 0
+      for attempt in 1 2 3; do
+        if git commit -q -m "Recontact on-chip bench: uncontended headline + full variant matrix"; then
+          stamp "banked + committed"
+          exit 0
+        fi
+        stamp "commit attempt $attempt failed (index lock?); retrying"
+        sleep 5
+        git add BENCH_onchip_r05.json "$LOG"
+      done
+      stamp "commit failed 3x; artifact left in working tree"
+      exit 1
     fi
-    stamp "run fell back to CPU (tunnel dropped mid-run?); keep watching"
+    stamp "run incomplete (cpu fallback / truncated / rc=$rc); keep watching"
   fi
   sleep 120
 done

@@ -1,0 +1,162 @@
+"""Topic-vmapped placement (``KA_PLACE_MODE=vmap``) byte parity.
+
+``ops/assignment.py:place_chunked`` batches the single-leg fast wave across
+topics and the solver rescues stranded topics through the sequential scan
+chain (``solvers/tpu.py:TpuSolver._place``). The contract is byte-identical
+output to the default scan mode on every input class — these tests pin it on
+the three interesting classes:
+
+- fast-leg-solvable instances (the vmapped leg does all the work),
+- exactly-saturated instances (every topic strands; the rescue does all the
+  work — the scaled-down giant replace showcase from test_wave_boundaries),
+- ragged chunking (chunk ∤ B, chunk > B) and mixed per-topic RF.
+
+Also pins the kernel-level premise the rescue rests on: fast-only placement
+really does flag the saturated instance infeasible (if the fast leg ever
+learns to solve it, the rescue test above silently stops exercising the
+rescue — this canary fails instead).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kafka_assigner_tpu.assigner import TopicAssigner
+from kafka_assigner_tpu.models.synthetic import rack_striped_cluster
+from kafka_assigner_tpu.solvers.tpu import TpuSolver
+
+
+def _solve(topics, live, rack_map):
+    return TopicAssigner(TpuSolver()).generate_assignments(
+        topics, live, rack_map, -1
+    )
+
+
+def _expansion_instance():
+    """Multi-topic reassignment the fast leg fully solves (replace 4 of 60
+    brokers, plenty of slack)."""
+    topic_map, _, racks = rack_striped_cluster(
+        60, 12, 24, 3, 5, name_fmt="pv-{:03d}", extra_brokers=4
+    )
+    topics = list(topic_map.items())
+    live = set(range(4, 64))
+    return topics, live, {b: racks[b] for b in live}
+
+
+def _saturated_instance():
+    """Every topic strands the fast leg (same shape as
+    test_wave_boundaries._saturated_instance, split into 4 topics so the
+    rescue handles a multi-topic subset)."""
+    topic_map, _, racks = rack_striped_cluster(
+        50, 4, 250, 3, 5, name_fmt="pvsat-{:02d}", extra_brokers=10
+    )
+    topics = list(topic_map.items())
+    live = set(range(10, 60))
+    return topics, live, {b: racks[b] for b in live}
+
+
+def test_vmap_equals_scan_on_fast_solvable(monkeypatch):
+    topics, live, rack_map = _expansion_instance()
+    base = _solve(topics, live, rack_map)
+    monkeypatch.setenv("KA_PLACE_MODE", "vmap")
+    assert _solve(topics, live, rack_map) == base
+
+
+@pytest.mark.parametrize("chunk", ["1", "5", "64"])
+def test_vmap_equals_scan_across_chunk_shapes(monkeypatch, chunk):
+    """chunk=1 (degenerate), 5 (ragged: 12 topics -> 3 chunks, 3 inert
+    pads), 64 (> B: single full-batch chunk)."""
+    topics, live, rack_map = _expansion_instance()
+    base = _solve(topics, live, rack_map)
+    monkeypatch.setenv("KA_PLACE_MODE", "vmap")
+    monkeypatch.setenv("KA_PLACE_CHUNK", chunk)
+    assert _solve(topics, live, rack_map) == base
+
+
+def test_vmap_rescue_on_saturated(monkeypatch):
+    """All four topics strand the fast leg; output must still be
+    byte-identical to the scan chain (the rescue re-solves them through it)
+    with optimal movement."""
+    topics, live, rack_map = _saturated_instance()
+    base = _solve(topics, live, rack_map)
+    monkeypatch.setenv("KA_PLACE_MODE", "vmap")
+    got = _solve(topics, live, rack_map)
+    assert got == base
+    cur = dict(topics)
+    moved = sum(
+        1
+        for t, a in got
+        for p, r in a.items()
+        for b in r
+        if b not in cur[t][p]
+    )
+    assert moved == 600  # only the replaced brokers' replicas move
+
+
+def test_fast_only_strands_saturated_canary():
+    """Kernel-level premise of the rescue test: fast-only placement flags
+    the saturated topics infeasible."""
+    from kafka_assigner_tpu.models.problem import encode_topic_group
+    from kafka_assigner_tpu.ops.assignment import place_chunked_jit
+
+    topics, live, rack_map = _saturated_instance()
+    encs, currents, jhashes, p_reals = encode_topic_group(
+        topics, rack_map, live, [3] * len(topics)
+    )
+    *_, infeasible, _, _ = place_chunked_jit(
+        jnp.asarray(currents),
+        jnp.asarray(encs[0].rack_idx),
+        jnp.asarray(jhashes),
+        jnp.asarray(p_reals),
+        n=encs[0].n,
+        rf=3,
+        chunk=8,
+        r_cap=encs[0].r_cap,
+    )
+    assert bool(np.asarray(infeasible)[: len(encs)].all())
+
+
+def test_vmap_mixed_rf(monkeypatch):
+    """Mixed per-topic RF rides the traced rfs lane through the vmapped
+    placement."""
+    topic_map, _, racks = rack_striped_cluster(
+        30, 6, 16, 3, 5, name_fmt="pvrf-{:02d}", extra_brokers=0
+    )
+    topics = list(topic_map.items())
+    live = set(range(30))
+    rack_map = {b: racks[b] for b in live}
+    rfs = [3, 2, 3, 1, 2, 3]
+    base = TpuSolver().assign_many(topics, rack_map, live, rfs)
+    monkeypatch.setenv("KA_PLACE_MODE", "vmap")
+    assert TpuSolver().assign_many(topics, rack_map, live, rfs) == base
+
+
+def test_narrow_boundary_values_match_wide():
+    """place_scan_narrow returns the same VALUES as place_scan, only
+    narrower dtypes (the host-boundary transfer optimization must never
+    change a placement)."""
+    from kafka_assigner_tpu.models.problem import encode_topic_group
+    from kafka_assigner_tpu.ops.assignment import (
+        place_scan_jit,
+        place_scan_narrow_jit,
+    )
+
+    topics, live, rack_map = _expansion_instance()
+    encs, currents, jhashes, p_reals = encode_topic_group(
+        topics, rack_map, live, [3] * len(topics)
+    )
+    args = (
+        jnp.asarray(currents),
+        jnp.asarray(encs[0].rack_idx),
+        jnp.asarray(jhashes),
+        jnp.asarray(p_reals),
+    )
+    kw = dict(n=encs[0].n, rf=3, wave_mode="auto", r_cap=encs[0].r_cap)
+    wide = jax.device_get(place_scan_jit(*args, **kw))
+    narrow = jax.device_get(place_scan_narrow_jit(*args, **kw))
+    assert narrow[0].dtype == np.int16
+    assert narrow[1].dtype == np.int8
+    for w, na in zip(wide, narrow):
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(na))
