@@ -1,5 +1,6 @@
 """Randomized differential soak: TPU solve (host-native vs on-device
-leadership) vs greedy, plus incremental vs dense what-if sweeps.
+leadership, scan vs topic-vmapped placement) vs greedy, plus incremental vs
+dense what-if sweeps.
 
 Usage:  python scripts/differential_soak.py [seconds]   (default 600)
 
@@ -113,6 +114,21 @@ def main(budget_s: float) -> int:
         )
         if (seq, seq_err) != (dev, dev_err):
             print(f"REPRO leadership divergence: seed={seed} n={n} p={p} "
+                  f"rf={rf} racks={racks} rm={remove} add={add}")
+            return 1
+        # Topic-vmapped placement lane (round 5, KA_PLACE_MODE=vmap): the
+        # chunked fast leg + scan-chain rescue must be byte-equal with the
+        # default scan placement, including error behavior, across the full
+        # random cluster space (chunk 2 forces ragged multi-chunk batches).
+        os.environ["KA_PLACE_CHUNK"] = "2"
+        try:
+            vm, vm_err = run(
+                topics, live, rack_map, "tpu", "KA_PLACE_MODE", "vmap"
+            )
+        finally:
+            os.environ.pop("KA_PLACE_CHUNK", None)
+        if (seq, seq_err) != (vm, vm_err):
+            print(f"REPRO place-vmap divergence: seed={seed} n={n} p={p} "
                   f"rf={rf} racks={racks} rm={remove} add={add}")
             return 1
         gre, _ = run(topics, live, rack_map, "greedy")
