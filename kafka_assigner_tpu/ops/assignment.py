@@ -1137,8 +1137,11 @@ def solve_batched(
 
     Returns (ordered (B, P_pad, RF), counters, infeasible (B,), deficits
     (B, P_pad), sticky_kept (B,)). Inert padding topics (p_real == 0) are
-    no-ops: nothing to stick, no deficit, no counter updates.
+    no-ops: nothing to stick, no deficit, no counter updates. ``currents``
+    may arrive int16 (upload narrowing, see ``place_scan``); widened on
+    device first.
     """
+    currents = currents.astype(jnp.int32)
     if alive is None:
         alive = default_alive(rack_idx, n)
     if rfs is None:
@@ -1184,7 +1187,12 @@ def place_scan(
     rescue path for topics the vmapped fast wave strands. Sequential (scan,
     not vmap) so the chained ``lax.cond`` legs stay real branches, but one
     compiled dispatch covers the whole rescue subset — through a tunneled
-    chip that matters more than the serialization (~80-100 ms per dispatch)."""
+    chip that matters more than the serialization (~80-100 ms per dispatch).
+
+    ``currents`` may arrive int16 (callers halve the host→device upload when
+    broker indices fit — the transfer rides the chip tunnel on the
+    deployment target); it is widened here, on device, before any math."""
+    currents = currents.astype(jnp.int32)
     if alive is None:
         alive = default_alive(rack_idx, n)
     if rfs is None:
@@ -1293,8 +1301,10 @@ def place_chunked(
     dispatch (one tunnel round-trip), with live intermediates scaled by
     ``chunk``, not B. Output contract and dtypes match
     ``place_scan_narrow``; padded rows (added when ``chunk`` ∤ B) are inert
-    topics, sliced off before returning.
+    topics, sliced off before returning. ``currents`` may arrive int16
+    (upload narrowing, see ``place_scan``); widened on device first.
     """
+    currents = currents.astype(jnp.int32)
     if alive is None:
         alive = default_alive(rack_idx, n)
     if rfs is None:

@@ -127,6 +127,17 @@ def place_tuning() -> tuple:
     return mode, _env_int("KA_PLACE_CHUNK", 256)
 
 
+def _narrow_upload(currents, rack_idx) -> "np.ndarray":
+    """Halve the (B, P_pad, L) host→device transfer when broker indices fit
+    int16 (the kernels widen on device — ``place_scan`` docstring). Values
+    are in [-1, n_pad); the guard bounds them within int16. Device-resident
+    (mesh-sharded) arrays pass through untouched — pulling one back to the
+    host to re-cast would defeat the sharding."""
+    if rack_idx.shape[0] < (1 << 15) and not hasattr(currents, "sharding"):
+        return np.asarray(currents, dtype=np.int16)
+    return currents
+
+
 def rf_compat_enabled() -> bool:
     """Opt-in reference bug-compat RF-decrease retention
     (``KA_RF_DECREASE_COMPAT=1``): the sticky fill keeps every current
@@ -391,10 +402,11 @@ class TpuSolver:
                         "split placement stage)",
                         file=sys.stderr,
                     )
+                up_currents = _narrow_upload(currents, encs[0].rack_idx)
                 ordered, counters_after, infeasible, deficits, _ = (
                     jax.device_get(
                         solve_batched_jit(
-                            jnp.asarray(currents),
+                            jnp.asarray(up_currents),
                             jnp.asarray(encs[0].rack_idx),
                             jnp.asarray(counters_before),
                             jnp.asarray(jhashes),
@@ -451,6 +463,8 @@ class TpuSolver:
         from ..ops.assignment import place_chunked_jit, place_scan_narrow_jit
 
         mode, chunk = place_tuning()
+        # The rescue path below reuses the ORIGINAL int32 array.
+        up_currents = _narrow_upload(currents, enc.rack_idx)
         # The vmapped fast leg assumes the default chained semantics behind
         # it ("auto": fast first, rescue legs after) and unsharded inputs;
         # explicit wave modes (incl. the compat "seq" default) and the mesh
@@ -474,7 +488,7 @@ class TpuSolver:
                 )
             return jax.device_get(
                 place_scan_narrow_jit(
-                    jnp.asarray(currents),
+                    jnp.asarray(up_currents),
                     jnp.asarray(enc.rack_idx),
                     jnp.asarray(jhashes),
                     jnp.asarray(p_reals),
@@ -489,7 +503,7 @@ class TpuSolver:
         self.last_place_mode = "vmap"
         acc_nodes, acc_count, infeasible, deficits, _ = jax.device_get(
             place_chunked_jit(
-                jnp.asarray(currents),
+                jnp.asarray(up_currents),
                 jnp.asarray(enc.rack_idx),
                 jnp.asarray(jhashes),
                 jnp.asarray(p_reals),
