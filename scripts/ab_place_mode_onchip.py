@@ -1,0 +1,94 @@
+"""Interleaved on-chip A/B: placement scan (default) vs KA_PLACE_MODE=vmap.
+
+The pre-registered flip rule (BASELINE.md "Post-first-contact work") says the
+scan default flips only if an on-chip ``place_vmap_warm_ms`` beats the on-chip
+default-path warm time. The supervised bench produced one paired sample
+(542.7 vs 531.2 ms — a 2% margin), which is inside plausible run-to-run noise
+for a tunneled chip. This script collects the paired evidence the decision
+deserves: N alternating warm solves per mode on the identical headline
+instance, same process, same device state, reporting per-sample times and
+medians. Output equality and a mode-degradation guard are asserted on every
+vmap sample (the solver reports which placement stage actually ran).
+
+Run on the real chip only; results append to stdout as one JSON line.
+"""
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_SAMPLES = int(os.environ.get("KA_AB_SAMPLES", "6"))
+
+
+def main() -> None:
+    from kafka_assigner_tpu.utils.compilecache import enable_persistent_cache
+
+    enable_persistent_cache()
+    import jax
+
+    if jax.default_backend() == "cpu":
+        print(json.dumps({"error": "not on chip"}))
+        sys.exit(1)
+
+    from bench import build_headline
+    from kafka_assigner_tpu.assigner import TopicAssigner
+
+    # Same measurement hygiene as bench.py: ambient variant knobs would
+    # silently turn either arm into a non-default configuration and feed the
+    # flip rule numbers for a path nobody ships.
+    for knob in (
+        "KA_PALLAS_LEADERSHIP", "KA_WAVE_MODE", "KA_LEADER_CHUNK",
+        "KA_LEADERSHIP", "KA_PLACE_MODE", "KA_PLACE_CHUNK",
+        "KA_RF_DECREASE_COMPAT",
+    ):
+        os.environ.pop(knob, None)
+
+    topics, live, rack_map = build_headline()
+
+    def solve(mode):
+        if mode == "vmap":
+            os.environ["KA_PLACE_MODE"] = "vmap"
+        else:
+            os.environ.pop("KA_PLACE_MODE", None)
+        try:
+            assigner = TopicAssigner("tpu")
+            t0 = time.perf_counter()
+            pairs = assigner.generate_assignments(topics, live, rack_map, -1)
+            ms = (time.perf_counter() - t0) * 1000.0
+            ran = getattr(assigner.solver, "last_place_mode", None)
+            return ms, pairs, ran
+        finally:
+            os.environ.pop("KA_PLACE_MODE", None)
+
+    # cold/warm-up one solve per mode (compiles should already be in the
+    # persistent cache from the supervised bench)
+    _, ref_pairs, _ = solve("scan")
+    _, vm_pairs, vm_ran = solve("vmap")
+    assert vm_pairs == ref_pairs, "vmap output mismatch vs scan"
+    assert vm_ran == "vmap", f"vmap degraded to {vm_ran}"
+
+    scan_ms, vmap_ms = [], []
+    for _ in range(N_SAMPLES):
+        ms, pairs, _ = solve("scan")
+        assert pairs == ref_pairs
+        scan_ms.append(round(ms, 1))
+        ms, pairs, ran = solve("vmap")
+        assert pairs == ref_pairs and ran == "vmap"
+        vmap_ms.append(round(ms, 1))
+
+    out = {
+        "samples": N_SAMPLES,
+        "scan_warm_ms": scan_ms,
+        "vmap_warm_ms": vmap_ms,
+        "scan_median_ms": round(statistics.median(scan_ms), 1),
+        "vmap_median_ms": round(statistics.median(vmap_ms), 1),
+        "vmap_wins": statistics.median(vmap_ms) < statistics.median(scan_ms),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
