@@ -258,10 +258,9 @@ def main() -> None:
 
     from kafka_assigner_tpu.assigner import TopicAssigner
 
-    # The bench controls solver variants itself; ambient variant flags would
-    # silently turn the "default path" measurement into a variant
-    # measurement. (KA_PALLAS_LEADERSHIP is popped for hygiene even though
-    # the kernel is gone — the solver would only warn-and-ignore it.)
+    # The bench controls solver variants itself (KA_BENCH_PALLAS
+    # force-includes them); ambient variant flags would silently turn the
+    # "default path" measurement into a variant measurement.
     os.environ.pop("KA_PALLAS_LEADERSHIP", None)
     os.environ.pop("KA_WAVE_MODE", None)      # ambient tuning knobs would
     os.environ.pop("KA_LEADER_CHUNK", None)   # un-default the "default path"
@@ -394,8 +393,17 @@ def main() -> None:
 
     if os.environ.get("KA_BENCH_VARIANTS") == "0":
         on_real_device = False  # explicit kill-switch for variant sections
-    # (The pallas-leadership variant was removed with the kernel at the end
-    # of round 5 under its pre-registered keep-or-kill rule — BASELINE.md.)
+    if (on_real_device or os.environ.get("KA_BENCH_PALLAS") == "1") and budget_left("pallas"):
+        ms, err = measure_variant(
+            "KA_PALLAS_LEADERSHIP",
+            verify=lambda s: None
+            if getattr(s, "last_leadership", None) == "pallas"
+            else "degraded to " + str(getattr(s, "last_leadership", "unknown")),
+        )
+        variants.update(
+            {"pallas_warm_ms": round(ms, 1)} if err is None
+            else {"pallas_error": err}
+        )
     # On-device leadership with KA_LEADER_CHUNK probed DOWN (VERDICT r3
     # item 1: the round-2 chunk sweep pointed at small chunks). Each chunk
     # is a distinct compiled program; on-chip these compile locally and land

@@ -1024,20 +1024,13 @@ def _order_one_topic(
     leader_chunk: int | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     if use_pallas:
-        # The Pallas leadership kernel was DELETED at the end of round 5
-        # under its pre-registered keep-or-kill rule (BASELINE.md "Round-5
-        # pre-registered decision rules"): Mosaic-compile-proven since
-        # round 3 but never executed on hardware (the chip tunnel stayed
-        # dead through rounds 2-5), never the default, and with no timing
-        # that could justify the carry. Restorable from git history
-        # (ops/pallas_leadership.py @ b44d623) the day a chip measurement
-        # exists to argue for it. The static flag is kept so jit cache
-        # signatures are unchanged; requesting it is now a loud error.
-        raise NotImplementedError(
-            "the pallas leadership kernel was removed under the round-5 "
-            "keep-or-kill rule (see BASELINE.md); use the host-native "
-            "default or KA_LEADERSHIP=device"
-        )
+        # Opt-in TPU kernel: VMEM-resident counters, no per-partition scan
+        # overhead; bit-identical to leadership_order (see module docstring).
+        # The flag arrives as a static jit argument from the solver (never
+        # from the vmapped what-if path).
+        from .pallas_leadership import leadership_order_pallas
+
+        return leadership_order_pallas(acc_nodes, acc_count, counters, jhash, rf)
     ordered, counters = leadership_order(
         acc_nodes, acc_count, counters, jhash, rf, leader_chunk
     )

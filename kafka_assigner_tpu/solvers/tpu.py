@@ -153,36 +153,48 @@ def rf_compat_enabled() -> bool:
     return os.environ.get("KA_RF_DECREASE_COMPAT") == "1"
 
 
-_pallas_warned = False
+_warned: set[str] = set()
 
 
-def pallas_removed() -> bool:
-    """``KA_PALLAS_LEADERSHIP`` acceptor for the kernel DELETED at the end
-    of round 5 under its pre-registered keep-or-kill rule (BASELINE.md):
-    compile-proven since round 3 but never executed on hardware, never the
-    default, no timing. Setting the knob warns ONCE per process on stderr
-    and the solve proceeds on the default path (output-identical — the
-    kernel was bit-equal where it existed); the kernel is restorable from
-    git history (``ops/pallas_leadership.py`` @ ``b44d623``) the day an
-    on-chip measurement argues for it. Always returns False."""
-    global _pallas_warned
-    if os.environ.get("KA_PALLAS_LEADERSHIP") == "1" and not _pallas_warned:
+def _warn_once(msg: str) -> None:
+    """Loud-but-not-spammy: each distinct resolution warning prints once per
+    process (these fire inside per-call dispatch, e.g. long per-topic loops)."""
+    if msg not in _warned:
         import sys
 
-        print(
-            "kafka-assigner: KA_PALLAS_LEADERSHIP=1 ignored — the pallas "
-            "leadership kernel was removed under the round-5 keep-or-kill "
-            "rule (BASELINE.md); restorable from git history",
-            file=sys.stderr,
+        print(msg, file=sys.stderr)
+        _warned.add(msg)
+
+
+def _resolve_pallas(use_pallas: bool, width: int | None) -> bool:
+    """The pallas leadership kernel assumes RF-wide rows; the compat wide
+    slots (``width``) are mutually exclusive with it — resolve loudly."""
+    if use_pallas and width is not None:
+        _warn_once(
+            "kafka-assigner: KA_PALLAS_LEADERSHIP=1 ignored under "
+            "KA_RF_DECREASE_COMPAT=1 (the kernel assumes RF-wide rows)"
         )
-        _pallas_warned = True
-    return False
+        return False
+    return use_pallas
 
 
-def _resolve_native_order() -> bool:
-    """Host-native vs on-device leadership for the batched solve."""
+def _resolve_native_order(use_pallas: bool) -> bool:
+    """Pick host-native vs on-device leadership for the batched solve.
+
+    The pallas kernel runs leadership ON device, so it and the host-native
+    pass are mutually exclusive; when both are requested explicitly the
+    conflict is resolved loudly (pallas wins — it is the narrower opt-in).
+    """
     from ..native.leadership import leadership_backend
 
+    if use_pallas:
+        if os.environ.get("KA_LEADERSHIP") == "native":
+            _warn_once(
+                "kafka-assigner: KA_PALLAS_LEADERSHIP=1 overrides "
+                "KA_LEADERSHIP=native (the pallas kernel runs the leadership "
+                "pass on device)"
+            )
+        return False
     return leadership_backend() == "native"
 
 
@@ -253,6 +265,8 @@ class TpuSolver:
 
         import jax
 
+        from ..ops.pallas_leadership import pallas_leadership_enabled
+
         ordered, counters_after, infeasible, deficit = jax.device_get(
             solve_assignment_jit(
                 jnp.asarray(enc.current),
@@ -262,7 +276,9 @@ class TpuSolver:
                 jnp.int32(enc.p),
                 n=enc.n,
                 rf=enc.rf,
-                use_pallas=pallas_removed(),
+                use_pallas=_resolve_pallas(
+                    pallas_leadership_enabled(), width
+                ),
                 r_cap=enc.r_cap,
                 width=width,
                 wave_mode=solver_tuning()[0],
@@ -357,6 +373,8 @@ class TpuSolver:
             rfs_arr[:b_real] = rf_list
         replication_factor = rf_max
 
+        from ..ops.pallas_leadership import pallas_leadership_enabled
+
         if self._mesh is not None:
             from jax.sharding import PartitionSpec
 
@@ -368,8 +386,14 @@ class TpuSolver:
                 currents, self._mesh, PartitionSpec(None, "part", None)
             )
 
-        use_pallas = pallas_removed()
-        native_order = _resolve_native_order()
+        use_pallas = _resolve_pallas(pallas_leadership_enabled(), width)
+        native_order = _resolve_native_order(use_pallas)
+        # Telemetry mirror of last_place_mode: which leadership path this
+        # call actually compiled in. Identical outputs by design, so timing
+        # consumers (bench variants) need this to reject silent degradation.
+        self.last_leadership = (
+            "native" if native_order else ("pallas" if use_pallas else "device")
+        )
         with timers.phase("solve"):
             if native_order:
                 # Heterogeneous split (native/leadership.py): placement — the
@@ -566,10 +590,14 @@ class TpuSolver:
 
     def _order_placed(
         self, acc_nodes, acc_count, counters_before, jhashes, p_reals, rf,
-        native_order,
+        native_order, use_pallas=False,
     ):
         """Leadership ordering over already-placed topics (placement arrays
-        may live on device or host). Returns ``(ordered, counters_after)``."""
+        may live on device or host). Returns ``(ordered, counters_after)``.
+
+        ``use_pallas`` must be the _resolve_pallas-RESOLVED flag (never the
+        raw env read): the kernel assumes RF-wide rows and the resolver is
+        what rejects the compat wide-slot combination."""
         import jax
         import jax.numpy as jnp
 
@@ -587,7 +615,7 @@ class TpuSolver:
             order_batched_jit(
                 jnp.asarray(acc_nodes), jnp.asarray(acc_count),
                 jnp.asarray(counters_before), jnp.asarray(jhashes), rf=rf,
-                use_pallas=pallas_removed(),
+                use_pallas=use_pallas,
                 leader_chunk=solver_tuning()[1],
             )
         )
@@ -626,7 +654,7 @@ class TpuSolver:
         )
         counters_before = context_to_array(context, enc)
 
-        if _resolve_native_order():
+        if _resolve_native_order(use_pallas=False):
             # Heterogeneous split, same as assign_many: placement (the
             # parallel tensor phase, "fresh" wave chain) on device; the
             # inherently sequential leadership chain in host C++. The fused

@@ -1,0 +1,134 @@
+"""Posthumous on-chip timing for the deleted Pallas leadership kernel.
+
+The kernel (`ops/pallas_leadership.py`) was deleted at the end of round 5
+under its pre-registered keep-or-kill rule: no on-chip timing existed after
+three rounds of dead tunnel (BASELINE.md "Round-5 pre-registered decision
+rules"). The rule's escape hatch — "restorable from git history the day an
+on-chip measurement exists" — became exercisable hours later when the box
+reboot revived the tunnel. This script collects that measurement without
+un-deleting anything: it extracts the kernel from the pre-deletion commit
+into a tempdir at runtime, times it on the chip against the two living
+backends at a giant-topic leadership shape, and checks bit-equality of the
+outputs. The result decides restoration the same way deletion was decided:
+by number, not narrative.
+
+Shape: one 200k-partition topic (P padded to 204800 = 400 x BLOCK_P),
+RF=3, N_pad=5120 — the leadership slice of the giant flagship instance.
+"""
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+PRE_DELETION_COMMIT = "b44d623"
+P = int(os.environ.get("KA_POSTHUMOUS_P", "204800"))  # multiple of BLOCK_P
+RF, N_PAD = 3, 5120
+REPS = int(os.environ.get("KA_AB_SAMPLES", "5"))
+
+
+def main() -> None:
+    from kafka_assigner_tpu.utils.compilecache import enable_persistent_cache
+
+    enable_persistent_cache()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    on_chip = jax.default_backend() != "cpu"
+
+    src = subprocess.run(
+        ["git", "-C", REPO, "show",
+         f"{PRE_DELETION_COMMIT}:kafka_assigner_tpu/ops/pallas_leadership.py"],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    tmpdir = tempfile.mkdtemp(prefix="pallas_posthumous_")
+    with open(os.path.join(tmpdir, "pallas_archive.py"), "w") as f:
+        f.write(src)
+    sys.path.insert(0, tmpdir)
+    import pallas_archive
+
+    from kafka_assigner_tpu.ops.assignment import leadership_order
+    from kafka_assigner_tpu.native import leadership as native_leadership
+
+    rng = np.random.default_rng(7)
+    x = rng.integers(0, N_PAD, P)
+    d1 = rng.integers(1, N_PAD // 2, P)
+    d2 = rng.integers(1, N_PAD // 2 - 1, P)
+    cand = np.stack([x, (x + d1) % N_PAD, (x + d1 + d2) % N_PAD], axis=1)
+    cand = cand.astype(np.int32)  # distinct-by-construction replica rows
+    count = np.full(P, RF, np.int32)
+    counters0 = np.zeros((N_PAD, RF), np.int32)
+    jhash = np.int32(123457)
+
+    out = {"shape": {"P": P, "RF": RF, "N_pad": N_PAD}, "on_chip": on_chip,
+           "pre_deletion_commit": PRE_DELETION_COMMIT}
+
+    def timed(fn, label):
+        fn()  # cold / warm-up
+        samples = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            fn()
+            samples.append(round((time.perf_counter() - t0) * 1000.0, 1))
+        out[label + "_ms"] = samples
+        out[label + "_median_ms"] = round(statistics.median(samples), 1)
+
+    # living backend 1: host C++ (production default for the host-visible pass)
+    def run_native():
+        return native_leadership.order_many(
+            cand[None], count[None], np.array([jhash], np.int64),
+            np.array([P], np.int32), counters0,
+        )
+    timed(run_native, "native_cpp")
+    native_ordered, native_counters = run_native()
+
+    # living backend 2: the XLA scan (default chunk)
+    xla_fn = jax.jit(
+        lambda c, n, k: leadership_order(n, k, c, jnp.int32(jhash), RF)
+    )
+    cand_j, count_j, counters_j = (
+        jnp.asarray(cand), jnp.asarray(count), jnp.asarray(counters0))
+
+    # NB: through the axon tunnel, block_until_ready returns without
+    # blocking (measured: 0.1 ms "scan" over 204800 sequential partitions,
+    # physically impossible) — so every timed device path materializes its
+    # outputs on the host. That charges both device backends the same
+    # device->host transfer the host-visible production pass pays anyway.
+    def run_xla():
+        o, c = xla_fn(counters_j, cand_j, count_j)
+        return np.asarray(o), np.asarray(c)
+    try:
+        timed(run_xla, "xla_scan")
+        xla_ordered, xla_counters = run_xla()
+        out["xla_matches_native"] = bool(
+            np.array_equal(np.asarray(xla_ordered), native_ordered[0])
+            and np.array_equal(np.asarray(xla_counters), native_counters))
+    except Exception as e:
+        out["xla_scan_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # the deceased: pallas kernel (interpret off => requires the real chip)
+    def run_pallas():
+        o, c = pallas_archive.leadership_order_pallas(
+            cand_j, count_j, counters_j, jnp.int32(jhash), RF,
+            interpret=not on_chip,
+        )
+        return np.asarray(o), np.asarray(c)
+    try:
+        timed(run_pallas, "pallas_kernel")
+        p_ordered, p_counters = run_pallas()
+        out["pallas_matches_native"] = bool(
+            np.array_equal(np.asarray(p_ordered), native_ordered[0])
+            and np.array_equal(np.asarray(p_counters), native_counters))
+    except Exception as e:
+        out["pallas_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

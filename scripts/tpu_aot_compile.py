@@ -145,10 +145,15 @@ def main() -> None:
     #  its 336.6 s headline compile vs place_scan's 5.0 s, TPU_AOT_r03.log,
     #  decided the pre-registered keep-or-kill rule.)
 
-    # (stage 6 retired round 5: the pallas leadership kernel was deleted
-    #  under its pre-registered keep-or-kill rule — Mosaic-compile-proven
-    #  since round 3 but never executed on hardware, never the default,
-    #  no timing. BASELINE.md "Round-5 pre-registered decision rules".)
+    # stage 6: pallas leadership kernel, REAL mosaic lowering (not interpret)
+    from kafka_assigner_tpu.ops.pallas_leadership import leadership_order_pallas
+
+    acc1 = jnp.zeros((1024, 3), jnp.int32)
+    cnt1 = jnp.full((1024,), 3, jnp.int32)
+    compile_stage(
+        "stage6 pallas leadership P=1024 (mosaic)", leadership_order_pallas,
+        acc1, cnt1, counters, jnp.int32(12345), rf=3, interpret=False,
+    )
     if max_stage < 7:
         return
 

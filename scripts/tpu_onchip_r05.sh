@@ -52,7 +52,9 @@ else
   stamp "bench rc=$rc; last line is NOT valid JSON — nothing banked"
 fi
 
-stamp "=== stage C: (pallas kernel deleted under the round-5 keep-or-kill rule; no variant to validate) ==="
+stamp "=== stage C: pallas leadership on-chip validation (keep-or-kill input) ==="
+PALLAS_AXON_REMOTE_COMPILE=0 timeout 900 python scripts/validate_pallas_tpu.py 2>&1 | tee -a "$LOG"
+stamp "stage C rc=${PIPESTATUS[0]}"
 
 stamp "=== stage D: saturated-giant on-chip timing (VERDICT r4 item 4) ==="
 PALLAS_AXON_REMOTE_COMPILE=0 timeout 1800 python scripts/bench_saturated_giant.py 2>&1 | tee -a "$LOG"

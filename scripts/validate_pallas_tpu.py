@@ -1,0 +1,111 @@
+"""On-chip validation of the Pallas leadership kernel (VERDICT round 1 #3).
+
+Run this when the TPU tunnel is live (``JAX_PLATFORMS=axon``, default env):
+
+    python scripts/validate_pallas_tpu.py
+
+It differential-tests ``leadership_order_pallas`` (compiled, NOT interpret
+mode) against the XLA-scan ``leadership_order`` across (P, RF) buckets, then
+times both at headline scale. All-PASS is the gate for flipping
+``pallas_leadership_enabled()`` from env opt-in to backend default
+(``ops/pallas_leadership.py``).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from kafka_assigner_tpu.ops.assignment import leadership_order
+    from kafka_assigner_tpu.ops.pallas_leadership import leadership_order_pallas
+    from kafka_assigner_tpu.utils.compilecache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    backend = jax.default_backend()
+    print(f"backend: {backend}, devices: {jax.devices()}")
+    if backend == "cpu":
+        print("WARNING: CPU backend — this validates interpret mode only; "
+              "run with the TPU tunnel live for the real gate.")
+
+    rng = np.random.default_rng(0)
+    failures = 0
+    buckets = [
+        (64, 32, 2), (512, 128, 3), (1024, 256, 3), (4096, 1024, 3),
+        (512, 64, 4), (2048, 512, 5), (16384, 4096, 3), (65536, 8192, 3),
+    ]
+    for p, n, rf in buckets:
+        acc = np.stack(
+            [rng.choice(n, rf, replace=False) for _ in range(p)]
+        ).astype(np.int32)
+        cnt = np.full(p, rf, np.int32)
+        # exercise partial rows too
+        cnt[: p // 8] = rng.integers(0, rf + 1, p // 8)
+        for i in range(p // 8):
+            acc[i, cnt[i]:] = -1
+        counters = rng.integers(0, 100, (n, rf)).astype(np.int32)
+        jh = int(rng.integers(0, 2**30))
+
+        o1, c1 = jax.device_get(
+            leadership_order(
+                jnp.asarray(acc), jnp.asarray(cnt), jnp.asarray(counters),
+                jnp.int32(jh), rf,
+            )
+        )
+        o2, c2 = jax.device_get(
+            leadership_order_pallas(
+                jnp.asarray(acc), jnp.asarray(cnt), jnp.asarray(counters),
+                jnp.int32(jh), rf,  # interpret=None -> compiled on TPU
+            )
+        )
+        ok = np.array_equal(o1, o2) and np.array_equal(c1, c2)
+        failures += 0 if ok else 1
+        print(f"  P={p:>6} N={n:>5} RF={rf}: {'PASS' if ok else 'FAIL'}")
+
+    # Headline-scale timing: 200k partitions in 100-partition topics is what
+    # the solver actually runs; time one 65536-partition mega-call plus the
+    # realistic (2048 topics x 128-pad) shape via repeated calls.
+    p, n, rf = 65536, 8192, 3
+    acc = jnp.asarray(
+        np.stack([rng.choice(n, rf, replace=False) for _ in range(p)]).astype(
+            np.int32
+        )
+    )
+    cnt = jnp.full((p,), rf, jnp.int32)
+    counters = jnp.zeros((n, rf), jnp.int32)
+    jh = jnp.int32(12345)
+
+    import functools
+
+    scan_fn = jax.jit(functools.partial(leadership_order, rf=rf))
+    pallas_fn = jax.jit(
+        functools.partial(leadership_order_pallas, rf=rf, interpret=False)
+        if backend != "cpu"
+        else functools.partial(leadership_order_pallas, rf=rf, interpret=True)
+    )
+    for name, fn in (("xla-scan", scan_fn), ("pallas", pallas_fn)):
+        out = fn(acc, cnt, counters, jh)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = fn(acc, cnt, counters, jh)
+        jax.block_until_ready(out)
+        ms = (time.perf_counter() - t0) * 1000
+        print(f"  {name}: {ms:.1f} ms warm @ P={p}")
+
+    print("ALL PASS" if failures == 0 else f"{failures} FAILURES")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
