@@ -116,6 +116,19 @@ def main(budget_s: float) -> int:
             print(f"REPRO leadership divergence: seed={seed} n={n} p={p} "
                   f"rf={rf} racks={racks} rm={remove} add={add}")
             return 1
+        # Pallas leadership lane (kernel restored late round 5 on the
+        # posthumous on-chip measurement): byte equality with the default
+        # path across the same random cluster space, error behavior
+        # included. Interpret mode on CPU — the identical formulation the
+        # chip lowers (bit-equality on hardware pinned separately,
+        # PALLAS_POSTHUMOUS_r05.json).
+        pal, pal_err = run(
+            topics, live, rack_map, "tpu", "KA_PALLAS_LEADERSHIP"
+        )
+        if (seq, seq_err) != (pal, pal_err):
+            print(f"REPRO pallas divergence: seed={seed} n={n} p={p} "
+                  f"rf={rf} racks={racks} rm={remove} add={add}")
+            return 1
         # Topic-vmapped placement lane (round 5, KA_PLACE_MODE=vmap): the
         # chunked fast leg + scan-chain rescue must be byte-equal with the
         # default scan placement, including error behavior, across the full

@@ -13,8 +13,9 @@
 # persistent cache (.jax_cache) already holds warm v5e entries from the
 # chipless AOT runs. bench.py self-supervises (headline secured before any
 # variant runs; variants = the KA_LEADER_CHUNK down-probe the leader-chunk
-# default is waiting on. The pallas variant was retired with the kernel
-# when its pre-registered keep-or-kill rule executed — BASELINE.md).
+# default is waiting on, plus the pallas variant — retired when the
+# keep-or-kill rule executed, restored with the kernel when the posthumous
+# on-chip measurement reversed that outcome — BASELINE.md).
 set -u
 cd /root/repo
 LOG=TPU_PROBE_r05.log
