@@ -44,6 +44,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main(budget_s: float) -> int:
     import jax
 
+    # The interpreter boots with the TPU plugin's JAX_PLATFORMS frozen by
+    # sitecustomize, so without this the soak silently rides the tunneled
+    # chip: slower, contends with on-chip benches, and a mid-compile kill
+    # wedges the terminal (observed 2026-07-31, ~08:35 — a timeout SIGTERM
+    # on an overrunning soak re-wedged the tunnel). CPU is the hermetic
+    # default; KA_SOAK_ONCHIP=1 opts into hardware lanes deliberately (the
+    # accidental on-chip run WAS valuable: 42 cases differentialing the
+    # real Mosaic pallas kernel on the v5e, zero divergence).
+    if os.environ.get("KA_SOAK_ONCHIP") != "1":
+        jax.config.update("jax_platforms", "cpu")
+
     from kafka_assigner_tpu.assigner import TopicAssigner
     from tests.helpers import moved_replicas
     from tests.test_invariants import make_cluster
