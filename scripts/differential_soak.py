@@ -132,14 +132,17 @@ def main(budget_s: float) -> int:
         # path across the same random cluster space, error behavior
         # included. Interpret mode on CPU — the identical formulation the
         # chip lowers (bit-equality on hardware pinned separately,
-        # PALLAS_POSTHUMOUS_r05.json).
-        pal, pal_err = run(
-            topics, live, rack_map, "tpu", "KA_PALLAS_LEADERSHIP"
-        )
-        if (seq, seq_err) != (pal, pal_err):
-            print(f"REPRO pallas divergence: seed={seed} n={n} p={p} "
-                  f"rf={rf} racks={racks} rm={remove} add={add}")
-            return 1
+        # PALLAS_POSTHUMOUS_r05.json). Interpret emulation is ~10× a full
+        # case's worth of work, so the lane samples 1-in-4 — still dozens
+        # of clusters per burst without starving the cheap lanes.
+        if r.random() < 0.25 or os.environ.get("KA_SOAK_ONCHIP") == "1":
+            pal, pal_err = run(
+                topics, live, rack_map, "tpu", "KA_PALLAS_LEADERSHIP"
+            )
+            if (seq, seq_err) != (pal, pal_err):
+                print(f"REPRO pallas divergence: seed={seed} n={n} p={p} "
+                      f"rf={rf} racks={racks} rm={remove} add={add}")
+                return 1
         # Topic-vmapped placement lane (round 5, KA_PLACE_MODE=vmap): the
         # chunked fast leg + scan-chain rescue must be byte-equal with the
         # default scan placement, including error behavior, across the full
