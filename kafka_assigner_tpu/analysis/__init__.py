@@ -1,0 +1,11 @@
+"""Project-native static analysis.
+
+- :mod:`.kalint` — the AST linter enforcing the knob-registry and
+  jit-boundary house rules (rules KA001-KA005; ``python -m
+  kafka_assigner_tpu.analysis.kalint``).
+- :mod:`.knobdoc` — generates the README "Tuning knobs" table from the
+  declarative registry in ``utils/env.py`` (``--check`` catches docs drift).
+
+No eager re-exports: both submodules double as ``python -m`` entry points,
+and importing them here would shadow that (runpy's double-import warning).
+"""

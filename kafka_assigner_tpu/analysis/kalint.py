@@ -1,0 +1,493 @@
+"""``kalint`` — the project-native AST linter.
+
+The system's value proposition is byte-compatibility with the reference
+assigner under a large surface of tuning knobs; the two correctness risks
+that grow with the codebase are silent config drift (a knob read raw,
+bypassing the loud-ignore house rule in ``utils/env.py``) and host-sync
+leaking into jitted solver paths. ``kalint`` machine-checks both:
+
+====== =====================================================================
+rule   meaning
+====== =====================================================================
+KA000  meta: unparsable file, or a suppression comment without a reason
+KA001  raw ``os.environ``/``os.getenv`` access to a ``KA_*`` knob outside
+       the registry module (``utils/env.py``) — use the typed accessors
+KA002  host-sync / nondeterminism call (``jax.device_get``, ``.item()``,
+       ``np.asarray``, ``time.*`` clocks, ``random.*``) inside kernel
+       modules (``ops/``) or inside any function traced by ``jax.jit``
+KA003  a ``KA_*`` string literal that does not resolve to a registered
+       knob (catches typos at lint time instead of silently-unset knobs)
+KA004  a registered knob missing from the README knob table (docs drift;
+       the table is generated — ``python -m ...analysis.knobdoc --write``)
+KA005  plan/golden JSON emission (``json.dumps``/``json.dump``) outside
+       ``io/json_io.py``'s byte-compat helpers
+====== =====================================================================
+
+Suppression: put ``# kalint: disable=KA002 -- <reason>`` on the offending
+line or on its own line directly above. The reason is mandatory — a
+reasonless suppression is itself a finding (KA000) and does not suppress.
+
+Run ``python -m kafka_assigner_tpu.analysis.kalint`` (no args: lint the whole
+package plus the README check; exit non-zero on findings), or pass explicit
+file paths. ``scripts/lint.sh`` wires this into the tier-1 gate.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import io
+import re
+import sys
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, List, Sequence, Set
+
+RULES = {
+    "KA000": "meta finding (syntax error / reasonless suppression)",
+    "KA001": "raw os.environ access to a KA_* knob outside the registry",
+    "KA002": "host-sync or nondeterminism call in traced kernel code",
+    "KA003": "KA_* string literal does not resolve to a registered knob",
+    "KA004": "registered knob missing from the README knob table",
+    "KA005": "plan JSON emission outside io/json_io.py",
+}
+
+#: Modules whose ENTIRE body is treated as traced kernel code (KA002): these
+#: compile under jit wholesale, and even their module-level helpers feed
+#: trace-time constants, so host clocks/randomness have no business anywhere
+#: in them.
+KERNEL_MODULES = frozenset({"ops/assignment.py", "ops/pallas_leadership.py"})
+#: The one module allowed to touch os.environ for KA_* knobs (KA001).
+REGISTRY_MODULE = "utils/env.py"
+#: The one module allowed to emit plan JSON (KA005).
+JSON_BOUNDARY_MODULE = "io/json_io.py"
+
+_KNOB_RE = re.compile(r"KA_[A-Z][A-Z0-9_]*")
+_SUPPRESS_RE = re.compile(
+    r"#\s*kalint:\s*disable=([A-Z0-9, ]+?)\s*(?:--\s*(\S.*))?$"
+)
+_TIME_CALLS = frozenset({
+    "time", "time_ns", "perf_counter", "perf_counter_ns",
+    "monotonic", "monotonic_ns", "sleep",
+})
+_NUMPY_ALIASES = frozenset({"np", "numpy", "onp"})
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+def _is_name(node: ast.AST, name: str) -> bool:
+    return isinstance(node, ast.Name) and node.id == name
+
+
+def _const_str(node: ast.AST):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _knob_literal(node: ast.AST):
+    v = _const_str(node)
+    return v if v is not None and _KNOB_RE.fullmatch(v) else None
+
+
+def _suppressions(src: str, path: str):
+    """Per-line ``# kalint: disable=...`` map. A suppression covers its own
+    line and the line below (so it can sit above a long statement). A
+    suppression without a reason is a KA000 finding and suppresses nothing
+    (the reason IS the audit trail).
+
+    Only real COMMENT tokens count — suppression syntax quoted inside a
+    string literal or docstring (e.g. this module's own docs) is neither a
+    suppression nor a finding."""
+    table: dict = {}
+    metas: List[Finding] = []
+    try:
+        comments = [
+            t for t in tokenize.generate_tokens(io.StringIO(src).readline)
+            if t.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        comments = []  # unparsable source is KA000 via ast.parse already
+    for tok in comments:
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        lineno = tok.start[0]
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = (m.group(2) or "").strip()
+        if not reason:
+            metas.append(Finding(
+                "KA000", path, lineno, tok.start[1] + m.start() + 1,
+                "suppression requires a reason: "
+                "'# kalint: disable=KAnnn -- <why>'",
+            ))
+            continue
+        table.setdefault(lineno, set()).update(rules)
+        table.setdefault(lineno + 1, set()).update(rules)
+    return table, metas
+
+
+# --- KA002 machinery --------------------------------------------------------
+
+def _banned_call(node: ast.Call):
+    """Message when ``node`` is one of the banned host-sync/nondeterminism
+    calls, else None."""
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    if f.attr == "device_get" and _is_name(f.value, "jax"):
+        return "jax.device_get(...) host sync"
+    if f.attr == "item" and not node.args and not node.keywords:
+        return ".item() host sync"
+    if f.attr == "asarray" and isinstance(f.value, ast.Name) \
+            and f.value.id in _NUMPY_ALIASES:
+        return f"{f.value.id}.asarray(...) host materialization"
+    if _is_name(f.value, "time") and f.attr in _TIME_CALLS:
+        return f"time.{f.attr}() wall clock / host nondeterminism"
+    if _is_name(f.value, "random"):
+        return f"random.{f.attr}() nondeterminism"
+    if (
+        isinstance(f.value, ast.Attribute)
+        and f.value.attr == "random"
+        and isinstance(f.value.value, ast.Name)
+        and f.value.value.id in _NUMPY_ALIASES
+    ):
+        return f"{f.value.value.id}.random.{f.attr}() nondeterminism"
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` or a bare ``jit`` name (``from jax import jit``)."""
+    return _is_name(node, "jit") or (
+        isinstance(node, ast.Attribute)
+        and node.attr == "jit"
+        and _is_name(node.value, "jax")
+    )
+
+
+def _jit_roots(tree: ast.AST) -> Set[str]:
+    """Function names handed to ``jax.jit`` in this module — as call
+    arguments (``f_jit = jax.jit(f, ...)``) or decorators (``@jax.jit``,
+    ``@jax.jit(...)``, ``@partial(jax.jit, ...)``)."""
+    roots: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_expr(node.func):
+            if node.args and isinstance(node.args[0], ast.Name):
+                roots.add(node.args[0].id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_expr(dec):
+                    roots.add(node.name)
+                elif isinstance(dec, ast.Call):
+                    if _is_jit_expr(dec.func):
+                        roots.add(node.name)
+                    elif (
+                        (_is_name(dec.func, "partial")
+                         or (isinstance(dec.func, ast.Attribute)
+                             and dec.func.attr == "partial"))
+                        and dec.args and _is_jit_expr(dec.args[0])
+                    ):
+                        roots.add(node.name)
+    return roots
+
+
+def _traced_functions(tree: ast.AST):
+    """Transitive closure of jit roots over same-module calls-by-name:
+    the statically knowable approximation of 'code that runs under
+    trace'. Cross-module callees are covered by KERNEL_MODULES."""
+    funcs = {
+        n.name: n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    traced = {name for name in _jit_roots(tree) if name in funcs}
+    frontier = list(traced)
+    while frontier:
+        fn = funcs[frontier.pop()]
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                callee = node.func.id
+                if callee in funcs and callee not in traced:
+                    traced.add(callee)
+                    frontier.append(callee)
+    return [funcs[name] for name in sorted(traced)]
+
+
+# --- rule passes ------------------------------------------------------------
+
+def _os_bindings(tree: ast.AST):
+    """Names the module binds to the ``os`` module, ``os.environ``, and
+    ``os.getenv`` — ``import os as o`` / ``from os import environ as env`` /
+    ``from os import getenv`` all count, so the import form cannot be used
+    to slip a raw knob read past KA001."""
+    os_mods = {"os"}
+    environs: Set[str] = set()
+    getenvs: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "os":
+                    os_mods.add(alias.asname or "os")
+        elif isinstance(node, ast.ImportFrom) and node.module == "os":
+            for alias in node.names:
+                bound = alias.asname or alias.name
+                if alias.name == "environ":
+                    environs.add(bound)
+                elif alias.name == "getenv":
+                    getenvs.add(bound)
+    return os_mods, environs, getenvs
+
+
+def _check_ka001(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
+    if relpath == REGISTRY_MODULE:
+        return []
+    os_mods, environs, getenvs = _os_bindings(tree)
+
+    def is_environ(node: ast.AST) -> bool:
+        if isinstance(node, ast.Name) and node.id in environs:
+            return True
+        return (
+            isinstance(node, ast.Attribute)
+            and node.attr == "environ"
+            and isinstance(node.value, ast.Name)
+            and node.value.id in os_mods
+        )
+
+    def is_getenv(node: ast.AST) -> bool:
+        if isinstance(node, ast.Name) and node.id in getenvs:
+            return True
+        return (
+            isinstance(node, ast.Attribute)
+            and node.attr == "getenv"
+            and isinstance(node.value, ast.Name)
+            and node.value.id in os_mods
+        )
+
+    out: List[Finding] = []
+
+    def hit(node, key):
+        out.append(Finding(
+            "KA001", path, node.lineno, node.col_offset + 1,
+            f"raw os.environ access to {key!r}; use the typed accessors in "
+            "utils/env.py (env_int/env_float/env_bool/env_choice/env_str)",
+        ))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("get", "pop", "setdefault")
+                and is_environ(f.value)
+                and node.args
+            ):
+                key = _knob_literal(node.args[0])
+                if key:
+                    hit(node, key)
+            elif is_getenv(f) and node.args:
+                key = _knob_literal(node.args[0])
+                if key:
+                    hit(node, key)
+        elif isinstance(node, ast.Subscript) and is_environ(node.value):
+            key = _knob_literal(node.slice)
+            if key:
+                hit(node, key)
+        elif isinstance(node, ast.Compare) and len(node.comparators) == 1:
+            if (
+                isinstance(node.ops[0], (ast.In, ast.NotIn))
+                and is_environ(node.comparators[0])
+            ):
+                key = _knob_literal(node.left)
+                if key:
+                    hit(node, key)
+    return out
+
+
+def _check_ka002(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
+    if relpath in KERNEL_MODULES:
+        scopes: Iterable[ast.AST] = [tree]
+        where = "kernel module"
+    else:
+        scopes = _traced_functions(tree)
+        where = "jit-traced function"
+    out: List[Finding] = []
+    seen: Set[int] = set()
+    for scope in scopes:
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Call) and id(node) not in seen:
+                seen.add(id(node))
+                msg = _banned_call(node)
+                if msg:
+                    out.append(Finding(
+                        "KA002", path, node.lineno, node.col_offset + 1,
+                        f"{msg} in {where} (host work must stay outside the "
+                        "traced solve)",
+                    ))
+    return out
+
+
+def _check_ka003(tree: ast.AST, knobs: Set[str], path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        v = _knob_literal(node)
+        if v is not None and v not in knobs:
+            out.append(Finding(
+                "KA003", path, node.lineno, node.col_offset + 1,
+                f"{v!r} is not a registered knob (typo? declare it in "
+                "utils/env.py)",
+            ))
+    return out
+
+
+def _check_ka005(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
+    if relpath == JSON_BOUNDARY_MODULE:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("dumps", "dump")
+            and _is_name(node.func.value, "json")
+        ):
+            out.append(Finding(
+                "KA005", path, node.lineno, node.col_offset + 1,
+                f"json.{node.func.attr}(...) outside io/json_io.py; plan "
+                "payloads must go through the byte-compat helpers (suppress "
+                "with a reason for non-plan payloads)",
+            ))
+    return out
+
+
+def check_readme(readme_text: str, knobs=None, path: str = "README.md"):
+    """KA004: every registered knob must appear in the README (the generated
+    knob table keeps this true; drift means the table is stale)."""
+    if knobs is None:
+        from ..utils.env import KNOBS
+
+        knobs = KNOBS
+    names = knobs if not hasattr(knobs, "keys") else list(knobs)
+    out: List[Finding] = []
+    for name in names:
+        # whole-name match: KA_FOO must not be satisfied by KA_FOO_BAR
+        pat = r"(?<![A-Z0-9_])" + re.escape(name) + r"(?![A-Z0-9_])"
+        if not re.search(pat, readme_text):
+            out.append(Finding(
+                "KA004", path, 1, 1,
+                f"registered knob {name} is missing from the README knob "
+                "table (regenerate: python -m "
+                "kafka_assigner_tpu.analysis.knobdoc --write)",
+            ))
+    return out
+
+
+# --- drivers ----------------------------------------------------------------
+
+def lint_source(
+    src: str,
+    relpath: str,
+    *,
+    knobs: Set[str] | None = None,
+    path: str | None = None,
+) -> List[Finding]:
+    """Lint one module. ``relpath`` is the package-relative posix path (it
+    selects the module class: registry / kernel / json boundary); ``path`` is
+    the display path for findings (defaults to ``relpath``)."""
+    path = path or relpath
+    if knobs is None:
+        from ..utils.env import KNOBS
+
+        knobs = set(KNOBS)
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(
+            "KA000", path, e.lineno or 1, (e.offset or 0) + 1,
+            f"syntax error: {e.msg}",
+        )]
+    suppress, findings = _suppressions(src, path)
+    findings = list(findings)
+    raw = (
+        _check_ka001(tree, relpath, path)
+        + _check_ka002(tree, relpath, path)
+        + _check_ka003(tree, set(knobs), path)
+        + _check_ka005(tree, relpath, path)
+    )
+    for f in raw:
+        if f.rule in suppress.get(f.line, ()):  # reasoned suppression
+            continue
+        findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def lint_package(root: Path | None = None) -> List[Finding]:
+    """Lint every module of the installed package tree plus the README knob
+    check; the empty list is the green state ``scripts/lint.sh`` gates on."""
+    pkg = Path(root) if root else Path(__file__).resolve().parent.parent
+    repo = pkg.parent
+    findings: List[Finding] = []
+    for p in sorted(pkg.rglob("*.py")):
+        rel = p.relative_to(pkg).as_posix()
+        try:
+            display = p.relative_to(repo).as_posix()
+        except ValueError:
+            display = str(p)
+        findings.extend(
+            lint_source(p.read_text(encoding="utf-8"), rel, path=display)
+        )
+    readme = repo / "README.md"
+    if readme.is_file():
+        findings.extend(check_readme(readme.read_text(encoding="utf-8")))
+    return findings
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="kalint", description="project-native static analysis "
+        "(knob registry + jit-boundary house rules)",
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="files to lint (default: the whole package + "
+                             "README knob check)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for rule, desc in RULES.items():
+            print(f"{rule}  {desc}")
+        return 0
+    if args.paths:
+        pkg = Path(__file__).resolve().parent.parent
+        findings: List[Finding] = []
+        for raw in args.paths:
+            p = Path(raw).resolve()
+            try:
+                rel = p.relative_to(pkg).as_posix()
+            except ValueError:
+                rel = p.name
+            findings.extend(
+                lint_source(p.read_text(encoding="utf-8"), rel, path=raw)
+            )
+    else:
+        findings = lint_package()
+    for f in findings:
+        print(f)
+    n = len(findings)
+    print(
+        f"kalint: {n} finding(s)" if n else "kalint: clean",
+        file=sys.stderr,
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -127,10 +127,11 @@ class TopicAssigner:
         backends get one dispatch per run of consecutive same-RF topics.
         """
         import contextlib
-        import os
+
+        from .utils.env import env_str
 
         trace_ctx = contextlib.nullcontext()
-        profile_dir = os.environ.get("KA_PROFILE")
+        profile_dir = env_str("KA_PROFILE")
         if profile_dir:
             # One device trace per batched solve (SURVEY.md §5: the
             # reference has no profiling at all; solve latency is our

@@ -234,8 +234,10 @@ def main() -> None:
     # (~20-40s). Library callers (run_tool) are never probed.
     import os
 
-    watchdog = float(os.environ.get("KA_DEVICE_WATCHDOG_S", "0") or 0)
-    if watchdog > 0 and os.environ.get("KA_CLI_CPU_FALLBACK") != "1":
+    from .utils.env import env_bool, env_float
+
+    watchdog = env_float("KA_DEVICE_WATCHDOG_S")
+    if watchdog > 0 and not env_bool("KA_CLI_CPU_FALLBACK"):
         from .utils.deviceprobe import probe_device_count, virtual_cpu_env
 
         # allow_cpu: the watchdog exists to detect a WEDGED accelerator, not

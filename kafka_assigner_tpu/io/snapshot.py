@@ -81,4 +81,5 @@ def write_snapshot(
         },
     }
     with open(path, "w", encoding="utf-8") as f:
+        # kalint: disable=KA005 -- snapshot capture file, not a byte-compat plan payload
         json.dump(data, f, indent=1)

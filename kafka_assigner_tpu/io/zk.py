@@ -53,9 +53,9 @@ def _resolve_endpoint(meta: dict, broker_id: str) -> tuple:
 
 class ZkBackend:
     def __init__(self, connect_string: str) -> None:
-        import os
+        from ..utils.env import env_choice
 
-        choice = os.environ.get("KA_ZK_CLIENT", "auto")
+        choice = env_choice("KA_ZK_CLIENT")
         client_cls = None
         if choice in ("auto", "kazoo"):
             try:

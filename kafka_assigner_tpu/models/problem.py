@@ -10,13 +10,13 @@ width, powers of two on the batch axis (``batch_bucket``).
 """
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 from typing import Dict, List, Mapping, Sequence, Set
 
 import numpy as np
 
 from ..solvers.base import Context
+from ..utils.env import env_bool
 from ..utils.javahash import java_string_hash
 
 
@@ -37,7 +37,7 @@ def _hostcodec():
     """The C boundary codec (``native/hostcodec.c``), or None when disabled
     (``KA_HOSTCODEC=0``) or unbuildable — the numpy paths below are the
     always-available reference implementation (differential-tested equal)."""
-    if os.environ.get("KA_HOSTCODEC") == "0":
+    if not env_bool("KA_HOSTCODEC"):
         return None
     try:
         from ..native.build import load_hostcodec

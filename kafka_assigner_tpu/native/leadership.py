@@ -15,10 +15,10 @@ round 2's remote compile). The device scan remains available
 from __future__ import annotations
 
 import ctypes
-import os
 
 import numpy as np
 
+from ..utils.env import env_choice
 from .build import NativeBuildError, load_native_library
 
 
@@ -28,16 +28,7 @@ def leadership_backend() -> str:
     measured ~25x faster than the device scan at the headline on CPU-XLA and
     it shrinks the compiled program (placement only), which matters where
     programs compile remotely over the chip tunnel."""
-    choice = os.environ.get("KA_LEADERSHIP", "auto")
-    if choice not in ("auto", "native", "device"):
-        import sys
-
-        print(
-            f"kafka-assigner: ignoring unknown KA_LEADERSHIP={choice!r} "
-            "(expected auto, native or device)",
-            file=sys.stderr,
-        )
-        choice = "auto"
+    choice = env_choice("KA_LEADERSHIP")
     if choice == "device":
         return "device"
     try:

@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.env import env_int, knob_default
+
 # Plain int (not a jax array): module import must not initialize a backend.
 BIG = 0x3FFFFFFF
 
@@ -62,7 +64,7 @@ def default_alive(rack_idx: jnp.ndarray, n: int) -> jnp.ndarray:
 #: an order of magnitude above any per-topic mask the 2000-topic headline
 #: builds (104 x 5120 ~ 0.5M), an order below the giant-topic shape where
 #: the dense wave measured 355 s warm (1e9-element masks per wave).
-DENSE_MASK_BUDGET = 1 << 27
+DENSE_MASK_BUDGET = knob_default("KA_DENSE_MASK_BUDGET")
 
 #: Per-wave drain divisor for the quota-balance leg (see _wave_body): each
 #: NODE offers ceil(headroom / QUOTA_WAVE_TARGET) slots per wave and each
@@ -74,19 +76,15 @@ DENSE_MASK_BUDGET = 1 << 27
 #: ~O(log(cap) / log(T/(T-1))) ≈ 25 at the giant replace-100 shape (T=4).
 #: Env-overridable for measurement (KA_QUOTA_WAVE_TARGET, trace-time read
 #: like dense_mask_budget).
-QUOTA_WAVE_TARGET = 4
+QUOTA_WAVE_TARGET = knob_default("KA_QUOTA_WAVE_TARGET")
 
 
 def quota_wave_target() -> int:
-    from ..utils.env import env_int
-
-    return env_int("KA_QUOTA_WAVE_TARGET", QUOTA_WAVE_TARGET)
+    return env_int("KA_QUOTA_WAVE_TARGET")
 
 
 def quota_endgame_headroom() -> int:
-    from ..utils.env import env_int
-
-    return env_int("KA_QUOTA_ENDGAME", QUOTA_ENDGAME_HEADROOM)
+    return env_int("KA_QUOTA_ENDGAME")
 
 #: Endgame handoff for the quota-balance leg: once every rack's headroom is
 #: at or below this, the hybrid body switches (lax.cond on the traced
@@ -98,7 +96,7 @@ def quota_endgame_headroom() -> int:
 #: tail it hands over is <= r_cap * QUOTA_ENDGAME_HEADROOM slots, so the
 #: node-per-wave waves it costs are bounded and small. Env-overridable for
 #: measurement (KA_QUOTA_ENDGAME, trace-time read like dense_mask_budget).
-QUOTA_ENDGAME_HEADROOM = 32
+QUOTA_ENDGAME_HEADROOM = knob_default("KA_QUOTA_ENDGAME")
 
 
 def dense_mask_budget() -> int:
@@ -111,9 +109,7 @@ def dense_mask_budget() -> int:
     ``jax.clear_caches()`` to take effect (tests do; production sets it at
     process start or never).
     """
-    from ..utils.env import env_int
-
-    return env_int("KA_DENSE_MASK_BUDGET", DENSE_MASK_BUDGET)
+    return env_int("KA_DENSE_MASK_BUDGET")
 
 # Below this partition-bucket size the (P, P) same-key-before-me count beats a
 # stable argsort in _requests_rank (CPU-XLA microbench, round 1: ~3x at P=128,

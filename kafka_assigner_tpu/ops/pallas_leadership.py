@@ -37,7 +37,6 @@ pass (``native/leadership.py``) remains the production default.
 """
 from __future__ import annotations
 
-import os
 from typing import Tuple
 
 import jax
@@ -46,6 +45,19 @@ from jax import lax
 
 BIG = 0x3FFFFFFF
 BLOCK_P = 512
+
+
+def _compiler_params_cls(pltpu):
+    # jax>=0.5 renamed TPUCompilerParams -> CompilerParams
+    for attr in ("CompilerParams", "TPUCompilerParams"):
+        cls = getattr(pltpu, attr, None)
+        if cls is not None:
+            return cls
+    raise RuntimeError(
+        "jax.experimental.pallas.tpu exposes neither CompilerParams nor "
+        "TPUCompilerParams; unsupported jax version for the Pallas "
+        "leadership kernel"
+    )
 
 
 def _kernel(jhash_ref, cand_ref, count_ref, counters_in_ref, out_ref, counters_ref):
@@ -171,7 +183,7 @@ def leadership_order_pallas(
             pl.BlockSpec(counters.shape, lambda i: (0, 0)),
         ),
         input_output_aliases={3: 1},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params_cls(pltpu)(
             dimension_semantics=("arbitrary",),  # sequential grid: counters carry
         ),
         interpret=interpret,
@@ -186,7 +198,9 @@ def leadership_order_pallas(
 
 def pallas_leadership_enabled() -> bool:
     """Opt-in until validated on real TPU hardware (see module docstring)."""
-    return os.environ.get("KA_PALLAS_LEADERSHIP") == "1"
+    from ..utils.env import env_bool
+
+    return env_bool("KA_PALLAS_LEADERSHIP")
 
 
 def should_interpret() -> bool:

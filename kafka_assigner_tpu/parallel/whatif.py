@@ -294,9 +294,9 @@ def evaluate_removal_scenarios(
                 raise ValueError(f"scenario {s}: unknown broker {b}")
             alive[s, idx] = False
 
-    import os
+    from ..utils.env import env_bool, env_int
 
-    if os.environ.get("KA_WHATIF_INCREMENTAL", "1") != "0":
+    if env_bool("KA_WHATIF_INCREMENTAL"):
         # With a mesh, offer it to the incremental path only when its
         # scenario axis divides the padded batch (same constraint the dense
         # sharded path has); otherwise run the incremental sweep unsharded —
@@ -322,7 +322,7 @@ def evaluate_removal_scenarios(
     per_scenario = max(
         1, currents.shape[0] * currents.shape[1] * max(rf, 1)
     )
-    budget = int(os.environ.get("KA_WHATIF_MEMBUDGET", str(1 << 28)))
+    budget = env_int("KA_WHATIF_MEMBUDGET")
     s_chunk = max(1, budget // per_scenario)
     if mesh is not None:
         m = mesh.shape.get("scenarios", 1)

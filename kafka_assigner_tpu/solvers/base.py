@@ -49,6 +49,7 @@ class Context:
         tmp = f"{path}.tmp.{os.getpid()}"
         try:
             with open(tmp, "w", encoding="utf-8") as f:
+                # kalint: disable=KA005 -- leadership-counter persistence, not a plan payload
                 json.dump(
                     {str(n): {str(s): c for s, c in slots.items()}
                      for n, slots in self.counter.items()},

@@ -28,7 +28,6 @@ warm path runs entirely on device.
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Dict, List, Mapping, Sequence, Set
 
 import numpy as np
@@ -82,19 +81,14 @@ def solver_tuning() -> tuple:
     Both participate in the jit cache key as static arguments.
     """
     from ..ops.assignment import WAVE_MODES
-    from ..utils.env import env_int
+    from ..utils.env import env_choice, env_int
 
+    # The default keeps the compat byte-parity contract intact; env_choice
+    # falls back to it loudly on an unknown chain name (house rule).
     default = "seq" if rf_compat_enabled() else "auto"
-    wave = os.environ.get("KA_WAVE_MODE") or default
-    if wave not in WAVE_MODES:
-        import sys
-
-        print(
-            f"kafka-assigner: ignoring unknown KA_WAVE_MODE={wave!r} "
-            f"(expected one of {sorted(WAVE_MODES)})",
-            file=sys.stderr,
-        )
-        wave = default  # keep the compat byte-parity default intact
+    wave = env_choice(
+        "KA_WAVE_MODE", choices=tuple(WAVE_MODES), default=default
+    )
     return wave, env_int("KA_LEADER_CHUNK")
 
 
@@ -112,19 +106,9 @@ def place_tuning() -> tuple:
     - ``KA_PLACE_CHUNK``: topics per vmapped block (memory bound; default
       256 ≈ low hundreds of MB of live wave state at the headline bucket).
     """
-    mode = os.environ.get("KA_PLACE_MODE") or "scan"
-    if mode not in ("scan", "vmap"):
-        import sys
+    from ..utils.env import env_choice, env_int
 
-        print(
-            f"kafka-assigner: ignoring unknown KA_PLACE_MODE={mode!r} "
-            "(expected 'scan' or 'vmap')",
-            file=sys.stderr,
-        )
-        mode = "scan"
-    from ..utils.env import env_int as _env_int
-
-    return mode, _env_int("KA_PLACE_CHUNK", 256)
+    return env_choice("KA_PLACE_MODE"), env_int("KA_PLACE_CHUNK")
 
 
 def _narrow_upload(currents, rack_idx) -> "np.ndarray":
@@ -150,7 +134,9 @@ def rf_compat_enabled() -> bool:
     reference's ``assignOrphans`` verbatim — see ``solver_tuning``), making
     all THREE backends byte-equal, orphaned decreases included; an explicit
     ``KA_WAVE_MODE`` restores the auction legs' movement-parity contract."""
-    return os.environ.get("KA_RF_DECREASE_COMPAT") == "1"
+    from ..utils.env import env_bool
+
+    return env_bool("KA_RF_DECREASE_COMPAT")
 
 
 _warned: set[str] = set()
@@ -186,9 +172,10 @@ def _resolve_native_order(use_pallas: bool) -> bool:
     conflict is resolved loudly (pallas wins — it is the narrower opt-in).
     """
     from ..native.leadership import leadership_backend
+    from ..utils.env import env_choice
 
     if use_pallas:
-        if os.environ.get("KA_LEADERSHIP") == "native":
+        if env_choice("KA_LEADERSHIP") == "native":
             _warn_once(
                 "kafka-assigner: KA_PALLAS_LEADERSHIP=1 overrides "
                 "KA_LEADERSHIP=native (the pallas kernel runs the leadership "

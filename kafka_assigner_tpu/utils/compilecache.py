@@ -17,6 +17,8 @@ from __future__ import annotations
 import os
 import sys
 
+from .env import env_bool, env_str
+
 #: Default cache location: sibling of this package, i.e. <repo>/.jax_cache
 #: (gitignored). Override with KA_COMPILE_CACHE_DIR; disable with
 #: KA_COMPILE_CACHE=0.
@@ -32,14 +34,14 @@ def enable_persistent_cache(cache_dir: str | None = None) -> bool:
     Never fatal: the cache is an optimization, and a tool must not lose its
     measurement because the cache directory is unwritable.
     """
-    if os.environ.get("KA_COMPILE_CACHE") == "0":
+    if not env_bool("KA_COMPILE_CACHE"):
         return False
     try:
         import jax
 
         jax.config.update(
             "jax_compilation_cache_dir",
-            cache_dir or os.environ.get("KA_COMPILE_CACHE_DIR", _DEFAULT_DIR),
+            cache_dir or env_str("KA_COMPILE_CACHE_DIR") or _DEFAULT_DIR,
         )
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         return True

@@ -1,24 +1,362 @@
-"""Shared parsing for integer environment knobs (``KA_LEADER_CHUNK``,
-``KA_DENSE_MASK_BUDGET``, ...): invalid values are ignored LOUDLY on stderr
-— the house rule for every tuning knob (mis-set knobs must never silently
-change the measured configuration)."""
+"""The declarative ``KA_*`` knob registry and its typed accessors.
+
+Every tuning knob the package reads is declared here exactly once — name,
+type, default, floor, choices, and a one-line effect doc — and read only
+through the typed accessors (:func:`env_int`, :func:`env_float`,
+:func:`env_bool`, :func:`env_choice`, :func:`env_str`). All accessors follow
+the house rule for tuning knobs: **mis-set knobs must never silently change
+the measured configuration** — an unparsable or unknown value is ignored
+LOUDLY on stderr and the declared default is used instead.
+
+Boolean truthiness convention (normalized by :func:`env_bool`): ``1``,
+``true``, ``yes``, ``on`` are true; ``0``, ``false``, ``no``, ``off`` are
+false (case-insensitive); unset or empty means the declared default; anything
+else warns and falls back to the default. ``KA_FOO=1`` / ``KA_FOO=0`` remain
+the canonical spellings used in docs.
+
+The registry is machine-checked by the project linter
+(``kafka_assigner_tpu/analysis/kalint.py``): raw ``os.environ`` access to a
+``KA_*`` name anywhere outside this module is rule KA001, an unregistered
+``KA_*`` literal is KA003, and a registered knob missing from the README
+knob table is KA004. The README table itself is generated from this registry
+(``python -m kafka_assigner_tpu.analysis.knobdoc --write``).
+"""
 from __future__ import annotations
 
 import os
 import sys
+from dataclasses import dataclass
+from typing import Any, Tuple
 
 
-def env_int(name: str, default: int | None = None, floor: int = 1):
-    """``int(os.environ[name])`` clamped to ``floor``; ``default`` when the
-    variable is unset or non-integer (the latter with a stderr warning)."""
+@dataclass(frozen=True)
+class Knob:
+    """One declared tuning knob.
+
+    ``default_doc`` overrides how the default renders in the generated README
+    table (for knobs whose effective default is computed at runtime);
+    ``internal`` marks process-internal handshake variables operators should
+    not set by hand (still registered so KA003/KA004 cover them).
+    """
+
+    name: str
+    type: str                            # "int" | "float" | "bool" | "choice" | "str"
+    default: Any
+    floor: Any = None                    # numeric clamp (min), None = unclamped
+    choices: Tuple[str, ...] | None = None
+    doc: str = ""
+    default_doc: str | None = None
+    internal: bool = False
+
+
+#: Declaration order is preserved and becomes the README table order.
+KNOBS: "dict[str, Knob]" = {}
+
+
+def _knob(
+    name: str,
+    type_: str,
+    default: Any,
+    *,
+    floor: Any = None,
+    choices: Tuple[str, ...] | None = None,
+    doc: str = "",
+    default_doc: str | None = None,
+    internal: bool = False,
+) -> None:
+    KNOBS[name] = Knob(
+        name, type_, default, floor, choices, doc, default_doc, internal
+    )
+
+
+# --- solver tuning ---------------------------------------------------------
+_knob(
+    "KA_WAVE_MODE", "choice", None,
+    default_doc="auto (seq under RF-decrease compat)",
+    doc="which orphan-spread fallback chain the batched solve compiles "
+        "(`auto`, `fast_balance`, `fast_dense`, ..., validated against "
+        "`ops/assignment.py:WAVE_MODES` at the call site). Chains starting "
+        "with the fast leg emit identical output on every instance the fast "
+        "leg solves; shorter chains compile fewer `while_loop` bodies — "
+        "compile time is a first-class cost when the accelerator compiles "
+        "remotely. Under `KA_RF_DECREASE_COMPAT=1` the default chain is the "
+        "reference-verbatim `seq` leg (byte-parity on orphaned RF decreases)",
+)
+_knob(
+    "KA_LEADER_CHUNK", "int", None, floor=1, default_doc="8 (kernel default)",
+    doc="partitions per leadership scan step (static unroll). Chunk-invariant "
+        "semantics (test-pinned); trades scan-step count against "
+        "compiled-code size",
+)
+_knob(
+    "KA_PLACE_MODE", "choice", "scan", choices=("scan", "vmap"),
+    doc="batched placement stage: `scan` serializes topics through the full "
+        "fallback chain (total work bounds wall clock — the host-CPU trade); "
+        "`vmap` batches the single-leg fast wave across topics and rescues "
+        "stranded topics through the scan chain (trip count bounds wall "
+        "clock — the on-chip trade). Byte-identical output either way "
+        "(`tests/test_place_vmap.py`)",
+)
+_knob(
+    "KA_PLACE_CHUNK", "int", 256, floor=1,
+    doc="topics per vmapped placement block under `KA_PLACE_MODE=vmap` "
+        "(memory bound; the default keeps live wave state in the low "
+        "hundreds of MB at the headline bucket)",
+)
+_knob(
+    "KA_RF_DECREASE_COMPAT", "bool", False,
+    doc="reference bug-compat RF decrease: sticky fill retains every current "
+        "replica passing the node/rack/capacity gates with no per-partition "
+        "RF bound (`KafkaAssignmentStrategy.java:320-324`), emitting the "
+        "reference's non-uniform replica lists. ALL THREE backends are then "
+        "byte-equal with the greedy oracle on every input class; default "
+        "(off) clamps to the requested RF "
+        "(`tests/test_rf_decrease_compat.py`)",
+)
+_knob(
+    "KA_PALLAS_LEADERSHIP", "bool", False,
+    doc="leadership ordering via the Pallas VMEM kernel instead of the "
+        "chunked `lax.scan` (`ops/pallas_leadership.py`). Hardware-validated "
+        "on a v5e: bit-identical and 3.3x faster than the XLA scan at a "
+        "200k-partition topic, but 170x slower than the default host-C++ "
+        "pass (`PALLAS_POSTHUMOUS_r05.json`) — useful only where leadership "
+        "must stay on device; overrides `KA_LEADERSHIP=native` loudly",
+)
+_knob(
+    "KA_LEADERSHIP", "choice", "auto", choices=("auto", "native", "device"),
+    doc="where the sequential leadership-ordering pass runs (`auto` = host "
+        "C++ `native/leadership.py` when buildable — the per-partition "
+        "counter chain is ~ns/step scalar code vs ~us/step as an XLA scan; "
+        "`device` restores the on-device scan, which jit-internal consumers "
+        "like the what-if sweep always use)",
+)
+_knob(
+    "KA_DENSE_MASK_BUDGET", "int", 1 << 27, floor=1,
+    doc="the static giant-shape gate (P_pad x N_pad elements) that demotes "
+        "the dense wave leg, slot-packs the fast waves, and inserts the "
+        "`balance_quota` hybrid before every node-per-wave balance leg. Read "
+        "at trace time (a mid-process change needs `jax.clear_caches()`); "
+        "tests use it to pin the giant-chain machinery on small instances "
+        "(`tests/test_wave_boundaries.py`)",
+)
+_knob(
+    "KA_QUOTA_WAVE_TARGET", "int", 4, floor=1,
+    doc="the `balance_quota` hybrid's per-node per-wave drain divisor "
+        "`ceil(headroom/T)`. The default is the measured optimum of a "
+        "seven-candidate matrix on the saturated showcase "
+        "(`QUOTA_TUNING_r05.json` via `scripts/tune_quota_knobs.py`) — a "
+        "measurement knob, not a tuning suggestion. Trace-time read like "
+        "`KA_DENSE_MASK_BUDGET`",
+)
+_knob(
+    "KA_QUOTA_ENDGAME", "int", 32, floor=1,
+    doc="the `balance_quota` hybrid's endgame handoff: once every rack's "
+        "headroom is at or below this, the proportional-quota drain hands "
+        "over to the corner-free node-per-wave balance wave. Trace-time read "
+        "like `KA_DENSE_MASK_BUDGET`",
+)
+_knob(
+    "KA_WHATIF_INCREMENTAL", "bool", True,
+    doc="the incremental what-if sweep (`parallel/whatif.py`: per scenario, "
+        "only topics hosting removed brokers or failing the clean/capacity "
+        "certificate are re-solved). Set to 0 to force the dense sweep, "
+        "which remains the differential oracle",
+)
+_knob(
+    "KA_WHATIF_MEMBUDGET", "int", 1 << 28, floor=1,
+    doc="scenario-axis memory chunking for the dense what-if sweep: one "
+        "dispatch's per-scenario solver state stays under this many int32 "
+        "elements (default 2^28 = 1 GiB of int32)",
+)
+_knob(
+    "KA_HOSTCODEC", "bool", True,
+    doc="the C dict<->tensor boundary codec (`native/hostcodec.c`). Set to 0 "
+        "to use the numpy reference encode/decode paths "
+        "(differential-tested equal)",
+)
+
+# --- io / metadata backends ------------------------------------------------
+_knob(
+    "KA_ZK_CLIENT", "choice", "auto", choices=("auto", "kazoo", "wire"),
+    doc="live-ZooKeeper client: `kazoo` when installed, else the in-tree "
+        "minimal jute wire client (`io/zkwire.py`, read-only subset — no "
+        "third-party dependency needed for live runs); "
+        "`tests/test_zk_socket.py` smokes both against a real-TCP jute "
+        "server",
+)
+
+# --- runtime / observability ------------------------------------------------
+_knob(
+    "KA_COMPILE_CACHE", "bool", True,
+    doc="persistent XLA compile-cache kill-switch (`utils/compilecache.py`); "
+        "set to 0 to disable",
+)
+_knob(
+    "KA_COMPILE_CACHE_DIR", "str", None, default_doc="`<repo>/.jax_cache`",
+    doc="persistent XLA compile cache location; `bench.py` and the "
+        "`scripts/` probes share one cache so a slow remote compile is paid "
+        "once per machine",
+)
+_knob(
+    "KA_LOG", "choice", "ERROR",
+    choices=("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"),
+    doc="stderr diagnostics level (`utils/logging.py`; stdout stays reserved "
+        "for payload JSON — the reference gets the same split from its "
+        "log4j config)",
+)
+_knob(
+    "KA_PROFILE", "str", None, default_doc="unset (no trace)",
+    doc="capture a `jax.profiler` device trace (TensorBoard/XProf xplane) "
+        "into this directory around each batched solve — phase wall-clocks "
+        "are always in `TpuSolver.last_timers`; this adds the op-level "
+        "device view",
+)
+_knob(
+    "KA_DEVICE_WATCHDOG_S", "float", 0.0, floor=0.0,
+    doc="console entry point probes accelerator init in a subprocess for "
+        "this many seconds and falls back to the CPU backend (with a stderr "
+        "warning) instead of hanging on a wedged TPU tunnel; 0 (default) "
+        "disables the probe",
+)
+_knob(
+    "KA_CLI_CPU_FALLBACK", "bool", False, internal=True,
+    doc="internal handshake set by the watchdog re-exec so the CPU-fallback "
+        "process does not probe again; not meant to be set by operators",
+)
+
+
+_UNSET = object()
+
+
+def _lookup(name: str) -> Knob:
+    try:
+        return KNOBS[name]
+    except KeyError:
+        raise KeyError(
+            f"{name!r} is not a registered knob; declare it in "
+            "kafka_assigner_tpu/utils/env.py (see kalint rule KA003)"
+        ) from None
+
+
+def _warn(msg: str) -> None:
+    print(f"kafka-assigner: {msg}", file=sys.stderr)
+
+
+def knob_default(name: str):
+    """The declared default of a registered knob (KeyError on a typo — the
+    programmatic twin of kalint's KA003)."""
+    return _lookup(name).default
+
+
+def registered_knobs() -> Tuple[Knob, ...]:
+    """All declared knobs, in declaration (= README table) order."""
+    return tuple(KNOBS.values())
+
+
+def env_int(name: str, default=_UNSET, floor=_UNSET):
+    """``int(os.environ[name])`` clamped to the knob's floor; the declared
+    default when unset/empty or non-integer (the latter with a stderr
+    warning). ``default``/``floor`` override the declaration when given."""
+    k = _lookup(name)
+    if default is _UNSET:
+        default = k.default
+    if floor is _UNSET:
+        floor = k.floor
     raw = os.environ.get(name)
     if not raw:
         return default
     try:
-        return max(floor, int(raw))
+        val = int(raw)
     except ValueError:
-        print(
-            f"kafka-assigner: ignoring non-integer {name}={raw!r}",
-            file=sys.stderr,
-        )
+        _warn(f"ignoring non-integer {name}={raw!r}")
         return default
+    return val if floor is None else max(floor, val)
+
+
+def env_float(name: str, default=_UNSET, floor=_UNSET):
+    """``float(os.environ[name])`` clamped to the knob's floor; the declared
+    default when unset/empty or non-numeric (the latter with a stderr
+    warning)."""
+    k = _lookup(name)
+    if default is _UNSET:
+        default = k.default
+    if floor is _UNSET:
+        floor = k.floor
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        val = float(raw)
+    except ValueError:
+        _warn(f"ignoring non-numeric {name}={raw!r}")
+        return default
+    return val if floor is None else max(floor, val)
+
+
+#: The normalized truthiness convention (module docstring).
+_TRUE = frozenset({"1", "true", "yes", "on"})
+_FALSE = frozenset({"0", "false", "no", "off"})
+
+
+def env_bool(name: str, default=_UNSET) -> bool:
+    """Boolean knob under the package truthiness convention; unset/empty means
+    the declared default, anything unrecognized warns and defaults."""
+    k = _lookup(name)
+    if default is _UNSET:
+        default = k.default
+    raw = os.environ.get(name)
+    if not raw:
+        return bool(default)
+    low = raw.strip().lower()
+    if low in _TRUE:
+        return True
+    if low in _FALSE:
+        return False
+    _warn(
+        f"ignoring non-boolean {name}={raw!r} "
+        "(truthy: 1/true/yes/on, falsy: 0/false/no/off)"
+    )
+    return bool(default)
+
+
+def env_choice(name: str, choices=None, default=_UNSET):
+    """Enumerated knob: the raw value must be one of ``choices`` (declared on
+    the knob, or passed for knobs whose choice set lives elsewhere, e.g.
+    ``KA_WAVE_MODE`` against ``ops/assignment.py:WAVE_MODES``). Case and
+    surrounding whitespace are forgiven when the folded form matches;
+    unknown values warn and default."""
+    k = _lookup(name)
+    if choices is None:
+        choices = k.choices
+    if not choices:
+        # Passing raw through unvalidated would be exactly the silent config
+        # drift the house rule forbids — a programming error, not a knob error.
+        raise KeyError(
+            f"{name} is a choice knob with no declared choice set; pass "
+            "choices= at the call site (e.g. KA_WAVE_MODE against "
+            "ops/assignment.py:WAVE_MODES)"
+        )
+    if default is _UNSET:
+        default = k.default
+    raw = os.environ.get(name)
+    if not raw or not raw.strip():
+        return default
+    raw = raw.strip()
+    for cand in (raw, raw.upper(), raw.lower()):
+        if cand in choices:
+            return cand
+    _warn(
+        f"ignoring unknown {name}={raw!r} "
+        f"(expected one of {sorted(choices)})"
+    )
+    return default
+
+
+def env_str(name: str, default=_UNSET):
+    """Free-form string knob (paths, directories); unset/empty means the
+    declared default. No parsing, so nothing to ignore loudly."""
+    k = _lookup(name)
+    if default is _UNSET:
+        default = k.default
+    raw = os.environ.get(name)
+    return raw if raw else default

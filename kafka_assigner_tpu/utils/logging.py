@@ -10,8 +10,9 @@ is controlled by ``KA_LOG`` (default ERROR, same posture as the reference).
 from __future__ import annotations
 
 import logging
-import os
 import sys
+
+from .env import env_choice
 
 _LOGGER_NAME = "kafka_assigner_tpu"
 
@@ -24,6 +25,8 @@ def get_logger(child: str | None = None) -> logging.Logger:
             logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s")
         )
         root.addHandler(handler)
-        root.setLevel(os.environ.get("KA_LOG", "ERROR").upper())
+        # env_choice folds case and falls back loudly on an unknown level
+        # (the raw .upper()+setLevel it replaces crashed on garbage).
+        root.setLevel(env_choice("KA_LOG"))
         root.propagate = False
     return root.getChild(child) if child else root

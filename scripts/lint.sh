@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# The project lint gate: kalint (knob-registry + jit-boundary house rules,
+# rules KA001-KA005), the README knob-table drift check, and ruff (config in
+# pyproject.toml) when installed. Exits non-zero on any finding; invoked by
+# tests/test_lint_gate.py so tier-1 catches regressions without separate CI
+# plumbing.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# CPU platform: lint must never contend for (or hang on) the tunneled chip.
+export JAX_PLATFORMS=cpu
+
+python -m kafka_assigner_tpu.analysis.kalint
+python -m kafka_assigner_tpu.analysis.knobdoc --check
+
+if command -v ruff >/dev/null 2>&1; then
+    ruff check kafka_assigner_tpu tests
+else
+    echo "lint.sh: ruff not installed; skipping ruff check" >&2
+fi

@@ -1,0 +1,333 @@
+"""kalint rule fixtures (each rule tripped and cleared on small snippets), a
+repo-wide clean run, and the loud-fallback contract of the typed knob
+accessors (``utils/env.py`` house rule: mis-set knobs must never silently
+change the measured configuration)."""
+from __future__ import annotations
+
+import pytest
+
+from kafka_assigner_tpu.analysis import kalint
+from kafka_assigner_tpu.utils.env import (
+    KNOBS,
+    env_bool,
+    env_choice,
+    env_float,
+    env_int,
+    env_str,
+    knob_default,
+)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# --- KA001: raw os.environ access to KA_* outside the registry --------------
+
+KA001_SNIPPET = 'import os\nmode = os.environ.get("KA_WAVE_MODE", "auto")\n'
+
+
+def test_ka001_trips_on_raw_environ_get():
+    findings = kalint.lint_source(KA001_SNIPPET, "solvers/foo.py")
+    assert any(f.rule == "KA001" and f.line == 2 for f in findings)
+
+
+@pytest.mark.parametrize("line", [
+    'v = os.environ["KA_LEADER_CHUNK"]',
+    'v = os.getenv("KA_LEADER_CHUNK")',
+    'v = "KA_LEADER_CHUNK" in os.environ',
+    'os.environ["KA_LEADER_CHUNK"] = "4"',
+])
+def test_ka001_trips_on_every_access_form(line):
+    findings = kalint.lint_source(f"import os\n{line}\n", "foo.py")
+    assert "KA001" in rules_of(findings)
+
+
+@pytest.mark.parametrize("src", [
+    'from os import environ\nv = environ.get("KA_LEADER_CHUNK")\n',
+    'from os import environ as env\nv = env["KA_LEADER_CHUNK"]\n',
+    'from os import getenv\nv = getenv("KA_LEADER_CHUNK")\n',
+    'from os import getenv as ge\nv = ge("KA_LEADER_CHUNK")\n',
+    'import os as o\nv = o.environ.get("KA_LEADER_CHUNK")\n',
+    'import os as o\nv = o.getenv("KA_LEADER_CHUNK")\n',
+])
+def test_ka001_trips_on_import_aliases(src):
+    assert "KA001" in rules_of(kalint.lint_source(src, "foo.py"))
+
+
+def test_ka001_exempts_the_registry_module():
+    findings = kalint.lint_source(KA001_SNIPPET, "utils/env.py")
+    assert "KA001" not in rules_of(findings)
+
+
+def test_ka001_ignores_non_knob_environ_access():
+    src = 'import os\nflags = os.environ.get("XLA_FLAGS", "")\n'
+    assert kalint.lint_source(src, "foo.py") == []
+
+
+# --- KA002: host sync / nondeterminism in traced kernel code -----------------
+
+def test_ka002_trips_module_wide_in_kernel_modules():
+    src = "import time\n\ndef helper():\n    return time.time()\n"
+    findings = kalint.lint_source(src, "ops/assignment.py")
+    assert any(f.rule == "KA002" and f.line == 4 for f in findings)
+    # The same code outside kernel modules and outside any jit root is host
+    # driver code — allowed.
+    assert kalint.lint_source(src, "generator.py") == []
+
+
+def test_ka002_trips_inside_jit_rooted_functions():
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "\n"
+        "def kernel(x):\n"
+        "    return np.asarray(x)\n"
+        "\n"
+        "kernel_jit = jax.jit(kernel, static_argnames=())\n"
+    )
+    findings = kalint.lint_source(src, "solvers/custom.py")
+    assert any(f.rule == "KA002" and f.line == 5 for f in findings)
+
+
+def test_ka002_follows_same_module_callees_of_jit_roots():
+    src = (
+        "import jax\n"
+        "import random\n"
+        "\n"
+        "def helper():\n"
+        "    return random.random()\n"
+        "\n"
+        "@jax.jit\n"
+        "def kernel(x):\n"
+        "    return x + helper()\n"
+    )
+    findings = kalint.lint_source(src, "solvers/custom.py")
+    assert any(f.rule == "KA002" and f.line == 5 for f in findings)
+
+
+def test_ka002_banned_calls_catalogue():
+    src = (
+        "import jax, time, random\n"
+        "import numpy as np\n"
+        "\n"
+        "@jax.jit\n"
+        "def kernel(x):\n"
+        "    a = jax.device_get(x)\n"
+        "    b = x.item()\n"
+        "    c = np.random.rand(3)\n"
+        "    d = time.perf_counter()\n"
+        "    return a, b, c, d\n"
+    )
+    findings = [f for f in kalint.lint_source(src, "foo.py") if f.rule == "KA002"]
+    assert {f.line for f in findings} == {6, 7, 8, 9}
+
+
+# --- KA003: unregistered KA_* literals ---------------------------------------
+
+def test_ka003_trips_on_typo_knob():
+    findings = kalint.lint_source('NAME = "KA_TYPO_NOT_A_KNOB"\n', "foo.py")
+    assert "KA003" in rules_of(findings)
+
+
+def test_ka003_accepts_registered_knob_literals():
+    assert kalint.lint_source('NAME = "KA_WAVE_MODE"\n', "foo.py") == []
+
+
+# --- KA004: README knob-table drift ------------------------------------------
+
+def test_ka004_flags_missing_knob():
+    findings = kalint.check_readme("table mentions only KA_WAVE_MODE here",
+                                   knobs=["KA_WAVE_MODE", "KA_LEADER_CHUNK"])
+    assert [f.rule for f in findings] == ["KA004"]
+    assert "KA_LEADER_CHUNK" in findings[0].message
+
+
+def test_ka004_clean_when_all_knobs_present():
+    text = " ".join(KNOBS)
+    assert kalint.check_readme(text) == []
+
+
+def test_ka004_prefix_of_another_knob_is_not_a_match():
+    findings = kalint.check_readme(
+        "only `KA_COMPILE_CACHE_DIR` is documented",
+        knobs=["KA_COMPILE_CACHE", "KA_COMPILE_CACHE_DIR"],
+    )
+    assert [f.rule for f in findings] == ["KA004"]
+    assert "KA_COMPILE_CACHE " in findings[0].message + " "
+
+
+# --- KA005: plan JSON emission outside io/json_io.py -------------------------
+
+KA005_SNIPPET = "import json\n\ndef emit(d):\n    return json.dumps(d)\n"
+
+
+def test_ka005_trips_outside_the_boundary():
+    findings = kalint.lint_source(KA005_SNIPPET, "generator.py")
+    assert any(f.rule == "KA005" and f.line == 4 for f in findings)
+
+
+def test_ka005_exempts_json_io():
+    assert kalint.lint_source(KA005_SNIPPET, "io/json_io.py") == []
+
+
+# --- suppressions ------------------------------------------------------------
+
+def test_suppression_with_reason_silences_the_finding():
+    src = (
+        "import json\n"
+        "\n"
+        "def emit(d):\n"
+        "    return json.dumps(d)  # kalint: disable=KA005 -- fixture payload\n"
+    )
+    assert kalint.lint_source(src, "generator.py") == []
+
+
+def test_suppression_on_the_line_above_also_counts():
+    src = (
+        "import json\n"
+        "\n"
+        "def emit(d):\n"
+        "    # kalint: disable=KA005 -- fixture payload\n"
+        "    return json.dumps(d)\n"
+    )
+    assert kalint.lint_source(src, "generator.py") == []
+
+
+def test_reasonless_suppression_is_a_finding_and_does_not_suppress():
+    src = (
+        "import json\n"
+        "\n"
+        "def emit(d):\n"
+        "    return json.dumps(d)  # kalint: disable=KA005\n"
+    )
+    rules = rules_of(kalint.lint_source(src, "generator.py"))
+    assert rules == {"KA000", "KA005"}
+
+
+def test_suppression_only_covers_named_rules():
+    src = (
+        "import os\n"
+        "v = os.environ.get('KA_WAVE_MODE')  # kalint: disable=KA005 -- wrong rule\n"
+    )
+    assert "KA001" in rules_of(kalint.lint_source(src, "foo.py"))
+
+
+def test_suppression_syntax_inside_strings_is_inert():
+    # Documenting the syntax in a docstring or literal must neither install
+    # a suppression nor trip the reasonless-suppression meta rule.
+    src = (
+        '"""Docs: write # kalint: disable=KA005 to suppress."""\n'
+        "import json\n"
+        "\n"
+        "def emit(d):\n"
+        "    s = 'ex: # kalint: disable=KA005 -- quoted reason'\n"
+        "    return json.dumps(d), s\n"
+    )
+    assert rules_of(kalint.lint_source(src, "generator.py")) == {"KA005"}
+
+
+# --- the package itself is clean ---------------------------------------------
+
+def test_package_is_kalint_clean():
+    findings = kalint.lint_package()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# --- typed accessor house rule: warn loudly, fall back ----------------------
+
+def test_env_float_warns_and_defaults_on_garbage(monkeypatch, capsys):
+    # The KA_DEVICE_WATCHDOG_S bugfix: a bare float() here used to crash the
+    # CLI on garbage instead of warning-and-defaulting.
+    monkeypatch.setenv("KA_DEVICE_WATCHDOG_S", "ten seconds")
+    assert env_float("KA_DEVICE_WATCHDOG_S") == 0.0
+    assert "ignoring non-numeric KA_DEVICE_WATCHDOG_S" in capsys.readouterr().err
+
+
+def test_env_float_parses_and_clamps(monkeypatch):
+    monkeypatch.setenv("KA_DEVICE_WATCHDOG_S", "12.5")
+    assert env_float("KA_DEVICE_WATCHDOG_S") == 12.5
+    monkeypatch.setenv("KA_DEVICE_WATCHDOG_S", "-3")
+    assert env_float("KA_DEVICE_WATCHDOG_S") == 0.0  # floor
+
+
+def test_env_int_warns_and_defaults_on_garbage(monkeypatch, capsys):
+    monkeypatch.setenv("KA_PLACE_CHUNK", "many")
+    assert env_int("KA_PLACE_CHUNK") == 256
+    assert "ignoring non-integer KA_PLACE_CHUNK" in capsys.readouterr().err
+    monkeypatch.setenv("KA_PLACE_CHUNK", "-5")
+    assert env_int("KA_PLACE_CHUNK") == 1  # floor clamp
+
+
+@pytest.mark.parametrize("raw,expected", [
+    ("1", True), ("true", True), ("YES", True), ("on", True),
+    ("0", False), ("false", False), ("No", False), ("off", False),
+])
+def test_env_bool_truthiness_convention(monkeypatch, raw, expected):
+    monkeypatch.setenv("KA_RF_DECREASE_COMPAT", raw)
+    assert env_bool("KA_RF_DECREASE_COMPAT") is expected
+
+
+def test_env_bool_warns_and_defaults_on_garbage(monkeypatch, capsys):
+    monkeypatch.setenv("KA_RF_DECREASE_COMPAT", "maybe")
+    assert env_bool("KA_RF_DECREASE_COMPAT") is False
+    assert "ignoring non-boolean KA_RF_DECREASE_COMPAT" in capsys.readouterr().err
+    monkeypatch.delenv("KA_RF_DECREASE_COMPAT")
+    assert env_bool("KA_RF_DECREASE_COMPAT") is False
+    # A default-on bool keeps its default under garbage too (loudly).
+    monkeypatch.setenv("KA_HOSTCODEC", "maybe")
+    assert env_bool("KA_HOSTCODEC") is True
+    assert "KA_HOSTCODEC" in capsys.readouterr().err
+
+
+def test_env_choice_warns_and_defaults_on_unknown(monkeypatch, capsys):
+    monkeypatch.setenv("KA_ZK_CLIENT", "thrift")
+    assert env_choice("KA_ZK_CLIENT") == "auto"
+    assert "ignoring unknown KA_ZK_CLIENT" in capsys.readouterr().err
+
+
+def test_env_choice_folds_case(monkeypatch):
+    monkeypatch.setenv("KA_LOG", "debug")
+    assert env_choice("KA_LOG") == "DEBUG"
+
+
+def test_env_choice_strips_whitespace(monkeypatch, capsys):
+    # Same forgiveness as env_bool: shell-export padding is not a misconfig.
+    monkeypatch.setenv("KA_LOG", " DEBUG ")
+    assert env_choice("KA_LOG") == "DEBUG"
+    assert capsys.readouterr().err == ""
+
+
+def test_env_choice_without_a_choice_set_is_a_programming_error():
+    # KA_WAVE_MODE's choice set lives at the call site (WAVE_MODES); reading
+    # it without one must raise, never pass raw through unvalidated.
+    with pytest.raises(KeyError, match="no declared choice set"):
+        env_choice("KA_WAVE_MODE")
+
+
+def test_env_str_returns_raw_or_default(monkeypatch):
+    monkeypatch.delenv("KA_PROFILE", raising=False)
+    assert env_str("KA_PROFILE") is None
+    monkeypatch.setenv("KA_PROFILE", "/tmp/trace")
+    assert env_str("KA_PROFILE") == "/tmp/trace"
+
+
+def test_unregistered_knob_is_a_programming_error():
+    with pytest.raises(KeyError, match="not a registered knob"):
+        env_int("KA_NOT_A_REGISTERED_KNOB")
+    with pytest.raises(KeyError, match="not a registered knob"):
+        knob_default("KA_NOT_A_REGISTERED_KNOB")
+
+
+def test_registry_defaults_match_kernel_constants():
+    # The registry is the single declaration; the ops constants must be
+    # derived from it, not drift beside it.
+    from kafka_assigner_tpu.ops.assignment import (
+        DENSE_MASK_BUDGET,
+        QUOTA_ENDGAME_HEADROOM,
+        QUOTA_WAVE_TARGET,
+    )
+
+    assert DENSE_MASK_BUDGET == knob_default("KA_DENSE_MASK_BUDGET")
+    assert QUOTA_WAVE_TARGET == knob_default("KA_QUOTA_WAVE_TARGET")
+    assert QUOTA_ENDGAME_HEADROOM == knob_default("KA_QUOTA_ENDGAME")

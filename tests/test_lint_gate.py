@@ -1,0 +1,37 @@
+"""The lint gate runs inside tier-1: ``scripts/lint.sh`` must exit 0 on the
+committed tree, and the kalint CLI must fail loudly (rule ID + file:line) on
+a file that violates the house rules — the regression wire for the whole
+static-analysis subsystem without separate CI plumbing."""
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_lint_sh_is_green_on_the_tree():
+    proc = subprocess.run(
+        ["bash", str(ROOT / "scripts" / "lint.sh")],
+        capture_output=True, text=True, cwd=ROOT, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_kalint_cli_fails_on_violations_with_rule_and_location(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import os\n"
+        'mode = os.environ.get("KA_TYPO_KNOB")\n',
+        encoding="utf-8",
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "kafka_assigner_tpu.analysis.kalint", str(bad)],
+        capture_output=True, text=True, cwd=ROOT, timeout=300,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": str(ROOT)},
+    )
+    assert proc.returncode == 1
+    assert "KA001" in proc.stdout and "KA003" in proc.stdout
+    assert f"{bad}:2" in proc.stdout  # file:line in the finding
