@@ -21,6 +21,9 @@ KA004  a registered knob missing from the README knob table (docs drift;
        the table is generated — ``python -m ...analysis.knobdoc --write``)
 KA005  plan/golden JSON emission (``json.dumps``/``json.dump``) outside
        ``io/json_io.py``'s byte-compat helpers
+KA006  a ``jnp.`` / ``jax.numpy`` call at module import time (module scope,
+       class bodies, decorators, default arguments) — imports must stay
+       cheap and backend-agnostic; build arrays lazily inside functions
 ====== =====================================================================
 
 Suppression: put ``# kalint: disable=KA002 -- <reason>`` on the offending
@@ -50,6 +53,7 @@ RULES = {
     "KA003": "KA_* string literal does not resolve to a registered knob",
     "KA004": "registered knob missing from the README knob table",
     "KA005": "plan JSON emission outside io/json_io.py",
+    "KA006": "jnp./jax.numpy call at module import time",
 }
 
 #: Modules whose ENTIRE body is treated as traced kernel code (KA002): these
@@ -369,6 +373,70 @@ def _check_ka005(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
     return out
 
 
+def _jnp_module_aliases(tree: ast.AST) -> Set[str]:
+    """Names this module binds to ``jax.numpy``: ``import jax.numpy as X``
+    and ``from jax import numpy as X``. The conventional ``jnp`` is always
+    included — most modules import it lazily inside functions, and a stray
+    module-level ``jnp.zeros(...)`` pasted above such an import is exactly
+    the bug class KA006 exists for (NameError today, silent backend init
+    after the next refactor)."""
+    aliases = {"jnp"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax.numpy" and alias.asname:
+                    aliases.add(alias.asname)
+        elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name == "numpy":
+                    aliases.add(alias.asname or "numpy")
+    return aliases
+
+
+def _deferred_nodes(tree: ast.AST) -> Set[int]:
+    """ids of AST nodes that do NOT execute at import time: function and
+    lambda bodies. Decorators, default arguments, and class bodies all run
+    at import and are deliberately left in."""
+    deferred: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    deferred.add(id(sub))
+        elif isinstance(node, ast.Lambda):
+            for sub in ast.walk(node.body):
+                deferred.add(id(sub))
+    return deferred
+
+
+def _check_ka006(tree: ast.AST, path: str) -> List[Finding]:
+    aliases = _jnp_module_aliases(tree)
+    deferred = _deferred_nodes(tree)
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if id(node) in deferred or not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        parts: List[str] = []
+        while isinstance(f, ast.Attribute):
+            parts.append(f.attr)
+            f = f.value
+        if not isinstance(f, ast.Name) or not parts:
+            continue
+        root = f.id
+        # `jnp.zeros(...)` (any registered alias) or the spelled-out
+        # `jax.numpy.zeros(...)` chain; `jax.jit(...)` etc. stay legal.
+        if root in aliases or (root == "jax" and parts[-1] == "numpy"):
+            dotted = ".".join([root] + list(reversed(parts)))
+            out.append(Finding(
+                "KA006", path, node.lineno, node.col_offset + 1,
+                f"{dotted}(...) at module import time (imports must stay "
+                "cheap and backend-agnostic; build arrays lazily inside "
+                "functions)",
+            ))
+    return out
+
+
 def check_readme(readme_text: str, knobs=None, path: str = "README.md"):
     """KA004: every registered knob must appear in the README (the generated
     knob table keeps this true; drift means the table is stale)."""
@@ -422,6 +490,7 @@ def lint_source(
         + _check_ka002(tree, relpath, path)
         + _check_ka003(tree, set(knobs), path)
         + _check_ka005(tree, relpath, path)
+        + _check_ka006(tree, path)
     )
     for f in raw:
         if f.rule in suppress.get(f.line, ()):  # reasoned suppression

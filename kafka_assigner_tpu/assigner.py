@@ -136,7 +136,7 @@ class TopicAssigner:
             # One device trace per batched solve (SURVEY.md §5: the
             # reference has no profiling at all; solve latency is our
             # headline metric). View with TensorBoard/XProf.
-            from .utils.timers import device_trace
+            from .obs.profile import device_trace
 
             trace_ctx = device_trace(profile_dir)
         with trace_ctx:

@@ -94,6 +94,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(loaded if present, saved after PRINT_REASSIGNMENT) "
                         "so repeated partial reassignments keep balancing "
                         "leaders cluster-wide")
+    p.add_argument("--report-json", dest="report_json", default=None,
+                   metavar="PATH",
+                   help="emit a schema-versioned machine-readable run report "
+                        "(tracing spans, metrics, plan stats) to PATH, plus "
+                        "a human summary on stderr; implies observability "
+                        "collection for this run (see KA_OBS_* knobs)")
     return p
 
 
@@ -117,6 +123,55 @@ def run_tool(argv: Optional[List[str]] = None) -> int:
 
     topics = args.topics.split(",") if args.topics is not None else None
 
+    from .utils.env import env_bool, env_str
+
+    # Observability capture (obs/): explicit opt-in via --report-json, the
+    # KA_OBS_REPORT default path, or KA_OBS_ENABLE=1. Off (the default) the
+    # dispatch below runs with the obs no-op singletons — byte-identical
+    # behavior and output (test-pinned).
+    report_path = args.report_json or env_str("KA_OBS_REPORT")
+    if report_path is None and not env_bool("KA_OBS_ENABLE"):
+        return _dispatch_mode(args, topics)
+
+    from . import obs
+
+    with obs.run_capture() as run:
+        status, error, rc = "error", None, 1
+        try:
+            with obs.span(f"mode/{args.mode}") as sp:
+                rc = _dispatch_mode(args, topics)
+                if rc != 0:
+                    # Failure signaled by return code, not exception (e.g.
+                    # the rack-blind backend refusal): the span must agree
+                    # with the report's top-level status.
+                    sp.fail()
+            status = "ok" if rc == 0 else "error"
+            return rc
+        except BaseException as e:
+            # The bugfix contract: a solve that raises mid-phase must still
+            # flush its spans (their __exit__ ran during unwinding, marked
+            # error) and emit the report — losing all timing data on the
+            # failing runs is losing it exactly when it matters most.
+            error = e
+            raise
+        finally:
+            # Emission must never mask the run's own outcome: a report that
+            # cannot even be built (e.g. a non-serializable metric value from
+            # a future instrumentation site) is reported on stderr, and the
+            # solve's exception/exit status always wins.
+            try:
+                report = obs.build_report(
+                    run, status=status, mode=args.mode,
+                    argv=list(argv) if argv is not None else sys.argv[1:],
+                    error=error,
+                )
+                obs.emit_report(report, report_path)
+            except Exception as e:
+                print(f"obs: could not emit run report: {e}", file=sys.stderr)
+
+
+def _dispatch_mode(args, topics) -> int:
+    """Backend open → mode dispatch → close (the pre-obs ``run_tool`` body)."""
     # Fail fast on an unavailable solver backend, before any metadata is read
     # or partial output emitted.
     get_solver(args.solver)

@@ -17,6 +17,7 @@ import sys
 from typing import Dict, List, Optional, Sequence, Set, TextIO
 
 from .assigner import TopicAssigner
+from .obs import gauge_set, obs_active, span
 from .solvers.base import Context
 from .io.base import BrokerInfo, MetadataBackend
 from .validate import validate_cluster_feasibility
@@ -206,10 +207,11 @@ def print_decommission_ranking(
     racks = {k: v for k, v in rack_assignment.items() if k in brokers}
     if scenario_file is not None:
         scenarios = load_scenario_file(scenario_file, live_brokers)
-        results = evaluate_removal_scenarios(
-            topic_map, brokers, racks, scenarios,
-            desired_replication_factor, mesh=mesh,
-        )
+        with span("whatif/rank"):
+            results = evaluate_removal_scenarios(
+                topic_map, brokers, racks, scenarios,
+                desired_replication_factor, mesh=mesh,
+            )
         ranked = sorted(
             results,
             key=lambda r: (not r.feasible, r.moved_replicas, r.removed),
@@ -224,11 +226,12 @@ def print_decommission_ranking(
             for r in ranked
         ]
     else:
-        ranked = rank_decommission_candidates(
-            topic_map, brokers, racks,
-            sorted(candidate_brokers) if candidate_brokers else None,
-            desired_replication_factor, mesh=mesh,
-        )
+        with span("whatif/rank"):
+            ranked = rank_decommission_candidates(
+                topic_map, brokers, racks,
+                sorted(candidate_brokers) if candidate_brokers else None,
+                desired_replication_factor, mesh=mesh,
+            )
         rows = [
             {
                 "broker": r.removed[0],
@@ -262,17 +265,46 @@ def print_fresh_assignment(
     brokers = {b.id for b in live_brokers}
     solver = get_solver("tpu")  # clean NotImplementedError when jax is absent
     context = Context()
-    pairs = [
-        (
-            topic,
-            solver.fresh_assignment(
-                topic, partition_count, brokers, rack_assignment,
-                replication_factor, context,
-            ),
-        )
-        for topic in topics
-    ]
+    with span("plan/fresh"):
+        pairs = [
+            (
+                topic,
+                solver.fresh_assignment(
+                    topic, partition_count, brokers, rack_assignment,
+                    replication_factor, context,
+                ),
+            )
+            for topic in topics
+        ]
+    if obs_active():
+        record_plan_stats({}, pairs)
     print("FRESH ASSIGNMENT:\n" + format_reassignment_pairs(pairs), file=out)
+
+
+def record_plan_stats(
+    initial: Dict[str, Dict[int, List[int]]],
+    final_pairs: Sequence[tuple],
+) -> None:
+    """Plan-disruption gauges (``plan.*`` → the run report's ``plan``
+    section): moved replicas (new broker acquisitions, the what-if sweep's
+    disruption metric), leader churn (partitions whose preferred leader —
+    replica slot 0 — changed), and plan size. Call sites gate on
+    ``obs_active`` so the disabled mode never pays the diff."""
+    moves = churn = partitions = 0
+    for topic, new in final_pairs:
+        old = initial.get(topic, {})
+        for p, replicas in new.items():
+            partitions += 1
+            before = list(old.get(p, []))
+            moves += len(set(replicas) - set(before))
+            lead_new = replicas[0] if replicas else None
+            lead_old = before[0] if before else None
+            if lead_new != lead_old:
+                churn += 1
+    gauge_set("plan.moves", moves)
+    gauge_set("plan.leader_churn", churn)
+    gauge_set("plan.topics", len(final_pairs))
+    gauge_set("plan.partitions", partitions)
 
 
 def print_least_disruptive_reassignment(
@@ -306,7 +338,8 @@ def print_least_disruptive_reassignment(
 
     topic_list = list(topics) if topics is not None else backend.all_topics()
 
-    initial = backend.partition_assignment(topic_list)
+    with span("metadata/assignment"):
+        initial = backend.partition_assignment(topic_list)
 
     # Rollback snapshot first (KafkaAssignmentGenerator.java:159-160), from
     # the same read the solver uses.
@@ -316,10 +349,11 @@ def print_least_disruptive_reassignment(
     # Up-front feasibility report on stderr — the reference only discovers
     # infeasibility mid-solve (KafkaAssignmentStrategy.java:183-184); the
     # solver's hard error remains the backstop.
-    issues = validate_cluster_feasibility(
-        [(t, initial[t]) for t in topic_list], brokers, rack_assignment,
-        desired_replication_factor,
-    )
+    with span("feasibility"):
+        issues = validate_cluster_feasibility(
+            [(t, initial[t]) for t in topic_list], brokers, rack_assignment,
+            desired_replication_factor,
+        )
     for issue in issues:
         # Straight to stderr, not through the (default-ERROR) logger: the
         # operator about to apply a reassignment must see these unprompted,
@@ -341,13 +375,17 @@ def print_least_disruptive_reassignment(
             raise ValueError(
                 f"invalid leadership context file {context_file!r}: {e}"
             ) from e
-    final_pairs = assigner.generate_assignments(
-        [(topic, initial[topic]) for topic in topic_list],
-        brokers,
-        rack_assignment,
-        desired_replication_factor,
-    )
-    payload = format_reassignment_pairs(final_pairs)
+    with span("plan/solve"):
+        final_pairs = assigner.generate_assignments(
+            [(topic, initial[topic]) for topic in topic_list],
+            brokers,
+            rack_assignment,
+            desired_replication_factor,
+        )
+    if obs_active():
+        record_plan_stats(initial, final_pairs)
+    with span("plan/emit"):
+        payload = format_reassignment_pairs(final_pairs)
     print("NEW ASSIGNMENT:\n" + payload, file=out)
     # Save after the payload is out: a failing save (unwritable path, disk
     # full) must never discard a completed solve.

@@ -22,6 +22,7 @@ import sys
 
 from typing import Dict, List, Sequence
 
+from ..obs.metrics import counter_add, hist_ms
 from .base import BrokerInfo
 
 
@@ -51,8 +52,10 @@ class KafkaAdminBackend:
                 ) from e
 
     def brokers(self) -> List[BrokerInfo]:
+        counter_add("zk.reads")  # metadata-op namespace, any backend
         if self._impl == "confluent":
-            md = self._admin.list_topics(timeout=10)
+            with hist_ms("zk.op_ms"):
+                md = self._admin.list_topics(timeout=10)
             if not self._warned_rack_blind:
                 self._warned_rack_blind = True
                 print(
@@ -67,7 +70,8 @@ class KafkaAdminBackend:
                 BrokerInfo(id=b.id, host=b.host, port=b.port, rack=None)
                 for b in sorted(md.brokers.values(), key=lambda b: b.id)
             ]
-        cluster = self._admin.describe_cluster()
+        with hist_ms("zk.op_ms"):
+            cluster = self._admin.describe_cluster()
         return [
             BrokerInfo(
                 id=int(b["node_id"]), host=b["host"], port=int(b["port"]),
@@ -77,23 +81,32 @@ class KafkaAdminBackend:
         ]
 
     def all_topics(self) -> List[str]:
+        counter_add("zk.reads")
         if self._impl == "confluent":
-            return sorted(self._admin.list_topics(timeout=10).topics)
-        return sorted(self._admin.list_topics())
+            with hist_ms("zk.op_ms"):
+                md = self._admin.list_topics(timeout=10)
+            return sorted(md.topics)
+        with hist_ms("zk.op_ms"):
+            names = self._admin.list_topics()
+        return sorted(names)
 
     def partition_assignment(
         self, topics: Sequence[str]
     ) -> Dict[str, Dict[int, List[int]]]:
+        counter_add("zk.reads")
         out: Dict[str, Dict[int, List[int]]] = {}
         if self._impl == "confluent":
-            md = self._admin.list_topics(timeout=10)
+            with hist_ms("zk.op_ms"):
+                md = self._admin.list_topics(timeout=10)
             for topic in topics:
                 tmeta = md.topics[topic]
                 out[topic] = {
                     int(p): list(pm.replicas) for p, pm in tmeta.partitions.items()
                 }
             return out
-        for t in self._admin.describe_topics(topics):
+        with hist_ms("zk.op_ms"):
+            described = self._admin.describe_topics(topics)
+        for t in described:
             out[t["topic"]] = {
                 int(p["partition"]): [int(r) for r in p["replicas"]]
                 for p in t["partitions"]

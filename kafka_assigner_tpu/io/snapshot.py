@@ -19,14 +19,21 @@ from __future__ import annotations
 import json
 from typing import Dict, List, Sequence
 
+from ..obs.metrics import counter_add
 from .base import BrokerInfo
 
 
 class SnapshotBackend:
     def __init__(self, path: str) -> None:
         self.path = path
-        with open(path, "r", encoding="utf-8") as f:
-            data = json.load(f)
+        with open(path, "rb") as f:
+            raw = f.read()
+        # zk.* is the metadata-op namespace for EVERY backend (obs/metrics
+        # docstring): one counter answers "how much metadata I/O" whether
+        # the run was live or hermetic.
+        counter_add("zk.reads")
+        counter_add("zk.bytes", len(raw))
+        data = json.loads(raw)
         self._brokers = [
             BrokerInfo(
                 id=int(b["id"]),

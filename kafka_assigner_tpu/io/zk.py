@@ -18,6 +18,8 @@ from __future__ import annotations
 import json
 from typing import Dict, List, Sequence
 
+from ..obs.metrics import counter_add
+from ..obs.trace import span
 from .base import BrokerInfo
 
 # Session/connect timeouts follow the reference: new ZkClient(zk, 10000, 10000)
@@ -73,29 +75,41 @@ class ZkBackend:
 
     def brokers(self) -> List[BrokerInfo]:
         out = []
-        for bid in sorted(self._zk.get_children("/brokers/ids"), key=int):
-            raw, _ = self._zk.get(f"/brokers/ids/{bid}")
-            meta = json.loads(raw)
-            host, port = _resolve_endpoint(meta, bid)
-            out.append(
-                BrokerInfo(id=int(bid), host=host, port=port, rack=meta.get("rack"))
-            )
+        with span("zk/brokers"):
+            children = sorted(self._zk.get_children("/brokers/ids"), key=int)
+            counter_add("zk.reads")
+            for bid in children:
+                raw, _ = self._zk.get(f"/brokers/ids/{bid}")
+                counter_add("zk.reads")
+                counter_add("zk.bytes", len(raw))
+                meta = json.loads(raw)
+                host, port = _resolve_endpoint(meta, bid)
+                out.append(
+                    BrokerInfo(
+                        id=int(bid), host=host, port=port,
+                        rack=meta.get("rack"),
+                    )
+                )
         return out
 
     def all_topics(self) -> List[str]:
+        counter_add("zk.reads")
         return sorted(self._zk.get_children("/brokers/topics"))
 
     def partition_assignment(
         self, topics: Sequence[str]
     ) -> Dict[str, Dict[int, List[int]]]:
         out: Dict[str, Dict[int, List[int]]] = {}
-        for topic in topics:
-            raw, _ = self._zk.get(f"/brokers/topics/{topic}")
-            meta = json.loads(raw)
-            out[topic] = {
-                int(p): [int(x) for x in replicas]
-                for p, replicas in meta.get("partitions", {}).items()
-            }
+        with span("zk/partition_assignment"):
+            for topic in topics:
+                raw, _ = self._zk.get(f"/brokers/topics/{topic}")
+                counter_add("zk.reads")
+                counter_add("zk.bytes", len(raw))
+                meta = json.loads(raw)
+                out[topic] = {
+                    int(p): [int(x) for x in replicas]
+                    for p, replicas in meta.get("partitions", {}).items()
+                }
         return out
 
     def close(self) -> None:

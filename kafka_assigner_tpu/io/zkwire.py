@@ -27,6 +27,8 @@ import socket
 import struct
 from typing import List, NamedTuple, Optional, Tuple
 
+from ..obs.metrics import counter_add, hist_ms
+
 #: ZooKeeper opcodes (zookeeper.ZooDefs.OpCode).
 OP_GET_DATA = 4
 OP_GET_CHILDREN = 8
@@ -176,6 +178,8 @@ class MiniZkClient:
 
     def _send_frame(self, payload: bytes) -> None:
         assert self._sock is not None
+        counter_add("zk.wire_frames_out")
+        counter_add("zk.wire_bytes_out", 4 + len(payload))
         self._sock.sendall(struct.pack(">i", len(payload)) + payload)
 
     def _recv_frame(self) -> bytes:
@@ -184,6 +188,8 @@ class MiniZkClient:
         (n,) = struct.unpack(">i", header)
         if n < 0 or n > (64 << 20):
             raise ZkWireError(f"invalid ZooKeeper frame length {n}")
+        counter_add("zk.wire_frames_in")
+        counter_add("zk.wire_bytes_in", 4 + n)
         return self._recv_exact(n)
 
     def _recv_exact(self, n: int) -> bytes:
@@ -202,6 +208,13 @@ class MiniZkClient:
             raise ZkWireError("ZooKeeper session is not started")
         self._xid += 1
         xid = self._xid
+        # Metrics-only timing (hist_ms): one RPC per znode is too hot for
+        # the span log, but the latency distribution is exactly what a
+        # fleet-scale run needs to see.
+        with hist_ms("zk.op_ms"):
+            return self._call_inner(op, xid, payload)
+
+    def _call_inner(self, op: int, xid: int, payload: bytes) -> _Reader:
         self._send_frame(struct.pack(">ii", xid, op) + payload)
         while True:
             r = _Reader(self._recv_frame())

@@ -1,0 +1,54 @@
+"""``obs`` — the process-wide observability subsystem.
+
+Three coupled pieces (ISSUE 3 tentpole; SURVEY.md §5 notes the reference has
+no tracing or profiling of any kind):
+
+- **tracing spans** (:mod:`.trace`): hierarchical, wall-clock, failure-aware
+  timing of host phases (``span("encode")``), collected per captured run;
+- **metrics registry** (:mod:`.metrics`): counters / gauges / histograms
+  (``zk.reads``, ``encode.pad_waste_frac``, ``whatif.scenarios``, ...);
+- **run reports** (:mod:`.report`): one stable, schema-versioned JSON
+  artifact per CLI run (``--report-json PATH`` / ``KA_OBS_REPORT``) plus a
+  human summary on stderr — bench scripts and service modes consume the
+  artifact instead of scraping logs.
+
+Contracts: zero overhead when disabled (no capture active → shared no-op
+span singleton, metric calls are one ``None`` check, no files); importing
+this package never touches jax (kalint KA006); spans wrap host work only —
+never code inside a jit trace (kalint KA002). Knobs: ``KA_OBS_ENABLE``,
+``KA_OBS_REPORT``, ``KA_OBS_HIST_EDGES`` (registry: ``utils/env.py``).
+"""
+from __future__ import annotations
+
+from .metrics import (
+    counter_add,
+    gauge_set,
+    hist_ms,
+    hist_observe,
+    obs_active,
+)
+from .profile import device_trace
+from .report import (
+    REPORT_SCHEMA_VERSION,
+    build_report,
+    emit_report,
+    validate_report,
+)
+from .trace import RunCollector, active_run, run_capture, span
+
+__all__ = [
+    "REPORT_SCHEMA_VERSION",
+    "RunCollector",
+    "active_run",
+    "build_report",
+    "counter_add",
+    "device_trace",
+    "emit_report",
+    "gauge_set",
+    "hist_ms",
+    "hist_observe",
+    "obs_active",
+    "run_capture",
+    "span",
+    "validate_report",
+]

@@ -1,0 +1,121 @@
+"""Metric write API: counters, gauges, histograms on the active run.
+
+Every function here is a no-op costing one attribute read and one ``None``
+check when no run is captured (``obs/trace.py`` activation model) — cheap
+enough for per-znode and per-dispatch call sites. Names are dotted,
+lowercase, and stable: they are the run report's public surface.
+
+Namespace conventions (documented in the README "Observability" section):
+
+- ``zk.*``      metadata-layer op counts/bytes — named after the reference's
+  ZooKeeper layer; the snapshot and Kafka-admin backends count here too, so
+  one query answers "how much metadata I/O did this run do" regardless of
+  backend;
+- ``encode.*``  host→device canonicalization (pad waste, group shape);
+- ``plan.*``    gauges lifted into the report's ``plan`` section (moves,
+  leader churn, topic/partition counts);
+- ``whatif.*``  scenario-sweep fan-out and dispatch metrics;
+- ``greedy.*`` / ``native.*``  per-backend solve counters.
+
+Histogram bucket upper edges come from ``KA_OBS_HIST_EDGES`` (ms for timing
+histograms); one shared edge set keeps reports comparable across runs.
+"""
+from __future__ import annotations
+
+import math
+import sys
+import time
+from typing import Tuple
+
+from . import trace
+
+#: Default histogram bucket upper edges (last bucket is the overflow).
+DEFAULT_HIST_EDGES: Tuple[float, ...] = (
+    1.0, 5.0, 25.0, 100.0, 500.0, 2500.0, 10000.0
+)
+
+
+def obs_active() -> bool:
+    """True when a run capture is recording — gate for metric computations
+    that are themselves non-trivial (e.g. plan diff stats)."""
+    return trace._ACTIVE is not None
+
+
+def counter_add(name: str, n: int = 1) -> None:
+    run = trace._ACTIVE
+    if run is not None:
+        run.counter_add(name, n)
+
+
+def gauge_set(name: str, value) -> None:
+    run = trace._ACTIVE
+    if run is not None:
+        run.gauge_set(name, value)
+
+
+def hist_observe(name: str, value: float) -> None:
+    run = trace._ACTIVE
+    if run is not None:
+        run.hist_observe(name, value)
+
+
+class _HistTimer:
+    """Metrics-only timer: observes elapsed ms into a histogram without
+    creating a span record (for per-op sites too hot for the span log,
+    e.g. one ZooKeeper RPC per znode)."""
+
+    __slots__ = ("_run", "_name", "_t0")
+
+    def __init__(self, run, name) -> None:
+        self._run = run
+        self._name = name
+
+    def __enter__(self) -> None:
+        self._t0 = time.perf_counter()
+        return None
+
+    def __exit__(self, *exc) -> bool:
+        self._run.hist_observe(
+            self._name, (time.perf_counter() - self._t0) * 1000.0
+        )
+        return False
+
+
+def hist_ms(name: str):
+    """Context manager observing the block's wall ms into histogram
+    ``name``; the shared no-op singleton when disabled."""
+    run = trace._ACTIVE
+    if run is None:
+        return trace.NULL_SPAN
+    return _HistTimer(run, name)
+
+
+def resolve_hist_edges() -> Tuple[float, ...]:
+    """Bucket edges from ``KA_OBS_HIST_EDGES`` (comma-separated floats,
+    sorted ascending). Malformed values are ignored LOUDLY and the default
+    edge set is used — the house rule for every knob (utils/env.py)."""
+    from ..utils.env import env_str
+
+    raw = env_str("KA_OBS_HIST_EDGES")
+    if not raw:
+        return DEFAULT_HIST_EDGES
+    try:
+        edges = tuple(sorted(float(t) for t in raw.split(",") if t.strip()))
+    except ValueError:
+        edges = ()
+    # nan/inf parse as floats but break bucketing (`value > nan` is always
+    # False), duplicates make unreachable phantom buckets (and zero-width
+    # ones for consumers deriving widths), and non-positive edges are dead
+    # buckets for ms values — all malformed, all rejected loudly.
+    if not all(
+        math.isfinite(e) and e > 0 for e in edges
+    ) or len(set(edges)) != len(edges):
+        edges = ()
+    if not edges:
+        print(
+            f"kafka-assigner: ignoring malformed KA_OBS_HIST_EDGES={raw!r} "
+            "(expected comma-separated distinct positive numbers)",
+            file=sys.stderr,
+        )
+        return DEFAULT_HIST_EDGES
+    return edges

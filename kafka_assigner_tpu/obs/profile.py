@@ -1,0 +1,22 @@
+"""Device-profiler hook: the op-level view the span log cannot give.
+
+Spans record host wall-clock per phase; ``device_trace`` captures a full
+``jax.profiler`` trace (TensorBoard/XProf xplane) of everything inside the
+block — wired to each batched solve by ``KA_PROFILE=<dir>``
+(``assigner.py``). Lives in ``obs/`` (it IS observability) but imports jax
+strictly lazily: importing this package must never initialize a backend
+(kalint KA006 posture).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """Capture a device profile (TPU trace) for everything in the block."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

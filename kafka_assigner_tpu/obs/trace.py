@@ -1,0 +1,237 @@
+"""Hierarchical tracing spans and the process-wide run collector.
+
+The reference has no tracing or profiling of any kind (SURVEY.md §5), yet
+solve latency is this repro's headline metric. A *span* is one timed,
+nameable section of host work (``span("encode")``); spans nest, record wall
+time, and mark failure status when an exception unwinds through them. All
+records land on the active :class:`RunCollector` — one per captured run —
+which also owns the metrics registry (``obs/metrics.py`` writes into it).
+
+Activation model — explicit, never ambient: nothing records until a caller
+(normally the CLI, via ``--report-json`` or ``KA_OBS_ENABLE=1``) enters
+:func:`run_capture`. With no active run every ``span(...)`` call returns one
+shared no-op singleton and every metric call is a single ``None`` check:
+zero allocation, zero syscalls, zero report files — the disabled mode is
+byte-identical to a build without this package (test-pinned).
+
+House constraints: this module must import without touching jax (kalint
+KA006 — the CLI imports it before any backend is up), and spans must only
+ever wrap HOST work — a span inside jit-traced code would be a host sync
+(kalint KA002 keeps that impossible in kernel modules).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+#: Hard cap on recorded spans per run: a runaway per-partition loop must not
+#: turn the report into a multi-GB artifact. Overflow is counted, not silent
+#: (``spans_dropped`` in the report — no silent caps).
+MAX_SPANS = 4096
+
+
+class RunCollector:
+    """All observability state for one captured run: the span log (flat,
+    start-ordered, parent-indexed) plus the metrics registry (counters,
+    gauges, histograms). Metric mutation is lock-guarded; span nesting uses
+    one stack and assumes the single orchestration thread the CLI has."""
+
+    def __init__(self, hist_edges: Tuple[float, ...] = ()) -> None:
+        self.spans: List[dict] = []
+        self.spans_dropped = 0
+        self.counters: Dict[str, int] = {}
+        self.gauges: Dict[str, float] = {}
+        self.hists: Dict[str, dict] = {}
+        self.hist_edges: Tuple[float, ...] = tuple(hist_edges)
+        self._stack: List[tuple] = []  # (span index | None, leaf name)
+        self._lock = threading.Lock()
+
+    # -- spans (single-threaded: the CLI orchestration thread) -------------
+
+    def _start(self, name: str) -> Optional[int]:
+        depth = len(self._stack)
+        path = "/".join([n for _, n in self._stack] + [name])
+        if len(self.spans) >= MAX_SPANS:
+            self.spans_dropped += 1
+            self._stack.append((None, name))
+            return None
+        parent = -1
+        for idx, _ in reversed(self._stack):
+            if idx is not None:
+                parent = idx
+                break
+        self.spans.append({
+            "name": name,
+            "path": path,
+            "parent": parent,
+            "depth": depth,
+            "ms": 0.0,
+            "status": "open",
+        })
+        self._stack.append((len(self.spans) - 1, name))
+        return len(self.spans) - 1
+
+    def _finish(self, idx: Optional[int], ms: float, ok: bool) -> None:
+        if self._stack:
+            self._stack.pop()
+        if idx is not None:
+            rec = self.spans[idx]
+            rec["ms"] = round(ms, 3)
+            rec["status"] = "ok" if ok else "error"
+
+    # -- metrics (written through obs/metrics.py) ---------------------------
+
+    def counter_add(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + int(n)
+
+    def gauge_set(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = value
+
+    def hist_observe(self, name: str, value: float) -> None:
+        with self._lock:
+            h = self.hists.get(name)
+            if h is None:
+                edges = list(self.hist_edges)
+                h = self.hists[name] = {
+                    "edges": edges,
+                    # one bucket per edge (value <= edge) plus overflow
+                    "counts": [0] * (len(edges) + 1),
+                    "count": 0,
+                    "sum": 0.0,
+                    "min": None,
+                    "max": None,
+                }
+            i = 0
+            edges = h["edges"]
+            while i < len(edges) and value > edges[i]:
+                i += 1
+            h["counts"][i] += 1
+            h["count"] += 1
+            h["sum"] = round(h["sum"] + value, 6)
+            h["min"] = value if h["min"] is None else min(h["min"], value)
+            h["max"] = value if h["max"] is None else max(h["max"], value)
+
+
+class _NullSpan:
+    """The shared disabled-mode span: no state, no timing. ``span()`` hands
+    the SAME instance to every caller when nothing records — the zero-
+    overhead contract tests pin with an identity check."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def fail(self) -> None:
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+#: The active collector, or None. Module-global on purpose: span/metric call
+#: sites read one attribute and bail — the whole disabled-mode cost.
+_ACTIVE: Optional[RunCollector] = None
+
+
+def active_run() -> Optional[RunCollector]:
+    """The collector of the current capture, or None when disabled."""
+    return _ACTIVE
+
+
+class _Span:
+    """One live span: records into the run (when active) and optionally
+    accumulates its elapsed ms into a plain dict ``sink`` (the
+    ``TpuSolver.last_timers`` compat path, which must keep working with obs
+    disabled) and/or an obs histogram ``hist``."""
+
+    __slots__ = (
+        "_run", "_name", "_sink", "_key", "_hist", "_log", "_t0", "_idx",
+        "_failed",
+    )
+
+    def __init__(self, run, name, sink, key, hist, log) -> None:
+        self._run = run
+        self._name = name
+        self._sink = sink
+        self._key = key
+        self._hist = hist
+        self._log = log
+        self._failed = False
+
+    def fail(self) -> None:
+        """Force error status at exit: for failures signaled by return code
+        rather than by an exception (the CLI's nonzero-rc paths), so the
+        span log and the report's top-level status never disagree."""
+        self._failed = True
+
+    def __enter__(self) -> "_Span":
+        if self._run is not None:
+            self._idx = self._run._start(self._name)
+        else:
+            self._idx = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, etype, evalue, tb) -> bool:
+        ms = (time.perf_counter() - self._t0) * 1000.0
+        if self._sink is not None:
+            k = self._key if self._key is not None else self._name
+            self._sink[k] = self._sink.get(k, 0.0) + ms
+        run = self._run
+        if run is not None:
+            run._finish(self._idx, ms, etype is None and not self._failed)
+            if self._hist is not None:
+                run.hist_observe(self._hist, ms)
+        if self._log is not None:
+            # The pre-obs Timers contract: every phase logs its own elapsed
+            # ms at INFO, success or failure, obs capture active or not.
+            self._log.info("phase %s: %.2f ms", self._name, ms)
+        return False
+
+
+def span(name: str, *, sink=None, key=None, hist=None, log=None):
+    """A context manager timing one section of host work.
+
+    - active run: records a nested span (wall ms, failure status when an
+      exception unwinds through it or ``.fail()`` was called), optionally
+      observing the elapsed ms into histogram ``hist``;
+    - ``sink``: a plain dict that ALWAYS accumulates ``sink[key or name] +=
+      ms``, run or no run — the live-``last_timers`` compat contract;
+    - ``log``: a logger that ALWAYS gets ``phase <name>: <ms> ms`` at INFO
+      on exit, success or failure — the pre-obs Timers stderr contract;
+    - disabled and no sink/log: returns the shared no-op singleton (zero
+      allocation).
+    """
+    run = _ACTIVE
+    if run is None and sink is None and log is None:
+        return NULL_SPAN
+    return _Span(run, name, sink, key, hist, log)
+
+
+@contextlib.contextmanager
+def run_capture(hist_edges=None) -> Iterator[RunCollector]:
+    """Activate a fresh :class:`RunCollector` for the duration of the block.
+
+    Captures nest by save/restore (an inner capture shadows, then the outer
+    resumes) so library callers and the CLI cannot corrupt each other.
+    Histogram bucket edges default to the ``KA_OBS_HIST_EDGES`` knob.
+    """
+    global _ACTIVE
+    if hist_edges is None:
+        from .metrics import resolve_hist_edges
+
+        hist_edges = resolve_hist_edges()
+    prev = _ACTIVE
+    run = RunCollector(hist_edges=tuple(hist_edges))
+    _ACTIVE = run
+    try:
+        yield run
+    finally:
+        _ACTIVE = prev

@@ -18,6 +18,8 @@ from ..models.problem import (
     encode_cluster,
     encode_topic_group,
 )
+from ..obs.metrics import counter_add, gauge_set
+from ..obs.trace import span
 
 
 def _topic_rfs(items, replication_factor):
@@ -99,16 +101,19 @@ def _rescue_flagged(
 
     from ..ops.assignment import whatif_sweep_jit
 
+    counter_add("whatif.rescued", len(flagged))
     sub = np.zeros((batch_bucket(len(flagged)), alive.shape[1]), dtype=bool)
     for i, s in enumerate(flagged):
         sub[i] = alive[s]
-    moved2, infeasible2, max_load2 = jax.device_get(
-        whatif_sweep_jit(
-            jnp.asarray(currents), jnp.asarray(rack_idx),
-            jnp.asarray(jhashes), jnp.asarray(p_reals), jnp.asarray(sub),
-            n=n, rf=rf, wave_mode="auto", rfs=jnp.asarray(rfs), r_cap=r_cap,
+    with span("whatif/rescue", hist="whatif.dispatch_ms"):
+        moved2, infeasible2, max_load2 = jax.device_get(
+            whatif_sweep_jit(
+                jnp.asarray(currents), jnp.asarray(rack_idx),
+                jnp.asarray(jhashes), jnp.asarray(p_reals), jnp.asarray(sub),
+                n=n, rf=rf, wave_mode="auto", rfs=jnp.asarray(rfs),
+                r_cap=r_cap,
+            )
         )
-    )
     for i, s in enumerate(flagged):
         moved[s] = moved2[i]
         infeasible[s] = infeasible2[i]
@@ -296,6 +301,11 @@ def evaluate_removal_scenarios(
 
     from ..utils.env import env_bool, env_int
 
+    # Fan-out telemetry: scenario count (the sweep's work unit) and the
+    # padded batch width actually dispatched (the fan-out the device sees).
+    counter_add("whatif.scenarios", s_real)
+    gauge_set("whatif.fanout", int(s_pad))
+
     if env_bool("KA_WHATIF_INCREMENTAL"):
         # With a mesh, offer it to the incremental path only when its
         # scenario axis divides the padded batch (same constraint the dense
@@ -304,11 +314,13 @@ def evaluate_removal_scenarios(
         inc_mesh = mesh
         if mesh is not None and s_pad % mesh.shape.get("scenarios", 1) != 0:
             inc_mesh = None
-        res = _evaluate_incremental(
-            currents, jhashes, p_reals, rfs, cluster, alive, scenarios,
-            s_real, rf, enc0.r_cap, len(items), mesh=inc_mesh,
-        )
+        with span("whatif/incremental"):
+            res = _evaluate_incremental(
+                currents, jhashes, p_reals, rfs, cluster, alive, scenarios,
+                s_real, rf, enc0.r_cap, len(items), mesh=inc_mesh,
+            )
         if res is not None:
+            counter_add("whatif.incremental_sweeps")
             return res
 
     from .mesh import fetch_global, put_sharded
@@ -329,28 +341,29 @@ def evaluate_removal_scenarios(
         s_chunk = max(m, (s_chunk // m) * m)  # keep chunks mesh-tileable
 
     def sweep_block(alive_block):
-        if mesh is not None:
-            alive_dev = put_sharded(
-                alive_block, mesh, PartitionSpec("scenarios", None)
-            )
-        else:
-            alive_dev = jnp.asarray(alive_block)
-        return map(
-            np.array,  # forced copy: the rescue pass below mutates rows
-            fetch_global(
-                whatif_sweep_jit(
-                    jnp.asarray(currents),
-                    jnp.asarray(enc0.rack_idx),
-                    jnp.asarray(jhashes),
-                    jnp.asarray(p_reals),
-                    alive_dev,
-                    n=enc0.n,
-                    rf=rf,
-                    rfs=jnp.asarray(rfs),
-                    r_cap=enc0.r_cap,
+        with span("whatif/dispatch", hist="whatif.dispatch_ms"):
+            if mesh is not None:
+                alive_dev = put_sharded(
+                    alive_block, mesh, PartitionSpec("scenarios", None)
                 )
-            ),
-        )
+            else:
+                alive_dev = jnp.asarray(alive_block)
+            return map(
+                np.array,  # forced copy: the rescue pass below mutates rows
+                fetch_global(
+                    whatif_sweep_jit(
+                        jnp.asarray(currents),
+                        jnp.asarray(enc0.rack_idx),
+                        jnp.asarray(jhashes),
+                        jnp.asarray(p_reals),
+                        alive_dev,
+                        n=enc0.n,
+                        rf=rf,
+                        rfs=jnp.asarray(rfs),
+                        r_cap=enc0.r_cap,
+                    )
+                ),
+            )
 
     if s_pad <= s_chunk:
         moved, infeasible, max_load = sweep_block(alive)

@@ -279,6 +279,12 @@ class GreedySolver:
         replication_factor: int,
         context: Context | None = None,
     ) -> Dict[int, List[int]]:
+        from ..obs.metrics import counter_add
+
+        # Counters, not per-topic spans: mode 3 loops this over every topic
+        # (thousands at the headline), and the span log is capped.
+        counter_add("greedy.assigns")
+        counter_add("greedy.partitions", len(partitions))
         return rack_aware_assignment(
             topic,
             current_assignment,

@@ -57,6 +57,10 @@ class NativeGreedySolver:
         replication_factor: int,
         context: Context | None = None,
     ) -> Dict[int, List[int]]:
+        from ..obs.metrics import counter_add
+
+        counter_add("native.assigns")
+        counter_add("native.partitions", len(partitions))
         if context is None:
             context = Context()
         enc = encode_problem(
@@ -108,10 +112,22 @@ class NativeGreedySolver:
     ) -> List[Tuple[str, Dict[int, List[int]]]]:
         """Run the whole serial topic loop in native code, counters shared in
         memory across topics (one ctypes call per run, not per topic)."""
+        from ..obs.trace import span
+
         if context is None:
             context = Context()
         if not named_currents:
             return []
+        with span("native/assign_many"):
+            return self._assign_many(
+                named_currents, rack_assignment, nodes, replication_factor,
+                context,
+            )
+
+    def _assign_many(
+        self, named_currents, rack_assignment, nodes, replication_factor,
+        context,
+    ) -> List[Tuple[str, Dict[int, List[int]]]]:
         cluster = encode_cluster(rack_assignment, nodes)
         rf = replication_factor
         encs = [

@@ -236,8 +236,10 @@ class TpuSolver:
     ) -> Dict[int, List[int]]:
         import jax.numpy as jnp
 
+        from ..obs.metrics import counter_add
         from ..ops.assignment import solve_assignment_jit
 
+        counter_add("solver.assign_calls")
         if context is None:
             context = Context()
         enc = encode_problem(
@@ -312,14 +314,22 @@ class TpuSolver:
         import jax
         import jax.numpy as jnp
 
+        from ..obs.metrics import gauge_set, obs_active
+        from ..obs.trace import span
         from ..ops.assignment import solve_batched_jit
-        from ..utils.timers import Timers
+        from ..utils.logging import get_logger
 
-        timers = Timers()
+        # Same logger name the pre-obs Timers used, so KA_LOG=INFO operators
+        # keep their "phase encode/solve/decode: N ms" stderr lines.
+        phase_log = get_logger("timers")
+
         # Live reference: phases land here as they complete, so a failed or
         # partial solve reports its own (partial) timings, never a stale
-        # previous run's.
-        self.last_timers = timers.ms
+        # previous run's. The obs spans (encode/solve/decode) feed the run
+        # report; the sink dict keeps last_timers working with obs disabled
+        # (the deprecated utils/timers.py contract).
+        phase_ms: Dict[str, float] = {}
+        self.last_timers = phase_ms
         if context is None:
             context = Context()
         if not named_currents:
@@ -329,13 +339,24 @@ class TpuSolver:
         else:
             rf_list = [int(r) for r in replication_factor]
         rf_max = max(rf_list)
-        with timers.phase("encode"):
+        with span("encode", sink=phase_ms, log=phase_log):
             # Fused one-pass group encode; the batch axis is bucketed like
             # every other axis (padding topics are inert: empty current,
             # p_real 0), so topic-count changes reuse the compiled scan.
             encs, currents, jhashes, p_reals = encode_topic_group(
                 named_currents, rack_assignment, nodes, rf_list,
             )
+            if obs_active():
+                # Bucketing cost, visible per run: the fraction of the
+                # padded (B, P) slab that is padding, not real partitions.
+                cells = int(currents.shape[0]) * int(currents.shape[1])
+                real = int(np.asarray(p_reals, dtype=np.int64).sum())
+                gauge_set(
+                    "encode.pad_waste_frac",
+                    round(1.0 - real / cells, 6) if cells else 0.0,
+                )
+                gauge_set("encode.topics", len(encs))
+                gauge_set("encode.p_pad", int(currents.shape[1]))
             # Compat slot width: on an RF decrease with KA_RF_DECREASE_COMPAT
             # the historical replica width exceeds rf_max and every slot can
             # survive sticky; the whole pipeline (placement, leadership,
@@ -381,7 +402,7 @@ class TpuSolver:
         self.last_leadership = (
             "native" if native_order else ("pallas" if use_pallas else "device")
         )
-        with timers.phase("solve"):
+        with span("solve", sink=phase_ms, log=phase_log):
             if native_order:
                 # Heterogeneous split (native/leadership.py): placement — the
                 # parallel tensor phase — on device; the sequential leadership
@@ -441,7 +462,7 @@ class TpuSolver:
                 f"Partition {int(encs[b].partition_ids[bad])} could not be "
                 "fully assigned!"
             )
-        with timers.phase("decode"):
+        with span("decode", sink=phase_ms, log=phase_log):
             apply_counter_updates(
                 context, enc_slab, counters_before, counters_after
             )
@@ -629,6 +650,9 @@ class TpuSolver:
         import jax
         import jax.numpy as jnp
 
+        from ..obs.metrics import counter_add
+
+        counter_add("solver.fresh_calls")
         if isinstance(partitions, int):
             partitions = list(range(partitions))
         if context is None:

@@ -212,6 +212,28 @@ _knob(
         "device view",
 )
 _knob(
+    "KA_OBS_ENABLE", "bool", False,
+    doc="collect obs/ tracing spans + metrics for CLI runs and print the "
+        "run summary on stderr; `--report-json PATH` (or `KA_OBS_REPORT`) "
+        "implies collection for that run regardless. Default off: the "
+        "disabled mode is zero-overhead and byte-identical to a build "
+        "without the subsystem",
+)
+_knob(
+    "KA_OBS_REPORT", "str", None, default_doc="unset (no report file)",
+    doc="default run-report path: when set, every CLI run emits the "
+        "schema-versioned JSON run report there (obs/report.py; the "
+        "`--report-json` flag overrides per run)",
+)
+_knob(
+    "KA_OBS_HIST_EDGES", "str", None,
+    default_doc="`1,5,25,100,500,2500,10000`",
+    doc="obs histogram bucket upper edges (comma-separated ascending "
+        "numbers, ms for timing histograms) shared by all histograms of a "
+        "run; malformed values are ignored loudly and the default edges "
+        "used",
+)
+_knob(
     "KA_DEVICE_WATCHDOG_S", "float", 0.0, floor=0.0,
     doc="console entry point probes accelerator init in a subprocess for "
         "this many seconds and falls back to the CPU backend (with a stderr "

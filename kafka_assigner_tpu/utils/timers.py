@@ -1,51 +1,44 @@
-"""Per-phase wall-clock timers and an optional device profiler hook.
+"""DEPRECATED compat shim over the ``obs/`` observability subsystem.
 
-The reference has no tracing or profiling of any kind (SURVEY.md §5) — solve
-latency is our headline metric, so phases are first-class observable here.
+``Timers`` and ``device_trace`` predate ``obs/`` (they were the repo's only
+instrumentation — SURVEY.md §5). Both now live there: phases are
+:func:`kafka_assigner_tpu.obs.span` spans, the device profiler hook is
+:mod:`kafka_assigner_tpu.obs.profile`. This module stays importable so
+external scripts keep working, and ``Timers`` keeps its exact contract (a
+live ``.ms`` dict accumulating per-phase wall milliseconds, obs enabled or
+not) — but new code should use ``obs`` directly::
 
-Usage::
-
-    timers = Timers()
-    with timers.phase("encode"):
+    from kafka_assigner_tpu.obs import span
+    with span("encode"):
         ...
-    timers.report()            # -> {"encode": 12.3, ...} and stderr log
-
-``device_trace`` wraps ``jax.profiler.trace`` so a TPU trace of a solve can
-be captured with one context manager (view with TensorBoard/XProf).
 """
 from __future__ import annotations
 
 import contextlib
-import time
 from typing import Dict, Iterator
 
+from ..obs.profile import device_trace  # noqa: F401  (compat re-export)
+from ..obs.trace import span
 from .logging import get_logger
 
 _log = get_logger("timers")
 
 
 class Timers:
+    """Deprecated: a bag of named phase timers backed by obs spans.
+
+    ``.ms`` accumulates per-phase wall milliseconds exactly as before (the
+    ``TpuSolver.last_timers`` live-reference contract); when an obs run
+    capture is active each phase additionally records a span.
+    """
+
     def __init__(self) -> None:
         self.ms: Dict[str, float] = {}
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
-        t0 = time.perf_counter()
-        try:
+        with span(name, sink=self.ms, key=name, log=_log):
             yield
-        finally:
-            elapsed = (time.perf_counter() - t0) * 1000.0
-            self.ms[name] = self.ms.get(name, 0.0) + elapsed
-            _log.info("phase %s: %.2f ms", name, elapsed)
 
     def report(self) -> Dict[str, float]:
         return dict(self.ms)
-
-
-@contextlib.contextmanager
-def device_trace(log_dir: str) -> Iterator[None]:
-    """Capture a device profile (TPU trace) for everything in the block."""
-    import jax
-
-    with jax.profiler.trace(log_dir):
-        yield

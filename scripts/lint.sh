@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # The project lint gate: kalint (knob-registry + jit-boundary house rules,
-# rules KA001-KA005), the README knob-table drift check, and ruff (config in
-# pyproject.toml) when installed. Exits non-zero on any finding; invoked by
+# rules KA001-KA006), the README knob-table drift check, the run-report
+# fixture schema check, and ruff (config in pyproject.toml) when installed. Exits non-zero on any finding; invoked by
 # tests/test_lint_gate.py so tier-1 catches regressions without separate CI
 # plumbing.
 set -euo pipefail
@@ -12,6 +12,11 @@ export JAX_PLATFORMS=cpu
 
 python -m kafka_assigner_tpu.analysis.kalint
 python -m kafka_assigner_tpu.analysis.knobdoc --check
+# Run-report schema drift: the checked-in fixture must parse and match the
+# emitter's declared version (a schema bump must regenerate the fixture).
+# (python -c, not -m: the package re-exports the module, and -m would warn.)
+python -c "import sys; from kafka_assigner_tpu.obs.report import main; \
+sys.exit(main(['--check-fixture', 'tests/golden/run_report_v1.json']))"
 
 if command -v ruff >/dev/null 2>&1; then
     ruff check kafka_assigner_tpu tests

@@ -171,6 +171,54 @@ def test_ka005_exempts_json_io():
     assert kalint.lint_source(KA005_SNIPPET, "io/json_io.py") == []
 
 
+# --- KA006: jnp. calls at module import time ---------------------------------
+
+def test_ka006_trips_on_import_time_jnp_call():
+    src = "import jax.numpy as jnp\nZEROS = jnp.zeros((8,))\n"
+    findings = kalint.lint_source(src, "foo.py")
+    assert any(f.rule == "KA006" and f.line == 2 for f in findings)
+
+
+def test_ka006_trips_on_spelled_out_chain_and_aliases():
+    assert "KA006" in rules_of(
+        kalint.lint_source("import jax\nX = jax.numpy.ones(3)\n", "foo.py")
+    )
+    assert "KA006" in rules_of(
+        kalint.lint_source("from jax import numpy as xp\nX = xp.ones(3)\n",
+                           "foo.py")
+    )
+
+
+def test_ka006_allows_calls_inside_functions():
+    src = (
+        "def f():\n"
+        "    import jax.numpy as jnp\n"
+        "    return jnp.zeros((8,))\n"
+        "g = lambda jnp: jnp.zeros(1)\n"
+    )
+    assert kalint.lint_source(src, "foo.py") == []
+
+
+def test_ka006_trips_on_default_args_and_class_bodies():
+    # Decorators, default arguments, and class bodies all execute at import.
+    src = (
+        "import jax.numpy as jnp\n"
+        "def f(x=jnp.zeros(1)):\n"
+        "    return x\n"
+        "class C:\n"
+        "    attr = jnp.ones(2)\n"
+    )
+    findings = [
+        f for f in kalint.lint_source(src, "foo.py") if f.rule == "KA006"
+    ]
+    assert {f.line for f in findings} == {2, 5}
+
+
+def test_ka006_does_not_flag_other_jax_api_calls():
+    src = "import jax\nkernel_jit = jax.jit(lambda x: x)\n"
+    assert "KA006" not in rules_of(kalint.lint_source(src, "foo.py"))
+
+
 # --- suppressions ------------------------------------------------------------
 
 def test_suppression_with_reason_silences_the_finding():

@@ -1,0 +1,369 @@
+"""``obs/`` subsystem coverage (ISSUE 3): run-report schema (golden fixture +
+version-bump drift), the mode-3 ``--report-json`` smoke, the error-path flush
+bugfix, disabled-mode zero-overhead, metrics accumulation under the what-if
+fan-out, and the deprecated ``utils/timers.py`` compat shim."""
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from kafka_assigner_tpu import obs
+from kafka_assigner_tpu.cli import run_tool
+from kafka_assigner_tpu.obs import metrics as metrics_mod
+from kafka_assigner_tpu.obs import report as report_mod
+from kafka_assigner_tpu.obs import trace as trace_mod
+from kafka_assigner_tpu.utils.timers import Timers
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "golden", "run_report_v1.json"
+)
+
+OBS_KNOBS = ("KA_OBS_ENABLE", "KA_OBS_REPORT", "KA_OBS_HIST_EDGES")
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_obs_env(monkeypatch):
+    """Every test here starts from the shipped default: obs off, no report
+    path, default histogram edges."""
+    for knob in OBS_KNOBS:
+        monkeypatch.delenv(knob, raising=False)
+
+
+@pytest.fixture()
+def snapshot(tmp_path):
+    """6 brokers across 3 racks, one RF-3 topic — small enough that the
+    in-process CLI solves stay cheap for tier-1."""
+    cluster = {
+        "brokers": [
+            {"id": 100 + i, "host": f"h{i}", "port": 9092, "rack": f"r{i % 3}"}
+            for i in range(6)
+        ],
+        "topics": {
+            "events": {
+                str(p): [100 + (p + i) % 5 for i in range(3)] for p in range(4)
+            },
+        },
+    }
+    path = tmp_path / "cluster.json"
+    path.write_text(json.dumps(cluster))
+    return str(path)
+
+
+# --- run-report schema: golden fixture + version bump -------------------------
+
+def test_golden_fixture_is_schema_valid():
+    with open(FIXTURE, "r", encoding="utf-8") as f:
+        fixture = json.load(f)
+    assert report_mod.validate_report(fixture) == []
+    # A schema bump MUST regenerate the checked-in fixture (scripts/lint.sh
+    # enforces the same via `obs.report --check-fixture`).
+    assert fixture["schema_version"] == report_mod.REPORT_SCHEMA_VERSION
+
+
+def test_version_drift_fails_validation():
+    with open(FIXTURE, "r", encoding="utf-8") as f:
+        fixture = json.load(f)
+    fixture["schema_version"] = report_mod.REPORT_SCHEMA_VERSION + 1
+    problems = report_mod.validate_report(fixture)
+    assert any("schema_version" in p for p in problems)
+
+
+def test_validator_catches_structural_drift():
+    with open(FIXTURE, "r", encoding="utf-8") as f:
+        fixture = json.load(f)
+    del fixture["plan"]
+    fixture["status"] = "partial"
+    fixture["spans"][0].pop("ms")
+    del fixture["metrics"]["histograms"]
+    problems = report_mod.validate_report(fixture)
+    assert any("missing required key 'plan'" in p for p in problems)
+    assert any("status" in p for p in problems)
+    assert any("span[0]" in p for p in problems)
+    assert any("metrics.histograms" in p for p in problems)
+    assert report_mod.validate_report([]) == ["report is not a JSON object"]
+
+
+def test_fixture_check_cli_entrypoint(tmp_path, capsys):
+    assert report_mod.main(["--check-fixture", FIXTURE]) == 0
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    assert report_mod.main(["--check-fixture", str(bad)]) == 1
+    capsys.readouterr()  # drain stderr diagnostics
+
+
+# --- tier-1 smoke: mode 3 with --report-json ----------------------------------
+
+def test_mode3_report_smoke(snapshot, tmp_path, capsys):
+    """The acceptance-criteria smoke: a synthetic PRINT_REASSIGNMENT solve
+    with ``--report-json`` emits a schema-versioned report carrying
+    encode/solve/decode spans, ZK op counters, and plan stats."""
+    report_path = tmp_path / "report.json"
+    rc = run_tool([
+        "--zk_string", f"file://{snapshot}", "--mode", "PRINT_REASSIGNMENT",
+        "--solver", "tpu", "--report-json", str(report_path),
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    with open(report_path, "r", encoding="utf-8") as f:
+        report = json.load(f)
+    assert report_mod.validate_report(report) == []
+    assert report["status"] == "ok"
+    assert report["mode"] == "PRINT_REASSIGNMENT"
+    names = {s["name"] for s in report["spans"]}
+    assert {"encode", "solve", "decode"} <= names
+    # Phase spans nest under the mode span.
+    mode_span = report["spans"][0]
+    assert mode_span["path"] == "mode/PRINT_REASSIGNMENT"
+    assert all(s["status"] == "ok" for s in report["spans"])
+    assert report["metrics"]["counters"]["zk.reads"] >= 1
+    assert report["metrics"]["counters"]["zk.bytes"] > 0
+    assert "encode.pad_waste_frac" in report["metrics"]["gauges"]
+    for key in ("moves", "leader_churn", "topics", "partitions"):
+        assert key in report["plan"]
+    assert report["plan"]["partitions"] == 4
+
+
+def test_error_path_still_emits_report(snapshot, tmp_path, capsys):
+    """The satellite bugfix: a solve raising mid-phase must still flush its
+    spans (marked error) and emit the report with ``"status": "error"``."""
+    report_path = tmp_path / "report.json"
+    with pytest.raises(KeyError):
+        run_tool([
+            "--zk_string", f"file://{snapshot}", "--mode",
+            "PRINT_REASSIGNMENT", "--topics", "no_such_topic",
+            "--report-json", str(report_path),
+        ])
+    capsys.readouterr()
+    with open(report_path, "r", encoding="utf-8") as f:
+        report = json.load(f)
+    assert report_mod.validate_report(report) == []
+    assert report["status"] == "error"
+    assert report["error"]["type"] == "KeyError"
+    assert "no_such_topic" in report["error"]["message"]
+    # The spans the exception unwound through flushed with error status —
+    # timing data survives exactly when it matters most.
+    assert report["spans"], "spans lost on the failure path"
+    assert any(s["status"] == "error" for s in report["spans"])
+
+
+# --- disabled mode: zero overhead, byte-identical output ----------------------
+
+def test_disabled_mode_uses_shared_noop_singleton():
+    assert obs.active_run() is None
+    assert obs.span("anything") is trace_mod.NULL_SPAN
+    assert obs.span("other") is trace_mod.NULL_SPAN
+    assert metrics_mod.hist_ms("zk.op_ms") is trace_mod.NULL_SPAN
+    # Metric writes with no capture are pure no-ops.
+    obs.counter_add("zk.reads")
+    obs.gauge_set("plan.moves", 1)
+    obs.hist_observe("whatif.dispatch_ms", 1.0)
+    assert not obs.obs_active()
+
+
+def test_disabled_run_is_byte_identical_and_fileless(
+    snapshot, tmp_path, monkeypatch, capsys
+):
+    argv = [
+        "--zk_string", f"file://{snapshot}", "--mode", "PRINT_REASSIGNMENT",
+        "--solver", "tpu",
+    ]
+    assert run_tool(argv) == 0
+    baseline = capsys.readouterr()
+
+    monkeypatch.setenv("KA_OBS_ENABLE", "0")
+    assert run_tool(argv) == 0
+    disabled = capsys.readouterr()
+    # KA_OBS_ENABLE=0 is byte-identical to a build without the subsystem.
+    assert disabled.out == baseline.out
+    assert disabled.err == baseline.err
+    assert "obs:" not in disabled.err
+    assert list(tmp_path.glob("*.json")) == [tmp_path / "cluster.json"]
+
+    monkeypatch.setenv("KA_OBS_ENABLE", "1")
+    assert run_tool(argv) == 0
+    enabled = capsys.readouterr()
+    # Collection never perturbs the payload: stdout stays byte-identical;
+    # only stderr gains the obs summary (and no file without a path).
+    assert enabled.out == baseline.out
+    assert "obs: run ok mode=PRINT_REASSIGNMENT" in enabled.err
+    assert list(tmp_path.glob("*.json")) == [tmp_path / "cluster.json"]
+
+
+def test_ka_obs_report_env_default_path(snapshot, tmp_path, monkeypatch, capsys):
+    report_path = tmp_path / "envreport.json"
+    monkeypatch.setenv("KA_OBS_REPORT", str(report_path))
+    assert run_tool([
+        "--zk_string", f"file://{snapshot}", "--mode", "PRINT_CURRENT_BROKERS",
+    ]) == 0
+    capsys.readouterr()
+    with open(report_path, "r", encoding="utf-8") as f:
+        report = json.load(f)
+    assert report_mod.validate_report(report) == []
+    assert report["mode"] == "PRINT_CURRENT_BROKERS"
+
+
+# --- metrics accumulation under the what-if fan-out ---------------------------
+
+def test_whatif_fanout_metrics():
+    from kafka_assigner_tpu.parallel.whatif import evaluate_removal_scenarios
+
+    from .test_invariants import make_cluster
+
+    current, live, rack_map = make_cluster(3, 8, 16, 3, 4)
+    topics = {"t0": current}
+    scenarios = [[], [100], [101]]
+    with obs.run_capture() as run:
+        results = evaluate_removal_scenarios(
+            topics, live, rack_map, scenarios, 3
+        )
+    assert len(results) == 3
+    assert run.counters["whatif.scenarios"] == 3
+    # The dispatched fan-out is the padded batch width the device sees.
+    assert run.gauges["whatif.fanout"] >= 3
+    assert any(s["path"].startswith("whatif/") for s in run.spans)
+    # The capture closed: nothing records afterwards.
+    assert obs.active_run() is None
+
+
+# --- span mechanics -----------------------------------------------------------
+
+def test_spans_nest_and_mark_failure():
+    with obs.run_capture() as run:
+        with pytest.raises(RuntimeError):
+            with obs.span("outer"):
+                with obs.span("inner"):
+                    pass
+                with obs.span("boom"):
+                    raise RuntimeError("x")
+    by_name = {s["name"]: s for s in run.spans}
+    assert by_name["inner"]["parent"] == 0
+    assert by_name["inner"]["path"] == "outer/inner"
+    assert by_name["inner"]["depth"] == 1
+    assert by_name["inner"]["status"] == "ok"
+    assert by_name["boom"]["status"] == "error"
+    assert by_name["outer"]["status"] == "error"
+
+
+def test_span_cap_overflow_is_counted_not_silent(monkeypatch):
+    monkeypatch.setattr(trace_mod, "MAX_SPANS", 2)
+    with obs.run_capture() as run:
+        for i in range(5):
+            with obs.span(f"s{i}"):
+                pass
+    assert len(run.spans) == 2
+    assert run.spans_dropped == 3
+    report = report_mod.build_report(run)
+    assert report["spans_dropped"] == 3
+
+
+def test_run_capture_nests_by_save_restore():
+    with obs.run_capture() as outer:
+        obs.counter_add("zk.reads")
+        with obs.run_capture() as inner:
+            obs.counter_add("zk.reads", 5)
+        assert obs.active_run() is outer
+        obs.counter_add("zk.reads")
+    assert outer.counters["zk.reads"] == 2
+    assert inner.counters["zk.reads"] == 5
+
+
+def test_histogram_bucketing_and_edges_knob(monkeypatch, capsys):
+    monkeypatch.setenv("KA_OBS_HIST_EDGES", "10,1")  # unsorted on purpose
+    with obs.run_capture() as run:
+        for v in (0.5, 5.0, 50.0):
+            obs.hist_observe("zk.op_ms", v)
+    h = run.hists["zk.op_ms"]
+    assert h["edges"] == [1.0, 10.0]
+    assert h["counts"] == [1, 1, 1]  # <=1, <=10, overflow
+    assert h["count"] == 3 and h["min"] == 0.5 and h["max"] == 50.0
+
+    monkeypatch.setenv("KA_OBS_HIST_EDGES", "not,numbers")
+    assert metrics_mod.resolve_hist_edges() == metrics_mod.DEFAULT_HIST_EDGES
+    assert "KA_OBS_HIST_EDGES" in capsys.readouterr().err  # loud ignore
+
+    # nan/inf break bucketing (`value > nan` is always False), duplicates
+    # make unreachable phantom buckets, non-positive edges are dead for ms
+    # values — all rejected as malformed, loudly.
+    for bad in ("nan,5", "5,5,100", "-5,100", "0,10"):
+        monkeypatch.setenv("KA_OBS_HIST_EDGES", bad)
+        assert (
+            metrics_mod.resolve_hist_edges() == metrics_mod.DEFAULT_HIST_EDGES
+        ), bad
+        assert "KA_OBS_HIST_EDGES" in capsys.readouterr().err
+
+
+def test_default_hist_edges_doc_matches_constant():
+    """The knob registry's default_doc (and therefore the generated README
+    knob table) must track obs/metrics.DEFAULT_HIST_EDGES — nothing else
+    gates this drift channel."""
+    from kafka_assigner_tpu.utils.env import KNOBS
+
+    documented = KNOBS["KA_OBS_HIST_EDGES"].default_doc.strip("`")
+    assert documented == ",".join(
+        f"{e:g}" for e in metrics_mod.DEFAULT_HIST_EDGES
+    )
+
+
+def test_span_fail_forces_error_status():
+    """Failures signaled by return code rather than exception (the CLI's
+    nonzero-rc paths) must not leave an ok span in an error report."""
+    with obs.run_capture() as run:
+        with obs.span("mode/X") as sp:
+            sp.fail()
+    assert run.spans[0]["status"] == "error"
+    # The disabled-mode singleton carries the same interface.
+    with obs.span("noop") as sp:
+        sp.fail()
+
+
+def test_span_log_contract_survives_failure():
+    """``span(log=...)`` keeps the pre-obs Timers stderr contract: the phase
+    line is emitted at INFO on success AND when an exception unwinds, with
+    or without an active capture."""
+    import logging
+
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger = logging.getLogger("test_obs.phase_log")
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+    handler = _Capture()
+    logger.addHandler(handler)
+    try:
+        with obs.span("encode", log=logger):
+            pass
+        with pytest.raises(RuntimeError):
+            with obs.span("solve", log=logger):
+                raise RuntimeError("mid-phase")
+    finally:
+        logger.removeHandler(handler)
+    assert any(m.startswith("phase encode:") for m in records)
+    assert any(m.startswith("phase solve:") for m in records)
+
+
+# --- utils/timers.py compat shim ----------------------------------------------
+
+def test_timers_shim_accumulates_without_capture():
+    timers = Timers()
+    with timers.phase("encode"):
+        pass
+    with timers.phase("encode"):
+        pass
+    assert set(timers.ms) == {"encode"}
+    assert timers.ms["encode"] >= 0.0
+    assert timers.report() == timers.ms
+
+
+def test_timers_shim_records_spans_under_capture():
+    timers = Timers()
+    with obs.run_capture() as run:
+        with timers.phase("solve"):
+            pass
+    assert [s["name"] for s in run.spans] == ["solve"]
+    assert "solve" in timers.ms  # the live last_timers contract, obs or not
