@@ -24,6 +24,11 @@ KA005  plan/golden JSON emission (``json.dumps``/``json.dump``) outside
 KA006  a ``jnp.`` / ``jax.numpy`` call at module import time (module scope,
        class bodies, decorators, default arguments) — imports must stay
        cheap and backend-agnostic; build arrays lazily inside functions
+KA007  a jit-traced function closes over a mutable module-level global
+       (reads a module-scope list/dict/set binding, or rebinds any global
+       via ``global``) — trace-time capture freezes the value at first
+       compile, so later mutations are silently ignored by every cached
+       executable; pass the value as an argument or bind it immutably
 ====== =====================================================================
 
 Suppression: put ``# kalint: disable=KA002 -- <reason>`` on the offending
@@ -54,6 +59,7 @@ RULES = {
     "KA004": "registered knob missing from the README knob table",
     "KA005": "plan JSON emission outside io/json_io.py",
     "KA006": "jnp./jax.numpy call at module import time",
+    "KA007": "jit-traced function closes over a mutable module-level global",
 }
 
 #: Modules whose ENTIRE body is treated as traced kernel code (KA002): these
@@ -437,6 +443,124 @@ def _check_ka006(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+#: Constructors whose module-scope result is a mutable container (KA007).
+_MUTABLE_CTORS = frozenset({
+    "list", "dict", "set", "bytearray", "defaultdict", "deque", "Counter",
+    "OrderedDict",
+})
+
+
+def _module_mutable_globals(tree: ast.AST) -> Set[str]:
+    """Names bound at module scope to obviously-mutable containers: literal
+    list/dict/set displays, comprehensions, or calls to the stdlib mutable
+    constructors. Module-scope statements only (incl. inside module-level
+    ``if``/``try`` blocks) — function and class bodies bind elsewhere."""
+
+    def value_is_mutable(node: ast.AST) -> bool:
+        if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None
+            )
+            return name in _MUTABLE_CTORS
+        return False
+
+    out: Set[str] = set()
+
+    def scan(stmts) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Assign) and value_is_mutable(stmt.value):
+                for target in stmt.targets:
+                    for n in ast.walk(target):
+                        if isinstance(n, ast.Name):
+                            out.add(n.id)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                    and value_is_mutable(stmt.value) \
+                    and isinstance(stmt.target, ast.Name):
+                out.add(stmt.target.id)
+            # recurse into compound module-scope statements
+            for attr in ("body", "orelse", "finalbody"):
+                scan(getattr(stmt, attr, []) or [])
+            for handler in getattr(stmt, "handlers", []) or []:
+                scan(handler.body)
+
+    scan(tree.body)  # type: ignore[attr-defined]
+    return out
+
+
+def _local_bindings(fn: ast.AST) -> Set[str]:
+    """Names the function binds locally (parameters, assignments, loop and
+    with targets, comprehension targets, inner defs): a Load of such a name
+    is not a global read. Over-approximates (any binding anywhere in the
+    function shadows for the whole check) — that only suppresses findings,
+    never fabricates them."""
+    bound: Set[str] = set()
+    args = fn.args
+    for a in (
+        list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        + ([args.vararg] if args.vararg else [])
+        + ([args.kwarg] if args.kwarg else [])
+    ):
+        bound.add(a.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            if node is not fn:
+                bound.add(node.name)
+        elif isinstance(node, ast.alias):
+            bound.add(node.asname or node.name.split(".")[0])
+    return bound
+
+
+def _check_ka007(tree: ast.AST, path: str) -> List[Finding]:
+    mutable = _module_mutable_globals(tree)
+    out: List[Finding] = []
+    for fn in _traced_functions(tree):
+        globals_declared: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                globals_declared.update(node.names)
+                out.append(Finding(
+                    "KA007", path, node.lineno, node.col_offset + 1,
+                    f"jit-traced function {fn.name!r} rebinds module "
+                    f"global(s) {', '.join(node.names)} via 'global' (the "
+                    "rebinding runs at trace time only; cached executables "
+                    "never see it — return the value instead)",
+                ))
+        if not mutable:
+            continue
+        local = _local_bindings(fn) - globals_declared
+        seen_names: Set[str] = set()
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in mutable
+                and node.id not in local
+                and node.id not in seen_names  # one finding per name per fn
+            ):
+                seen_names.add(node.id)
+                out.append(Finding(
+                    "KA007", path, node.lineno, node.col_offset + 1,
+                    f"jit-traced function {fn.name!r} closes over mutable "
+                    f"module global {node.id!r} (its value is frozen into "
+                    "the compiled executable at trace time; later mutations "
+                    "are silently ignored — pass it as an argument or bind "
+                    "it immutably, e.g. tuple/frozenset/MappingProxyType)",
+                ))
+    return out
+
+
 def check_readme(readme_text: str, knobs=None, path: str = "README.md"):
     """KA004: every registered knob must appear in the README (the generated
     knob table keeps this true; drift means the table is stale)."""
@@ -491,6 +615,7 @@ def lint_source(
         + _check_ka003(tree, set(knobs), path)
         + _check_ka005(tree, relpath, path)
         + _check_ka006(tree, path)
+        + _check_ka007(tree, path)
     )
     for f in raw:
         if f.rule in suppress.get(f.line, ()):  # reasoned suppression

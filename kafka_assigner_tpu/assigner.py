@@ -111,6 +111,7 @@ class TopicAssigner:
         brokers: Set[int],
         rack_assignment: Mapping[int, str],
         desired_replication_factor: int = -1,
+        preencoded: tuple | None = None,
     ) -> List[Tuple[str, Dict[int, List[int]]]]:
         """Solve many topics through one shared Context, returning
         ``[(topic, assignment), ...]`` in input order.
@@ -125,6 +126,13 @@ class TopicAssigner:
         order) — mixed replication factors included for backends that
         declare ``supports_mixed_rf`` (the TPU solver does); other batching
         backends get one dispatch per run of consecutive same-RF topics.
+
+        ``preencoded``: an ``encode_topic_group`` result for exactly these
+        topics in this order (the streaming-ingest overlap builds it while
+        ZooKeeper responses arrive, ``generator.py``); forwarded to a
+        mixed-RF batching backend so it can skip its own encode. Ignored —
+        the work was merely speculative — for backends that cannot consume
+        it.
         """
         import contextlib
 
@@ -142,7 +150,7 @@ class TopicAssigner:
         with trace_ctx:
             return self._generate_assignments(
                 topic_assignments, brokers, rack_assignment,
-                desired_replication_factor,
+                desired_replication_factor, preencoded,
             )
 
     def _generate_assignments(
@@ -151,6 +159,7 @@ class TopicAssigner:
         brokers: Set[int],
         rack_assignment: Mapping[int, str],
         desired_replication_factor: int = -1,
+        preencoded: tuple | None = None,
     ) -> List[Tuple[str, Dict[int, List[int]]]]:
         items = (
             list(topic_assignments.items())
@@ -184,6 +193,16 @@ class TopicAssigner:
         # the CLI topic order either way, so the Context evolves exactly as
         # in the serial loop.
         if items and getattr(self.solver, "supports_mixed_rf", False):
+            if preencoded is not None:
+                # Keyword only when there is something to forward: a
+                # third-party mixed-RF backend predating the parameter must
+                # keep working unchanged (the contract above).
+                return list(
+                    assign_many(
+                        items, rack_assignment, set(brokers), rfs,
+                        self.context, preencoded=preencoded,
+                    )
+                )
             return list(
                 assign_many(
                     items, rack_assignment, set(brokers), rfs, self.context

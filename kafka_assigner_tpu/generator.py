@@ -13,8 +13,10 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import sys
-from typing import Dict, List, Optional, Sequence, Set, TextIO
+import threading
+from typing import Dict, List, Optional, Sequence, Set, TextIO, Tuple
 
 from .assigner import TopicAssigner
 from .obs import gauge_set, obs_active, span
@@ -307,6 +309,117 @@ def record_plan_stats(
     gauge_set("plan.partitions", partitions)
 
 
+#: Sentinel closing the ingest stream (the producer finished cleanly).
+_INGEST_DONE = object()
+
+
+def stream_initial_assignment(
+    backend: MetadataBackend,
+    topic_list: Sequence[str],
+    brokers: Optional[Set[int]] = None,
+    rack_assignment: Optional[Dict[int, str]] = None,
+    want_encode: bool = False,
+) -> Tuple[Dict[str, Dict[int, List[int]]], Optional[tuple]]:
+    """Metadata ingest overlapped with host encode.
+
+    A producer thread drains ``backend.fetch_topics`` (pipelined reads on
+    live backends, ``KA_ZK_PIPELINE``) into a queue while this — the
+    orchestration — thread folds arrived topics into the batched host encode
+    in ``KA_ZK_INGEST_CHUNK``-sized chunks, so the encode work that used to
+    start only after the last ZooKeeper round-trip now hides inside the
+    fetch. Returns ``(initial, preencoded)`` where ``initial`` is exactly
+    ``backend.partition_assignment(topic_list)`` and ``preencoded`` is the
+    ``encode_topic_group`` result for the same topic order (or None when
+    encoding was not requested or streaming is unavailable/disabled —
+    callers fall back to encoding inside the solver, identical output either
+    way).
+
+    Failure contract: a producer-side exception (missing znode, wire error,
+    missing snapshot topic) re-raises here, on the orchestration thread, so
+    tracing spans and the run report see it exactly like a serial fetch
+    failure. A CONSUMER-side abort (encode error, KeyboardInterrupt) leaves
+    the daemon producer blocked on its socket; it is not joined — the CLI's
+    ``backend.close()`` on the unwind path closes that socket, which errors
+    the producer out promptly (possible stderr noise, never a hang past the
+    socket timeout).
+    """
+    from .utils.env import env_bool, env_int
+
+    fetch = getattr(backend, "fetch_topics", None)
+    if fetch is None or not env_bool("KA_ZK_OVERLAP"):
+        return backend.partition_assignment(topic_list), None
+
+    acc = None
+    if want_encode and brokers is not None:
+        from .models.problem import GroupEncodeAccumulator
+
+        acc = GroupEncodeAccumulator(rack_assignment or {}, brokers)
+
+    if acc is None:
+        # Nothing to overlap: the pipelined fetch is the whole win, so drain
+        # the stream inline — no producer thread, no queue hops.
+        initial = {}
+        streamed = 0
+        with span("ingest/stream"):
+            for topic, parts in fetch(topic_list):
+                initial[topic] = parts
+                streamed += 1
+        if obs_active():
+            gauge_set("ingest.topics", streamed)
+        return initial, None
+
+    q: "queue.Queue" = queue.Queue()
+    producer_done = threading.Event()
+
+    def _produce() -> None:
+        try:
+            for item in fetch(topic_list):
+                q.put(item)
+            q.put(_INGEST_DONE)
+        except BaseException as e:  # re-raised on the consumer side
+            q.put(e)
+        finally:
+            producer_done.set()
+
+    t = threading.Thread(target=_produce, name="zk-ingest", daemon=True)
+    chunk_size = env_int("KA_ZK_INGEST_CHUNK")
+    initial: Dict[str, Dict[int, List[int]]] = {}
+    chunk: List[tuple] = []
+    streamed = 0
+    overlap_ms = 0.0
+    with span("ingest/stream"):
+        t.start()
+        while True:
+            item = q.get()
+            if item is _INGEST_DONE:
+                break
+            if isinstance(item, BaseException):
+                t.join()
+                raise item
+            topic, parts = item
+            initial[topic] = parts
+            streamed += 1
+            if acc is not None:
+                chunk.append((topic, parts))
+                if len(chunk) >= chunk_size:
+                    overlapping = not producer_done.is_set()
+                    before = acc.encode_ms
+                    acc.add(chunk)
+                    if overlapping:
+                        overlap_ms += acc.encode_ms - before
+                    chunk = []
+        t.join()
+        if acc is not None and chunk:
+            acc.add(chunk)
+    preencoded = acc.finish() if acc is not None else None
+    if obs_active():
+        gauge_set("ingest.topics", streamed)
+        if acc is not None:
+            gauge_set("ingest.encode_ms", round(acc.encode_ms, 3))
+            gauge_set("ingest.overlap_ms", round(overlap_ms, 3))
+    return initial, preencoded
+
+
 def print_least_disruptive_reassignment(
     backend: MetadataBackend,
     topics: Optional[Sequence[str]],
@@ -339,7 +452,14 @@ def print_least_disruptive_reassignment(
     topic_list = list(topics) if topics is not None else backend.all_topics()
 
     with span("metadata/assignment"):
-        initial = backend.partition_assignment(topic_list)
+        # Pipelined ingest overlapped with host encode: the TPU path gets the
+        # batched group encode built WHILE ZooKeeper responses stream in (the
+        # solver then skips its own encode — identical arrays by
+        # construction); other solvers still get the pipelined fetch.
+        initial, preencoded = stream_initial_assignment(
+            backend, topic_list, brokers, rack_assignment,
+            want_encode=(solver == "tpu"),
+        )
 
     # Rollback snapshot first (KafkaAssignmentGenerator.java:159-160), from
     # the same read the solver uses.
@@ -381,6 +501,7 @@ def print_least_disruptive_reassignment(
             brokers,
             rack_assignment,
             desired_replication_factor,
+            preencoded=preencoded,
         )
     if obs_active():
         record_plan_stats(initial, final_pairs)

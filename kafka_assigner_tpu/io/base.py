@@ -18,7 +18,7 @@ snapshot, anything else a live ZK quorum.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Protocol, Sequence
+from typing import Dict, Iterator, List, Optional, Protocol, Sequence, Tuple
 
 
 @dataclass(frozen=True)
@@ -51,6 +51,27 @@ class MetadataBackend(Protocol):
     def partition_assignment(
         self, topics: Sequence[str]
     ) -> Dict[str, Dict[int, List[int]]]: ...
+
+    def fetch_topics(
+        self, topics: Sequence[str]
+    ) -> Iterator[Tuple[str, Dict[int, List[int]]]]:
+        """Streaming variant of :meth:`partition_assignment`: yield
+        ``(topic, {partition: [replica ids]})`` per input entry, in input
+        order, as results become available — live backends pipeline the
+        underlying reads (``KA_ZK_PIPELINE``) so callers can overlap
+        downstream work (host encode) with the remaining round-trips.
+        Offline backends yield from memory.
+
+        The body below is a real default, not a stub: a third-party backend
+        that explicitly subclasses this Protocol without overriding it
+        inherits a correct (non-streaming) implementation over
+        :meth:`partition_assignment`. Pure duck-typed backends without the
+        method at all are handled by callers via ``getattr`` fallback
+        (``generator.stream_initial_assignment``)."""
+        topics = list(topics)
+        assignment = self.partition_assignment(topics)
+        for t in topics:
+            yield t, assignment[t]
 
     def close(self) -> None: ...
 

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import sys
 
-from typing import Dict, List, Sequence
+from typing import Dict, Iterator, List, Sequence, Tuple
 
 from ..obs.metrics import counter_add, hist_ms
 from .base import BrokerInfo
@@ -112,6 +112,17 @@ class KafkaAdminBackend:
                 for p in t["partitions"]
             }
         return out
+
+    def fetch_topics(
+        self, topics: Sequence[str]
+    ) -> Iterator[Tuple[str, Dict[int, List[int]]]]:
+        """Streaming half of the backend surface. The AdminClient metadata
+        call is already a single batched RPC (nothing to pipeline), so this
+        fetches once and yields per input entry in input order."""
+        topics = list(topics)
+        assignment = self.partition_assignment(topics)
+        for t in topics:
+            yield t, assignment[t]
 
     def close(self) -> None:
         if self._impl == "kafka-python":

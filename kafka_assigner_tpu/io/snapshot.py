@@ -17,7 +17,7 @@ metadata so the CLI, tests, and batched what-if sweeps run without a cluster:
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Sequence
+from typing import Dict, Iterator, List, Sequence, Tuple
 
 from ..obs.metrics import counter_add
 from .base import BrokerInfo
@@ -53,6 +53,20 @@ class SnapshotBackend:
 
     def all_topics(self) -> List[str]:
         return list(self._topics)
+
+    def fetch_topics(
+        self, topics: Sequence[str]
+    ) -> Iterator[Tuple[str, Dict[int, List[int]]]]:
+        """Streaming half of the backend surface, trivially: the snapshot is
+        already in memory, so this just yields per input entry in input
+        order (missing topics raise up front, exactly like
+        :meth:`partition_assignment`)."""
+        topics = list(topics)
+        missing = [t for t in topics if t not in self._topics]
+        if missing:
+            raise KeyError(f"topics not in snapshot: {missing}")
+        for t in topics:
+            yield t, {p: list(r) for p, r in self._topics[t].items()}
 
     def partition_assignment(
         self, topics: Sequence[str]

@@ -16,9 +16,10 @@ covers every offline use.
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Sequence
+from collections import deque
+from typing import Dict, Iterator, List, Sequence, Tuple
 
-from ..obs.metrics import counter_add
+from ..obs.metrics import counter_add, gauge_set
 from ..obs.trace import span
 from .base import BrokerInfo
 
@@ -73,13 +74,54 @@ class ZkBackend:
         self._zk = client_cls(hosts=connect_string, timeout=ZK_TIMEOUT_S)
         self._zk.start(timeout=ZK_TIMEOUT_S)
 
+    def _iter_gets(
+        self, paths: Sequence[str]
+    ) -> Iterator[Tuple[bytes, object]]:
+        """``(data, stat)`` per path, in path order — pipelined where the
+        client allows it. Wire client: the xid-matched ``iter_get`` window.
+        Kazoo: a sliding window of async handles (kazoo pipelines on its own
+        connection thread; the window bounds outstanding memory). Anything
+        else: serial gets.
+
+        Runs on whatever thread is consuming the iterator (the streaming
+        ingest's producer thread) — metrics only, no tracing spans (the span
+        stack belongs to the orchestration thread).
+        """
+        if not paths:
+            return
+        iter_get = getattr(self._zk, "iter_get", None)
+        if iter_get is not None:
+            yield from iter_get(paths)
+            return
+        get_async = getattr(self._zk, "get_async", None)
+        if get_async is not None:
+            from ..utils.env import env_int
+
+            window = env_int("KA_ZK_PIPELINE")
+            counter_add("zk.pipeline.batches")
+            gauge_set("zk.pipeline.in_flight", min(window, len(paths)))
+            counter_add(
+                "zk.pipeline.rtts_saved",
+                len(paths) - -(-len(paths) // window),
+            )
+            handles: deque = deque()
+            for path in paths:
+                handles.append(get_async(path))
+                if len(handles) >= window:
+                    yield handles.popleft().get(timeout=ZK_TIMEOUT_S)
+            while handles:
+                yield handles.popleft().get(timeout=ZK_TIMEOUT_S)
+            return
+        for path in paths:
+            yield self._zk.get(path)
+
     def brokers(self) -> List[BrokerInfo]:
         out = []
         with span("zk/brokers"):
             children = sorted(self._zk.get_children("/brokers/ids"), key=int)
             counter_add("zk.reads")
-            for bid in children:
-                raw, _ = self._zk.get(f"/brokers/ids/{bid}")
+            paths = [f"/brokers/ids/{bid}" for bid in children]
+            for bid, (raw, _) in zip(children, self._iter_gets(paths)):
                 counter_add("zk.reads")
                 counter_add("zk.bytes", len(raw))
                 meta = json.loads(raw)
@@ -96,20 +138,34 @@ class ZkBackend:
         counter_add("zk.reads")
         return sorted(self._zk.get_children("/brokers/topics"))
 
+    def fetch_topics(
+        self, topics: Sequence[str]
+    ) -> Iterator[Tuple[str, Dict[int, List[int]]]]:
+        """Batched topic-metadata fetch: yields ``(topic, {partition:
+        [replica ids]})`` per input entry, in input order, as pipelined
+        responses arrive — the streaming half of the ``MetadataBackend``
+        surface (``io/base.py``). Duplicates are fetched per occurrence,
+        like the serial loop. A missing topic raises the wire client's
+        ``NoNodeError`` (kazoo: its own ``NoNodeError``) at that topic's
+        position."""
+        topics = list(topics)
+        paths = [f"/brokers/topics/{topic}" for topic in topics]
+        for topic, (raw, _) in zip(topics, self._iter_gets(paths)):
+            counter_add("zk.reads")
+            counter_add("zk.bytes", len(raw))
+            meta = json.loads(raw)
+            yield topic, {
+                int(p): [int(x) for x in replicas]
+                for p, replicas in meta.get("partitions", {}).items()
+            }
+
     def partition_assignment(
         self, topics: Sequence[str]
     ) -> Dict[str, Dict[int, List[int]]]:
         out: Dict[str, Dict[int, List[int]]] = {}
         with span("zk/partition_assignment"):
-            for topic in topics:
-                raw, _ = self._zk.get(f"/brokers/topics/{topic}")
-                counter_add("zk.reads")
-                counter_add("zk.bytes", len(raw))
-                meta = json.loads(raw)
-                out[topic] = {
-                    int(p): [int(x) for x in replicas]
-                    for p, replicas in meta.get("partitions", {}).items()
-                }
+            for topic, parts in self.fetch_topics(topics):
+                out[topic] = parts
         return out
 
     def close(self) -> None:

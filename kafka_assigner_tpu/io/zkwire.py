@@ -16,18 +16,34 @@ protocol (ZooKeeper's jute serialization, unchanged since 3.0):
 - ``closeSession`` (type -11).
 
 No watches, no ephemerals, no writes, no reconnects: the CLI opens a
-session, reads the broker/topic znodes, and closes — all inside the
-reference's own 10 s timeout envelope. ``tests/test_zk_socket.py`` runs this
-client against an in-process jute server over a real TCP socket (and runs
-kazoo against the same server when it is installed).
+session, reads the broker/topic znodes, and closes. The reference's 10 s
+timeout bounds each connect attempt and each in-session read; session
+ESTABLISHMENT may retry up to ``KA_ZK_CONNECT_RETRIES`` loudly-warned
+passes over the endpoint list with backoff, so the worst-case connect
+envelope is ``passes x endpoints x timeout`` against a SYN-blackholing
+quorum — set the knob to 1 to restore a single-pass bound.
+``tests/test_zk_socket.py`` runs this client against an in-process jute
+server over a real TCP socket (and runs kazoo against the same server when
+it is installed).
+
+Reads pipeline (ISSUE 4): ``get_many``/``iter_get`` keep up to
+``KA_ZK_PIPELINE`` requests in flight on the session socket with
+out-of-order-safe xid matching, so N znode reads cost ~``ceil(N/window)``
+round-trips instead of N; a window of one degrades to the exact serial
+frame sequence (``tests/test_zk_golden_frames.py`` pins both byte-for-byte
+against spec-derived frames). Session connects retry across the shuffled
+endpoint list with backoff (``KA_ZK_CONNECT_RETRIES``).
 """
 from __future__ import annotations
 
+import random
 import socket
 import struct
-from typing import List, NamedTuple, Optional, Tuple
+import sys
+import time
+from typing import Iterator, List, NamedTuple, Optional, Sequence, Tuple
 
-from ..obs.metrics import counter_add, hist_ms
+from ..obs.metrics import counter_add, gauge_set, hist_observe, hist_ms
 
 #: ZooKeeper opcodes (zookeeper.ZooDefs.OpCode).
 OP_GET_DATA = 4
@@ -135,27 +151,55 @@ class MiniZkClient:
         self._timeout = timeout
         self._sock: Optional[socket.socket] = None
         self._xid = 0
+        self._max_in_flight = 0  # high-water mark across this session
 
     # -- session ----------------------------------------------------------
 
     def start(self, timeout: Optional[float] = None) -> None:
+        """Establish a session: up to ``KA_ZK_CONNECT_RETRIES`` passes over
+        the endpoint list (shuffled once, like production ZK clients, so a
+        fleet of callers does not pile onto the first quorum member), with
+        exponential backoff between passes. Every failed pass is warned on
+        stderr — a silent half-minute of retries looks exactly like a hang."""
+        from ..utils.env import env_int
+
         deadline_t = timeout if timeout is not None else self._timeout
+        retries = env_int("KA_ZK_CONNECT_RETRIES")
+        endpoints = list(self._endpoints)
+        random.shuffle(endpoints)
         last_err: Optional[Exception] = None
-        for host, port in self._endpoints:
-            try:
-                sock = socket.create_connection((host, port), deadline_t)
-                sock.settimeout(deadline_t)
-                self._sock = sock
-                self._handshake(int(deadline_t * 1000))
-                return
-            except (OSError, ZkWireError) as e:
-                last_err = e
-                if self._sock is not None:
-                    self._sock.close()
-                    self._sock = None
+        for attempt in range(1, retries + 1):
+            for host, port in endpoints:
+                try:
+                    sock = socket.create_connection((host, port), deadline_t)
+                    sock.settimeout(deadline_t)
+                    # Pipelining sends many small frames back-to-back; with
+                    # Nagle on, each write after the first stalls on the
+                    # peer's delayed ACK (~40 ms on many stacks) — the exact
+                    # latency this client exists to remove.
+                    sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
+                    self._sock = sock
+                    self._handshake(int(deadline_t * 1000))
+                    return
+                except (OSError, ZkWireError) as e:
+                    last_err = e
+                    if self._sock is not None:
+                        self._sock.close()
+                        self._sock = None
+            if attempt < retries:
+                backoff = min(0.1 * (2 ** (attempt - 1)), 2.0)
+                print(
+                    f"kafka-assigner: ZooKeeper connect pass {attempt}/"
+                    f"{retries} failed over {len(endpoints)} endpoint(s) "
+                    f"({last_err}); retrying in {backoff:.1f}s",
+                    file=sys.stderr,
+                )
+                time.sleep(backoff)
         raise ZkWireError(
             f"could not establish a ZooKeeper session with any of "
-            f"{self._endpoints}: {last_err}"
+            f"{endpoints} after {retries} pass(es): {last_err}"
         )
 
     def _handshake(self, timeout_ms: int) -> None:
@@ -216,6 +260,20 @@ class MiniZkClient:
 
     def _call_inner(self, op: int, xid: int, payload: bytes) -> _Reader:
         self._send_frame(struct.pack(">ii", xid, op) + payload)
+        rxid, err, r = self._recv_reply()
+        if rxid != xid:
+            raise ZkWireError(
+                f"ZooKeeper reply xid {rxid} does not match request {xid}"
+            )
+        if err == ERR_NONODE:
+            raise NoNodeError(f"znode does not exist (err {err})")
+        if err != 0:
+            raise ZkWireError(f"ZooKeeper error {err}")
+        return r
+
+    def _recv_reply(self) -> Tuple[int, int, _Reader]:
+        """One reply frame's ``ReplyHeader`` (xid, err) plus its body reader,
+        skipping stray ping replies (the session-keepalive xid)."""
         while True:
             r = _Reader(self._recv_frame())
             rxid = r.read_int()
@@ -223,15 +281,7 @@ class MiniZkClient:
             err = r.read_int()
             if rxid == PING_XID:  # stray ping reply; not ours
                 continue
-            if rxid != xid:
-                raise ZkWireError(
-                    f"ZooKeeper reply xid {rxid} does not match request {xid}"
-                )
-            if err == ERR_NONODE:
-                raise NoNodeError(f"znode does not exist (err {err})")
-            if err != 0:
-                raise ZkWireError(f"ZooKeeper error {err}")
-            return r
+            return rxid, err, r
 
     def _path(self, path: str) -> str:
         return (self._chroot + path) if self._chroot else path
@@ -251,6 +301,145 @@ class MiniZkClient:
         r = self._call(OP_GET_DATA, _pack_str(self._path(path)) + b"\x00")
         data = r.read_buffer() or b""
         return data, r.read_stat()
+
+    # -- pipelined reads --------------------------------------------------
+
+    def iter_get(
+        self, paths: Sequence[str]
+    ) -> Iterator[Tuple[bytes, ZnodeStat]]:
+        """Pipelined ``getData`` over the session socket: up to
+        ``KA_ZK_PIPELINE`` requests in flight at once, responses matched by
+        xid (ZooKeeper answers a session's requests in order, but the
+        matching is out-of-order-safe by construction — a reordering proxy
+        or a future multi-op cannot silently mis-pair results). Yields
+        ``(data, stat)`` in request order as responses arrive, so callers
+        can overlap downstream work with the remaining round-trips.
+
+        Failure contract: a per-response timeout raises loudly, naming the
+        outstanding window; a server-reported error (``NoNodeError`` for a
+        missing znode) stops new sends, drains the already-sent window —
+        keeping the session usable, exactly like a failed serial ``get`` —
+        and is raised at the failing path's position in request order, after
+        every earlier result has been yielded. With a window of one the
+        frame sequence on the wire is byte-identical to serial ``get``
+        calls.
+
+        Abandoning the iterator early (``break``, GeneratorExit) drains the
+        in-flight window on close, so the session stays usable for
+        subsequent calls. Latency accounting note: pipelined reads report
+        ``zk.pipeline.batch_ms`` only — a reply's arrival time inside a
+        window is not a per-op latency, so they deliberately do NOT feed the
+        serial ``zk.op_ms`` histogram (which therefore covers serial ops
+        only).
+
+        Not thread-safe: one pipelined batch (or serial call) at a time per
+        client — the streaming ingest hands the whole client to its producer
+        thread for the duration of the batch.
+        """
+        if self._sock is None:
+            raise ZkWireError("ZooKeeper session is not started")
+        from ..utils.env import env_int
+
+        window = env_int("KA_ZK_PIPELINE")
+        n = len(paths)
+        if n == 0:
+            return
+        t0 = time.perf_counter()
+        counter_add("zk.pipeline.batches")
+        pending: dict = {}   # xid -> request position
+        ready: dict = {}     # position -> (data, stat) | ZkWireError
+        sent = 0
+        yielded = 0
+        failed = False       # stop filling the window once an error lands
+        desynced = False     # socket state unknown: draining cannot help
+        try:
+            while yielded < n:
+                while sent < n and len(pending) < window and not failed:
+                    self._xid += 1
+                    self._send_frame(
+                        struct.pack(">ii", self._xid, OP_GET_DATA)
+                        + _pack_str(self._path(paths[sent])) + b"\x00"
+                    )
+                    pending[self._xid] = sent
+                    sent += 1
+                    if len(pending) > self._max_in_flight:
+                        self._max_in_flight = len(pending)
+                        gauge_set(
+                            "zk.pipeline.in_flight", self._max_in_flight
+                        )
+                if pending:
+                    try:
+                        rxid, err, r = self._recv_reply()
+                    except socket.timeout:
+                        desynced = True
+                        raise ZkWireError(
+                            f"timed out waiting for {len(pending)} pipelined "
+                            f"ZooKeeper replies (window {window}, first "
+                            f"outstanding path "
+                            f"{paths[min(pending.values())]!r})"
+                        ) from None
+                    pos = pending.pop(rxid, None)
+                    if pos is None:
+                        desynced = True
+                        raise ZkWireError(
+                            f"ZooKeeper reply xid {rxid} matches no "
+                            f"in-flight pipelined request "
+                            f"(window {sorted(pending)})"
+                        )
+                    if err == ERR_NONODE:
+                        ready[pos] = NoNodeError(
+                            f"znode does not exist: {paths[pos]!r} "
+                            f"(err {err})"
+                        )
+                        failed = True
+                    elif err != 0:
+                        ready[pos] = ZkWireError(
+                            f"ZooKeeper error {err} for {paths[pos]!r}"
+                        )
+                        failed = True
+                    else:
+                        data = r.read_buffer() or b""
+                        ready[pos] = (data, r.read_stat())
+                while yielded in ready:
+                    res = ready[yielded]
+                    if isinstance(res, ZkWireError):
+                        if pending:  # drain the in-flight window first so
+                            break    # the session stays usable after raise
+                        raise res
+                    del ready[yielded]
+                    yielded += 1
+                    if yielded == n:
+                        # Account BEFORE the final yield: consumers like
+                        # zip() abandon the generator at its last item, so
+                        # code after the loop would never run.
+                        counter_add(
+                            "zk.pipeline.rtts_saved", n - -(-n // window)
+                        )
+                        hist_observe(
+                            "zk.pipeline.batch_ms",
+                            (time.perf_counter() - t0) * 1e3,
+                        )
+                    yield res
+        finally:
+            # Early abandonment (break/GeneratorExit) leaves replies for the
+            # in-flight window unread on the socket; the next serial call
+            # would mis-pair them as stale xids. Drain them here — unless the
+            # socket is already desynced/broken, where reading again can only
+            # block or re-fail (swallowed: the original error wins).
+            if pending and not desynced:
+                try:
+                    while pending:
+                        rxid, _, _ = self._recv_reply()
+                        pending.pop(rxid, None)
+                except (OSError, ZkWireError):
+                    pass
+
+    def get_many(
+        self, paths: Sequence[str]
+    ) -> List[Tuple[bytes, ZnodeStat]]:
+        """Batch primitive over :meth:`iter_get`: all results at once, in
+        request order."""
+        return list(self.iter_get(paths))
 
     # -- teardown ---------------------------------------------------------
 

@@ -10,6 +10,8 @@ width, powers of two on the batch axis (``batch_bucket``).
 """
 from __future__ import annotations
 
+import dataclasses
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Mapping, Sequence, Set
 
@@ -377,6 +379,86 @@ def encode_topic_group(
             )
         )
     return encs, currents, jhashes, p_reals
+
+
+class GroupEncodeAccumulator:
+    """Incremental :func:`encode_topic_group`: feed topic chunks as they
+    arrive (the streaming ZooKeeper ingest, ``generator.py``), then
+    :meth:`finish` into the exact arrays the one-shot group encode would
+    have produced.
+
+    Why chunking is safe: the group-wide buckets are maxima of per-topic
+    shapes (``p_pad = _pad8(max p)``, ``width = max(w, 2)``,
+    ``b_pad = batch_bucket(B)``), and the encoded *values* — the id→index
+    mapping, jhashes, p_reals — never depend on which other topics share the
+    batch. So each chunk encodes with its own (smaller) buckets while later
+    responses are still in flight — that is the expensive dict-walking /
+    ``searchsorted`` work — and ``finish`` only block-copies the chunk slabs
+    into the final group-bucketed arrays: byte-identical to the one-shot
+    encode by construction (test-pinned, any chunk size).
+
+    Replication factors are usually not known until the whole topic list is
+    in hand (RF inference is L2's job, after ingest); chunks encode with a
+    placeholder ``rf`` and the consumer rewrites it on the finished
+    encodings (``dataclasses.replace``) — ``rf`` is carried metadata, not an
+    input to the array encode.
+    """
+
+    def __init__(
+        self, rack_assignment: Mapping[int, str], nodes: Set[int]
+    ) -> None:
+        self.cluster = encode_cluster(rack_assignment, nodes)
+        self._chunks: List[tuple] = []  # (encs, currents, jhashes, p_reals)
+        self._total = 0
+        self.encode_ms = 0.0  # host time spent in add() — the overlap numerator
+
+    def add(self, named_currents: Sequence[tuple], rfs: int = 0) -> None:
+        """Encode one chunk of ``(topic, current_assignment)`` pairs (in
+        stream order) against the shared cluster encoding."""
+        if not named_currents:
+            return
+        t0 = time.perf_counter()
+        out = encode_topic_group(
+            named_currents, {}, set(), [rfs] * len(named_currents),
+            cluster=self.cluster,
+        )
+        self._chunks.append(out)
+        self._total += len(named_currents)
+        self.encode_ms += (time.perf_counter() - t0) * 1000.0
+
+    def finish(self) -> tuple:
+        """Merge the chunk slabs into group-wide buckets; returns the same
+        ``(encs, currents, jhashes, p_reals)`` tuple as one-shot
+        :func:`encode_topic_group` over the concatenated chunks."""
+        if not self._chunks:
+            return (
+                [],
+                np.full((1, 8, 2), -1, dtype=np.int32),
+                np.zeros(1, dtype=np.int32),
+                np.zeros(1, dtype=np.int32),
+            )
+        p_pad = max(c[1].shape[1] for c in self._chunks)
+        width = max(c[1].shape[2] for c in self._chunks)
+        b_pad = batch_bucket(self._total)
+        currents = np.full((b_pad, p_pad, width), -1, dtype=np.int32)
+        jhashes = np.zeros(b_pad, dtype=np.int32)
+        p_reals = np.zeros(b_pad, dtype=np.int32)
+        encs: List[ProblemEncoding] = []
+        i = 0
+        for cencs, ccur, cjh, cpr in self._chunks:
+            b = len(cencs)
+            currents[i:i + b, : ccur.shape[1], : ccur.shape[2]] = ccur[:b]
+            jhashes[i:i + b] = cjh[:b]
+            p_reals[i:i + b] = cpr[:b]
+            for k, e in enumerate(cencs):
+                encs.append(
+                    dataclasses.replace(
+                        e, current=currents[i + k], p_pad=p_pad
+                    )
+                )
+            i += b
+        self._chunks = []
+        return encs, currents, jhashes, p_reals
 
 
 def _encode_topic_group_codec(codec, named_currents, rfs, cluster):

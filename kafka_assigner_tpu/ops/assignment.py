@@ -30,6 +30,7 @@ here falls back to the host inside ``jit``.
 from __future__ import annotations
 
 import sys
+from types import MappingProxyType
 from typing import NamedTuple, Tuple
 
 import jax
@@ -650,7 +651,11 @@ def _seq_fill(
 #:               leg is what makes the default chains a TRUE superset of
 #:               the reference: any instance greedy solves, the chain
 #:               solves (identically, when it falls through to this leg).
-WAVE_MODES = {
+#: MappingProxyType, not a plain dict: ``_resolve_wave_plan`` reads this
+#: under jit trace, and kalint KA007 (rightly) flags mutable globals closed
+#: over by traced code — a mid-process mutation would be silently baked into
+#: every cached executable. The proxy makes the freeze real.
+WAVE_MODES = MappingProxyType({
     "auto": ("fast", "dense", "balance", "seq"),
     "fresh": ("balance", "fast", "dense", "seq"),
     "fast": ("fast",),
@@ -670,7 +675,7 @@ WAVE_MODES = {
     # on-chip timing. Production chains get it auto-inserted before every
     # node-per-wave balance leg at giant shapes (see spread_orphans).
     "balance_quota": ("balance_quota",),
-}
+})
 
 
 def _resolve_wave_plan(

@@ -34,6 +34,7 @@ import numpy as np
 
 from ..models.problem import (
     apply_counter_updates,
+    encode_cluster,
     encode_topic_group,
     context_to_array,
     decode_assignment,
@@ -294,6 +295,7 @@ class TpuSolver:
         nodes: Set[int],
         replication_factor,  # int, or Sequence[int] per topic (mixed RF)
         context: Context | None = None,
+        preencoded: tuple | None = None,
     ) -> List[tuple]:
         """Solve a group of topics in ONE device dispatch, returning
         ``[(topic, assignment), ...]`` in input order (duplicate topic names
@@ -310,6 +312,15 @@ class TpuSolver:
         serially in the given order, while dispatch/transfer latency is paid
         once per run instead of once per topic. Every topic is padded to the
         group-wide (P, L) bucket; padded rows are inert.
+
+        ``preencoded``: an ``encode_topic_group``-shaped tuple ``(encs,
+        currents, jhashes, p_reals)`` for exactly these topics in this order,
+        built while metadata responses were still streaming in (the ingest/
+        encode overlap, ``generator.stream_initial_assignment``). The encode
+        phase then only rewrites the per-topic ``rf`` metadata and builds the
+        counter slab; the arrays are identical to what the in-line encode
+        would produce (pinned by ``tests/test_zk_ingest_stream.py``), so
+        everything downstream is oblivious.
         """
         import jax
         import jax.numpy as jnp
@@ -340,12 +351,44 @@ class TpuSolver:
             rf_list = [int(r) for r in replication_factor]
         rf_max = max(rf_list)
         with span("encode", sink=phase_ms, log=phase_log):
-            # Fused one-pass group encode; the batch axis is bucketed like
-            # every other axis (padding topics are inert: empty current,
-            # p_real 0), so topic-count changes reuse the compiled scan.
-            encs, currents, jhashes, p_reals = encode_topic_group(
-                named_currents, rack_assignment, nodes, rf_list,
-            )
+            if preencoded is not None:
+                encs, currents, jhashes, p_reals = preencoded
+                if len(encs) != len(named_currents) or any(
+                    e.topic != t for e, (t, _) in zip(encs, named_currents)
+                ):
+                    raise ValueError(
+                        "preencoded group does not match the topic batch "
+                        f"({len(encs)} encodings for {len(named_currents)} "
+                        "topics)"
+                    )
+                # The encode bakes in the broker set and rack map; a stale
+                # preencode (e.g. reused after a broker removal) would
+                # silently solve against the wrong cluster and emit a plan
+                # an operator could apply. encode_cluster is O(N) — noise
+                # next to the solve.
+                cluster = encode_cluster(rack_assignment, nodes)
+                if not (
+                    np.array_equal(encs[0].broker_ids, cluster.broker_ids)
+                    and np.array_equal(encs[0].rack_idx, cluster.rack_idx)
+                ):
+                    raise ValueError(
+                        "preencoded group was built against a different "
+                        "broker set or rack assignment than this solve"
+                    )
+                # rf is carried metadata, not an encode input: the streaming
+                # encoder ran before RF inference, so stamp the real values.
+                encs = [
+                    dataclasses.replace(e, rf=rf)
+                    for e, rf in zip(encs, rf_list)
+                ]
+            else:
+                # Fused one-pass group encode; the batch axis is bucketed
+                # like every other axis (padding topics are inert: empty
+                # current, p_real 0), so topic-count changes reuse the
+                # compiled scan.
+                encs, currents, jhashes, p_reals = encode_topic_group(
+                    named_currents, rack_assignment, nodes, rf_list,
+                )
             if obs_active():
                 # Bucketing cost, visible per run: the fraction of the
                 # padded (B, P) slab that is padding, not real partitions.

@@ -185,6 +185,37 @@ _knob(
         "server",
 )
 
+_knob(
+    "KA_ZK_PIPELINE", "int", 32, floor=1,
+    doc="max in-flight pipelined requests per ZooKeeper session: the wire "
+        "client's xid-matched `get_many`/`iter_get` window (`io/zkwire.py`) "
+        "and the kazoo backend's async-handle window, so N metadata reads "
+        "cost ~ceil(N/window) round-trips instead of N. `1` degrades to "
+        "exactly the serial request/response behavior "
+        "(`tests/test_zk_golden_frames.py` pins byte-identical decodes)",
+)
+_knob(
+    "KA_ZK_CONNECT_RETRIES", "int", 3, floor=1,
+    doc="connection passes over the shuffled ZooKeeper endpoint list before "
+        "the wire client gives up (`zkwire.MiniZkClient.start`); exponential "
+        "backoff between passes (0.1 s doubling, capped at 2 s), every "
+        "failed pass warned on stderr",
+)
+_knob(
+    "KA_ZK_INGEST_CHUNK", "int", 64, floor=1,
+    doc="topics per streamed host-encode chunk in the mode-3 ingest/encode "
+        "overlap (`generator.py`): fetched topics fold into the batched "
+        "encode in chunks of this size while later ZooKeeper responses are "
+        "still in flight (chunk-size-invariant output by construction)",
+)
+_knob(
+    "KA_ZK_OVERLAP", "bool", True,
+    doc="overlap pipelined metadata ingest with host encode via the "
+        "producer/consumer topic stream (`generator.py`); set to 0 to "
+        "restore strictly sequential fetch-then-encode (byte-identical "
+        "output either way, test-pinned)",
+)
+
 # --- runtime / observability ------------------------------------------------
 _knob(
     "KA_COMPILE_CACHE", "bool", True,

@@ -1,0 +1,167 @@
+"""Hermetic ZooKeeper-ingest microbench (ISSUE 4 acceptance): serial gets
+vs pipelined ``get_many`` vs pipelined fetch overlapped with host encode,
+against the in-tree jute server (``tests/test_zk_socket.py``) with injected
+per-reply latency — the RTT cost a real quorum imposes, reproduced on
+loopback.
+
+The serial path pays one injected RTT per znode (`O(topics)` round-trips —
+what the pre-ISSUE-4 wire client did); the pipelined path pays roughly
+``ceil(topics / KA_ZK_PIPELINE)``; the overlap path additionally hides the
+host ``encode_topic_group`` work inside the remaining round-trips via the
+production ``stream_initial_assignment`` producer/consumer (the exact code
+path mode 3 runs).
+
+Run:  python scripts/bench_zk_ingest.py [--topics 500] [--rtt-ms 1.0]
+Emits BENCH_zk_ingest.json (one JSON object, BENCH_* artifact style) and a
+one-line summary on stderr. The acceptance gate — >= 5x pipelined speedup
+at 1 ms RTT x 500 topics and byte-identical decoded metadata — is asserted
+here, not eyeballed.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def build_tree(n_topics: int, n_brokers: int = 12, partitions: int = 8):
+    brokers = {
+        str(i): {"host": f"h{i}", "port": 9092, "rack": f"r{i % 3}"}
+        for i in range(n_brokers)
+    }
+    tree = {}
+    for bid, meta in brokers.items():
+        tree[f"/brokers/ids/{bid}"] = json.dumps(meta).encode()
+    for t in range(n_topics):
+        parts = {
+            str(p): [(p + t + r) % n_brokers for r in range(3)]
+            for p in range(partitions)
+        }
+        tree[f"/brokers/topics/topic-{t:04d}"] = json.dumps(
+            {"partitions": parts}
+        ).encode()
+    return tree
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--topics", type=int, default=500)
+    parser.add_argument("--rtt-ms", type=float, default=1.0)
+    parser.add_argument("--out", default=os.path.join(
+        _REPO, "BENCH_zk_ingest.json"
+    ))
+    args = parser.parse_args()
+
+    from tests.test_zk_socket import JuteZkServer
+
+    from kafka_assigner_tpu.generator import stream_initial_assignment
+    from kafka_assigner_tpu.io.zk import ZkBackend
+    from kafka_assigner_tpu.io.zkwire import MiniZkClient
+    from kafka_assigner_tpu.models.problem import encode_topic_group
+    from kafka_assigner_tpu.utils.env import knob_default
+
+    os.environ.setdefault("KA_ZK_CLIENT", "wire")
+    window = int(os.environ.get("KA_ZK_PIPELINE") or
+                 knob_default("KA_ZK_PIPELINE"))
+
+    tree = build_tree(args.topics)
+    topic_names = sorted(
+        p.rsplit("/", 1)[1] for p in tree if p.startswith("/brokers/topics/")
+    )
+    paths = [f"/brokers/topics/{t}" for t in topic_names]
+    server = JuteZkServer(tree, reply_delay_s=args.rtt_ms / 1000.0)
+    server.start()
+    hosts = f"127.0.0.1:{server.port}"
+
+    try:
+        # -- serial: one blocking round-trip per znode (the old client) ----
+        client = MiniZkClient(hosts, timeout=30.0)
+        client.start()
+        t0 = time.perf_counter()
+        serial = [client.get(p) for p in paths]
+        serial_s = time.perf_counter() - t0
+        client.stop()
+        client.close()
+
+        # -- pipelined: xid-matched window over the same socket ------------
+        client = MiniZkClient(hosts, timeout=30.0)
+        client.start()
+        t0 = time.perf_counter()
+        pipelined = client.get_many(paths)
+        pipelined_s = time.perf_counter() - t0
+        client.stop()
+        client.close()
+
+        if pipelined != serial:
+            raise SystemExit(
+                "FAIL: pipelined decode differs from serial decode"
+            )
+
+        # -- pipelined + encode overlap: the production mode-3 ingest ------
+        backend = ZkBackend(hosts)
+        live = {int(b.id) for b in backend.brokers()}
+        racks = {b.id: b.rack for b in backend.brokers() if b.rack}
+        # Reference: sequential fetch-then-encode on the pipelined client.
+        t0 = time.perf_counter()
+        initial_seq = backend.partition_assignment(topic_names)
+        encode_topic_group(
+            [(t, initial_seq[t]) for t in topic_names], racks, live, 0
+        )
+        fetch_then_encode_s = time.perf_counter() - t0
+        backend.close()
+
+        backend = ZkBackend(hosts)
+        t0 = time.perf_counter()
+        initial, pre = stream_initial_assignment(
+            backend, topic_names, live, racks, want_encode=True
+        )
+        overlap_s = time.perf_counter() - t0
+        backend.close()
+        if initial != initial_seq or pre is None:
+            raise SystemExit("FAIL: streamed ingest diverged from serial")
+    finally:
+        server.shutdown()
+
+    result = {
+        "bench": "zk_ingest",
+        "topics": args.topics,
+        "rtt_ms": args.rtt_ms,
+        "window": window,
+        "serial_s": round(serial_s, 4),
+        "pipelined_s": round(pipelined_s, 4),
+        "fetch_then_encode_s": round(fetch_then_encode_s, 4),
+        "pipelined_overlap_s": round(overlap_s, 4),
+        "speedup_pipelined": round(serial_s / pipelined_s, 2),
+        "speedup_overlap_vs_serial_ingest": round(
+            (serial_s + (fetch_then_encode_s - pipelined_s)) / overlap_s, 2
+        ),
+        "decoded_identical": True,
+    }
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result), file=sys.stderr)
+    if args.topics >= 500 and args.rtt_ms >= 1.0:
+        if result["speedup_pipelined"] < 5.0:
+            print(
+                f"FAIL: pipelined speedup {result['speedup_pipelined']}x "
+                "< 5x acceptance floor", file=sys.stderr,
+            )
+            return 1
+        print(
+            f"OK: {result['speedup_pipelined']}x pipelined, overlap ingest "
+            f"{result['pipelined_overlap_s']}s vs fetch-then-encode "
+            f"{result['fetch_then_encode_s']}s", file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

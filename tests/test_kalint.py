@@ -219,6 +219,108 @@ def test_ka006_does_not_flag_other_jax_api_calls():
     assert "KA006" not in rules_of(kalint.lint_source(src, "foo.py"))
 
 
+# --- KA007: jit-traced functions closing over mutable globals ----------------
+
+KA007_SNIPPET = (
+    "import jax\n"
+    "CACHE = {}\n"
+    "\n"
+    "@jax.jit\n"
+    "def kernel(x):\n"
+    "    return x + CACHE['bias']\n"
+)
+
+
+def test_ka007_trips_on_mutable_global_read_under_trace():
+    findings = kalint.lint_source(KA007_SNIPPET, "solvers/custom.py")
+    assert any(
+        f.rule == "KA007" and f.line == 6 and "CACHE" in f.message
+        for f in findings
+    )
+
+
+def test_ka007_untraced_function_is_host_code():
+    src = "CACHE = {}\n\ndef host():\n    return CACHE\n"
+    assert kalint.lint_source(src, "generator.py") == []
+
+
+@pytest.mark.parametrize("binding", [
+    "TABLE = [1, 2]",
+    "TABLE = set()",
+    "TABLE = dict(a=1)",
+    "TABLE = {k: k for k in range(3)}",
+    "TABLE: dict = {}",
+])
+def test_ka007_mutable_binding_forms(binding):
+    src = (
+        f"import jax\n{binding}\n\n"
+        "@jax.jit\ndef kernel(x):\n    return TABLE and x\n"
+    )
+    assert "KA007" in rules_of(kalint.lint_source(src, "foo.py"))
+
+
+@pytest.mark.parametrize("binding", [
+    "TABLE = (1, 2)",
+    "TABLE = frozenset({1})",
+    "from types import MappingProxyType\nTABLE = MappingProxyType({'a': 1})",
+])
+def test_ka007_immutable_bindings_are_clean(binding):
+    src = (
+        f"import jax\n{binding}\n\n"
+        "@jax.jit\ndef kernel(x):\n    return TABLE and x\n"
+    )
+    assert "KA007" not in rules_of(kalint.lint_source(src, "foo.py"))
+
+
+def test_ka007_local_shadow_is_clean():
+    src = (
+        "import jax\nCACHE = {}\n\n"
+        "@jax.jit\ndef kernel(x):\n"
+        "    CACHE = {'bias': 1}\n"
+        "    return x + CACHE['bias']\n"
+    )
+    assert "KA007" not in rules_of(kalint.lint_source(src, "foo.py"))
+
+
+def test_ka007_follows_same_module_callees_of_jit_roots():
+    src = (
+        "import jax\nMODES = {'a': 1}\n\n"
+        "def resolve(m):\n"
+        "    return MODES[m]\n\n"
+        "def kernel(x, m):\n"
+        "    return x * resolve(m)\n\n"
+        "kernel_jit = jax.jit(kernel, static_argnames=('m',))\n"
+    )
+    findings = kalint.lint_source(src, "foo.py")
+    assert any(f.rule == "KA007" and f.line == 5 for f in findings)
+
+
+def test_ka007_trips_on_global_rebinding_under_trace():
+    src = (
+        "import jax\nSTATE = 0\n\n"
+        "@jax.jit\ndef kernel(x):\n"
+        "    global STATE\n"
+        "    STATE = x\n"
+        "    return x\n"
+    )
+    findings = kalint.lint_source(src, "foo.py")
+    assert any(
+        f.rule == "KA007" and "rebinds" in f.message for f in findings
+    )
+
+
+def test_ka007_one_finding_per_name_per_function():
+    src = (
+        "import jax\nCACHE = {}\n\n"
+        "@jax.jit\ndef kernel(x):\n"
+        "    return CACHE['a'] + CACHE['b'] + x\n"
+    )
+    findings = [
+        f for f in kalint.lint_source(src, "foo.py") if f.rule == "KA007"
+    ]
+    assert len(findings) == 1
+
+
 # --- suppressions ------------------------------------------------------------
 
 def test_suppression_with_reason_silences_the_finding():

@@ -120,6 +120,17 @@ def test_mode3_report_smoke(snapshot, tmp_path, capsys):
     assert report["metrics"]["counters"]["zk.reads"] >= 1
     assert report["metrics"]["counters"]["zk.bytes"] > 0
     assert "encode.pad_waste_frac" in report["metrics"]["gauges"]
+    # The streaming ingest (ISSUE 4) spans/gauges every mode-3 TPU run,
+    # snapshot backend included; the zk.pipeline.* counters are live-wire
+    # only and asserted in tests/test_zk_socket.py against the jute server.
+    paths = {s["path"] for s in report["spans"]}
+    assert (
+        "mode/PRINT_REASSIGNMENT/metadata/assignment/ingest/stream" in paths
+    )
+    gauges = report["metrics"]["gauges"]
+    assert gauges["ingest.topics"] == 1
+    assert gauges["ingest.encode_ms"] >= 0.0
+    assert gauges["ingest.overlap_ms"] >= 0.0
     for key in ("moves", "leader_churn", "topics", "partitions"):
         assert key in report["plan"]
     assert report["plan"]["partitions"] == 4

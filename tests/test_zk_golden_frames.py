@@ -18,6 +18,8 @@ import pathlib
 import socket
 import struct
 
+import pytest
+
 from kafka_assigner_tpu.io.zkwire import MiniZkClient
 
 from .test_zk_socket import JuteZkServer
@@ -113,6 +115,127 @@ def test_server_frames_match_spec_goldens():
         conn.close()
     finally:
         server.shutdown()
+
+
+def test_server_answers_pipelined_requests_in_order():
+    """The server side of the pipelining contract: a burst of back-to-back
+    requests (the mid-batch-error scenario's frames) is answered with the
+    spec-golden replies in request order — ZooKeeper's per-session ordering
+    guarantee, which the client's xid matching does not depend on but the
+    fixture server must still honor."""
+    server = JuteZkServer(
+        {"/brokers/ids/1": b"DATA1", "/brokers/ids/2": b"DATA2"}
+    )
+    server.start()
+    try:
+        conn = socket.create_connection(("127.0.0.1", server.port), 5.0)
+        conn.settimeout(5.0)
+        conn.sendall(_g("connect_request"))
+        burst = (
+            _g("pipelined_get_request_1")
+            + _g("pipelined_err_request_2_nope")
+            + _g("pipelined_err_request_3")
+        )
+        expect = (
+            _g("connect_response")
+            + _g("pipelined_get_response_1")
+            + _g("pipelined_err_response_2_nonode")
+            + _g("pipelined_err_response_3")
+        )
+        conn.sendall(burst)
+        got = b""
+        while len(got) < len(expect):
+            chunk = conn.recv(len(expect) - len(got))
+            assert chunk, "server closed mid-burst"
+            got += chunk
+        assert got == expect
+        conn.close()
+    finally:
+        server.shutdown()
+
+
+def _fresh_client(replies):
+    """A handshaken client over a scripted socket preloaded with ``replies``
+    (connect_response is prepended; xids then start at 1, exactly like the
+    pipelined scenario frames assume)."""
+    client = MiniZkClient("127.0.0.1:2181", timeout=10.0)
+    sock = ScriptedSock([_g("connect_response")] + list(replies))
+    client._sock = sock
+    client._handshake(10_000)
+    sock.sent = b""
+    return client, sock
+
+
+def test_pipelined_get_many_matches_spec_goldens(monkeypatch):
+    """Scenario A: two pipelined gets, replies out of order. Request bytes
+    are golden-pinned; decoded results must be byte-identical to serial
+    ``get`` calls fed the same (in-order) reply frames."""
+    monkeypatch.setenv("KA_ZK_PIPELINE", "8")
+    serial_client, _ = _fresh_client(
+        [_g("pipelined_get_response_1"), _g("pipelined_get_response_2")]
+    )
+    serial = [
+        serial_client.get("/brokers/ids/1"),
+        serial_client.get("/brokers/ids/2"),
+    ]
+    assert serial[0][0] == b"DATA1" and serial[1][0] == b"DATA2"
+
+    client, sock = _fresh_client(
+        # Out-of-order wire arrival: xid2's reply first.
+        [_g("pipelined_get_response_2"), _g("pipelined_get_response_1")]
+    )
+    results = client.get_many(["/brokers/ids/1", "/brokers/ids/2"])
+    # Both requests hit the wire back-to-back, before any reply was read.
+    assert sock.sent == (
+        _g("pipelined_get_request_1") + _g("pipelined_get_request_2")
+    )
+    assert results == serial  # byte-identical (data, Stat) decode, in order
+
+
+def test_pipelined_serial_window_is_byte_identical_on_the_wire(monkeypatch):
+    """The degradation pin: KA_ZK_PIPELINE=1 produces exactly the serial
+    frame sequence — same request bytes, one in flight at a time."""
+    monkeypatch.setenv("KA_ZK_PIPELINE", "1")
+    client, sock = _fresh_client(
+        [_g("pipelined_get_response_1"), _g("pipelined_get_response_2")]
+    )
+    results = client.get_many(["/brokers/ids/1", "/brokers/ids/2"])
+    assert sock.sent == (
+        _g("pipelined_get_request_1") + _g("pipelined_get_request_2")
+    )
+    assert [d for d, _ in results] == [b"DATA1", b"DATA2"]
+
+
+def test_pipelined_mid_batch_error_xid(monkeypatch):
+    """Scenario B: the middle request's reply is a NoNode error xid,
+    arriving after a LATER request's reply. The client yields the clean
+    prefix (byte-identical to serial), drains the window, and raises at the
+    failing position."""
+    from kafka_assigner_tpu.io.zkwire import NoNodeError
+
+    monkeypatch.setenv("KA_ZK_PIPELINE", "8")
+    serial_client, _ = _fresh_client([_g("pipelined_get_response_1")])
+    serial_first = serial_client.get("/brokers/ids/1")
+
+    client, sock = _fresh_client(
+        [
+            _g("pipelined_err_response_3"),        # later xid lands first
+            _g("pipelined_get_response_1"),
+            _g("pipelined_err_response_2_nonode"),  # the mid-batch error
+        ]
+    )
+    got = []
+    with pytest.raises(NoNodeError, match="/nope"):
+        for item in client.iter_get(
+            ["/brokers/ids/1", "/nope", "/brokers/ids/2"]
+        ):
+            got.append(item)
+    assert sock.sent == (
+        _g("pipelined_get_request_1")
+        + _g("pipelined_err_request_2_nope")
+        + _g("pipelined_err_request_3")
+    )
+    assert got == [serial_first]  # the clean prefix, byte-identical
 
 
 def test_goldens_are_self_consistent():

@@ -1,0 +1,276 @@
+"""Streaming ingest/encode overlap (ISSUE 4 tentpole): the chunked
+``GroupEncodeAccumulator`` must be byte-identical to the one-shot
+``encode_topic_group`` at every chunk size, ``stream_initial_assignment``
+must reproduce ``partition_assignment`` exactly (and hand the solver a
+pre-encode only when asked), and producer-side failures must surface on the
+orchestration thread like serial fetch failures."""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from kafka_assigner_tpu.generator import stream_initial_assignment
+from kafka_assigner_tpu.io.snapshot import SnapshotBackend
+from kafka_assigner_tpu.models.problem import (
+    GroupEncodeAccumulator,
+    encode_topic_group,
+)
+
+
+def _cluster():
+    brokers = set(range(100, 112))
+    racks = {b: f"r{b % 3}" for b in sorted(brokers) if b != 111}  # one rackless
+    topics = []
+    for i in range(9):
+        p = 1 + (i * 7) % 13
+        topics.append(
+            (
+                f"topic-{i}",
+                {
+                    pid: [100 + (pid + r + i) % 12 for r in range(2 + i % 3)]
+                    for pid in range(p)
+                },
+            )
+        )
+    # One topic with a dead broker and one with ragged replica lists: both
+    # encode paths (vectorized + general fill) must stream identically.
+    topics.append(("dead-broker", {0: [100, 999], 1: [101, 102]}))
+    topics.append(("ragged", {0: [100], 1: [101, 102, 103]}))
+    return topics, racks, brokers
+
+
+@pytest.mark.parametrize("chunk", [1, 3, 4, 64])
+def test_accumulator_matches_one_shot_encode(chunk):
+    topics, racks, brokers = _cluster()
+    rfs = [2 + i % 3 for i in range(len(topics))]
+    ref_encs, ref_cur, ref_jh, ref_pr = encode_topic_group(
+        topics, racks, brokers, rfs
+    )
+    acc = GroupEncodeAccumulator(racks, brokers)
+    for i in range(0, len(topics), chunk):
+        acc.add(topics[i:i + chunk])
+    encs, cur, jh, pr = acc.finish()
+    assert np.array_equal(cur, ref_cur)
+    assert np.array_equal(jh, ref_jh)
+    assert np.array_equal(pr, ref_pr)
+    assert len(encs) == len(ref_encs)
+    for e, r in zip(encs, ref_encs):
+        assert e.topic == r.topic
+        assert (e.n, e.p, e.n_pad, e.p_pad, e.r_cap) == (
+            r.n, r.p, r.n_pad, r.p_pad, r.r_cap
+        )
+        assert e.jhash == r.jhash
+        assert np.array_equal(e.partition_ids, r.partition_ids)
+        assert np.array_equal(e.current, r.current)
+        assert np.array_equal(e.rack_idx, r.rack_idx)
+    assert acc.encode_ms >= 0.0
+
+
+def test_accumulator_empty_group():
+    _, racks, brokers = _cluster()
+    encs, cur, jh, pr = GroupEncodeAccumulator(racks, brokers).finish()
+    assert encs == []
+    assert cur.shape == (1, 8, 2)
+
+
+@pytest.fixture()
+def snapshot(tmp_path):
+    topics, racks, brokers = _cluster()
+    cluster = {
+        "brokers": [
+            {"id": b, "host": f"h{b}", "port": 9092,
+             **({"rack": racks[b]} if b in racks else {})}
+            for b in sorted(brokers)
+        ],
+        "topics": {
+            t: {str(p): r for p, r in parts.items()}
+            for t, parts in topics
+            if t != "dead-broker"  # snapshots only carry live replicas
+        },
+    }
+    path = tmp_path / "cluster.json"
+    path.write_text(json.dumps(cluster))
+    return str(path)
+
+
+def test_stream_matches_partition_assignment(snapshot):
+    backend = SnapshotBackend(snapshot)
+    names = backend.all_topics()
+    ref = backend.partition_assignment(names)
+    initial, pre = stream_initial_assignment(backend, names)
+    assert initial == ref
+    assert pre is None  # no encode requested
+
+    topics, racks, brokers = _cluster()
+    initial, pre = stream_initial_assignment(
+        backend, names, brokers, racks, want_encode=True
+    )
+    assert initial == ref
+    ref_encs, ref_cur, ref_jh, ref_pr = encode_topic_group(
+        [(t, ref[t]) for t in names], racks, brokers, 0
+    )
+    encs, cur, jh, pr = pre
+    assert np.array_equal(cur, ref_cur)
+    assert np.array_equal(jh, ref_jh)
+    assert np.array_equal(pr, ref_pr)
+    assert [e.topic for e in encs] == [e.topic for e in ref_encs]
+
+
+def test_stream_respects_overlap_kill_switch(snapshot, monkeypatch):
+    backend = SnapshotBackend(snapshot)
+    names = backend.all_topics()
+    monkeypatch.setenv("KA_ZK_OVERLAP", "0")
+    _, racks, brokers = _cluster()
+    initial, pre = stream_initial_assignment(
+        backend, names, brokers, racks, want_encode=True
+    )
+    assert initial == backend.partition_assignment(names)
+    assert pre is None  # strictly sequential fetch-then-encode
+
+
+def test_stream_falls_back_without_fetch_topics(snapshot):
+    # Third-party backends predating fetch_topics keep working untouched.
+    backend = SnapshotBackend(snapshot)
+
+    class Legacy:
+        partition_assignment = backend.partition_assignment
+
+    names = backend.all_topics()
+    initial, pre = stream_initial_assignment(Legacy(), names)
+    assert initial == backend.partition_assignment(names)
+    assert pre is None
+
+
+def test_producer_error_reraises_on_consumer_thread(snapshot):
+    backend = SnapshotBackend(snapshot)
+    with pytest.raises(KeyError, match="no_such_topic"):
+        stream_initial_assignment(backend, ["no_such_topic"])
+
+
+def test_third_party_mixed_rf_solver_without_preencoded_kwarg():
+    # A mixed-RF batching backend predating the preencoded parameter must
+    # keep working: the kwarg is only forwarded when a preencode exists.
+    from kafka_assigner_tpu.assigner import TopicAssigner
+    from kafka_assigner_tpu.solvers.greedy import GreedySolver
+
+    class LegacyBatcher(GreedySolver):
+        supports_mixed_rf = True
+
+        def assign_many(self, named_currents, rack_assignment, nodes, rfs,
+                        context):  # no preencoded kwarg on purpose
+            return [
+                (t, self.assign(t, cur, rack_assignment, set(nodes),
+                                set(cur), rf, context))
+                for (t, cur), rf in zip(named_currents, rfs)
+            ]
+
+    brokers = set(range(1, 9))
+    racks = {b: f"r{b % 4}" for b in brokers}
+    assigner = TopicAssigner(LegacyBatcher())
+    out = assigner.generate_assignments(
+        [("t", {0: [1, 2], 1: [2, 3]})], brokers, racks, -1,
+    )
+    assert out and out[0][0] == "t"
+
+
+def test_stale_preencoded_cluster_is_rejected():
+    # A preencode reused across a broker-set change must fail loudly, not
+    # silently solve against the baked-in stale cluster.
+    import pytest as _pytest
+
+    from kafka_assigner_tpu.solvers.tpu import TpuSolver
+
+    topics = [("t", {0: [1, 2], 1: [2, 3]})]
+    racks = {1: "a", 2: "b", 3: "c", 4: "a"}
+    acc = GroupEncodeAccumulator(racks, {1, 2, 3, 4})
+    acc.add(topics)
+    pre = acc.finish()
+    with _pytest.raises(ValueError, match="different broker set"):
+        TpuSolver().assign_many(
+            topics, racks, {1, 2, 3}, 2, preencoded=pre  # broker 4 removed
+        )
+
+
+def test_explicit_protocol_subclass_inherits_working_fetch_topics(snapshot):
+    # base.py's Protocol body is a real default: an explicit subclass that
+    # never heard of fetch_topics still streams correctly (non-pipelined).
+    from kafka_assigner_tpu.io.base import MetadataBackend
+
+    inner = SnapshotBackend(snapshot)
+
+    class Subclassed(MetadataBackend):
+        def brokers(self):
+            return inner.brokers()
+
+        def all_topics(self):
+            return inner.all_topics()
+
+        def partition_assignment(self, topics):
+            return inner.partition_assignment(topics)
+
+        def close(self):
+            pass
+
+    backend = Subclassed()
+    names = inner.all_topics()
+    assert list(backend.fetch_topics(names)) == list(
+        inner.fetch_topics(names)
+    )
+    initial, pre = stream_initial_assignment(backend, names)
+    assert initial == inner.partition_assignment(names)
+
+
+def test_kazoo_async_window_path(monkeypatch):
+    # kazoo is not installed in this image; its fetch path — a sliding
+    # window of async handles — is pinned against a duck-typed fake, with
+    # the in-flight count asserted never to exceed the knob.
+    from kafka_assigner_tpu.io.zk import ZkBackend
+
+    class Handle:
+        def __init__(self, owner, path):
+            self.owner = owner
+            self.path = path
+
+        def get(self, timeout=None):
+            self.owner.outstanding -= 1
+            return (
+                json.dumps(
+                    {"partitions": {"0": [1, 2], "1": [2, 3]}}
+                ).encode(),
+                None,
+            )
+
+    class FakeKazoo:
+        def __init__(self):
+            self.outstanding = 0
+            self.max_outstanding = 0
+
+        def get_async(self, path):
+            self.outstanding += 1
+            self.max_outstanding = max(
+                self.max_outstanding, self.outstanding
+            )
+            return Handle(self, path)
+
+    monkeypatch.setenv("KA_ZK_PIPELINE", "3")
+    backend = ZkBackend.__new__(ZkBackend)
+    backend._zk = FakeKazoo()
+    names = [f"t{i}" for i in range(8)]
+    out = list(backend.fetch_topics(names))
+    assert [t for t, _ in out] == names
+    assert all(parts == {0: [1, 2], 1: [2, 3]} for _, parts in out)
+    assert backend._zk.max_outstanding == 3  # the window bound held
+
+
+def test_duplicate_topics_stream_per_occurrence(snapshot):
+    backend = SnapshotBackend(snapshot)
+    names = backend.all_topics()[:1] * 3
+    topics, racks, brokers = _cluster()
+    initial, pre = stream_initial_assignment(
+        backend, names, brokers, racks, want_encode=True
+    )
+    assert list(initial) == names[:1]
+    encs, cur, jh, pr = pre
+    assert [e.topic for e in encs] == names  # solved per occurrence

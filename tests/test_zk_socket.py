@@ -12,15 +12,18 @@ client (``io/zkwire.py``) is exercised always; when ``kazoo`` is installed
 from __future__ import annotations
 
 import json
+import queue
 import socket
 import struct
 import threading
+import time
 
 import pytest
 
 from kafka_assigner_tpu.io.zkwire import (
     MiniZkClient,
     NoNodeError,
+    ZkWireError,
     parse_hosts,
 )
 
@@ -28,17 +31,36 @@ from kafka_assigner_tpu.io.zkwire import (
 class JuteZkServer(threading.Thread):
     """Minimal single-purpose ZooKeeper server: serves a static znode tree
     over the real wire protocol. ``tree`` maps full znode path -> bytes
-    (data) and directories are implied by children paths."""
+    (data) and directories are implied by children paths.
 
-    def __init__(self, tree):
+    ``reply_delay_s`` injects one-way latency: every reply is released
+    ``reply_delay_s`` after its request was processed, by a per-connection
+    sender thread that preserves reply order — so pipelined requests see
+    their delays overlap (network latency), while a serial client pays the
+    delay per round-trip. ``scripts/bench_zk_ingest.py`` uses this to
+    measure the serial-vs-pipelined ingest gap hermetically. ``port``
+    pins the listen port (0 = ephemeral) so restart/retry tests can bring a
+    server up on an address a client is already retrying."""
+
+    def __init__(self, tree, reply_delay_s=0.0, port=0):
         super().__init__(daemon=True)
         self.tree = dict(tree)
+        self.reply_delay_s = reply_delay_s
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.sock.bind(("127.0.0.1", 0))
-        self.sock.listen(4)
+        self.sock.bind(("127.0.0.1", port))
+        self.sock.listen(64)
         self.port = self.sock.getsockname()[1]
         self._stop = threading.Event()
+        # Children index, built once: the per-request O(tree) prefix scan
+        # dominated the pipelined bench (~0.4 ms/op of pure fixture cost)
+        # and hid the transport latency this server exists to model.
+        self._kids = {}
+        for p in self.tree:
+            parent = ""
+            for seg in p.strip("/").split("/"):
+                self._kids.setdefault(parent + "/", set()).add(seg)
+                parent = f"{parent}/{seg}"
 
     # -- jute helpers -----------------------------------------------------
 
@@ -53,13 +75,7 @@ class JuteZkServer(threading.Thread):
         )
 
     def _children(self, path):
-        prefix = path.rstrip("/") + "/"
-        names = {
-            p[len(prefix):].split("/", 1)[0]
-            for p in self.tree
-            if p.startswith(prefix)
-        }
-        return sorted(names)
+        return sorted(self._kids.get(path.rstrip("/") + "/", ()))
 
     def _exists(self, path):
         return path in self.tree or bool(self._children(path))
@@ -72,11 +88,47 @@ class JuteZkServer(threading.Thread):
                 conn, _ = self.sock.accept()
             except OSError:
                 return
+            # Mirror real ZooKeeper: replies must not sit in Nagle's buffer
+            # waiting for a delayed ACK while the client pipelines.
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
 
     def _serve_conn(self, conn):
+        # Delayed-reply mode: replies queue to a per-connection sender that
+        # releases each one reply_delay_s after processing, in order — the
+        # reader keeps consuming pipelined requests meanwhile, so concurrent
+        # requests overlap their latency exactly like a real network RTT.
+        sender_q = sender = None
+        if self.reply_delay_s:
+            sender_q = queue.Queue()
+
+            def _sender():
+                while True:
+                    item = sender_q.get()
+                    if item is None:
+                        return
+                    due, payload = item
+                    delay = due - time.monotonic()
+                    if delay > 0:
+                        time.sleep(delay)
+                    try:
+                        conn.sendall(struct.pack(">i", len(payload)) + payload)
+                    except OSError:
+                        return
+
+            sender = threading.Thread(target=_sender, daemon=True)
+            sender.start()
+
+        def send(payload):
+            if sender_q is None:
+                self._send_frame(conn, payload)
+            else:
+                sender_q.put(
+                    (time.monotonic() + self.reply_delay_s, payload)
+                )
+
         try:
             frame = self._recv_frame(conn)
             if frame is None:
@@ -90,7 +142,7 @@ class JuteZkServer(threading.Thread):
                 + self._buf(b"\x00" * 16)
                 + (b"\x00" if has_ro else b"")
             )
-            self._send_frame(conn, resp)
+            send(resp)
             while True:
                 frame = self._recv_frame(conn)
                 if frame is None:
@@ -98,38 +150,34 @@ class JuteZkServer(threading.Thread):
                 xid, op = struct.unpack(">ii", frame[:8])
                 body = frame[8:]
                 if op == 11:  # ping
-                    self._send_frame(conn, struct.pack(">iqi", -2, 1, 0))
+                    send(struct.pack(">iqi", -2, 1, 0))
                     continue
                 if op == -11:  # closeSession
-                    self._send_frame(conn, struct.pack(">iqi", xid, 1, 0))
+                    send(struct.pack(">iqi", xid, 1, 0))
                     return
                 (plen,) = struct.unpack(">i", body[:4])
                 path = body[4:4 + plen].decode("utf-8")
                 if op == 8:  # getChildren
                     kids = self._children(path)
                     if not self._exists(path):
-                        self._send_frame(
-                            conn, struct.pack(">iqi", xid, 1, -101)
-                        )
+                        send(struct.pack(">iqi", xid, 1, -101))
                         continue
                     payload = struct.pack(">iqi", xid, 1, 0)
                     payload += struct.pack(">i", len(kids))
                     for k in kids:
                         payload += self._buf(k.encode("utf-8"))
-                    self._send_frame(conn, payload)
+                    send(payload)
                 elif op == 4:  # getData
                     data = self.tree.get(path)
                     if data is None:
-                        self._send_frame(
-                            conn, struct.pack(">iqi", xid, 1, -101)
-                        )
+                        send(struct.pack(">iqi", xid, 1, -101))
                         continue
                     payload = (
                         struct.pack(">iqi", xid, 1, 0)
                         + self._buf(data)
                         + self._stat(len(data), len(self._children(path)))
                     )
-                    self._send_frame(conn, payload)
+                    send(payload)
                 elif op == 3:  # exists
                     if self._exists(path):
                         payload = struct.pack(">iqi", xid, 1, 0) + self._stat(
@@ -138,12 +186,16 @@ class JuteZkServer(threading.Thread):
                         )
                     else:
                         payload = struct.pack(">iqi", xid, 1, -101)
-                    self._send_frame(conn, payload)
+                    send(payload)
                 else:  # unimplemented op: loud error, not a hang
-                    self._send_frame(conn, struct.pack(">iqi", xid, 1, -6))
+                    send(struct.pack(">iqi", xid, 1, -6))
         except (OSError, struct.error):
             pass
         finally:
+            if sender_q is not None:
+                # FIFO drain: queued replies flush before the close.
+                sender_q.put(None)
+                sender.join(timeout=10)
             conn.close()
 
     @staticmethod
@@ -280,6 +332,176 @@ def test_cli_end_to_end_over_real_socket(zk_server, capsys, monkeypatch):
     for parts in new.values():
         for replicas in parts.values():
             assert 4 not in replicas  # h4 drained
+
+
+def _dead_port() -> int:
+    """A port that was just bound and released — connecting to it refuses
+    (nothing listens) on any sane loopback."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_start_falls_through_refused_endpoint(zk_server):
+    # The satellite fix: one refused endpoint must not kill the session
+    # attempt while a healthy quorum member is listed right next to it.
+    client = MiniZkClient(
+        f"127.0.0.1:{_dead_port()},127.0.0.1:{zk_server.port}", timeout=5.0
+    )
+    client.start()
+    try:
+        assert client.get_children("/brokers/topics") == ["events", "logs"]
+    finally:
+        client.stop()
+        client.close()
+
+
+def test_start_exhausts_retries_loudly(monkeypatch, capsys):
+    monkeypatch.setenv("KA_ZK_CONNECT_RETRIES", "2")
+    client = MiniZkClient(
+        f"127.0.0.1:{_dead_port()},127.0.0.1:{_dead_port()}", timeout=0.5
+    )
+    with pytest.raises(ZkWireError, match=r"after 2 pass\(es\)"):
+        client.start()
+    # The backoff pass warns on stderr — silent retries look like a hang.
+    assert "connect pass 1/2 failed" in capsys.readouterr().err
+
+
+def test_start_succeeds_on_retry_pass(monkeypatch):
+    # Nothing listens on the reserved port for the first pass; a server
+    # comes up on it mid-backoff and the second pass lands the session.
+    monkeypatch.setenv("KA_ZK_CONNECT_RETRIES", "5")
+    port = _dead_port()
+    started = []
+
+    def _bring_up():
+        time.sleep(0.15)
+        server = JuteZkServer(_cluster_tree(), port=port)
+        server.start()
+        started.append(server)
+
+    threading.Thread(target=_bring_up, daemon=True).start()
+    client = MiniZkClient(f"127.0.0.1:{port}", timeout=2.0)
+    try:
+        client.start()
+        assert client.get_children("/brokers/topics") == ["events", "logs"]
+        client.stop()
+        client.close()
+    finally:
+        for server in started:
+            server.shutdown()
+
+
+def test_get_many_matches_serial_gets(zk_server, monkeypatch):
+    paths = [f"/brokers/ids/{i}" for i in (1, 2, 3, 4)] + [
+        "/brokers/topics/events", "/brokers/topics/logs"
+    ]
+    serial_client = MiniZkClient(f"127.0.0.1:{zk_server.port}", timeout=5.0)
+    serial_client.start()
+    monkeypatch.setenv("KA_ZK_PIPELINE", "1")  # window of one == serial
+    try:
+        serial = [serial_client.get(p) for p in paths]
+        assert serial_client.get_many(paths) == serial
+        for window in ("2", "3", "64"):
+            monkeypatch.setenv("KA_ZK_PIPELINE", window)
+            assert serial_client.get_many(paths) == serial
+        # The session stays usable after a mid-batch missing znode.
+        with pytest.raises(NoNodeError, match="/brokers/ids/99"):
+            serial_client.get_many(
+                ["/brokers/ids/1", "/brokers/ids/99", "/brokers/ids/2"]
+            )
+        assert serial_client.get("/brokers/ids/3") == serial[2]
+    finally:
+        serial_client.stop()
+        serial_client.close()
+
+
+def test_iter_get_abandonment_drains_the_window(zk_server, monkeypatch):
+    # Breaking out of iter_get mid-batch must not poison the session: the
+    # in-flight replies are drained on generator close, so the next serial
+    # call sees only its own xid.
+    monkeypatch.setenv("KA_ZK_PIPELINE", "8")
+    client = MiniZkClient(f"127.0.0.1:{zk_server.port}", timeout=5.0)
+    client.start()
+    try:
+        paths = [f"/brokers/ids/{i}" for i in (1, 2, 3, 4)]
+        for i, item in enumerate(client.iter_get(paths)):
+            if i == 0:
+                break  # 3 replies still in flight
+        data, _ = client.get("/brokers/ids/3")
+        assert json.loads(data)["host"] == "h3"
+        assert client.get_children("/brokers/topics") == ["events", "logs"]
+    finally:
+        client.stop()
+        client.close()
+
+
+def test_mode3_output_byte_identical_across_ingest_modes(
+    zk_server, capsys, monkeypatch
+):
+    # The acceptance pin: pipelining and the ingest/encode overlap are pure
+    # latency optimizations — stdout must stay byte-identical with the
+    # window forced to one, the overlap disabled, and any chunk size.
+    from kafka_assigner_tpu.cli import run_tool
+
+    monkeypatch.setenv("KA_ZK_CLIENT", "wire")
+    argv = [
+        "--zk_string", f"127.0.0.1:{zk_server.port}",
+        "--mode", "PRINT_REASSIGNMENT", "--solver", "tpu",
+        "--broker_hosts_to_remove", "h4",
+    ]
+    assert run_tool(argv) == 0
+    baseline = capsys.readouterr().out
+    assert baseline.startswith("CURRENT ASSIGNMENT:\n")
+    for env in (
+        {"KA_ZK_PIPELINE": "1"},
+        {"KA_ZK_OVERLAP": "0"},
+        {"KA_ZK_INGEST_CHUNK": "1"},
+        {"KA_ZK_PIPELINE": "2", "KA_ZK_INGEST_CHUNK": "1"},
+    ):
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        assert run_tool(argv) == 0
+        assert capsys.readouterr().out == baseline, env
+        for k in env:
+            monkeypatch.delenv(k)
+
+
+def test_pipeline_metrics_in_run_report(zk_server, tmp_path, monkeypatch, capsys):
+    # The obs wiring of this PR's tentpole: a live-wire mode-3 run reports
+    # the pipelined-ingest telemetry in the schema-v1 artifact.
+    import json as json_mod
+
+    from kafka_assigner_tpu.cli import run_tool
+    from kafka_assigner_tpu.obs import report as report_mod
+
+    monkeypatch.setenv("KA_ZK_CLIENT", "wire")
+    report_path = tmp_path / "report.json"
+    rc = run_tool([
+        "--zk_string", f"127.0.0.1:{zk_server.port}",
+        "--mode", "PRINT_REASSIGNMENT", "--solver", "tpu",
+        "--report-json", str(report_path),
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    with open(report_path, "r", encoding="utf-8") as f:
+        report = json_mod.load(f)
+    assert report_mod.validate_report(report) == []
+    counters = report["metrics"]["counters"]
+    gauges = report["metrics"]["gauges"]
+    # brokers() and the topic ingest each pipeline one batch.
+    assert counters["zk.pipeline.batches"] >= 2
+    assert counters["zk.pipeline.rtts_saved"] >= 1
+    assert gauges["zk.pipeline.in_flight"] >= 2
+    assert gauges["ingest.topics"] == 2
+    assert "ingest.overlap_ms" in gauges
+    paths = {s["path"] for s in report["spans"]}
+    assert (
+        "mode/PRINT_REASSIGNMENT/metadata/assignment/ingest/stream" in paths
+    )
+    assert "zk.pipeline.batch_ms" in report["metrics"]["histograms"]
 
 
 def test_kazoo_against_real_socket(zk_server):
