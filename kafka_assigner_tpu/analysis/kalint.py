@@ -29,6 +29,10 @@ KA007  a jit-traced function closes over a mutable module-level global
        via ``global``) — trace-time capture freezes the value at first
        compile, so later mutations are silently ignored by every cached
        executable; pass the value as an argument or bind it immutably
+KA008  an ``except`` clause that swallows its exception silently (a body
+       that is nothing but ``pass`` or a bare ``continue``) — a robustness
+       layer lives or dies on failures staying visible: log it, count it,
+       re-raise it, or suppress with a written reason
 ====== =====================================================================
 
 Suppression: put ``# kalint: disable=KA002 -- <reason>`` on the offending
@@ -60,6 +64,7 @@ RULES = {
     "KA005": "plan JSON emission outside io/json_io.py",
     "KA006": "jnp./jax.numpy call at module import time",
     "KA007": "jit-traced function closes over a mutable module-level global",
+    "KA008": "except clause swallows the exception silently (pass/continue)",
 }
 
 #: Modules whose ENTIRE body is treated as traced kernel code (KA002): these
@@ -561,6 +566,28 @@ def _check_ka007(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+def _check_ka008(tree: ast.AST, path: str) -> List[Finding]:
+    """An ``except`` body that is exactly one ``pass`` or one bare
+    ``continue`` handles nothing and records nothing — the exception
+    vanishes. Any other body (a log call, a metric bump, a re-raise, even an
+    assignment) is taken as deliberate handling; truly-intentional swallows
+    carry a reasoned suppression, which IS the audit trail."""
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        body = node.body
+        if len(body) == 1 and isinstance(body[0], (ast.Pass, ast.Continue)):
+            what = "pass" if isinstance(body[0], ast.Pass) else "continue"
+            out.append(Finding(
+                "KA008", path, body[0].lineno, body[0].col_offset + 1,
+                f"except clause swallows the exception silently (bare "
+                f"{what}): log it, count it, re-raise, or suppress with a "
+                "reason",
+            ))
+    return out
+
+
 def check_readme(readme_text: str, knobs=None, path: str = "README.md"):
     """KA004: every registered knob must appear in the README (the generated
     knob table keeps this true; drift means the table is stale)."""
@@ -616,6 +643,7 @@ def lint_source(
         + _check_ka005(tree, relpath, path)
         + _check_ka006(tree, path)
         + _check_ka007(tree, path)
+        + _check_ka008(tree, path)
     )
     for f in raw:
         if f.rule in suppress.get(f.line, ()):  # reasoned suppression

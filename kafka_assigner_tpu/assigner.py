@@ -11,6 +11,7 @@ Responsibilities (SURVEY.md §1 L2):
 """
 from __future__ import annotations
 
+import sys
 from typing import Dict, List, Mapping, Sequence, Set, Tuple
 
 from .solvers.base import Context, Solver, get_solver
@@ -53,9 +54,70 @@ class TopicAssigner:
     object and all solver math is functional.
     """
 
-    def __init__(self, solver: str | Solver = "greedy") -> None:
+    def __init__(
+        self, solver: str | Solver = "greedy", failure_policy: str = "strict"
+    ) -> None:
         self.solver: Solver = get_solver(solver) if isinstance(solver, str) else solver
         self.context = Context()
+        #: ``best-effort`` arms the solver fallback chain: a non-greedy
+        #: backend that CRASHES (compile failure, device OOM — any
+        #: non-ValueError exception) is retried through the greedy oracle
+        #: for the affected group instead of killing the run. Safe because
+        #: (a) every backend is byte-equal with the greedy oracle
+        #: (test-pinned parity), and (b) backends only apply leadership-
+        #: counter updates after a successful solve, so the shared Context
+        #: is untouched by the crash and the replay is exact.
+        self.failure_policy = failure_policy
+        #: How many groups fell back to greedy in the most recent
+        #: ``generate_assignments`` call (the run report's
+        #: ``solve.fallbacks`` source).
+        self.fallbacks = 0
+        self._greedy_fallback: Solver | None = None
+
+    def _should_fallback(self, exc: Exception) -> bool:
+        """Crash classes only: ValueError is input validation/infeasibility
+        (greedy would refuse identically — nothing to rescue), and a greedy
+        backend has no one left to fall back to."""
+        return (
+            self.failure_policy == "best-effort"
+            and not isinstance(exc, ValueError)
+            and getattr(self.solver, "name", None) != "greedy"
+        )
+
+    def _fallback_group(
+        self,
+        items: Sequence[Tuple[str, Mapping[int, Sequence[int]]]],
+        rfs: Sequence[int],
+        rack_assignment: Mapping[int, str],
+        brokers: Set[int],
+        exc: Exception,
+    ) -> List[Tuple[str, Dict[int, List[int]]]]:
+        """Re-solve one crashed group through the greedy oracle, loudly."""
+        from .obs.metrics import counter_add
+
+        counter_add("solve.fallbacks")
+        self.fallbacks += 1
+        print(
+            f"kafka-assigner: best-effort: "
+            f"{getattr(self.solver, 'name', type(self.solver).__name__)} "
+            f"solver crashed ({type(exc).__name__}: {exc}); falling back to "
+            f"the greedy solver for {len(items)} topic(s)",
+            file=sys.stderr,
+        )
+        if self._greedy_fallback is None:
+            from .solvers.greedy import GreedySolver
+
+            self._greedy_fallback = GreedySolver()
+        return [
+            (
+                topic,
+                self._greedy_fallback.assign(
+                    topic, cur, rack_assignment, set(brokers), set(cur),
+                    rf, self.context,
+                ),
+            )
+            for (topic, cur), rf in zip(items, rfs)
+        ]
 
     def _infer_replication_factor(
         self,
@@ -172,19 +234,29 @@ class TopicAssigner:
             )
             for topic, cur in items
         ]
+        self.fallbacks = 0
         assign_many = getattr(self.solver, "assign_many", None)
         out: List[Tuple[str, Dict[int, List[int]]]] = []
         if assign_many is None:
             for (topic, cur), rf in zip(items, rfs):
-                out.append(
-                    (
-                        topic,
-                        self.solver.assign(
-                            topic, cur, rack_assignment, set(brokers), set(cur),
-                            rf, self.context,
-                        ),
+                try:
+                    out.append(
+                        (
+                            topic,
+                            self.solver.assign(
+                                topic, cur, rack_assignment, set(brokers),
+                                set(cur), rf, self.context,
+                            ),
+                        )
                     )
-                )
+                except Exception as e:
+                    if not self._should_fallback(e):
+                        raise
+                    out.extend(
+                        self._fallback_group(
+                            [(topic, cur)], [rf], rack_assignment, brokers, e
+                        )
+                    )
             return out
 
         # A mixed-RF-capable backend takes the whole list in ONE dispatch
@@ -193,30 +265,41 @@ class TopicAssigner:
         # the CLI topic order either way, so the Context evolves exactly as
         # in the serial loop.
         if items and getattr(self.solver, "supports_mixed_rf", False):
-            if preencoded is not None:
-                # Keyword only when there is something to forward: a
-                # third-party mixed-RF backend predating the parameter must
-                # keep working unchanged (the contract above).
+            # Keyword only when there is something to forward: a third-party
+            # mixed-RF backend predating the parameter must keep working
+            # unchanged (the contract above).
+            kwargs = {} if preencoded is None else {"preencoded": preencoded}
+            try:
                 return list(
                     assign_many(
                         items, rack_assignment, set(brokers), rfs,
-                        self.context, preencoded=preencoded,
+                        self.context, **kwargs,
                     )
                 )
-            return list(
-                assign_many(
-                    items, rack_assignment, set(brokers), rfs, self.context
+            except Exception as e:
+                if not self._should_fallback(e):
+                    raise
+                return self._fallback_group(
+                    items, rfs, rack_assignment, brokers, e
                 )
-            )
         i = 0
         while i < len(items):
             j = i
             while j < len(items) and rfs[j] == rfs[i]:
                 j += 1
-            out.extend(
-                assign_many(
-                    items[i:j], rack_assignment, set(brokers), rfs[i], self.context
+            try:
+                solved = list(
+                    assign_many(
+                        items[i:j], rack_assignment, set(brokers), rfs[i],
+                        self.context,
+                    )
                 )
-            )
+            except Exception as e:
+                if not self._should_fallback(e):
+                    raise
+                solved = self._fallback_group(
+                    items[i:j], rfs[i:j], rack_assignment, brokers, e
+                )
+            out.extend(solved)
             i = j
         return out

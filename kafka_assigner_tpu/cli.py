@@ -17,7 +17,11 @@ Divergences from the reference, on purpose:
     cites nonexistent ``--kafka_assigner_*`` names — latent bug,
     ``KafkaAssignmentGenerator.java:263-265``);
   - bad usage exits with status 1 after printing usage to stderr (the
-    reference returns 0, ``KafkaAssignmentGenerator.java:266-270``).
+    reference returns 0, ``KafkaAssignmentGenerator.java:266-270``);
+  - failure classes exit with DISTINCT documented codes (the ``EXIT_*``
+    constants below; README "Failure model"): ingest vs. solve vs.
+    validation vs. best-effort degraded success, so supervisors can react
+    without scraping stderr.
 """
 from __future__ import annotations
 
@@ -25,7 +29,9 @@ import argparse
 import sys
 from typing import List, Optional
 
+from .errors import IngestError, SolveError
 from .generator import (
+    Degradation,
     build_rack_assignment,
     print_current_assignment,
     print_current_brokers,
@@ -36,7 +42,19 @@ from .generator import (
     resolve_excluded_broker_ids,
 )
 from .io.base import open_backend
+from .io.zkwire import ZkWireError
 from .solvers.base import get_solver
+
+# Documented exit codes (README "Failure model"): the reference collapses
+# every failure into one generic nonzero JVM exit, so a supervising process
+# cannot distinguish "the quorum was down" from "the plan is infeasible"
+# without scraping stderr. 2 is left to argparse (its own usage-error code).
+EXIT_OK = 0            # plan emitted, nothing degraded
+EXIT_USAGE = 1         # bad flag combination / unavailable backend refusal
+EXIT_INGEST = 3        # metadata ingest failed past the retry budget
+EXIT_SOLVE = 4         # solver crashed (and best-effort fallback too)
+EXIT_VALIDATION = 5    # input/validation failure (RF bounds, unknown hosts)
+EXIT_DEGRADED = 6      # best-effort success: plan emitted, but degraded
 
 # The reference's three modes (KafkaAssignmentGenerator.java:86-101) plus
 # RANK_DECOMMISSION, which exposes the what-if fleet: it solves one candidate
@@ -94,6 +112,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "(loaded if present, saved after PRINT_REASSIGNMENT) "
                         "so repeated partial reassignments keep balancing "
                         "leaders cluster-wide")
+    p.add_argument("--failure-policy", dest="failure_policy", default=None,
+                   choices=("strict", "best-effort"),
+                   help="strict (default): abort on the first unrecoverable "
+                        "ingest/solve failure, like the reference. "
+                        "best-effort: skip topics that vanish mid-scan and "
+                        "fall back to the greedy solver when the TPU solve "
+                        "crashes — degradations are reported on stderr and "
+                        "in the run report, and the process exits with the "
+                        "documented degraded-success code (default: the "
+                        "KA_FAILURE_POLICY knob)")
     p.add_argument("--report-json", dest="report_json", default=None,
                    metavar="PATH",
                    help="emit a schema-versioned machine-readable run report "
@@ -140,12 +168,17 @@ def run_tool(argv: Optional[List[str]] = None) -> int:
         try:
             with obs.span(f"mode/{args.mode}") as sp:
                 rc = _dispatch_mode(args, topics)
-                if rc != 0:
+                if rc not in (EXIT_OK, EXIT_DEGRADED):
                     # Failure signaled by return code, not exception (e.g.
                     # the rack-blind backend refusal): the span must agree
-                    # with the report's top-level status.
+                    # with the report's top-level status. Degraded success
+                    # is NOT a span failure — the plan was emitted.
                     sp.fail()
-            status = "ok" if rc == 0 else "error"
+            status = (
+                "ok" if rc == EXIT_OK
+                else "degraded" if rc == EXIT_DEGRADED
+                else "error"
+            )
             return rc
         except BaseException as e:
             # The bugfix contract: a solve that raises mid-phase must still
@@ -263,6 +296,10 @@ def _dispatch_mode(args, topics) -> int:
                 scenario_file=args.scenario_file,
             )
         else:
+            from .utils.env import env_choice
+
+            policy = args.failure_policy or env_choice("KA_FAILURE_POLICY")
+            degradation = Degradation()
             print_least_disruptive_reassignment(
                 backend,
                 topics,
@@ -273,10 +310,57 @@ def _dispatch_mode(args, topics) -> int:
                 solver=args.solver,
                 live_brokers=live_brokers,
                 context_file=args.leadership_context,
+                failure_policy=policy,
+                degradation=degradation,
             )
+            if degradation.any():
+                # The plan on stdout is complete for what it covers, but the
+                # operator (and any supervising autoscaler) must be able to
+                # tell this run from a clean one without parsing stderr.
+                print(
+                    f"kafka-assigner: degraded success: "
+                    f"{len(degradation.topics_skipped)} topic(s) skipped, "
+                    f"{degradation.solve_fallbacks} solver fallback(s); "
+                    f"exiting {EXIT_DEGRADED}",
+                    file=sys.stderr,
+                )
+                return EXIT_DEGRADED
     finally:
         backend.close()
     return 0
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    """:func:`run_tool` with the documented exit-code mapping: the process
+    entry point (and the chaos soak) call this; library callers keep calling
+    ``run_tool`` and receive the raw typed exceptions.
+
+    Mapping (README "Failure model"): phase-tagged errors from the pipeline
+    (``errors.py``) plus the raw transport/validation classes that can
+    escape before tagging. Anything unrecognized propagates with its
+    traceback — an undocumented crash must stay loud, not be laundered into
+    a documented code.
+    """
+    try:
+        return run_tool(argv)
+    except IngestError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_INGEST
+    except SolveError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_SOLVE
+    except BrokenPipeError:
+        # stdout's consumer went away (| head, killed pager) AFTER the work
+        # succeeded — not an ingest failure; keep Python's loud default.
+        raise
+    except (ZkWireError, OSError) as e:
+        # Connect/read failures raised before the pipeline tags them
+        # (backend open, broker listing, modes 1/2/4 metadata reads).
+        print(f"error: metadata ingest failed: {e}", file=sys.stderr)
+        return EXIT_INGEST
+    except (ValueError, KeyError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_VALIDATION
 
 
 def main() -> None:
@@ -310,7 +394,7 @@ def main() -> None:
             os.execve(sys.executable, [sys.executable, "-m",
                                        "kafka_assigner_tpu.cli"] + sys.argv[1:],
                       env)
-    sys.exit(run_tool())
+    sys.exit(run())
 
 
 if __name__ == "__main__":

@@ -1,0 +1,40 @@
+"""Phase-tagged failure types for the CLI's documented exit codes.
+
+The reference collapses every failure into one generic nonzero JVM exit; an
+operator (or the autoscaler driving this tool, arXiv:2206.11170) cannot tell
+"the quorum was unreachable" from "the solve is infeasible" without parsing
+stderr. The pipeline driver (``generator.py``) tags unrecoverable failures
+with the phase they escaped from, and ``cli.run`` maps each type to its exit
+code (README "Failure model"):
+
+========================= ===========================================
+type                      meaning / exit code
+========================= ===========================================
+:class:`IngestError`      metadata ingest failed past the resilience
+                          layer's retry budget (exit 3)
+:class:`SolveError`       a solver backend crashed — and, under
+                          ``best-effort``, so did the greedy fallback
+                          (exit 4)
+``ValueError``/``KeyError`` input/validation failures keep their plain
+                          stdlib types for library callers (exit 5)
+========================= ===========================================
+
+Both types chain the original exception (``raise ... from e``), so library
+callers that want the underlying ``ZkWireError``/XLA error still reach it
+via ``__cause__``.
+"""
+from __future__ import annotations
+
+
+class KafkaAssignerError(RuntimeError):
+    """Base for phase-tagged unrecoverable failures of a CLI run."""
+
+
+class IngestError(KafkaAssignerError):
+    """Cluster-metadata ingest failed (connect/read/replay budget
+    exhausted, snapshot unreadable, topic vanished under strict policy)."""
+
+
+class SolveError(KafkaAssignerError):
+    """The solver backend crashed (compile failure, device OOM) and no
+    fallback produced a plan."""

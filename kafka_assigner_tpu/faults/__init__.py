@@ -1,0 +1,20 @@
+"""Deterministic fault-injection harness (ISSUE 5 tentpole).
+
+The wire client (``io/zkwire.py``) and the TPU solver consult this package
+at well-defined fault points; with no injector active every hook is a single
+``None`` check. See :mod:`kafka_assigner_tpu.faults.inject` for the fault
+taxonomy, the ``KA_FAULTS_*`` knobs, and the spec grammar.
+"""
+from .inject import (  # noqa: F401
+    FAULT_KINDS,
+    FAULT_SCOPES,
+    FaultEvent,
+    FaultInjector,
+    FaultSpecError,
+    InjectedSolverCrash,
+    active_injector,
+    fault_point,
+    install,
+    parse_spec,
+    reset,
+)

@@ -1,0 +1,324 @@
+"""Deterministic, seedable fault injection for the cluster-I/O and solve
+paths — the harness that drives the resilience layer (ISSUE 5).
+
+The reference tool can only be failure-tested against a real, misbehaving
+ZooKeeper quorum; dynamic-reconfiguration work (arXiv:1602.03770,
+arXiv:2206.11170) treats metadata churn *during* the plan computation as the
+common case, so this repro injects those races hermetically, at the exact
+protocol seams where production sees them. Faults are injected CLIENT-side,
+inside ``io/zkwire.py``'s socket handling, so one process (the CLI, the
+chaos soak, a unit test) reproduces a byte-exact failure schedule with no
+cooperation from the server.
+
+Fault taxonomy (``FAULT_KINDS``), one per failure class the tentpole names:
+
+========== ================ ==============================================
+kind       scope            effect at the hook
+========== ================ ==============================================
+blackhole  connect          the connect attempt raises ConnectionRefused
+expire     handshake        the ConnectResponse is rewritten to the
+                            session-expired form (timeOut=0, sessionId=0)
+drop       reply            the session socket is closed mid-frame and the
+                            read raises ConnectionReset
+trunc      reply            the reply frame is truncated (arg = bytes
+                            kept; default half), desyncing the decoder
+slow       reply            the reply is delayed ``arg`` seconds (default
+                            0.05) before the client sees it
+nonode     reply            the reply's error field is rewritten to
+                            KeeperException.NoNode — a znode deleted
+                            between ``getChildren`` and ``getData``
+crash      solve            the TPU solver raises ``InjectedSolverCrash``
+                            before dispatch (stands in for a compile
+                            failure / device OOM)
+========== ================ ==============================================
+
+Spec grammar (``KA_FAULTS_SPEC``): semicolon-separated events
+``scope:index=kind[:arg]`` — the fault fires the ``index``-th time that
+scope's hook runs (0-based, per-scope counters), e.g.::
+
+    KA_FAULTS_SPEC='reply:3=drop;reply:6=nonode;connect:0=blackhole'
+
+or the single word ``random``: a schedule drawn from
+``random.Random(KA_FAULTS_SEED)`` with per-hook probability
+``KA_FAULTS_RATE`` over the first :data:`RANDOM_HORIZON` indexes of each
+scope (the chaos soak's mode; same seed ⇒ same schedule, byte-for-byte).
+
+Activation: :func:`install` (programmatic, wins) or the ``KA_FAULTS_SPEC``
+knob (read via :func:`active_injector`, cached per (spec, seed) so the wire
+client and the solver see one coherent schedule). A malformed spec is
+ignored LOUDLY and injection stays off — the house rule for every knob.
+Every fired fault prints one stderr line and bumps the ``faults.injected``
+(+ ``faults.injected.<kind>``) counters, so a run report accounts for the
+schedule it survived.
+"""
+from __future__ import annotations
+
+import random
+import struct
+import sys
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..obs.metrics import counter_add
+
+#: Scopes (hook sites) and the kinds each accepts.
+FAULT_SCOPES: Dict[str, Tuple[str, ...]] = {
+    "connect": ("blackhole",),
+    "handshake": ("expire",),
+    "reply": ("drop", "trunc", "slow", "nonode"),
+    "solve": ("crash",),
+}
+FAULT_KINDS = tuple(k for kinds in FAULT_SCOPES.values() for k in kinds)
+
+#: ``random`` mode draws events over this many indexes per scope — enough to
+#: cover any realistic mode-3 run against the test fixtures while keeping the
+#: schedule finite and printable.
+RANDOM_HORIZON: Dict[str, int] = {
+    "connect": 3, "handshake": 3, "reply": 64, "solve": 2,
+}
+
+ERR_NONODE = -101
+
+
+class FaultSpecError(ValueError):
+    """``KA_FAULTS_SPEC`` does not parse (unknown scope/kind, bad index)."""
+
+
+class InjectedSolverCrash(RuntimeError):
+    """The ``solve`` fault point fired — stands in for an XLA compile
+    failure or device OOM (both surface as RuntimeError subclasses)."""
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault: fires the ``index``-th time ``scope``'s hook
+    runs. ``arg`` is kind-specific (trunc: bytes kept; slow: seconds)."""
+
+    scope: str
+    index: int
+    kind: str
+    arg: Optional[float] = None
+
+    def __str__(self) -> str:
+        suffix = "" if self.arg is None else f":{self.arg:g}"
+        return f"{self.scope}:{self.index}={self.kind}{suffix}"
+
+
+def parse_spec(
+    spec: str, seed: int = 0, rate: float = 0.05
+) -> List[FaultEvent]:
+    """Parse a ``KA_FAULTS_SPEC`` value into a schedule. ``random`` draws a
+    seed-deterministic schedule; anything else is the explicit event list."""
+    spec = spec.strip()
+    if spec == "random":
+        return random_schedule(seed, rate)
+    events: List[FaultEvent] = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        head, eq, kind_arg = raw.partition("=")
+        if not eq:
+            raise FaultSpecError(
+                f"fault event {raw!r} is not of the form scope:index=kind"
+            )
+        scope, _, idx_s = head.partition(":")
+        scope = scope.strip()
+        if scope not in FAULT_SCOPES:
+            raise FaultSpecError(
+                f"unknown fault scope {scope!r} in {raw!r} "
+                f"(expected one of {sorted(FAULT_SCOPES)})"
+            )
+        try:
+            index = int(idx_s) if idx_s.strip() else 0
+        except ValueError:
+            raise FaultSpecError(
+                f"fault index {idx_s!r} in {raw!r} is not an integer"
+            ) from None
+        if index < 0:
+            raise FaultSpecError(f"fault index must be >= 0 in {raw!r}")
+        kind, _, arg_s = kind_arg.partition(":")
+        kind = kind.strip()
+        if kind not in FAULT_SCOPES[scope]:
+            raise FaultSpecError(
+                f"fault kind {kind!r} is not valid for scope {scope!r} "
+                f"(expected one of {FAULT_SCOPES[scope]})"
+            )
+        arg = None
+        if arg_s.strip():
+            try:
+                arg = float(arg_s)
+            except ValueError:
+                raise FaultSpecError(
+                    f"fault arg {arg_s!r} in {raw!r} is not a number"
+                ) from None
+        events.append(FaultEvent(scope, index, kind, arg))
+    return events
+
+
+def random_schedule(seed: int, rate: float) -> List[FaultEvent]:
+    """A seed-deterministic randomized schedule: each (scope, index) slot up
+    to :data:`RANDOM_HORIZON` fires with probability ``rate``, the kind drawn
+    uniformly from the scope's kinds. Same seed ⇒ identical schedule."""
+    rng = random.Random(int(seed))
+    events: List[FaultEvent] = []
+    for scope in sorted(FAULT_SCOPES):
+        kinds = FAULT_SCOPES[scope]
+        for index in range(RANDOM_HORIZON[scope]):
+            if rng.random() < rate:
+                events.append(FaultEvent(scope, index, rng.choice(kinds)))
+    return events
+
+
+class FaultInjector:
+    """One live schedule: per-scope hook counters plus the fired-event log.
+
+    Hook methods are called from the wire client's socket paths (possibly on
+    the ingest producer thread) and from the solver; each consults the
+    schedule at the scope's current index and fires at most one event. The
+    same instance must serve every hook of a run so the counters stay
+    coherent — :func:`active_injector` caches per (spec, seed).
+    """
+
+    def __init__(self, events: List[FaultEvent]) -> None:
+        self.schedule: Tuple[FaultEvent, ...] = tuple(events)
+        self._events = {(e.scope, e.index): e for e in events}
+        self._counts: Dict[str, int] = {}
+        self.fired: List[FaultEvent] = []
+
+    def _next(self, scope: str) -> Optional[FaultEvent]:
+        i = self._counts.get(scope, 0)
+        self._counts[scope] = i + 1
+        return self._events.get((scope, i))
+
+    def _fire(self, ev: FaultEvent) -> None:
+        self.fired.append(ev)
+        counter_add("faults.injected")
+        counter_add(f"faults.injected.{ev.kind}")
+        print(f"kafka-assigner: fault injected: {ev}", file=sys.stderr)
+
+    # -- hooks -------------------------------------------------------------
+
+    def connect_attempt(self) -> None:
+        """Called before each socket connect attempt; ``blackhole`` refuses."""
+        ev = self._next("connect")
+        if ev is not None and ev.kind == "blackhole":
+            self._fire(ev)
+            raise ConnectionRefusedError(
+                "injected fault: connect blackhole"
+            )
+
+    def filter_handshake(self, frame: bytes) -> bytes:
+        """Called with each ConnectResponse frame; ``expire`` rewrites it to
+        the session-expired form the real server sends (timeOut=0)."""
+        ev = self._next("handshake")
+        if ev is not None and ev.kind == "expire":
+            self._fire(ev)
+            return (
+                struct.pack(">iiq", 0, 0, 0)
+                + struct.pack(">i", 16) + b"\x00" * 16
+            )
+        return frame
+
+    def filter_reply(self, frame: bytes, sock) -> bytes:
+        """Called with each in-session reply frame (serial and pipelined);
+        may delay, corrupt, or kill the read according to the schedule."""
+        ev = self._next("reply")
+        if ev is None:
+            return frame
+        if ev.kind == "slow":
+            self._fire(ev)
+            time.sleep(ev.arg if ev.arg is not None else 0.05)
+            return frame
+        if ev.kind == "trunc":
+            self._fire(ev)
+            keep = int(ev.arg) if ev.arg is not None else len(frame) // 2
+            return frame[:max(0, keep)]
+        if ev.kind == "drop":
+            self._fire(ev)
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:  # kalint: disable=KA008 -- socket already dead; the injected reset below is the signal
+                    pass
+            raise ConnectionResetError(
+                "injected fault: socket dropped mid-frame"
+            )
+        if ev.kind == "nonode":
+            self._fire(ev)
+            # ReplyHeader = xid(4) + zxid(8) + err(4); rewrite err, drop the
+            # body (a real NoNode reply carries none).
+            return frame[:12] + struct.pack(">i", ERR_NONODE)
+        return frame
+
+    def solve_attempt(self) -> None:
+        """Called at the top of each batched TPU solve; ``crash`` raises."""
+        ev = self._next("solve")
+        if ev is not None and ev.kind == "crash":
+            self._fire(ev)
+            raise InjectedSolverCrash(
+                "injected fault: TPU solver crash (compile failure / OOM "
+                "stand-in)"
+            )
+
+
+#: Programmatic override (tests) — wins over the env knob when set.
+_INSTALLED: Optional[FaultInjector] = None
+#: Env-built injector cache keyed by (spec, seed): the wire client and the
+#: solver construct lazily but must share one schedule's counters.
+_ENV_CACHE: Optional[Tuple[Tuple[str, int], Optional[FaultInjector]]] = None
+
+
+def install(injector: Optional[FaultInjector]) -> None:
+    """Install an injector programmatically (None uninstalls); overrides the
+    ``KA_FAULTS_SPEC`` knob until :func:`reset`."""
+    global _INSTALLED
+    _INSTALLED = injector
+
+
+def reset() -> None:
+    """Forget the installed injector and the env cache: the next
+    :func:`active_injector` call starts a fresh schedule (fresh counters).
+    The chaos soak calls this between runs."""
+    global _INSTALLED, _ENV_CACHE
+    _INSTALLED = None
+    _ENV_CACHE = None
+
+
+def active_injector() -> Optional[FaultInjector]:
+    """The injector for the current process, or None (the fast path: one
+    global read). Env-driven construction follows the knob house rule — a
+    malformed ``KA_FAULTS_SPEC`` warns on stderr and injection stays OFF."""
+    if _INSTALLED is not None:
+        return _INSTALLED
+    from ..utils.env import env_float, env_int, env_str
+
+    spec = env_str("KA_FAULTS_SPEC")
+    if not spec:
+        return None
+    seed = env_int("KA_FAULTS_SEED")
+    global _ENV_CACHE
+    if _ENV_CACHE is not None and _ENV_CACHE[0] == (spec, seed):
+        return _ENV_CACHE[1]
+    injector: Optional[FaultInjector] = None
+    try:
+        injector = FaultInjector(
+            parse_spec(spec, seed, env_float("KA_FAULTS_RATE"))
+        )
+    except FaultSpecError as e:
+        print(
+            f"kafka-assigner: ignoring malformed KA_FAULTS_SPEC ({e}); "
+            "fault injection disabled",
+            file=sys.stderr,
+        )
+    _ENV_CACHE = ((spec, seed), injector)
+    return injector
+
+
+def fault_point(scope: str) -> None:
+    """Generic crash-style fault point for non-wire call sites (today:
+    ``solve`` in the TPU solver). No-op without an active injector."""
+    inj = active_injector()
+    if inj is not None and scope == "solve":
+        inj.solve_attempt()

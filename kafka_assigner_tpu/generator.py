@@ -11,6 +11,7 @@ log4j.properties:21-31``).
 """
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import queue
@@ -19,9 +20,11 @@ import threading
 from typing import Dict, List, Optional, Sequence, Set, TextIO, Tuple
 
 from .assigner import TopicAssigner
+from .errors import IngestError, SolveError
 from .obs import gauge_set, obs_active, span
 from .solvers.base import Context
 from .io.base import BrokerInfo, MetadataBackend
+from .io.zkwire import ZkWireError
 from .validate import validate_cluster_feasibility
 from .io.json_io import (
     format_brokers_json,
@@ -309,8 +312,42 @@ def record_plan_stats(
     gauge_set("plan.partitions", partitions)
 
 
+def _is_ingest_failure(e: BaseException) -> bool:
+    """Failure classes the metadata phase tags as :class:`IngestError`:
+    the wire client's errors, socket/file errors, snapshot KeyErrors — and
+    kazoo's exception tree, matched by ancestor NAME so the tagging works
+    whether or not the optional kazoo package is importable here."""
+    if isinstance(e, (ZkWireError, OSError, KeyError)):
+        return True
+    return any(c.__name__ == "KazooException" for c in type(e).__mro__)
+
+
 #: Sentinel closing the ingest stream (the producer finished cleanly).
 _INGEST_DONE = object()
+
+
+@dataclasses.dataclass
+class Degradation:
+    """What a ``--failure-policy best-effort`` run survived: the record the
+    CLI turns into the degraded-success exit code and the run report's
+    ``ingest.topics_skipped``/``solve.fallbacks`` accounting."""
+
+    topics_skipped: List[str] = dataclasses.field(default_factory=list)
+    solve_fallbacks: int = 0
+
+    def any(self) -> bool:
+        return bool(self.topics_skipped or self.solve_fallbacks)
+
+
+def _note_skipped(topic: str, skipped: List[str]) -> None:
+    """Record one vanished topic — loud on stderr per occurrence (the
+    operator must see exactly what the plan will NOT cover)."""
+    skipped.append(topic)
+    print(
+        f"kafka-assigner: best-effort: topic {topic!r} vanished during the "
+        "metadata scan; skipping it",
+        file=sys.stderr,
+    )
 
 
 def stream_initial_assignment(
@@ -319,6 +356,8 @@ def stream_initial_assignment(
     brokers: Optional[Set[int]] = None,
     rack_assignment: Optional[Dict[int, str]] = None,
     want_encode: bool = False,
+    failure_policy: str = "strict",
+    skipped: Optional[List[str]] = None,
 ) -> Tuple[Dict[str, Dict[int, List[int]]], Optional[tuple]]:
     """Metadata ingest overlapped with host encode.
 
@@ -334,19 +373,63 @@ def stream_initial_assignment(
     callers fall back to encoding inside the solver, identical output either
     way).
 
-    Failure contract: a producer-side exception (missing znode, wire error,
-    missing snapshot topic) re-raises here, on the orchestration thread, so
-    tracing spans and the run report see it exactly like a serial fetch
-    failure. A CONSUMER-side abort (encode error, KeyboardInterrupt) leaves
-    the daemon producer blocked on its socket; it is not joined — the CLI's
+    ``failure_policy="best-effort"`` (ISSUE 5): a topic that vanishes
+    mid-scan — deleted between the topic listing and its metadata read — is
+    skipped instead of aborting the ingest: it is appended to the caller's
+    ``skipped`` list (and warned per occurrence on stderr), left out of
+    ``initial`` AND of the preencode, and the stream keeps flowing. The
+    returned pair then covers exactly ``topic_list`` minus the skipped
+    occurrences, in order. Backends predating the ``missing=`` parameter
+    degrade to strict with a stderr notice.
+
+    Failure contract (strict, and every non-missing failure): a
+    producer-side exception (missing znode, wire error, missing snapshot
+    topic) re-raises here, on the orchestration thread, so tracing spans and
+    the run report see it exactly like a serial fetch failure. A
+    CONSUMER-side abort (encode error, KeyboardInterrupt) leaves the daemon
+    producer blocked on its socket; it is not joined — the CLI's
     ``backend.close()`` on the unwind path closes that socket, which errors
     the producer out promptly (possible stderr noise, never a hang past the
     socket timeout).
     """
     from .utils.env import env_bool, env_int
 
+    best_effort = failure_policy == "best-effort"
+    if skipped is None:
+        skipped = []
     fetch = getattr(backend, "fetch_topics", None)
+
+    def _open_stream():
+        if best_effort:
+            try:
+                return fetch(topic_list, missing="skip")
+            except TypeError:
+                # Third-party backend predating the degradation contract:
+                # strict semantics, said out loud rather than silently.
+                print(
+                    "kafka-assigner: this metadata backend predates the "
+                    "missing-topic degradation contract; --failure-policy "
+                    "best-effort degrades to strict for ingest",
+                    file=sys.stderr,
+                )
+        return fetch(topic_list)
+
     if fetch is None or not env_bool("KA_ZK_OVERLAP"):
+        if fetch is not None and best_effort:
+            # Overlap disabled but degradation requested: drain the stream
+            # inline (identical output to partition_assignment) so vanished
+            # topics can still be skipped per entry.
+            initial = {}
+            with span("ingest/stream"):
+                for topic, parts in _open_stream():
+                    if parts is None:
+                        _note_skipped(topic, skipped)
+                        continue
+                    initial[topic] = parts
+            if obs_active():
+                gauge_set("ingest.topics", len(initial))
+                gauge_set("ingest.topics_skipped", len(skipped))
+            return initial, None
         return backend.partition_assignment(topic_list), None
 
     acc = None
@@ -361,11 +444,16 @@ def stream_initial_assignment(
         initial = {}
         streamed = 0
         with span("ingest/stream"):
-            for topic, parts in fetch(topic_list):
+            for topic, parts in _open_stream():
+                if parts is None:
+                    _note_skipped(topic, skipped)
+                    continue
                 initial[topic] = parts
                 streamed += 1
         if obs_active():
             gauge_set("ingest.topics", streamed)
+            if best_effort:
+                gauge_set("ingest.topics_skipped", len(skipped))
         return initial, None
 
     q: "queue.Queue" = queue.Queue()
@@ -373,7 +461,7 @@ def stream_initial_assignment(
 
     def _produce() -> None:
         try:
-            for item in fetch(topic_list):
+            for item in _open_stream():
                 q.put(item)
             q.put(_INGEST_DONE)
         except BaseException as e:  # re-raised on the consumer side
@@ -397,6 +485,9 @@ def stream_initial_assignment(
                 t.join()
                 raise item
             topic, parts = item
+            if parts is None:  # vanished mid-scan (best-effort stream)
+                _note_skipped(topic, skipped)
+                continue
             initial[topic] = parts
             streamed += 1
             if acc is not None:
@@ -414,6 +505,8 @@ def stream_initial_assignment(
     preencoded = acc.finish() if acc is not None else None
     if obs_active():
         gauge_set("ingest.topics", streamed)
+        if best_effort:
+            gauge_set("ingest.topics_skipped", len(skipped))
         if acc is not None:
             gauge_set("ingest.encode_ms", round(acc.encode_ms, 3))
             gauge_set("ingest.overlap_ms", round(overlap_ms, 3))
@@ -431,6 +524,8 @@ def print_least_disruptive_reassignment(
     out: Optional[TextIO] = None,
     live_brokers: Optional[Sequence[BrokerInfo]] = None,
     context_file: Optional[str] = None,
+    failure_policy: str = "strict",
+    degradation: Optional[Degradation] = None,
 ) -> Dict[str, Dict[int, List[int]]]:
     """Mode 3 — the reassignment driver (``KafkaAssignmentGenerator.java:131-187``):
     resolve the broker set (all-live default, minus exclusions), choose topics,
@@ -439,7 +534,16 @@ def print_least_disruptive_reassignment(
 
     Metadata is read exactly once: the rollback snapshot and the solver both
     see the same ``initial`` assignment (the reference reads ZK twice,
-    ``KafkaAssignmentGenerator.java:160,163`` — a race we close)."""
+    ``KafkaAssignmentGenerator.java:160,163`` — a race we close).
+
+    ``failure_policy="best-effort"`` (ISSUE 5): topics that vanish mid-scan
+    are skipped (reported per occurrence on stderr and in the run report's
+    ``ingest.topics_skipped``), and a crashed non-greedy solve falls back to
+    the greedy solver per group (``solve.fallbacks``); what the run survived
+    is written into the caller-supplied ``degradation`` record, which the
+    CLI turns into the degraded-success exit code. Unrecoverable failures
+    are re-raised phase-tagged (:class:`~.errors.IngestError` /
+    :class:`~.errors.SolveError`) so the CLI exit code names the phase."""
     out = out if out is not None else sys.stdout
     broker_set = set(specified_brokers)
     if not broker_set:
@@ -451,14 +555,45 @@ def print_least_disruptive_reassignment(
 
     topic_list = list(topics) if topics is not None else backend.all_topics()
 
+    skipped: List[str] = []
     with span("metadata/assignment"):
         # Pipelined ingest overlapped with host encode: the TPU path gets the
         # batched group encode built WHILE ZooKeeper responses stream in (the
         # solver then skips its own encode — identical arrays by
         # construction); other solvers still get the pipelined fetch.
-        initial, preencoded = stream_initial_assignment(
-            backend, topic_list, brokers, rack_assignment,
-            want_encode=(solver == "tpu"),
+        try:
+            initial, preencoded = stream_initial_assignment(
+                backend, topic_list, brokers, rack_assignment,
+                want_encode=(solver == "tpu"),
+                failure_policy=failure_policy, skipped=skipped,
+            )
+        except Exception as e:
+            if not _is_ingest_failure(e):
+                raise
+            raise IngestError(f"metadata ingest failed: {e}") from e
+    if skipped:
+        # The plan can only cover what survived the scan. Filter by presence
+        # in the ingested map (duplicate-occurrence-safe).
+        topic_list = [t for t in topic_list if t in initial]
+        if any(t in initial for t in skipped):
+            # Duplicate-occurrence edge (a name both vanished AND resolved
+            # within one scan): the preencode's occurrence list no longer
+            # matches the filtered one — drop it and let the solver
+            # re-encode. The common case (a name wholly vanished) keeps the
+            # overlap's preencode: the accumulator only ever saw the
+            # surviving occurrences, which IS the filtered list.
+            preencoded = None
+        # A name still present in the plan did not degrade it: count only
+        # the occurrences the plan actually lost (and re-stamp the gauge).
+        skipped = [t for t in skipped if t not in initial]
+        if obs_active():
+            gauge_set("ingest.topics_skipped", len(skipped))
+    if skipped:
+        print(
+            f"kafka-assigner: best-effort: {len(skipped)} topic read(s) "
+            f"vanished mid-scan; planning the remaining "
+            f"{len(topic_list)} topic(s)",
+            file=sys.stderr,
         )
 
     # Rollback snapshot first (KafkaAssignmentGenerator.java:159-160), from
@@ -487,7 +622,7 @@ def print_least_disruptive_reassignment(
     # (KafkaAssignmentGenerator.java:166-176), duplicates solved per
     # occurrence like the reference loop. The TPU backend folds the whole
     # loop into a single device dispatch with identical output.
-    assigner = TopicAssigner(solver=solver)
+    assigner = TopicAssigner(solver=solver, failure_policy=failure_policy)
     if context_file is not None and os.path.exists(context_file):
         try:
             assigner.context = Context.load(context_file)
@@ -496,13 +631,26 @@ def print_least_disruptive_reassignment(
                 f"invalid leadership context file {context_file!r}: {e}"
             ) from e
     with span("plan/solve"):
-        final_pairs = assigner.generate_assignments(
-            [(topic, initial[topic]) for topic in topic_list],
-            brokers,
-            rack_assignment,
-            desired_replication_factor,
-            preencoded=preencoded,
-        )
+        try:
+            final_pairs = assigner.generate_assignments(
+                [(topic, initial[topic]) for topic in topic_list],
+                brokers,
+                rack_assignment,
+                desired_replication_factor,
+                preencoded=preencoded,
+            )
+        except (ValueError, SolveError):
+            # ValueError = input validation (RF bounds, infeasibility):
+            # keeps its plain type for library callers and the validation
+            # exit code. SolveError = an already-tagged backend crash.
+            raise
+        except Exception as e:
+            raise SolveError(
+                f"solver backend crashed ({type(e).__name__}): {e}"
+            ) from e
+    if degradation is not None:
+        degradation.topics_skipped = list(skipped)
+        degradation.solve_fallbacks = assigner.fallbacks
     if obs_active():
         record_plan_stats(initial, final_pairs)
     with span("plan/emit"):

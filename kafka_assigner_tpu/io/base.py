@@ -53,7 +53,7 @@ class MetadataBackend(Protocol):
     ) -> Dict[str, Dict[int, List[int]]]: ...
 
     def fetch_topics(
-        self, topics: Sequence[str]
+        self, topics: Sequence[str], missing: str = "raise"
     ) -> Iterator[Tuple[str, Dict[int, List[int]]]]:
         """Streaming variant of :meth:`partition_assignment`: yield
         ``(topic, {partition: [replica ids]})`` per input entry, in input
@@ -62,13 +62,48 @@ class MetadataBackend(Protocol):
         downstream work (host encode) with the remaining round-trips.
         Offline backends yield from memory.
 
+        ``missing="skip"`` is the graceful-degradation contract (ISSUE 5):
+        a topic the backend cannot resolve — deleted between the topic
+        listing and the metadata read — yields ``(topic, None)`` instead of
+        raising, and the stream keeps flowing; callers under
+        ``--failure-policy best-effort`` record and skip those entries.
+        The default ``"raise"`` keeps the strict fail-fast behavior.
+
         The body below is a real default, not a stub: a third-party backend
         that explicitly subclasses this Protocol without overriding it
         inherits a correct (non-streaming) implementation over
         :meth:`partition_assignment`. Pure duck-typed backends without the
         method at all are handled by callers via ``getattr`` fallback
         (``generator.stream_initial_assignment``)."""
+        import sys
+
         topics = list(topics)
+        if missing == "skip":
+            try:
+                assignment = self.partition_assignment(topics)
+            except Exception as batch_err:
+                # The generic default cannot know the backend's missing-
+                # topic error class, so probe per topic — but a backend
+                # where NOTHING resolves is a transport outage, not a
+                # cluster with every topic deleted: re-raise the original
+                # error so strict AND best-effort report ingest failure
+                # instead of a silent near-empty "degraded" plan.
+                assignment = {}
+                for t in dict.fromkeys(topics):
+                    try:
+                        assignment.update(self.partition_assignment([t]))
+                    except Exception as per_topic_err:
+                        print(
+                            f"kafka-assigner: topic {t!r} unresolvable "
+                            f"({type(per_topic_err).__name__}: "
+                            f"{per_topic_err}); treating as vanished",
+                            file=sys.stderr,
+                        )
+                if not assignment:
+                    raise batch_err
+            for t in topics:
+                yield t, assignment.get(t)
+            return
         assignment = self.partition_assignment(topics)
         for t in topics:
             yield t, assignment[t]

@@ -114,15 +114,55 @@ class KafkaAdminBackend:
         return out
 
     def fetch_topics(
-        self, topics: Sequence[str]
+        self, topics: Sequence[str], missing: str = "raise"
     ) -> Iterator[Tuple[str, Dict[int, List[int]]]]:
         """Streaming half of the backend surface. The AdminClient metadata
         call is already a single batched RPC (nothing to pipeline), so this
-        fetches once and yields per input entry in input order."""
+        fetches once and yields per input entry in input order. Under
+        ``missing="skip"`` a topic absent from the batched metadata yields
+        ``(topic, None)`` instead of a KeyError (the best-effort
+        degradation contract, io/base.py)."""
         topics = list(topics)
+        if missing == "skip":
+            for t, parts in zip(topics, self._fetch_skip_missing(topics)):
+                yield t, parts
+            return
         assignment = self.partition_assignment(topics)
         for t in topics:
             yield t, assignment[t]
+
+    @staticmethod
+    def _is_unknown_topic(e: Exception) -> bool:
+        """Missing-topic errors only (KeyError from the confluent metadata
+        map, kafka-python's UnknownTopicOrPartitionError by name) — a
+        TRANSPORT failure must re-raise as an ingest failure, never be
+        laundered into 'every topic vanished' degraded success."""
+        return isinstance(e, KeyError) or "UnknownTopic" in type(e).__name__
+
+    def _fetch_skip_missing(self, topics):
+        """The ``missing="skip"`` lane: ONE batched RPC first (strict-cost),
+        falling back to per-topic probes only when the batch fails on a
+        missing topic. Returns per-input-entry assignments (None = vanished).
+        """
+        unique = list(dict.fromkeys(topics))
+        try:
+            assignment = self.partition_assignment(unique)
+        except Exception as e:
+            if not self._is_unknown_topic(e):
+                raise
+            assignment = {}
+            for t in unique:
+                try:
+                    assignment.update(self.partition_assignment([t]))
+                except Exception as per_topic_err:
+                    if not self._is_unknown_topic(per_topic_err):
+                        raise
+                    print(
+                        f"kafka-assigner: topic {t!r} unknown to the "
+                        "AdminClient; treating as vanished",
+                        file=sys.stderr,
+                    )
+        return [assignment.get(t) for t in topics]
 
     def close(self) -> None:
         if self._impl == "kafka-python":

@@ -55,17 +55,23 @@ class SnapshotBackend:
         return list(self._topics)
 
     def fetch_topics(
-        self, topics: Sequence[str]
+        self, topics: Sequence[str], missing: str = "raise"
     ) -> Iterator[Tuple[str, Dict[int, List[int]]]]:
         """Streaming half of the backend surface, trivially: the snapshot is
         already in memory, so this just yields per input entry in input
-        order (missing topics raise up front, exactly like
-        :meth:`partition_assignment`)."""
+        order. Missing topics raise up front, exactly like
+        :meth:`partition_assignment` — or yield ``(topic, None)`` under
+        ``missing="skip"`` (the best-effort degradation contract, matching
+        the live backends)."""
         topics = list(topics)
-        missing = [t for t in topics if t not in self._topics]
-        if missing:
-            raise KeyError(f"topics not in snapshot: {missing}")
+        if missing != "skip":
+            absent = [t for t in topics if t not in self._topics]
+            if absent:
+                raise KeyError(f"topics not in snapshot: {absent}")
         for t in topics:
+            if t not in self._topics:
+                yield t, None
+                continue
             yield t, {p: list(r) for p, r in self._topics[t].items()}
 
     def partition_assignment(

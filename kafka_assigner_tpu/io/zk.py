@@ -74,14 +74,22 @@ class ZkBackend:
         self._zk = client_cls(hosts=connect_string, timeout=ZK_TIMEOUT_S)
         self._zk.start(timeout=ZK_TIMEOUT_S)
 
+    @staticmethod
+    def _is_nonode(e: Exception) -> bool:
+        """True for any client's missing-znode error — the wire client's
+        ``NoNodeError`` or kazoo's (matched by name: kazoo may be absent)."""
+        return type(e).__name__ == "NoNodeError"
+
     def _iter_gets(
-        self, paths: Sequence[str]
+        self, paths: Sequence[str], missing_ok: bool = False
     ) -> Iterator[Tuple[bytes, object]]:
         """``(data, stat)`` per path, in path order — pipelined where the
         client allows it. Wire client: the xid-matched ``iter_get`` window.
         Kazoo: a sliding window of async handles (kazoo pipelines on its own
         connection thread; the window bounds outstanding memory). Anything
-        else: serial gets.
+        else: serial gets. Under ``missing_ok`` a missing znode yields
+        ``None`` at its position instead of raising (graceful degradation,
+        ISSUE 5).
 
         Runs on whatever thread is consuming the iterator (the streaming
         ingest's producer thread) — metrics only, no tracing spans (the span
@@ -91,7 +99,7 @@ class ZkBackend:
             return
         iter_get = getattr(self._zk, "iter_get", None)
         if iter_get is not None:
-            yield from iter_get(paths)
+            yield from iter_get(paths, missing_ok=missing_ok)
             return
         get_async = getattr(self._zk, "get_async", None)
         if get_async is not None:
@@ -104,16 +112,31 @@ class ZkBackend:
                 "zk.pipeline.rtts_saved",
                 len(paths) - -(-len(paths) // window),
             )
+
+            def _resolve(handle):
+                try:
+                    return handle.get(timeout=ZK_TIMEOUT_S)
+                except Exception as e:
+                    if missing_ok and self._is_nonode(e):
+                        return None
+                    raise
+
             handles: deque = deque()
             for path in paths:
                 handles.append(get_async(path))
                 if len(handles) >= window:
-                    yield handles.popleft().get(timeout=ZK_TIMEOUT_S)
+                    yield _resolve(handles.popleft())
             while handles:
-                yield handles.popleft().get(timeout=ZK_TIMEOUT_S)
+                yield _resolve(handles.popleft())
             return
         for path in paths:
-            yield self._zk.get(path)
+            try:
+                yield self._zk.get(path)
+            except Exception as e:
+                if missing_ok and self._is_nonode(e):
+                    yield None
+                else:
+                    raise
 
     def brokers(self) -> List[BrokerInfo]:
         out = []
@@ -139,18 +162,26 @@ class ZkBackend:
         return sorted(self._zk.get_children("/brokers/topics"))
 
     def fetch_topics(
-        self, topics: Sequence[str]
+        self, topics: Sequence[str], missing: str = "raise"
     ) -> Iterator[Tuple[str, Dict[int, List[int]]]]:
         """Batched topic-metadata fetch: yields ``(topic, {partition:
         [replica ids]})`` per input entry, in input order, as pipelined
         responses arrive — the streaming half of the ``MetadataBackend``
         surface (``io/base.py``). Duplicates are fetched per occurrence,
-        like the serial loop. A missing topic raises the wire client's
-        ``NoNodeError`` (kazoo: its own ``NoNodeError``) at that topic's
-        position."""
+        like the serial loop. A missing topic — the delete-during-scan race
+        — raises the wire client's ``NoNodeError`` (kazoo: its own
+        ``NoNodeError``) at that topic's position, or under
+        ``missing="skip"`` yields ``(topic, None)`` and keeps streaming
+        (the ``--failure-policy best-effort`` degradation path)."""
         topics = list(topics)
         paths = [f"/brokers/topics/{topic}" for topic in topics]
-        for topic, (raw, _) in zip(topics, self._iter_gets(paths)):
+        stream = self._iter_gets(paths, missing_ok=(missing == "skip"))
+        for topic, res in zip(topics, stream):
+            if res is None:
+                counter_add("zk.topics_missing")
+                yield topic, None
+                continue
+            raw, _ = res
             counter_add("zk.reads")
             counter_add("zk.bytes", len(raw))
             meta = json.loads(raw)

@@ -32,7 +32,22 @@ out-of-order-safe xid matching, so N znode reads cost ~``ceil(N/window)``
 round-trips instead of N; a window of one degrades to the exact serial
 frame sequence (``tests/test_zk_golden_frames.py`` pins both byte-for-byte
 against spec-derived frames). Session connects retry across the shuffled
-endpoint list with backoff (``KA_ZK_CONNECT_RETRIES``).
+endpoint list with jittered backoff (``KA_ZK_CONNECT_RETRIES``).
+
+Self-healing reads (ISSUE 5): a session that dies MID-read — socket drop,
+truncated/desynced frame, per-reply timeout — no longer kills the run.
+Transport-level failures raise :class:`ZkConnectionError` (a loud subclass
+of :class:`ZkWireError`), and both the serial ops and the pipelined
+``iter_get`` window catch it, re-establish the session (up to
+``KA_ZK_SESSION_RETRIES`` times, jittered backoff, every attempt warned on
+stderr + counted as ``zk.session.reestablished``) and re-issue ONLY the
+unanswered reads. Reads are idempotent, so the replay is byte-identical to
+an uninterrupted run (the golden-frame pins hold with the window replayed
+at any cut point). Server-REPORTED errors (NoNode, auth) are never
+retried — a missing znode on a healthy session is an answer, not a fault.
+The fault-injection harness (``faults/inject.py``, ``KA_FAULTS_SPEC``)
+hooks this client at the connect/handshake/reply seams to drive exactly
+these paths deterministically.
 """
 from __future__ import annotations
 
@@ -43,6 +58,7 @@ import sys
 import time
 from typing import Iterator, List, NamedTuple, Optional, Sequence, Tuple
 
+from ..faults.inject import active_injector
 from ..obs.metrics import counter_add, gauge_set, hist_observe, hist_ms
 
 #: ZooKeeper opcodes (zookeeper.ZooDefs.OpCode).
@@ -59,6 +75,14 @@ PING_XID = -2
 
 class ZkWireError(RuntimeError):
     """Connection-level or server-reported failure of the wire client."""
+
+
+class ZkConnectionError(ZkWireError):
+    """Transport-level failure of an open session (socket drop, truncated or
+    desynced frame, reply timeout): the socket's state is unknown but no
+    read was half-applied, so the unanswered requests may be safely
+    re-issued on a fresh session (reads are idempotent). The resilience
+    layer retries exactly this class — never server-reported errors."""
 
 
 class NoNodeError(ZkWireError):
@@ -98,7 +122,7 @@ class _Reader:
 
     def _take(self, n: int) -> bytes:
         if self.off + n > len(self.data):
-            raise ZkWireError("truncated ZooKeeper reply frame")
+            raise ZkConnectionError("truncated ZooKeeper reply frame")
         out = self.data[self.off:self.off + n]
         self.off += n
         return out
@@ -152,6 +176,10 @@ class MiniZkClient:
         self._sock: Optional[socket.socket] = None
         self._xid = 0
         self._max_in_flight = 0  # high-water mark across this session
+        # Fault-injection harness hook (None in production: one attribute
+        # read per frame). Resolved once per client so a run's schedule is
+        # coherent across reconnects.
+        self._faults = active_injector()
 
     # -- session ----------------------------------------------------------
 
@@ -171,6 +199,8 @@ class MiniZkClient:
         for attempt in range(1, retries + 1):
             for host, port in endpoints:
                 try:
+                    if self._faults is not None:
+                        self._faults.connect_attempt()
                     sock = socket.create_connection((host, port), deadline_t)
                     sock.settimeout(deadline_t)
                     # Pipelining sends many small frames back-to-back; with
@@ -189,7 +219,11 @@ class MiniZkClient:
                         self._sock.close()
                         self._sock = None
             if attempt < retries:
+                # Jittered backoff (0.5x-1.5x the nominal step): a fleet of
+                # parallel what-if workers retrying a flapped quorum member
+                # must not re-arrive in lockstep (thundering herd).
                 backoff = min(0.1 * (2 ** (attempt - 1)), 2.0)
+                backoff *= 0.5 + random.random()
                 print(
                     f"kafka-assigner: ZooKeeper connect pass {attempt}/"
                     f"{retries} failed over {len(endpoints)} endpoint(s) "
@@ -211,11 +245,16 @@ class MiniZkClient:
             + b"\x00"
         )
         self._send_frame(req)
-        r = _Reader(self._recv_frame())
+        raw = self._recv_frame()
+        if self._faults is not None:
+            raw = self._faults.filter_handshake(raw)
+        r = _Reader(raw)
         r.read_int()            # protocolVersion
         negotiated = r.read_int()  # timeOut
-        session_id = r.read_long()
-        if negotiated <= 0 or session_id == 0 and negotiated == 0:
+        r.read_long()           # sessionId (0 on expiry, unused otherwise)
+        if negotiated <= 0:
+            # The expired-session ConnectResponse: negotiated timeout 0
+            # (sessionId is also 0, but the timeout alone is decisive).
             raise ZkWireError("ZooKeeper session expired during handshake")
 
     # -- rpc --------------------------------------------------------------
@@ -231,7 +270,7 @@ class MiniZkClient:
         header = self._recv_exact(4)
         (n,) = struct.unpack(">i", header)
         if n < 0 or n > (64 << 20):
-            raise ZkWireError(f"invalid ZooKeeper frame length {n}")
+            raise ZkConnectionError(f"invalid ZooKeeper frame length {n}")
         counter_add("zk.wire_frames_in")
         counter_add("zk.wire_bytes_in", 4 + n)
         return self._recv_exact(n)
@@ -242,27 +281,63 @@ class MiniZkClient:
         while n:
             chunk = self._sock.recv(n)
             if not chunk:
-                raise ZkWireError("ZooKeeper connection closed mid-reply")
+                raise ZkConnectionError("ZooKeeper connection closed mid-reply")
             chunks.append(chunk)
             n -= len(chunk)
         return b"".join(chunks)
 
+    def _reconnect(self, attempt: int, retries: int, err: Exception) -> None:
+        """Tear down the dead socket and establish a fresh session (which
+        itself retries over the endpoint list): the in-session half of the
+        resilience layer. Jittered backoff, loud stderr, counted."""
+        counter_add("zk.session.reestablished")
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:  # kalint: disable=KA008 -- socket already dead; the reconnect below is the recovery
+                pass
+            self._sock = None
+        backoff = min(0.05 * (2 ** (attempt - 1)), 1.0) * (0.5 + random.random())
+        print(
+            f"kafka-assigner: ZooKeeper session lost mid-read "
+            f"({type(err).__name__}: {err}); re-establishing and replaying "
+            f"unanswered reads (attempt {attempt}/{retries}, "
+            f"backoff {backoff:.2f}s)",
+            file=sys.stderr,
+        )
+        time.sleep(backoff)
+        self.start()
+
     def _call(self, op: int, payload: bytes) -> _Reader:
         if self._sock is None:
             raise ZkWireError("ZooKeeper session is not started")
-        self._xid += 1
-        xid = self._xid
-        # Metrics-only timing (hist_ms): one RPC per znode is too hot for
-        # the span log, but the latency distribution is exactly what a
-        # fleet-scale run needs to see.
-        with hist_ms("zk.op_ms"):
-            return self._call_inner(op, xid, payload)
+        from ..utils.env import env_int
+
+        retries = env_int("KA_ZK_SESSION_RETRIES")
+        attempt = 0
+        while True:
+            self._xid += 1
+            xid = self._xid
+            try:
+                # Metrics-only timing (hist_ms): one RPC per znode is too
+                # hot for the span log, but the latency distribution is
+                # exactly what a fleet-scale run needs to see.
+                with hist_ms("zk.op_ms"):
+                    return self._call_inner(op, xid, payload)
+            except (OSError, ZkConnectionError) as e:
+                # Transport death only: a serial read is unanswered by
+                # definition, so re-issuing it on a fresh session is safe.
+                # NoNode/server errors propagate untouched above.
+                attempt += 1
+                if attempt > retries:
+                    raise
+                self._reconnect(attempt, retries, e)
 
     def _call_inner(self, op: int, xid: int, payload: bytes) -> _Reader:
         self._send_frame(struct.pack(">ii", xid, op) + payload)
         rxid, err, r = self._recv_reply()
         if rxid != xid:
-            raise ZkWireError(
+            raise ZkConnectionError(
                 f"ZooKeeper reply xid {rxid} does not match request {xid}"
             )
         if err == ERR_NONODE:
@@ -275,7 +350,10 @@ class MiniZkClient:
         """One reply frame's ``ReplyHeader`` (xid, err) plus its body reader,
         skipping stray ping replies (the session-keepalive xid)."""
         while True:
-            r = _Reader(self._recv_frame())
+            raw = self._recv_frame()
+            if self._faults is not None:
+                raw = self._faults.filter_reply(raw, self._sock)
+            r = _Reader(raw)
             rxid = r.read_int()
             r.read_long()  # zxid
             err = r.read_int()
@@ -305,8 +383,8 @@ class MiniZkClient:
     # -- pipelined reads --------------------------------------------------
 
     def iter_get(
-        self, paths: Sequence[str]
-    ) -> Iterator[Tuple[bytes, ZnodeStat]]:
+        self, paths: Sequence[str], missing_ok: bool = False
+    ) -> Iterator[Optional[Tuple[bytes, ZnodeStat]]]:
         """Pipelined ``getData`` over the session socket: up to
         ``KA_ZK_PIPELINE`` requests in flight at once, responses matched by
         xid (ZooKeeper answers a session's requests in order, but the
@@ -320,9 +398,19 @@ class MiniZkClient:
         missing znode) stops new sends, drains the already-sent window —
         keeping the session usable, exactly like a failed serial ``get`` —
         and is raised at the failing path's position in request order, after
-        every earlier result has been yielded. With a window of one the
-        frame sequence on the wire is byte-identical to serial ``get``
-        calls.
+        every earlier result has been yielded. Under ``missing_ok=True`` a
+        missing znode instead yields ``None`` at its position and the
+        pipeline keeps flowing — the graceful-degradation hook for topics
+        deleted between ``getChildren`` and ``getData`` (ISSUE 5). With a
+        window of one the frame sequence on the wire is byte-identical to
+        serial ``get`` calls.
+
+        Self-healing (ISSUE 5): a transport-level death mid-window
+        (:class:`ZkConnectionError`, ``OSError``) re-establishes the session
+        and re-issues only the not-yet-yielded reads, up to
+        ``KA_ZK_SESSION_RETRIES`` times — results already handed to the
+        caller are never re-fetched, so the output stream is byte-identical
+        to an uninterrupted run.
 
         Abandoning the iterator early (``break``, GeneratorExit) drains the
         in-flight window on close, so the session stays usable for
@@ -341,15 +429,59 @@ class MiniZkClient:
         from ..utils.env import env_int
 
         window = env_int("KA_ZK_PIPELINE")
+        retries = env_int("KA_ZK_SESSION_RETRIES")
         n = len(paths)
         if n == 0:
             return
         t0 = time.perf_counter()
         counter_add("zk.pipeline.batches")
-        pending: dict = {}   # xid -> request position
-        ready: dict = {}     # position -> (data, stat) | ZkWireError
-        sent = 0
         yielded = 0
+        attempt = 0
+        while yielded < n:
+            inner = self._iter_get_window(paths, yielded, window, missing_ok)
+            try:
+                try:
+                    for res in inner:
+                        yielded += 1
+                        if yielded == n:
+                            # Account BEFORE the final yield: consumers like
+                            # zip() abandon the generator at its last item,
+                            # so code after the loop would never run.
+                            counter_add(
+                                "zk.pipeline.rtts_saved", n - -(-n // window)
+                            )
+                            hist_observe(
+                                "zk.pipeline.batch_ms",
+                                (time.perf_counter() - t0) * 1e3,
+                            )
+                        yield res
+                finally:
+                    # Prompt close on any exit (incl. the caller abandoning
+                    # THIS generator): the window helper's own finally then
+                    # drains its in-flight replies.
+                    inner.close()
+            except (OSError, ZkConnectionError) as e:
+                attempt += 1
+                if attempt > retries:
+                    raise
+                self._reconnect(attempt, retries, e)
+
+    def _iter_get_window(
+        self,
+        paths: Sequence[str],
+        start: int,
+        window: int,
+        missing_ok: bool,
+    ) -> Iterator[Optional[Tuple[bytes, ZnodeStat]]]:
+        """One session's attempt at positions ``start..n-1`` of a pipelined
+        batch (the replay loop in :meth:`iter_get` re-enters here after a
+        reconnect). Yields results in position order; transport failures
+        raise :class:`ZkConnectionError`/``OSError`` to the replay loop."""
+        n = len(paths)
+        pending: dict = {}   # xid -> request position
+        ready: dict = {}     # position -> (data, stat) | None | ZkWireError
+        sent = start
+        yielded = start
         failed = False       # stop filling the window once an error lands
         desynced = False     # socket state unknown: draining cannot help
         try:
@@ -372,7 +504,7 @@ class MiniZkClient:
                         rxid, err, r = self._recv_reply()
                     except socket.timeout:
                         desynced = True
-                        raise ZkWireError(
+                        raise ZkConnectionError(
                             f"timed out waiting for {len(pending)} pipelined "
                             f"ZooKeeper replies (window {window}, first "
                             f"outstanding path "
@@ -381,12 +513,14 @@ class MiniZkClient:
                     pos = pending.pop(rxid, None)
                     if pos is None:
                         desynced = True
-                        raise ZkWireError(
+                        raise ZkConnectionError(
                             f"ZooKeeper reply xid {rxid} matches no "
                             f"in-flight pipelined request "
                             f"(window {sorted(pending)})"
                         )
-                    if err == ERR_NONODE:
+                    if err == ERR_NONODE and missing_ok:
+                        ready[pos] = None  # degraded: caller skips this path
+                    elif err == ERR_NONODE:
                         ready[pos] = NoNodeError(
                             f"znode does not exist: {paths[pos]!r} "
                             f"(err {err})"
@@ -408,17 +542,6 @@ class MiniZkClient:
                         raise res
                     del ready[yielded]
                     yielded += 1
-                    if yielded == n:
-                        # Account BEFORE the final yield: consumers like
-                        # zip() abandon the generator at its last item, so
-                        # code after the loop would never run.
-                        counter_add(
-                            "zk.pipeline.rtts_saved", n - -(-n // window)
-                        )
-                        hist_observe(
-                            "zk.pipeline.batch_ms",
-                            (time.perf_counter() - t0) * 1e3,
-                        )
                     yield res
         finally:
             # Early abandonment (break/GeneratorExit) leaves replies for the
@@ -431,15 +554,15 @@ class MiniZkClient:
                     while pending:
                         rxid, _, _ = self._recv_reply()
                         pending.pop(rxid, None)
-                except (OSError, ZkWireError):
+                except (OSError, ZkWireError):  # kalint: disable=KA008 -- best-effort drain; the original error wins
                     pass
 
     def get_many(
-        self, paths: Sequence[str]
-    ) -> List[Tuple[bytes, ZnodeStat]]:
+        self, paths: Sequence[str], missing_ok: bool = False
+    ) -> List[Optional[Tuple[bytes, ZnodeStat]]]:
         """Batch primitive over :meth:`iter_get`: all results at once, in
-        request order."""
-        return list(self.iter_get(paths))
+        request order (``None`` per missing path under ``missing_ok``)."""
+        return list(self.iter_get(paths, missing_ok=missing_ok))
 
     # -- teardown ---------------------------------------------------------
 
@@ -453,9 +576,9 @@ class MiniZkClient:
             self._sock.settimeout(1.0)
             try:
                 self._recv_frame()
-            except (OSError, ZkWireError):
+            except (OSError, ZkWireError):  # kalint: disable=KA008 -- best-effort close ack; the session is ending either way
                 pass
-        except OSError:
+        except OSError:  # kalint: disable=KA008 -- close of an already-dead socket; nothing left to report to
             pass
 
     def close(self) -> None:

@@ -13,7 +13,8 @@ Schema v1 (all keys always present)::
     {
       "schema_version": 1,
       "tool": "kafka-assignment-generator",
-      "status": "ok" | "error",
+      "status": "ok" | "degraded" | "error",   # degraded: best-effort run
+                                               # that skipped/fell back
       "mode": "<CLI mode or null>",
       "argv": [...],                  # CLI argv (no env values: no secrets)
       "spans": [{"name","path","parent","depth","ms","status"}, ...],
@@ -165,8 +166,10 @@ def validate_report(obj) -> List[str]:
             f"schema_version {obj.get('schema_version')!r} != emitter's "
             f"{REPORT_SCHEMA_VERSION} (bump = regenerate the fixture)"
         )
-    if obj.get("status") not in ("ok", "error"):
-        problems.append(f"status {obj.get('status')!r} not in (ok, error)")
+    if obj.get("status") not in ("ok", "degraded", "error"):
+        problems.append(
+            f"status {obj.get('status')!r} not in (ok, degraded, error)"
+        )
     spans = obj.get("spans")
     if not isinstance(spans, list):
         problems.append("spans is not a list")

@@ -59,7 +59,7 @@ class Context:
         except BaseException:
             try:
                 os.unlink(tmp)
-            except OSError:
+            except OSError:  # kalint: disable=KA008 -- tmp-file cleanup on the unwind path; the original error re-raises below
                 pass
             raise
 

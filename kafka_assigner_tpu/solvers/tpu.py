@@ -237,9 +237,13 @@ class TpuSolver:
     ) -> Dict[int, List[int]]:
         import jax.numpy as jnp
 
+        from ..faults.inject import fault_point
         from ..obs.metrics import counter_add
         from ..ops.assignment import solve_assignment_jit
 
+        # Deterministic crash injection (KA_FAULTS_SPEC solve:i=crash): the
+        # compile-failure/OOM stand-in the fallback chain is tested against.
+        fault_point("solve")
         counter_add("solver.assign_calls")
         if context is None:
             context = Context()
@@ -325,10 +329,15 @@ class TpuSolver:
         import jax
         import jax.numpy as jnp
 
+        from ..faults.inject import fault_point
         from ..obs.metrics import gauge_set, obs_active
         from ..obs.trace import span
         from ..ops.assignment import solve_batched_jit
         from ..utils.logging import get_logger
+
+        # Deterministic crash injection (KA_FAULTS_SPEC solve:i=crash): the
+        # compile-failure/OOM stand-in the fallback chain is tested against.
+        fault_point("solve")
 
         # Same logger name the pre-obs Timers used, so KA_LOG=INFO operators
         # keep their "phase encode/solve/decode: N ms" stderr lines.

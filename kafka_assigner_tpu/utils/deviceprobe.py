@@ -46,7 +46,7 @@ def probe_device_count(timeout_s: float, allow_cpu: bool = False) -> int:
         )
         if proc.returncode == 0:
             return int(proc.stdout.strip().splitlines()[-1])
-    except (subprocess.TimeoutExpired, ValueError, IndexError):
+    except (subprocess.TimeoutExpired, ValueError, IndexError):  # kalint: disable=KA008 -- probe failure IS the signal; -1 below tells the caller
         pass
     return -1
 

@@ -202,6 +202,15 @@ _knob(
         "failed pass warned on stderr",
 )
 _knob(
+    "KA_ZK_SESSION_RETRIES", "int", 2, floor=0,
+    doc="in-session re-establishment attempts when an open ZooKeeper "
+        "session dies mid-read (socket drop, truncated/desynced frame, "
+        "timeout): the wire client reconnects with jittered backoff and "
+        "re-issues ONLY the unanswered reads (idempotent replay, "
+        "byte-identical output — `io/zkwire.py`). 0 restores fail-fast; "
+        "server-reported errors (NoNode) are never retried",
+)
+_knob(
     "KA_ZK_INGEST_CHUNK", "int", 64, floor=1,
     doc="topics per streamed host-encode chunk in the mode-3 ingest/encode "
         "overlap (`generator.py`): fetched topics fold into the batched "
@@ -214,6 +223,38 @@ _knob(
         "producer/consumer topic stream (`generator.py`); set to 0 to "
         "restore strictly sequential fetch-then-encode (byte-identical "
         "output either way, test-pinned)",
+)
+
+# --- robustness / fault injection -------------------------------------------
+_knob(
+    "KA_FAILURE_POLICY", "choice", "strict", choices=("strict", "best-effort"),
+    doc="default `--failure-policy` for CLI runs (the flag overrides). "
+        "`strict` aborts on the first unrecoverable ingest/solve failure "
+        "(reference behavior); `best-effort` degrades gracefully: topics "
+        "that vanish mid-scan are skipped (reported via "
+        "`ingest.topics_skipped` + stderr), a crashed TPU solve falls back "
+        "to the greedy solver (`solve.fallbacks`), and the run exits with "
+        "the documented degraded-success code (see README \"Failure model\")",
+)
+_knob(
+    "KA_FAULTS_SPEC", "str", None, default_doc="unset (no injection)",
+    doc="fault-injection schedule for the harness in `faults/inject.py`: "
+        "semicolon-separated `scope:index=kind[:arg]` events "
+        "(scopes connect/handshake/reply/solve; kinds blackhole, expire, "
+        "drop, trunc, slow, nonode, crash), or the word `random` for a "
+        "seed-deterministic schedule (`KA_FAULTS_SEED`/`KA_FAULTS_RATE`). "
+        "Malformed specs are ignored loudly and injection stays off",
+)
+_knob(
+    "KA_FAULTS_SEED", "int", 0,
+    doc="seed for `KA_FAULTS_SPEC=random` schedules (same seed = identical "
+        "schedule, byte-for-byte — the chaos soak's reproducibility handle)",
+)
+_knob(
+    "KA_FAULTS_RATE", "float", 0.05, floor=0.0,
+    doc="per-hook fault probability for `KA_FAULTS_SPEC=random` schedules "
+        "(drawn over the first few dozen indexes of each scope; see "
+        "`faults/inject.py:RANDOM_HORIZON`)",
 )
 
 # --- runtime / observability ------------------------------------------------

@@ -1,0 +1,329 @@
+#!/usr/bin/env python
+"""Chaos soak: the full mode-3 pipeline under injected fault schedules
+against the in-repo jute test server (ISSUE 5 acceptance harness).
+
+Two modes:
+
+- ``--matrix`` (fast; wired into ``scripts/lint.sh`` so tier-1 gates on it):
+  one deterministic schedule per fault class, run under BOTH failure
+  policies, with a per-class expected-outcome table — self-healing classes
+  must stay byte-identical at exit 0, degradation classes must exit with
+  the documented code and account for themselves in the run report.
+
+- ``--runs N`` (default 200; the slow soak, ``tests/test_chaos_soak.py``):
+  N randomized seed-deterministic schedules (``KA_FAULTS_SPEC=random``).
+  Every run must terminate within ``--timeout`` seconds (zero hangs) and
+  either (a) exit 0 with stdout byte-identical to the no-fault baseline, or
+  (b) exit with a documented degraded/failure code and, when degraded, a
+  run report whose ``faults.injected`` covers its
+  ``ingest.topics_skipped + solve.fallbacks``. A run that exits 0 with
+  DIFFERENT bytes — a silent partial result — fails the soak.
+
+Runs in-process (one interpreter, one jit cache); per-run isolation comes
+from ``faults.reset()`` + a fresh env schedule + a fresh server tree.
+"""
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from kafka_assigner_tpu import faults  # noqa: E402
+from kafka_assigner_tpu.cli import (  # noqa: E402
+    EXIT_DEGRADED,
+    EXIT_INGEST,
+    EXIT_OK,
+    EXIT_SOLVE,
+    run,
+)
+from tests.jute_server import JuteZkServer, cluster_tree  # noqa: E402
+
+#: The deterministic fault matrix: one schedule per fault class. Reply
+#: indexes follow the mode-3 read sequence against the fixture tree:
+#: 0 getChildren(/brokers/ids), 1-4 broker getData, 5 getChildren(topics),
+#: 6-7 topic getData.
+MATRIX = [
+    # (name, spec, solver, {policy: (expected_rcs, byte_identical)})
+    ("drop", "reply:3=drop", "greedy",
+     {"strict": ([EXIT_OK], True), "best-effort": ([EXIT_OK], True)}),
+    ("trunc", "reply:2=trunc", "greedy",
+     {"strict": ([EXIT_OK], True), "best-effort": ([EXIT_OK], True)}),
+    ("slow", "reply:1=slow:0.05", "greedy",
+     {"strict": ([EXIT_OK], True), "best-effort": ([EXIT_OK], True)}),
+    ("expire", "handshake:0=expire", "greedy",
+     {"strict": ([EXIT_OK], True), "best-effort": ([EXIT_OK], True)}),
+    ("blackhole", "connect:0=blackhole", "greedy",
+     {"strict": ([EXIT_OK], True), "best-effort": ([EXIT_OK], True)}),
+    ("nonode", "reply:6=nonode", "greedy",
+     {"strict": ([EXIT_INGEST], False),
+      "best-effort": ([EXIT_DEGRADED], False)}),
+    ("crash", "solve:0=crash", "tpu",
+     {"strict": ([EXIT_SOLVE], False),
+      # The greedy fallback is parity-pinned: degraded code, SAME bytes.
+      "best-effort": ([EXIT_DEGRADED], True)}),
+]
+
+DOCUMENTED_FAILURE_RCS = (1, EXIT_INGEST, EXIT_SOLVE, 5)
+
+
+class RunResult:
+    def __init__(self, rc, out, err, wall_s, hung=False):
+        self.rc, self.out, self.err = rc, out, err
+        self.wall_s, self.hung = wall_s, hung
+
+
+def run_mode3(port, solver, policy, report_path, timeout_s):
+    """One CLI mode-3 run in a watchdog thread: a hang is a soak failure,
+    never a wait-forever."""
+    argv = [
+        "--zk_string", f"127.0.0.1:{port}",
+        "--mode", "PRINT_REASSIGNMENT", "--solver", solver,
+        "--failure-policy", policy,
+        "--report-json", report_path,
+    ]
+    result = {}
+    out_buf, err_buf = io.StringIO(), io.StringIO()
+
+    def _target():
+        with contextlib.redirect_stdout(out_buf), \
+                contextlib.redirect_stderr(err_buf):
+            try:
+                result["rc"] = run(argv)
+            except BaseException as e:  # undocumented escape: report it
+                result["exc"] = e
+
+    t0 = time.perf_counter()
+    worker = threading.Thread(target=_target, daemon=True)
+    worker.start()
+    worker.join(timeout_s)
+    wall = time.perf_counter() - t0
+    if worker.is_alive():
+        return RunResult(None, out_buf.getvalue(), err_buf.getvalue(),
+                         wall, hung=True)
+    if "exc" in result:
+        raise result["exc"]
+    return RunResult(result["rc"], out_buf.getvalue(), err_buf.getvalue(),
+                     wall)
+
+
+def with_server(fn):
+    server = JuteZkServer(cluster_tree())
+    server.start()
+    try:
+        return fn(server)
+    finally:
+        server.shutdown()
+
+
+def set_schedule(env, spec=None, seed=None):
+    for k in ("KA_FAULTS_SPEC", "KA_FAULTS_SEED", "KA_FAULTS_RATE"):
+        os.environ.pop(k, None)
+    os.environ.update(env)
+    if spec is not None:
+        os.environ["KA_FAULTS_SPEC"] = spec
+    if seed is not None:
+        os.environ["KA_FAULTS_SEED"] = str(seed)
+    faults.reset()
+
+
+def load_report(path):
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def baseline_bytes(port, solver, report_dir, timeout_s):
+    set_schedule({})
+    res = run_mode3(
+        port, solver, "strict",
+        os.path.join(report_dir, "baseline.json"), timeout_s,
+    )
+    if res.hung or res.rc != EXIT_OK:
+        raise SystemExit(
+            f"FAIL: no-fault baseline run broken (rc={res.rc} "
+            f"hung={res.hung})\n{res.err}"
+        )
+    return res.out
+
+
+def soak_matrix(args, report_dir):
+    failures = []
+    for name, spec, solver, outcomes in MATRIX:
+        base = with_server(
+            lambda s: baseline_bytes(s.port, solver, report_dir, args.timeout)
+        )
+        for policy, (want_rcs, want_identical) in outcomes.items():
+            report_path = os.path.join(
+                report_dir, f"matrix_{name}_{policy}.json"
+            )
+
+            def _one(server):
+                set_schedule({"KA_ZK_CLIENT": "wire",
+                              "KA_ZK_CONNECT_RETRIES": "3"}, spec=spec)
+                return run_mode3(
+                    server.port, solver, policy, report_path, args.timeout
+                )
+
+            res = with_server(_one)
+            tag = f"matrix[{name}/{policy}]"
+            if res.hung:
+                failures.append(f"{tag}: HUNG after {args.timeout}s")
+                continue
+            if res.rc not in want_rcs:
+                failures.append(
+                    f"{tag}: rc={res.rc}, expected {want_rcs}\n{res.err}"
+                )
+                continue
+            if want_identical and res.out != base:
+                failures.append(f"{tag}: stdout diverged from baseline")
+                continue
+            if res.rc == EXIT_OK and res.out != base:
+                failures.append(f"{tag}: rc=0 with non-identical stdout")
+                continue
+            report = load_report(report_path)
+            if report is None:
+                failures.append(f"{tag}: no run report emitted")
+                continue
+            counters = report["metrics"]["counters"]
+            if "fault injected" in res.err \
+                    and not counters.get("faults.injected"):
+                failures.append(f"{tag}: fired faults not counted")
+            if res.rc == EXIT_DEGRADED and report["status"] != "degraded":
+                failures.append(
+                    f"{tag}: rc=degraded but report status "
+                    f"{report['status']!r}"
+                )
+            print(f"chaos_soak: {tag}: rc={res.rc} ok "
+                  f"({res.wall_s:.2f}s)", file=sys.stderr)
+    return failures
+
+
+def soak_random(args, report_dir):
+    base = with_server(
+        lambda s: baseline_bytes(s.port, args.solver, report_dir,
+                                 args.timeout)
+    )
+    failures = []
+    stats = {"identical": 0, "degraded": 0, "failed": 0}
+    for i in range(args.runs):
+        seed = args.seed + i
+        report_path = os.path.join(report_dir, "random.json")
+
+        def _one(server):
+            set_schedule(
+                {"KA_ZK_CLIENT": "wire", "KA_ZK_CONNECT_RETRIES": "3",
+                 "KA_FAULTS_RATE": str(args.rate)},
+                spec="random", seed=seed,
+            )
+            return run_mode3(
+                server.port, args.solver, args.policy, report_path,
+                args.timeout,
+            )
+
+        res = with_server(_one)
+        tag = f"run[{i}] seed={seed}"
+        if res.hung:
+            failures.append(f"{tag}: HUNG after {args.timeout}s")
+            continue
+        report = load_report(report_path)
+        if res.rc == EXIT_OK:
+            if res.out != base:
+                failures.append(
+                    f"{tag}: rc=0 but stdout diverged (silent partial "
+                    "result)"
+                )
+                continue
+            stats["identical"] += 1
+        elif res.rc == EXIT_DEGRADED:
+            stats["degraded"] += 1
+            if report is None or report["status"] != "degraded":
+                failures.append(f"{tag}: degraded rc without degraded report")
+                continue
+            counters = report["metrics"]["counters"]
+            gauges = report["metrics"]["gauges"]
+            skipped = gauges.get("ingest.topics_skipped", 0)
+            fallbacks = counters.get("solve.fallbacks", 0)
+            injected = counters.get("faults.injected", 0)
+            if skipped + fallbacks < 1:
+                failures.append(f"{tag}: degraded rc with nothing degraded")
+            if injected < skipped + fallbacks:
+                failures.append(
+                    f"{tag}: {skipped}+{fallbacks} degradations but only "
+                    f"{injected} injected faults accounted"
+                )
+        elif res.rc in DOCUMENTED_FAILURE_RCS:
+            stats["failed"] += 1
+            if report is not None and report["status"] not in ("error",):
+                failures.append(
+                    f"{tag}: failure rc {res.rc} with report status "
+                    f"{report['status']!r}"
+                )
+        else:
+            failures.append(f"{tag}: undocumented rc={res.rc}\n{res.err}")
+        if (i + 1) % 20 == 0:
+            print(f"chaos_soak: {i + 1}/{args.runs} schedules "
+                  f"({stats})", file=sys.stderr)
+    print(f"chaos_soak: random soak stats: {stats}", file=sys.stderr)
+    return failures
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="chaos_soak",
+        description="mode-3 pipeline under injected fault schedules: "
+        "byte-identical output or correctly-reported degradation, never a "
+        "hang or a silent partial result",
+    )
+    parser.add_argument("--matrix", action="store_true",
+                        help="fast deterministic one-fault-per-class matrix "
+                             "(strict + best-effort); tier-1's smoke")
+    parser.add_argument("--runs", type=int, default=200,
+                        help="randomized schedules for the full soak")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="base seed (run i uses seed+i)")
+    parser.add_argument("--rate", type=float, default=0.08,
+                        help="per-hook fault probability for random mode")
+    parser.add_argument("--policy", default="best-effort",
+                        choices=("strict", "best-effort"),
+                        help="failure policy for random-mode runs")
+    parser.add_argument("--solver", default="greedy",
+                        choices=("greedy", "native", "tpu"),
+                        help="solver for random-mode runs (the matrix picks "
+                             "per class)")
+    parser.add_argument("--timeout", type=float, default=120.0,
+                        help="per-run hang bound in seconds")
+    args = parser.parse_args(argv)
+
+    # The soak mutates process env; keep the host shell's knobs restorable.
+    saved_env = dict(os.environ)
+    try:
+        with tempfile.TemporaryDirectory(prefix="chaos_soak_") as report_dir:
+            if args.matrix:
+                failures = soak_matrix(args, report_dir)
+            else:
+                failures = soak_random(args, report_dir)
+    finally:
+        os.environ.clear()
+        os.environ.update(saved_env)
+        faults.reset()
+    for f in failures:
+        print(f"chaos_soak: FAIL: {f}", file=sys.stderr)
+    if failures:
+        return 1
+    print("chaos_soak: PASS", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

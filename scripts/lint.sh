@@ -1,7 +1,9 @@
 #!/usr/bin/env bash
 # The project lint gate: kalint (knob-registry + jit-boundary house rules,
-# rules KA001-KA006), the README knob-table drift check, the run-report
-# fixture schema check, and ruff (config in pyproject.toml) when installed. Exits non-zero on any finding; invoked by
+# rules KA001-KA008), the README knob-table drift check, the run-report
+# fixture schema check, the fault-matrix smoke (one injected fault per
+# class, strict + best-effort), and ruff (config in pyproject.toml) when
+# installed. Exits non-zero on any finding; invoked by
 # tests/test_lint_gate.py so tier-1 catches regressions without separate CI
 # plumbing.
 set -euo pipefail
@@ -17,6 +19,11 @@ python -m kafka_assigner_tpu.analysis.knobdoc --check
 # (python -c, not -m: the package re-exports the module, and -m would warn.)
 python -c "import sys; from kafka_assigner_tpu.obs.report import main; \
 sys.exit(main(['--check-fixture', 'tests/golden/run_report_v1.json']))"
+# Fault-matrix smoke (ISSUE 5): one deterministic injected fault per class,
+# strict + best-effort — self-healing classes must stay byte-identical,
+# degradation classes must exit with the documented codes. The full
+# randomized 200-schedule soak is the slow-marked tests/test_chaos_soak.py.
+python scripts/chaos_soak.py --matrix
 
 if command -v ruff >/dev/null 2>&1; then
     ruff check kafka_assigner_tpu tests

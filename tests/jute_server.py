@@ -1,0 +1,255 @@
+"""The in-repo ZooKeeper jute test server, shared by the socket tests, the
+golden-frame pins, ``scripts/bench_zk_ingest.py``, and the chaos soak
+(``scripts/chaos_soak.py``).
+
+A minimal single-purpose server speaking the actual ZooKeeper wire protocol
+over a real TCP port: session handshake plus the read subset (getChildren /
+getData / exists / ping / closeSession) over a static znode tree.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import struct
+import threading
+import time
+
+
+class JuteZkServer(threading.Thread):
+    """Serves a static znode tree over the real wire protocol. ``tree`` maps
+    full znode path -> bytes (data); directories are implied by children
+    paths.
+
+    ``reply_delay_s`` injects one-way latency: every reply is released
+    ``reply_delay_s`` after its request was processed, by a per-connection
+    sender thread that preserves reply order — so pipelined requests see
+    their delays overlap (network latency), while a serial client pays the
+    delay per round-trip. ``scripts/bench_zk_ingest.py`` uses this to
+    measure the serial-vs-pipelined ingest gap hermetically. ``port``
+    pins the listen port (0 = ephemeral) so restart/retry tests can bring a
+    server up on an address a client is already retrying.
+
+    ``expire_handshakes``: the first N connections receive the
+    session-expired ConnectResponse (negotiated timeOut=0, sessionId=0 —
+    what a real server sends when the client presents a dead session) and
+    are then closed; connection N+1 onward handshakes normally. Exercises
+    the client's ``"session expired during handshake"`` branch end-to-end.
+    """
+
+    def __init__(self, tree, reply_delay_s=0.0, port=0, expire_handshakes=0):
+        super().__init__(daemon=True)
+        self.tree = dict(tree)
+        self.reply_delay_s = reply_delay_s
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", port))
+        self.sock.listen(64)
+        self.port = self.sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._expire_lock = threading.Lock()
+        self._expire_remaining = int(expire_handshakes)
+        # Children index, built once: the per-request O(tree) prefix scan
+        # dominated the pipelined bench (~0.4 ms/op of pure fixture cost)
+        # and hid the transport latency this server exists to model.
+        self._kids = {}
+        for p in self.tree:
+            parent = ""
+            for seg in p.strip("/").split("/"):
+                self._kids.setdefault(parent + "/", set()).add(seg)
+                parent = f"{parent}/{seg}"
+
+    # -- jute helpers -----------------------------------------------------
+
+    @staticmethod
+    def _buf(data):
+        return struct.pack(">i", len(data)) + data
+
+    @staticmethod
+    def _stat(data_len, n_children):
+        return struct.pack(
+            ">qqqqiiiqiiq", 1, 1, 0, 0, 0, 0, 0, 0, data_len, n_children, 1
+        )
+
+    def _children(self, path):
+        return sorted(self._kids.get(path.rstrip("/") + "/", ()))
+
+    def _exists(self, path):
+        return path in self.tree or bool(self._children(path))
+
+    # -- server loop ------------------------------------------------------
+
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            # Mirror real ZooKeeper: replies must not sit in Nagle's buffer
+            # waiting for a delayed ACK while the client pipelines.
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn):
+        # Delayed-reply mode: replies queue to a per-connection sender that
+        # releases each one reply_delay_s after processing, in order — the
+        # reader keeps consuming pipelined requests meanwhile, so concurrent
+        # requests overlap their latency exactly like a real network RTT.
+        sender_q = sender = None
+        if self.reply_delay_s:
+            sender_q = queue.Queue()
+
+            def _sender():
+                while True:
+                    item = sender_q.get()
+                    if item is None:
+                        return
+                    due, payload = item
+                    delay = due - time.monotonic()
+                    if delay > 0:
+                        time.sleep(delay)
+                    try:
+                        conn.sendall(struct.pack(">i", len(payload)) + payload)
+                    except OSError:
+                        return
+
+            sender = threading.Thread(target=_sender, daemon=True)
+            sender.start()
+
+        def send(payload):
+            if sender_q is None:
+                self._send_frame(conn, payload)
+            else:
+                sender_q.put(
+                    (time.monotonic() + self.reply_delay_s, payload)
+                )
+
+        try:
+            frame = self._recv_frame(conn)
+            if frame is None:
+                return
+            # ConnectRequest: proto, lastZxid, timeOut, sessionId, passwd
+            # [+ readOnly byte for 3.4+ clients].
+            _, _, timeout_ms, _ = struct.unpack(">iqiq", frame[:24])
+            has_ro = len(frame) > 24 + 4 + 16
+            with self._expire_lock:
+                expire = self._expire_remaining > 0
+                if expire:
+                    self._expire_remaining -= 1
+            if expire:
+                # Session-expired ConnectResponse: negotiated timeout 0,
+                # session id 0, then close — the real server's behavior.
+                send(
+                    struct.pack(">iiq", 0, 0, 0)
+                    + self._buf(b"\x00" * 16)
+                    + (b"\x00" if has_ro else b"")
+                )
+                return
+            resp = (
+                struct.pack(">iiq", 0, timeout_ms, 0x1EAF)
+                + self._buf(b"\x00" * 16)
+                + (b"\x00" if has_ro else b"")
+            )
+            send(resp)
+            while True:
+                frame = self._recv_frame(conn)
+                if frame is None:
+                    return
+                xid, op = struct.unpack(">ii", frame[:8])
+                body = frame[8:]
+                if op == 11:  # ping
+                    send(struct.pack(">iqi", -2, 1, 0))
+                    continue
+                if op == -11:  # closeSession
+                    send(struct.pack(">iqi", xid, 1, 0))
+                    return
+                (plen,) = struct.unpack(">i", body[:4])
+                path = body[4:4 + plen].decode("utf-8")
+                if op == 8:  # getChildren
+                    kids = self._children(path)
+                    if not self._exists(path):
+                        send(struct.pack(">iqi", xid, 1, -101))
+                        continue
+                    payload = struct.pack(">iqi", xid, 1, 0)
+                    payload += struct.pack(">i", len(kids))
+                    for k in kids:
+                        payload += self._buf(k.encode("utf-8"))
+                    send(payload)
+                elif op == 4:  # getData
+                    data = self.tree.get(path)
+                    if data is None:
+                        send(struct.pack(">iqi", xid, 1, -101))
+                        continue
+                    payload = (
+                        struct.pack(">iqi", xid, 1, 0)
+                        + self._buf(data)
+                        + self._stat(len(data), len(self._children(path)))
+                    )
+                    send(payload)
+                elif op == 3:  # exists
+                    if self._exists(path):
+                        payload = struct.pack(">iqi", xid, 1, 0) + self._stat(
+                            len(self.tree.get(path, b"")),
+                            len(self._children(path)),
+                        )
+                    else:
+                        payload = struct.pack(">iqi", xid, 1, -101)
+                    send(payload)
+                else:  # unimplemented op: loud error, not a hang
+                    send(struct.pack(">iqi", xid, 1, -6))
+        except (OSError, struct.error):
+            pass
+        finally:
+            if sender_q is not None:
+                # FIFO drain: queued replies flush before the close.
+                sender_q.put(None)
+                sender.join(timeout=10)
+            conn.close()
+
+    @staticmethod
+    def _recv_frame(conn):
+        header = b""
+        while len(header) < 4:
+            chunk = conn.recv(4 - len(header))
+            if not chunk:
+                return None
+            header += chunk
+        (n,) = struct.unpack(">i", header)
+        data = b""
+        while len(data) < n:
+            chunk = conn.recv(n - len(data))
+            if not chunk:
+                return None
+            data += chunk
+        return data
+
+    @staticmethod
+    def _send_frame(conn, payload):
+        conn.sendall(struct.pack(">i", len(payload)) + payload)
+
+    def shutdown(self):
+        self._stop.set()
+        self.sock.close()
+
+
+def cluster_tree():
+    """The standard four-broker / two-topic fixture tree shared by the
+    socket tests and the chaos soak."""
+    brokers = {
+        "1": {"host": "h1", "port": 9092, "rack": "ra"},
+        "2": {"host": None, "endpoints": ["PLAINTEXT://h2:9093"], "rack": "rb"},
+        "3": {"host": "h3", "port": 9092, "rack": "rc"},
+        "4": {"host": "h4", "port": 9092, "rack": "ra"},
+    }
+    topics = {
+        "events": {"partitions": {"0": [1, 2, 3], "1": [2, 3, 4]}},
+        "logs": {"partitions": {"0": [3, 4]}},
+    }
+    tree = {}
+    for bid, meta in brokers.items():
+        tree[f"/brokers/ids/{bid}"] = json.dumps(meta).encode()
+    for t, meta in topics.items():
+        tree[f"/brokers/topics/{t}"] = json.dumps(meta).encode()
+    return tree

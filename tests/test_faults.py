@@ -1,0 +1,450 @@
+"""The fault-injection harness and the resilience layer it drives (ISSUE 5):
+schedule determinism, session re-establishment with idempotent read replay
+(byte-identical results), graceful topic-level degradation, the solver
+fallback chain, and the documented CLI exit codes."""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from kafka_assigner_tpu import faults
+from kafka_assigner_tpu.cli import (
+    EXIT_DEGRADED,
+    EXIT_INGEST,
+    EXIT_SOLVE,
+    EXIT_VALIDATION,
+    run,
+)
+from kafka_assigner_tpu.faults.inject import (
+    FaultEvent,
+    FaultInjector,
+    FaultSpecError,
+    parse_spec,
+    random_schedule,
+)
+from kafka_assigner_tpu.io.zkwire import (
+    MiniZkClient,
+    NoNodeError,
+    ZkConnectionError,
+    ZkWireError,
+)
+from kafka_assigner_tpu.obs import run_capture
+
+from .jute_server import JuteZkServer, cluster_tree
+
+
+@pytest.fixture(autouse=True)
+def _fresh_injector():
+    """Each test starts with no installed injector and a cold env cache —
+    the cache is keyed by (spec, seed) and would otherwise leak consumed
+    per-scope counters across tests."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def zk_server():
+    server = JuteZkServer(cluster_tree())
+    server.start()
+    yield server
+    server.shutdown()
+
+
+@pytest.fixture()
+def snapshot(tmp_path):
+    cluster = {
+        "brokers": [
+            {"id": 100 + i, "host": f"host{i}", "port": 9092,
+             "rack": f"r{i % 3}"}
+            for i in range(6)
+        ],
+        "topics": {
+            "events": {
+                str(p): [100 + (p + i) % 5 for i in range(3)]
+                for p in range(6)
+            },
+            "logs": {
+                str(p): [100 + (p + i) % 5 for i in range(2)]
+                for p in range(4)
+            },
+        },
+    }
+    path = tmp_path / "cluster.json"
+    path.write_text(json.dumps(cluster))
+    return str(path)
+
+
+# --- spec / schedule ---------------------------------------------------------
+
+def test_parse_spec_explicit_events():
+    events = parse_spec(
+        "reply:3=drop; reply:5=trunc:8 ;connect:0=blackhole;"
+        "handshake:1=expire;solve=crash;reply:2=slow:0.01"
+    )
+    assert FaultEvent("reply", 3, "drop") in events
+    assert FaultEvent("reply", 5, "trunc", 8.0) in events
+    assert FaultEvent("connect", 0, "blackhole") in events
+    assert FaultEvent("handshake", 1, "expire") in events
+    assert FaultEvent("solve", 0, "crash") in events  # index defaults to 0
+    assert FaultEvent("reply", 2, "slow", 0.01) in events
+
+
+@pytest.mark.parametrize("bad", [
+    "reply:3",                # no kind
+    "nowhere:0=drop",         # unknown scope
+    "reply:0=expire",         # kind not valid for scope
+    "reply:x=drop",           # non-integer index
+    "reply:-1=drop",          # negative index
+    "reply:0=slow:abc",       # non-numeric arg
+])
+def test_parse_spec_rejects_malformed(bad):
+    with pytest.raises(FaultSpecError):
+        parse_spec(bad)
+
+
+def test_random_schedule_is_seed_deterministic():
+    a = random_schedule(seed=7, rate=0.3)
+    b = random_schedule(seed=7, rate=0.3)
+    c = random_schedule(seed=8, rate=0.3)
+    assert a == b
+    assert a != c
+    assert a  # rate 0.3 over ~70 slots: statistically certain to fire
+
+
+def test_malformed_spec_env_is_ignored_loudly(monkeypatch, capsys):
+    monkeypatch.setenv("KA_FAULTS_SPEC", "reply:0=warp")
+    assert faults.active_injector() is None
+    assert "ignoring malformed KA_FAULTS_SPEC" in capsys.readouterr().err
+
+
+def test_env_injector_cached_per_spec(monkeypatch):
+    monkeypatch.setenv("KA_FAULTS_SPEC", "reply:0=slow:0.001")
+    first = faults.active_injector()
+    assert first is not None and faults.active_injector() is first
+
+
+# --- wire-client resilience (session replay) ---------------------------------
+
+PATHS = [f"/brokers/ids/{i}" for i in (1, 2, 3, 4)] + [
+    "/brokers/topics/events", "/brokers/topics/logs"
+]
+
+
+def _client(server, **kw):
+    return MiniZkClient(f"127.0.0.1:{server.port}", timeout=5.0, **kw)
+
+
+def _baseline(server):
+    client = _client(server)
+    client.start()
+    try:
+        return client.get_many(PATHS)
+    finally:
+        client.stop()
+        client.close()
+
+
+@pytest.mark.parametrize("spec", [
+    "reply:2=drop",                   # socket drop mid-frame
+    "reply:1=trunc",                  # truncated reply desyncs the decoder
+    "reply:0=trunc:3",                # truncated INSIDE the reply header
+    "reply:0=slow:0.01",              # slow reply: no failure at all
+    "reply:1=drop;reply:4=drop",      # two drops in one batch
+])
+def test_pipelined_reads_self_heal_byte_identical(zk_server, spec):
+    expected = _baseline(zk_server)
+    faults.install(FaultInjector(parse_spec(spec)))
+    with run_capture() as rec:
+        client = _client(zk_server)
+        client.start()
+        try:
+            assert client.get_many(PATHS) == expected
+        finally:
+            client.stop()
+            client.close()
+    n_faults = len(spec.split(";"))
+    assert rec.counters.get("faults.injected") == n_faults
+    if "drop" in spec or "trunc" in spec:
+        assert rec.counters.get("zk.session.reestablished", 0) >= 1
+
+
+def test_serial_reads_self_heal(zk_server):
+    expected = _baseline(zk_server)
+    faults.install(FaultInjector(parse_spec("reply:1=drop")))
+    client = _client(zk_server)
+    client.start()
+    try:
+        assert [client.get(p) for p in PATHS] == expected
+        # The listing op heals too.
+        assert client.get_children("/brokers/ids") == ["1", "2", "3", "4"]
+    finally:
+        client.stop()
+        client.close()
+
+
+def test_session_retries_zero_fails_fast(zk_server, monkeypatch):
+    monkeypatch.setenv("KA_ZK_SESSION_RETRIES", "0")
+    faults.install(FaultInjector(parse_spec("reply:1=drop")))
+    client = _client(zk_server)
+    client.start()
+    try:
+        with pytest.raises((OSError, ZkConnectionError)):
+            client.get_many(PATHS)
+    finally:
+        client.close()
+
+
+def test_nonode_race_strict_raises_in_order(zk_server):
+    # An injected NoNode on the reply stream is indistinguishable from a
+    # znode deleted mid-scan; strict pipelining raises it at the victim's
+    # position and the session stays usable.
+    faults.install(FaultInjector(parse_spec("reply:1=nonode")))
+    client = _client(zk_server)
+    client.start()
+    try:
+        with pytest.raises(NoNodeError, match="/brokers/ids/2"):
+            client.get_many(PATHS)
+        assert client.get_children("/brokers/topics") == ["events", "logs"]
+    finally:
+        client.stop()
+        client.close()
+
+
+def test_nonode_race_missing_ok_yields_none(zk_server):
+    expected = _baseline(zk_server)
+    faults.install(FaultInjector(parse_spec("reply:1=nonode")))
+    client = _client(zk_server)
+    client.start()
+    try:
+        got = client.get_many(PATHS, missing_ok=True)
+        assert got[1] is None  # the victim's position, not an exception
+        assert got[:1] == expected[:1] and got[2:] == expected[2:]
+    finally:
+        client.stop()
+        client.close()
+
+
+def test_connect_blackhole_consumes_one_attempt(zk_server, monkeypatch):
+    monkeypatch.setenv("KA_ZK_CONNECT_RETRIES", "3")
+    faults.install(FaultInjector(parse_spec("connect:0=blackhole")))
+    client = _client(zk_server)
+    client.start()  # first attempt refused, retry lands
+    try:
+        assert client.get_children("/brokers/topics") == ["events", "logs"]
+    finally:
+        client.stop()
+        client.close()
+
+
+def test_connect_blackhole_everywhere_reports_failure(zk_server, monkeypatch):
+    monkeypatch.setenv("KA_ZK_CONNECT_RETRIES", "2")
+    faults.install(FaultInjector(parse_spec(
+        ";".join(f"connect:{i}=blackhole" for i in range(8))
+    )))
+    client = _client(zk_server)
+    with pytest.raises(ZkWireError, match=r"after 2 pass\(es\)"):
+        client.start()
+
+
+def test_injected_handshake_expiry_is_survivable(zk_server, monkeypatch):
+    # Client-side twin of the server-side expiry test: the injected expired
+    # ConnectResponse drives the same parsing branch, and the connect-pass
+    # loop recovers.
+    monkeypatch.setenv("KA_ZK_CONNECT_RETRIES", "3")
+    faults.install(FaultInjector(parse_spec("handshake:0=expire")))
+    client = _client(zk_server)
+    client.start()
+    try:
+        assert client.get_children("/brokers/topics") == ["events", "logs"]
+    finally:
+        client.stop()
+        client.close()
+
+
+# --- graceful degradation / fallback chain -----------------------------------
+
+def test_stream_best_effort_skips_vanished_topic(snapshot):
+    from kafka_assigner_tpu.generator import stream_initial_assignment
+    from kafka_assigner_tpu.io.snapshot import SnapshotBackend
+
+    backend = SnapshotBackend(snapshot)
+    skipped: list = []
+    initial, pre = stream_initial_assignment(
+        backend, ["events", "ghost", "logs"],
+        failure_policy="best-effort", skipped=skipped,
+    )
+    assert skipped == ["ghost"]
+    assert set(initial) == {"events", "logs"}
+    # Strict keeps the fail-fast contract.
+    with pytest.raises(KeyError, match="ghost"):
+        stream_initial_assignment(backend, ["events", "ghost"])
+
+
+def test_assigner_falls_back_to_greedy_per_group():
+    from kafka_assigner_tpu.assigner import TopicAssigner
+    from kafka_assigner_tpu.solvers.greedy import GreedySolver
+
+    class Crashy(GreedySolver):
+        name = "crashy"
+
+        def assign(self, *a, **kw):
+            raise RuntimeError("device OOM")
+
+    topics = {
+        "a": {0: [1, 2], 1: [2, 3]},
+        "b": {0: [3, 1]},
+    }
+    brokers = {1, 2, 3}
+    oracle = TopicAssigner(solver="greedy").generate_assignments(
+        list(topics.items()), brokers, {}, -1
+    )
+    best = TopicAssigner(solver=Crashy(), failure_policy="best-effort")
+    got = best.generate_assignments(list(topics.items()), brokers, {}, -1)
+    assert got == oracle  # parity: the fallback output IS the greedy output
+    assert best.fallbacks == 2  # one per crashed serial group
+
+    strict = TopicAssigner(solver=Crashy())
+    with pytest.raises(RuntimeError, match="device OOM"):
+        strict.generate_assignments(list(topics.items()), brokers, {}, -1)
+    # ValueError (validation/infeasibility) never triggers the fallback.
+
+    class Infeasible(GreedySolver):
+        name = "infeasible"
+
+        def assign(self, *a, **kw):
+            raise ValueError("Partition 0 could not be fully assigned!")
+
+    nofb = TopicAssigner(solver=Infeasible(), failure_policy="best-effort")
+    with pytest.raises(ValueError, match="fully assigned"):
+        nofb.generate_assignments(list(topics.items()), brokers, {}, -1)
+    assert nofb.fallbacks == 0
+
+
+# --- CLI exit codes ----------------------------------------------------------
+
+def _dead_port() -> int:
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_exit_code_ingest_failure(monkeypatch, capsys):
+    monkeypatch.setenv("KA_ZK_CONNECT_RETRIES", "1")
+    monkeypatch.setenv("KA_ZK_CLIENT", "wire")
+    rc = run([
+        "--zk_string", f"127.0.0.1:{_dead_port()}",
+        "--mode", "PRINT_REASSIGNMENT",
+    ])
+    err = capsys.readouterr().err
+    assert rc == EXIT_INGEST
+    assert "metadata ingest failed" in err
+
+
+def test_exit_code_validation_failure(snapshot, capsys):
+    rc = run([
+        "--zk_string", snapshot, "--mode", "PRINT_REASSIGNMENT",
+        "--desired_replication_factor", "99",
+    ])
+    err = capsys.readouterr().err
+    assert rc == EXIT_VALIDATION
+    assert "higher replication factor" in err
+
+
+def test_exit_code_solve_failure_strict(snapshot, monkeypatch, capsys):
+    monkeypatch.setenv("KA_FAULTS_SPEC", "solve:0=crash")
+    rc = run([
+        "--zk_string", snapshot, "--mode", "PRINT_REASSIGNMENT",
+        "--solver", "tpu",
+    ])
+    err = capsys.readouterr().err
+    assert rc == EXIT_SOLVE
+    assert "fault injected: solve" in err
+
+
+def test_exit_code_degraded_solver_fallback(
+    snapshot, monkeypatch, capsys, tmp_path
+):
+    # Greedy baseline: the fallback output must be byte-identical to it
+    # (all backends are parity-pinned), with the degraded exit code and the
+    # fallback accounted in the run report.
+    assert run([
+        "--zk_string", snapshot, "--mode", "PRINT_REASSIGNMENT",
+        "--solver", "greedy",
+    ]) == 0
+    baseline = capsys.readouterr().out
+
+    report_path = tmp_path / "report.json"
+    monkeypatch.setenv("KA_FAULTS_SPEC", "solve:0=crash")
+    rc = run([
+        "--zk_string", snapshot, "--mode", "PRINT_REASSIGNMENT",
+        "--solver", "tpu", "--failure-policy", "best-effort",
+        "--report-json", str(report_path),
+    ])
+    captured = capsys.readouterr()
+    assert rc == EXIT_DEGRADED
+    assert captured.out == baseline
+    assert "falling back to the greedy solver" in captured.err
+    report = json.loads(report_path.read_text())
+    assert report["status"] == "degraded"
+    assert report["metrics"]["counters"]["solve.fallbacks"] == 1
+    assert report["metrics"]["counters"]["faults.injected"] == 1
+
+
+def test_exit_code_degraded_skipped_topic(snapshot, monkeypatch, capsys, tmp_path):
+    report_path = tmp_path / "report.json"
+    monkeypatch.setenv("KA_FAILURE_POLICY", "best-effort")  # knob, not flag
+    rc = run([
+        "--zk_string", snapshot, "--mode", "PRINT_REASSIGNMENT",
+        "--topics", "events,ghost,logs",
+        "--report-json", str(report_path),
+    ])
+    captured = capsys.readouterr()
+    assert rc == EXIT_DEGRADED
+    assert "topic 'ghost' vanished" in captured.err
+    # The emitted plan covers exactly the surviving topics.
+    from kafka_assigner_tpu.io.json_io import parse_reassignment_json
+
+    payload = captured.out.split("NEW ASSIGNMENT:\n", 1)[1].strip()
+    assert set(parse_reassignment_json(payload)) == {"events", "logs"}
+    report = json.loads(report_path.read_text())
+    assert report["status"] == "degraded"
+    assert report["metrics"]["gauges"]["ingest.topics_skipped"] == 1
+
+
+def test_mode3_output_unchanged_with_injection_disabled(snapshot, capsys):
+    # The acceptance pin: with no faults scheduled, strict and best-effort
+    # emit byte-identical stdout and both exit 0.
+    assert run([
+        "--zk_string", snapshot, "--mode", "PRINT_REASSIGNMENT",
+    ]) == 0
+    baseline = capsys.readouterr().out
+    assert run([
+        "--zk_string", snapshot, "--mode", "PRINT_REASSIGNMENT",
+        "--failure-policy", "best-effort",
+    ]) == 0
+    assert capsys.readouterr().out == baseline
+
+
+def test_cli_live_wire_nonode_race_best_effort(zk_server, monkeypatch, capsys):
+    # End-to-end over a real socket: reply index 6 is the first topic
+    # getData (children, 4 brokers, children, topics...), so the injected
+    # NoNode simulates 'events' deleted between listing and read.
+    monkeypatch.setenv("KA_ZK_CLIENT", "wire")
+    monkeypatch.setenv("KA_FAULTS_SPEC", "reply:6=nonode")
+    rc = run([
+        "--zk_string", f"127.0.0.1:{zk_server.port}",
+        "--mode", "PRINT_REASSIGNMENT", "--failure-policy", "best-effort",
+    ])
+    captured = capsys.readouterr()
+    assert rc == EXIT_DEGRADED
+    assert "vanished during the metadata scan" in captured.err
+    payload = captured.out.split("NEW ASSIGNMENT:\n", 1)[1].strip()
+    from kafka_assigner_tpu.io.json_io import parse_reassignment_json
+
+    assert set(parse_reassignment_json(payload)) == {"logs"}

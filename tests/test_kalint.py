@@ -321,6 +321,61 @@ def test_ka007_one_finding_per_name_per_function():
     assert len(findings) == 1
 
 
+# --- KA008: silently-swallowed exceptions ------------------------------------
+
+def test_ka008_trips_on_bare_pass():
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except OSError:\n"
+        "        pass\n"
+    )
+    findings = kalint.lint_source(src, "foo.py")
+    assert any(f.rule == "KA008" and f.line == 5 for f in findings)
+
+
+def test_ka008_trips_on_bare_continue():
+    src = (
+        "def f(items):\n"
+        "    for x in items:\n"
+        "        try:\n"
+        "            work(x)\n"
+        "        except ValueError:\n"
+        "            continue\n"
+    )
+    assert "KA008" in rules_of(kalint.lint_source(src, "foo.py"))
+
+
+@pytest.mark.parametrize("body", [
+    "raise",
+    "log.warning('boom')",
+    "count += 1",
+    "x = fallback()",
+])
+def test_ka008_any_real_handling_is_clean(body):
+    src = (
+        "def f():\n"
+        "    count = 0\n"
+        "    try:\n"
+        "        work()\n"
+        "    except OSError:\n"
+        f"        {body}\n"
+    )
+    assert "KA008" not in rules_of(kalint.lint_source(src, "foo.py"))
+
+
+def test_ka008_reasoned_suppression_silences():
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except OSError:  # kalint: disable=KA008 -- best-effort cleanup\n"
+        "        pass\n"
+    )
+    assert kalint.lint_source(src, "foo.py") == []
+
+
 # --- suppressions ------------------------------------------------------------
 
 def test_suppression_with_reason_silences_the_finding():

@@ -139,19 +139,24 @@ def test_mode3_report_smoke(snapshot, tmp_path, capsys):
 def test_error_path_still_emits_report(snapshot, tmp_path, capsys):
     """The satellite bugfix: a solve raising mid-phase must still flush its
     spans (marked error) and emit the report with ``"status": "error"``."""
+    from kafka_assigner_tpu.errors import IngestError
+
     report_path = tmp_path / "report.json"
-    with pytest.raises(KeyError):
+    # Phase-tagged since ISSUE 5: a missing topic is an ingest failure (the
+    # raw KeyError rides along as __cause__ for library callers).
+    with pytest.raises(IngestError, match="no_such_topic") as exc_info:
         run_tool([
             "--zk_string", f"file://{snapshot}", "--mode",
             "PRINT_REASSIGNMENT", "--topics", "no_such_topic",
             "--report-json", str(report_path),
         ])
+    assert isinstance(exc_info.value.__cause__, KeyError)
     capsys.readouterr()
     with open(report_path, "r", encoding="utf-8") as f:
         report = json.load(f)
     assert report_mod.validate_report(report) == []
     assert report["status"] == "error"
-    assert report["error"]["type"] == "KeyError"
+    assert report["error"]["type"] == "IngestError"
     assert "no_such_topic" in report["error"]["message"]
     # The spans the exception unwound through flushed with error status —
     # timing data survives exactly when it matters most.
