@@ -47,8 +47,22 @@ _tests_since_clear = 0
 
 
 def pytest_runtest_teardown(item, nextitem):
+    # A warm-up thread that outlives its test would write store entries and
+    # obs metrics into the NEXT test's context (generator.py ISSUE 6);
+    # joining is a no-op unless the test left one running.
+    from kafka_assigner_tpu.generator import join_warmup_threads
+
+    join_warmup_threads()
+
     global _tests_since_clear
     _tests_since_clear += 1
     if _tests_since_clear >= 40:
         _tests_since_clear = 0
         jax.clear_caches()
+        # The program store's in-memory executables hold the same LLVM JIT
+        # mappings the jax cache does; clear them together so the window
+        # bound above keeps holding. Re-warming is a store *load* (ms), not
+        # a recompile — exactly the cross-process path production takes.
+        from kafka_assigner_tpu.utils import programstore
+
+        programstore.clear_memory()

@@ -33,6 +33,15 @@ KA008  an ``except`` clause that swallows its exception silently (a body
        that is nothing but ``pass`` or a bare ``continue``) — a robustness
        layer lives or dies on failures staying visible: log it, count it,
        re-raise it, or suppress with a written reason
+KA009  a jitted ``ops/`` entry point (a ``*_jit`` name from
+       ``ops.assignment``) dispatched outside a registered bucket-boundary
+       module — every array crossing into ``ops/`` must be padded to a
+       registered bucket size (``models/problem.py``: partition/node axes
+       multiples of 8, batch axis powers of two), and only the boundary
+       modules build their arrays through that encode layer (the program
+       store contract-checks their shapes at runtime,
+       ``utils/programstore.py:BucketContract``). An ad-hoc dispatch site
+       would silently explode the per-signature compile/program caches
 ====== =====================================================================
 
 Suppression: put ``# kalint: disable=KA002 -- <reason>`` on the offending
@@ -65,6 +74,7 @@ RULES = {
     "KA006": "jnp./jax.numpy call at module import time",
     "KA007": "jit-traced function closes over a mutable module-level global",
     "KA008": "except clause swallows the exception silently (pass/continue)",
+    "KA009": "ops/ jit entry dispatched outside a bucket-boundary module",
 }
 
 #: Modules whose ENTIRE body is treated as traced kernel code (KA002): these
@@ -76,6 +86,13 @@ KERNEL_MODULES = frozenset({"ops/assignment.py", "ops/pallas_leadership.py"})
 REGISTRY_MODULE = "utils/env.py"
 #: The one module allowed to emit plan JSON (KA005).
 JSON_BOUNDARY_MODULE = "io/json_io.py"
+#: Modules allowed to dispatch the jitted ops/ entry points (KA009): each
+#: builds its arrays through models/problem.py's bucketing layer and its
+#: dispatches are shape-contract-checked at runtime by the program store
+#: (utils/programstore.py:BucketContract).
+BUCKET_BOUNDARY_MODULES = frozenset({
+    "solvers/tpu.py", "solvers/warmup.py", "parallel/whatif.py",
+})
 
 _KNOB_RE = re.compile(r"KA_[A-Z][A-Z0-9_]*")
 _SUPPRESS_RE = re.compile(
@@ -566,6 +583,65 @@ def _check_ka007(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+def _ops_jit_bindings(tree: ast.AST):
+    """Names this module binds to ``ops.assignment`` ``*_jit`` entry points
+    (``from ..ops.assignment import solve_batched_jit [as x]``) and names
+    bound to the ``ops.assignment`` module itself (``from ..ops import
+    assignment [as x]``, ``import ...ops.assignment as x``) — both forms can
+    dispatch a kernel program."""
+    entries: Set[str] = set()
+    modules: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.endswith("ops.assignment"):
+                for alias in node.names:
+                    if alias.name.endswith("_jit"):
+                        entries.add(alias.asname or alias.name)
+            elif node.module.endswith("ops") or node.module == "ops":
+                for alias in node.names:
+                    if alias.name == "assignment":
+                        modules.add(alias.asname or "assignment")
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.endswith("ops.assignment") and alias.asname:
+                    modules.add(alias.asname)
+    return entries, modules
+
+
+def _check_ka009(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
+    if relpath in BUCKET_BOUNDARY_MODULES or relpath in KERNEL_MODULES:
+        return []
+    entries, modules = _ops_jit_bindings(tree)
+    if not entries and not modules:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        target = None
+        if isinstance(f, ast.Name) and f.id in entries:
+            target = f.id
+        elif (
+            isinstance(f, ast.Attribute)
+            and f.attr.endswith("_jit")
+            and isinstance(f.value, ast.Name)
+            and f.value.id in modules
+        ):
+            target = f.attr
+        if target:
+            out.append(Finding(
+                "KA009", path, node.lineno, node.col_offset + 1,
+                f"ops kernel entry {target}(...) dispatched outside a "
+                "bucket-boundary module (arrays crossing into ops/ must be "
+                "padded to registered bucket sizes — models/problem.py "
+                "_pad8/batch_bucket — and dispatched from "
+                f"{sorted(BUCKET_BOUNDARY_MODULES)}, whose shapes the "
+                "program store contract-checks at runtime)",
+            ))
+    return out
+
+
 def _check_ka008(tree: ast.AST, path: str) -> List[Finding]:
     """An ``except`` body that is exactly one ``pass`` or one bare
     ``continue`` handles nothing and records nothing — the exception
@@ -644,6 +720,7 @@ def lint_source(
         + _check_ka006(tree, path)
         + _check_ka007(tree, path)
         + _check_ka008(tree, path)
+        + _check_ka009(tree, relpath, path)
     )
     for f in raw:
         if f.rule in suppress.get(f.line, ()):  # reasoned suppression

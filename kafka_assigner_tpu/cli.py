@@ -133,6 +133,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 def run_tool(argv: Optional[List[str]] = None) -> int:
     """Parse, validate, connect, dispatch (``KafkaAssignmentGenerator.java:256-299``)."""
+    # Persistent XLA compile cache, honoring KA_COMPILE_CACHE (never fatal).
+    # Until ISSUE 6 only bench/scripts/conftest enabled it — the production
+    # entry point was the one place the cache was off, so every CLI run paid
+    # the full backend compile the cache exists to amortize.
+    from .utils.compilecache import enable_persistent_cache
+
+    enable_persistent_cache()
+
     parser = build_parser()
     args = parser.parse_args(argv)
     try:
@@ -328,6 +336,135 @@ def _dispatch_mode(args, topics) -> int:
     finally:
         backend.close()
     return 0
+
+
+def build_warm_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ka-warm",
+        description="Seed the persistent AOT program store "
+        "(utils/programstore.py) so later processes start load-bound "
+        "instead of compile-bound: warm the batched-solve programs for a "
+        "cluster snapshot's exact bucket signature, or for an explicit "
+        "synthetic bucket set.",
+    )
+    p.add_argument("--zk_string", default=None,
+                   help="cluster to warm for: ZK quorum host:port pairs or a "
+                        "file://cluster.json snapshot (the store is seeded "
+                        "for this cluster's exact bucket signature)")
+    p.add_argument("--topics", default=None,
+                   help="comma-separated topic subset (default: all topics)")
+    p.add_argument("--desired_replication_factor", type=int, default=-1,
+                   help="RF override, like the generator flag; default "
+                        "infers from the current assignment")
+    p.add_argument("--buckets", default=None,
+                   metavar="TOPICS,PARTITIONS,RF,BROKERS[,RACKS]",
+                   help="warm a synthetic bucket set instead of a cluster, "
+                        "e.g. the headline 2048,128,3,5120,8 — no metadata "
+                        "backend needed")
+    return p
+
+
+def run_warm(argv: Optional[List[str]] = None) -> int:
+    """``ka-warm``: precompile/load the solve programs for a cluster (or an
+    explicit bucket set) into the program store, so the NEXT process — CLI or
+    daemon — finds them resident. Exit 0 on success, 1 on usage error,
+    ingest errors map like the generator's."""
+    from .models.problem import encode_cluster, group_pads, _pad8
+    from .obs.trace import span
+    from .solvers.warmup import warm_solver_programs
+    from .utils.compilecache import enable_persistent_cache
+
+    parser = build_warm_parser()
+    args = parser.parse_args(argv)
+    enable_persistent_cache()
+
+    if (args.buckets is None) == (args.zk_string is None):
+        print("error: pass exactly one of --zk_string or --buckets",
+              file=sys.stderr)
+        parser.print_usage(sys.stderr)
+        return 1
+
+    if args.buckets is not None:
+        try:
+            parts = [int(tok) for tok in args.buckets.split(",")]
+            if len(parts) == 4:
+                parts.append(8)
+            n_topics, partitions, rf, brokers, racks = parts
+            if min(n_topics, partitions, rf, brokers, racks) < 1:
+                raise ValueError("all bucket fields must be positive")
+        except ValueError as e:
+            print(f"error: bad --buckets value {args.buckets!r}: {e}",
+                  file=sys.stderr)
+            return 1
+        rack_assignment = {i: f"r{i % racks}" for i in range(brokers)}
+        cluster = encode_cluster(rack_assignment, set(range(brokers)))
+        p_pad, width = _pad8(partitions), max(rf, 2)
+    else:
+        from .assigner import infer_topic_rf
+        from .io.base import open_backend
+
+        backend = open_backend(args.zk_string)
+        try:
+            live = backend.brokers()
+            topic_list = (
+                args.topics.split(",") if args.topics is not None
+                else backend.all_topics()
+            )
+            initial = backend.partition_assignment(topic_list)
+        finally:
+            backend.close()
+        brokers_set = {b.id for b in live}
+        rack_assignment = {
+            b.id: b.rack for b in live if b.rack is not None
+        }
+        rfs = [
+            infer_topic_rf(t, initial[t], args.desired_replication_factor)
+            for t in topic_list
+        ]
+        rf = max((r for r in rfs if r > 0), default=2)
+        n_topics = len(topic_list)
+        cluster = encode_cluster(rack_assignment, brokers_set)
+        p_pad, width = group_pads([initial[t] for t in topic_list])
+
+    with span("warmup"):
+        outcomes = warm_solver_programs(
+            cluster, n_topics, p_pad, width, rf
+        )
+    for name, outcome in sorted(outcomes.items()):
+        print(f"ka-warm: {name}: {outcome}", file=sys.stderr)
+    if not outcomes or "error" in outcomes.values():
+        print("ka-warm: warm-up incomplete (see warnings above)",
+              file=sys.stderr)
+        return 1
+    if all(o == "jit" for o in outcomes.values()):
+        # Compiled in-process only (store disabled or shapes rejected by the
+        # bucket contract): the next process would still start cold, which
+        # defeats this tool's whole purpose — say so and fail.
+        print(
+            "ka-warm: programs compiled but NOTHING persisted — the store "
+            "is disabled (KA_PROGRAM_STORE=0?) or the signature was "
+            "rejected; the next process will still pay the cold compile",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"ka-warm: store seeded for {n_topics} topic(s), "
+        f"p_pad={p_pad}, width={width}, rf={rf}, "
+        f"n={cluster.n}", file=sys.stderr,
+    )
+    return 0
+
+
+def warm_main() -> None:
+    """Console entry point for ``ka-warm`` (pyproject.toml)."""
+    try:
+        sys.exit(run_warm())
+    except (ZkWireError, OSError) as e:
+        print(f"error: metadata ingest failed: {e}", file=sys.stderr)
+        sys.exit(EXIT_INGEST)
+    except (ValueError, KeyError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        sys.exit(EXIT_VALIDATION)
 
 
 def run(argv: Optional[List[str]] = None) -> int:

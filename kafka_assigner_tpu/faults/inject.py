@@ -30,6 +30,10 @@ nonode     reply            the reply's error field is rewritten to
 crash      solve            the TPU solver raises ``InjectedSolverCrash``
                             before dispatch (stands in for a compile
                             failure / device OOM)
+crash      warmup           the ingest-overlapped warm-up thread raises
+                            ``InjectedWarmupCrash`` before it touches the
+                            program store (the warm-up must degrade to the
+                            cold path, byte-identically — ISSUE 6)
 ========== ================ ==============================================
 
 Spec grammar (``KA_FAULTS_SPEC``): semicolon-separated events
@@ -68,14 +72,16 @@ FAULT_SCOPES: Dict[str, Tuple[str, ...]] = {
     "handshake": ("expire",),
     "reply": ("drop", "trunc", "slow", "nonode"),
     "solve": ("crash",),
+    "warmup": ("crash",),
 }
 FAULT_KINDS = tuple(k for kinds in FAULT_SCOPES.values() for k in kinds)
 
 #: ``random`` mode draws events over this many indexes per scope — enough to
 #: cover any realistic mode-3 run against the test fixtures while keeping the
-#: schedule finite and printable.
+#: schedule finite and printable. (``warmup`` sorts last, so adding it left
+#: every pre-existing scope's seed-deterministic draws unchanged.)
 RANDOM_HORIZON: Dict[str, int] = {
-    "connect": 3, "handshake": 3, "reply": 64, "solve": 2,
+    "connect": 3, "handshake": 3, "reply": 64, "solve": 2, "warmup": 2,
 }
 
 ERR_NONODE = -101
@@ -88,6 +94,13 @@ class FaultSpecError(ValueError):
 class InjectedSolverCrash(RuntimeError):
     """The ``solve`` fault point fired — stands in for an XLA compile
     failure or device OOM (both surface as RuntimeError subclasses)."""
+
+
+class InjectedWarmupCrash(RuntimeError):
+    """The ``warmup`` fault point fired — stands in for anything killing the
+    ingest-overlapped warm-up thread (store corruption, compile failure on
+    the background thread). The contract under test: the solve must proceed
+    on the cold path, byte-identically."""
 
 
 @dataclass(frozen=True)
@@ -262,6 +275,17 @@ class FaultInjector:
                 "stand-in)"
             )
 
+    def warmup_attempt(self) -> None:
+        """Called at the top of the ingest warm-up thread; ``crash`` raises
+        (the thread's degradation handler is what's under test)."""
+        ev = self._next("warmup")
+        if ev is not None and ev.kind == "crash":
+            self._fire(ev)
+            raise InjectedWarmupCrash(
+                "injected fault: warm-up thread crash (store/compile "
+                "failure stand-in)"
+            )
+
 
 #: Programmatic override (tests) — wins over the env knob when set.
 _INSTALLED: Optional[FaultInjector] = None
@@ -317,8 +341,13 @@ def active_injector() -> Optional[FaultInjector]:
 
 
 def fault_point(scope: str) -> None:
-    """Generic crash-style fault point for non-wire call sites (today:
-    ``solve`` in the TPU solver). No-op without an active injector."""
+    """Generic crash-style fault point for non-wire call sites (``solve`` in
+    the TPU solver, ``warmup`` in the ingest warm-up thread). No-op without
+    an active injector."""
     inj = active_injector()
-    if inj is not None and scope == "solve":
+    if inj is None:
+        return
+    if scope == "solve":
         inj.solve_attempt()
+    elif scope == "warmup":
+        inj.warmup_attempt()

@@ -326,6 +326,99 @@ def _is_ingest_failure(e: BaseException) -> bool:
 _INGEST_DONE = object()
 
 
+#: Live warm-up threads (in-process test/soak hygiene: a daemon thread that
+#: outlives its run would write metrics into the NEXT run's capture;
+#: ``join_warmup_threads`` lets multi-run processes drain them).
+_LIVE_WARMUPS: List[threading.Thread] = []
+_WARMUP_LOCK = threading.Lock()
+
+
+def join_warmup_threads(timeout: float = 60.0) -> None:
+    """Wait for any still-running warm-up threads (no-op in the common
+    case: a correctly-predicted warm-up finishes before its own solve
+    does). Multi-run processes — the test suite, the chaos soak — call
+    this between runs so one run's background compile can never bleed
+    metrics or store writes into the next."""
+    with _WARMUP_LOCK:
+        threads, _LIVE_WARMUPS[:] = list(_LIVE_WARMUPS), []
+    for t in threads:
+        t.join(timeout)
+
+
+def _start_warmup_thread(acc, n_topics: int, desired_rf: int):
+    """Spawn the ingest-overlapped device warm-up (ISSUE 6) once the first
+    encoded chunk reveals the partition/width buckets: a daemon thread asks
+    the program store to make the predicted solve programs resident (load or
+    compile) while the remaining metadata is still in flight.
+
+    Failure contract: a warm-up crash of any kind (including the injected
+    ``warmup:i=crash`` fault class, consumed HERE on the orchestration
+    thread so per-scope fault indexes stay coherent across a process's
+    runs) degrades to the normal cold path with a stderr warning and a
+    ``warmup.failures`` count, never to a failed solve. Returns the thread,
+    or None when warm-up is disabled (``KA_WARMUP=0``), nothing was encoded
+    yet, or the injected crash fired.
+    """
+    import time
+
+    from .obs.metrics import counter_add
+    from .obs.trace import record_span
+    from .utils.env import env_bool
+
+    if not env_bool("KA_WARMUP"):
+        return None
+    shape = acc.peek_shape()
+    if shape is None:
+        return None
+    p_pad, width = shape
+    rf = desired_rf if desired_rf > 0 else width
+
+    try:
+        from .faults.inject import fault_point
+
+        # Injected warm-up crash (KA_FAULTS_SPEC warmup:i=crash): the chaos
+        # matrix's proof that a dead warm-up is invisible in the plan bytes.
+        fault_point("warmup")
+    except BaseException as e:
+        counter_add("warmup.failures")
+        print(
+            f"kafka-assigner: warm-up failed ({type(e).__name__}: {e}); "
+            "continuing on the cold compile path",
+            file=sys.stderr,
+        )
+        return None
+
+    def _warm() -> None:
+        t0 = time.perf_counter()
+        ok = True
+        try:
+            from .solvers.warmup import warm_solver_programs
+
+            outcomes = warm_solver_programs(
+                acc.cluster, n_topics, p_pad, width, rf
+            )
+            for name, outcome in outcomes.items():
+                counter_add(f"warmup.{outcome}")
+                if outcome == "error":
+                    ok = False
+        except BaseException as e:
+            ok = False
+            counter_add("warmup.failures")
+            print(
+                f"kafka-assigner: warm-up failed ({type(e).__name__}: {e}); "
+                "continuing on the cold compile path",
+                file=sys.stderr,
+            )
+        finally:
+            record_span("warmup", (time.perf_counter() - t0) * 1000.0, ok)
+
+    t = threading.Thread(target=_warm, name="ka-warmup", daemon=True)
+    with _WARMUP_LOCK:
+        _LIVE_WARMUPS.append(t)
+    t.start()
+    return t
+
+
 @dataclasses.dataclass
 class Degradation:
     """What a ``--failure-policy best-effort`` run survived: the record the
@@ -358,6 +451,7 @@ def stream_initial_assignment(
     want_encode: bool = False,
     failure_policy: str = "strict",
     skipped: Optional[List[str]] = None,
+    desired_rf: int = -1,
 ) -> Tuple[Dict[str, Dict[int, List[int]]], Optional[tuple]]:
     """Metadata ingest overlapped with host encode.
 
@@ -372,6 +466,11 @@ def stream_initial_assignment(
     encoding was not requested or streaming is unavailable/disabled —
     callers fall back to encoding inside the solver, identical output either
     way).
+
+    ``desired_rf``: the CLI's ``--desired_replication_factor`` (or -1 for
+    "infer") — only a HINT here, consumed by the ingest-overlapped warm-up
+    (ISSUE 6) to predict the solve's replica-width bucket before RF
+    inference runs; it never changes the returned data.
 
     ``failure_policy="best-effort"`` (ISSUE 5): a topic that vanishes
     mid-scan — deleted between the topic listing and its metadata read — is
@@ -475,6 +574,10 @@ def stream_initial_assignment(
     chunk: List[tuple] = []
     streamed = 0
     overlap_ms = 0.0
+    # At most ONE start attempt per run: a crashed attempt (the injected
+    # warmup:i=crash class) must degrade to the cold path, not be silently
+    # retried by the tail-chunk site below.
+    warmup_attempted = False
     with span("ingest/stream"):
         t.start()
         while True:
@@ -499,9 +602,22 @@ def stream_initial_assignment(
                     if overlapping:
                         overlap_ms += acc.encode_ms - before
                     chunk = []
+                    if not warmup_attempted:
+                        # First chunk encoded: the bucket signature is now
+                        # predictable — start making the solve's programs
+                        # resident while the rest of the metadata streams.
+                        warmup_attempted = True
+                        _start_warmup_thread(acc, len(topic_list), desired_rf)
         t.join()
         if acc is not None and chunk:
             acc.add(chunk)
+        if acc is not None and not warmup_attempted:
+            # Short run (everything fit in one tail chunk): still warm —
+            # load/compile overlaps the feasibility pass and rollback
+            # emission, and the solve's per-program lock joins in on the
+            # same executable instead of compiling twice.
+            warmup_attempted = True
+            _start_warmup_thread(acc, len(topic_list), desired_rf)
     preencoded = acc.finish() if acc is not None else None
     if obs_active():
         gauge_set("ingest.topics", streamed)
@@ -566,6 +682,7 @@ def print_least_disruptive_reassignment(
                 backend, topic_list, brokers, rack_assignment,
                 want_encode=(solver == "tpu"),
                 failure_policy=failure_policy, skipped=skipped,
+                desired_rf=desired_replication_factor,
             )
         except Exception as e:
             if not _is_ingest_failure(e):

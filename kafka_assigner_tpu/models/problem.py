@@ -426,6 +426,18 @@ class GroupEncodeAccumulator:
         self._total += len(named_currents)
         self.encode_ms += (time.perf_counter() - t0) * 1000.0
 
+    def peek_shape(self) -> tuple | None:
+        """(p_pad, width) bucket maxima over the chunks encoded SO FAR, or
+        None before any chunk arrived — the partial-metadata signal the
+        ingest warm-up predicts the solve's program signature from
+        (``solvers/warmup.py``). Later chunks can only grow these maxima."""
+        if not self._chunks:
+            return None
+        return (
+            max(c[1].shape[1] for c in self._chunks),
+            max(c[1].shape[2] for c in self._chunks),
+        )
+
     def finish(self) -> tuple:
         """Merge the chunk slabs into group-wide buckets; returns the same
         ``(encs, currents, jhashes, p_reals)`` tuple as one-shot

@@ -15,7 +15,12 @@ Namespace conventions (documented in the README "Observability" section):
 - ``plan.*``    gauges lifted into the report's ``plan`` section (moves,
   leader churn, topic/partition counts);
 - ``whatif.*``  scenario-sweep fan-out and dispatch metrics;
-- ``greedy.*`` / ``native.*``  per-backend solve counters.
+- ``greedy.*`` / ``native.*``  per-backend solve counters;
+- ``compile.store.*``  persistent-program-store traffic (hits/misses
+  counters, loads/compiles ms histograms — the run report's cold-vs-warm
+  compile attribution, ``utils/programstore.py``);
+- ``warmup.*``  ingest-overlapped warm-up outcomes per program
+  (warmed/hit/jit/error) and ``warmup.failures`` for crashed warm-ups.
 
 Histogram bucket upper edges come from ``KA_OBS_HIST_EDGES`` (ms for timing
 histograms); one shared edge set keeps reports comparable across runs.

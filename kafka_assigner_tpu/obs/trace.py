@@ -53,25 +53,28 @@ class RunCollector:
     def _start(self, name: str) -> Optional[int]:
         depth = len(self._stack)
         path = "/".join([n for _, n in self._stack] + [name])
-        if len(self.spans) >= MAX_SPANS:
-            self.spans_dropped += 1
-            self._stack.append((None, name))
-            return None
-        parent = -1
-        for idx, _ in reversed(self._stack):
-            if idx is not None:
-                parent = idx
-                break
-        self.spans.append({
-            "name": name,
-            "path": path,
-            "parent": parent,
-            "depth": depth,
-            "ms": 0.0,
-            "status": "open",
-        })
-        self._stack.append((len(self.spans) - 1, name))
-        return len(self.spans) - 1
+        # The append+index pair is lock-guarded only because record_complete
+        # (background-thread spans) appends to the same list.
+        with self._lock:
+            if len(self.spans) >= MAX_SPANS:
+                self.spans_dropped += 1
+                self._stack.append((None, name))
+                return None
+            parent = -1
+            for idx, _ in reversed(self._stack):
+                if idx is not None:
+                    parent = idx
+                    break
+            self.spans.append({
+                "name": name,
+                "path": path,
+                "parent": parent,
+                "depth": depth,
+                "ms": 0.0,
+                "status": "open",
+            })
+            self._stack.append((len(self.spans) - 1, name))
+            return len(self.spans) - 1
 
     def _finish(self, idx: Optional[int], ms: float, ok: bool) -> None:
         if self._stack:
@@ -80,6 +83,24 @@ class RunCollector:
             rec = self.spans[idx]
             rec["ms"] = round(ms, 3)
             rec["status"] = "ok" if ok else "error"
+
+    def record_complete(self, name: str, ms: float, ok: bool = True) -> None:
+        """Record an already-finished span as a ROOT-level record — the
+        thread-safe entry for background work (e.g. the ingest warm-up
+        thread), which must never touch the orchestration thread's nesting
+        stack. Same cap/overflow accounting as live spans."""
+        with self._lock:
+            if len(self.spans) >= MAX_SPANS:
+                self.spans_dropped += 1
+                return
+            self.spans.append({
+                "name": name,
+                "path": name,
+                "parent": -1,
+                "depth": 0,
+                "ms": round(ms, 3),
+                "status": "ok" if ok else "error",
+            })
 
     # -- metrics (written through obs/metrics.py) ---------------------------
 
@@ -194,6 +215,16 @@ class _Span:
             # ms at INFO, success or failure, obs capture active or not.
             self._log.info("phase %s: %.2f ms", self._name, ms)
         return False
+
+
+def record_span(name: str, ms: float, ok: bool = True) -> None:
+    """Record a completed span from ANY thread (no-op when disabled): the
+    background-thread counterpart of :func:`span`, used by work that runs
+    concurrently with the orchestration thread's span stack (the ingest
+    warm-up, ``generator.py``)."""
+    run = _ACTIVE
+    if run is not None:
+        run.record_complete(name, ms, ok)
 
 
 def span(name: str, *, sink=None, key=None, hist=None, log=None):

@@ -22,6 +22,43 @@ from ..obs.metrics import counter_add, gauge_set
 from ..obs.trace import span
 
 
+#: Sweep entry points routed through the persistent program store, same
+#: scheme as solvers/tpu.py:_PROGRAM_SPECS (the fan-out programs are cached
+#: and warm-started too — a daemon answering interactive what-if queries
+#: must not pay a cold compile on its first ranking). Mesh-sharded dispatches
+#: bypass the store inside the wrapper (sharding-specific executables).
+_SWEEP_SPECS = {
+    "whatif_sweep": (
+        "whatif_sweep_jit",
+        ("n", "rf", "wave_mode", "r_cap"),
+        (("b", "p", None), ("n",), ("b",), ("b",), (None, "n")),
+    ),
+    "whatif_subset_sweep": (
+        "whatif_subset_sweep_jit",
+        ("n", "rf", "r_cap"),
+        ((None, "p", "p", None), ("n",), (None, "p"), (None, "p"),
+         (None, "n")),
+    ),
+}
+
+
+def _sweep_program(name: str):
+    """Store-backed wrapper for a sweep entry (plain jit when the store layer
+    is unavailable — the sweep must not depend on the optimization)."""
+    from ..ops import assignment as ops
+    from ..solvers.tpu import _warn_once
+
+    attr, statics, axes = _SWEEP_SPECS[name]
+    jit_fn = getattr(ops, attr)
+    try:
+        from ..utils.programstore import BucketContract, wrap_jit
+
+        return wrap_jit(name, jit_fn, statics, BucketContract(axes))
+    except Exception as e:
+        _warn_once(f"kafka-assigner: program store unavailable ({e})")
+        return jit_fn
+
+
 def _topic_rfs(items, replication_factor):
     """Per-topic RF: the desired override, else inferred from each topic's
     own replica lists (clusters routinely mix RFs) with the assigner's
@@ -99,7 +136,7 @@ def _rescue_flagged(
     import jax
     import jax.numpy as jnp
 
-    from ..ops.assignment import whatif_sweep_jit
+    whatif_sweep_jit = _sweep_program("whatif_sweep")
 
     counter_add("whatif.rescued", len(flagged))
     sub = np.zeros((batch_bucket(len(flagged)), alive.shape[1]), dtype=bool)
@@ -143,7 +180,8 @@ def _evaluate_incremental(
     import jax.numpy as jnp
 
     from ..models.problem import _pad8
-    from ..ops.assignment import whatif_subset_sweep_jit
+
+    whatif_subset_sweep_jit = _sweep_program("whatif_subset_sweep")
 
     n = cluster.n
     clean, loads_t, maxload_t = _topic_stats(
@@ -262,7 +300,7 @@ def evaluate_removal_scenarios(
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec
 
-    from ..ops.assignment import whatif_sweep_jit
+    whatif_sweep_jit = _sweep_program("whatif_sweep")
 
     all_items = list(topic_assignments.items())
     all_rfs = _topic_rfs(all_items, replication_factor)

@@ -153,6 +153,65 @@ def _warn_once(msg: str) -> None:
         _warned.add(msg)
 
 
+#: The solver's ops/ entry points, as routed through the persistent program
+#: store (utils/programstore.py): name -> (ops attr, static argnames, bucket
+#: contract). The contract mirrors the encode-side bucketing rules
+#: (models/problem.py: batch axis "b" power-of-two, partition/node axes
+#: "p"/"n" multiples of 8, replica width exact) — the runtime half of kalint
+#: rule KA009: an unbucketed shape is dispatched through plain jit and never
+#: persisted, so ad-hoc shapes cannot explode the store.
+_PROGRAM_SPECS = {
+    "solve_assignment": (
+        "solve_assignment_jit",
+        ("n", "rf", "use_pallas", "r_cap", "width", "wave_mode"),
+        (("p", None), ("n",), ("n", None)),
+    ),
+    "solve_batched": (
+        "solve_batched_jit",
+        ("n", "rf", "wave_mode", "use_pallas", "leader_chunk", "r_cap",
+         "width"),
+        (("b", "p", None), ("n",), ("n", None), ("b",), ("b",)),
+    ),
+    "place_scan": (
+        "place_scan_jit",
+        ("n", "rf", "wave_mode", "r_cap", "width"),
+        (("b", "p", None), ("n",), ("b",), ("b",)),
+    ),
+    "place_scan_narrow": (
+        "place_scan_narrow_jit",
+        ("n", "rf", "wave_mode", "r_cap", "width"),
+        (("b", "p", None), ("n",), ("b",), ("b",)),
+    ),
+    "place_chunked": (
+        "place_chunked_jit",
+        ("n", "rf", "chunk", "r_cap", "width"),
+        (("b", "p", None), ("n",), ("b",), ("b",)),
+    ),
+    "order_batched": (
+        "order_batched_jit",
+        ("rf", "use_pallas", "leader_chunk"),
+        (("b", "p", None), ("b", "p"), ("n", None), ("b",)),
+    ),
+}
+
+
+def _program(name: str):
+    """The store-backed wrapper for one ops/ jitted entry point. Falls back
+    to plain jit dispatch when the store layer cannot even be constructed —
+    the solve must not depend on the optimization existing."""
+    from ..ops import assignment as ops
+
+    attr, statics, axes = _PROGRAM_SPECS[name]
+    jit_fn = getattr(ops, attr)
+    try:
+        from ..utils.programstore import BucketContract, wrap_jit
+
+        return wrap_jit(name, jit_fn, statics, BucketContract(axes))
+    except Exception as e:
+        _warn_once(f"kafka-assigner: program store unavailable ({e})")
+        return jit_fn
+
+
 def _resolve_pallas(use_pallas: bool, width: int | None) -> bool:
     """The pallas leadership kernel assumes RF-wide rows; the compat wide
     slots (``width``) are mutually exclusive with it — resolve loudly."""
@@ -195,6 +254,15 @@ def _fresh_solve_jit(*args, **kwargs):
     except NameError:
         fn = jax.jit(_fresh_solve, static_argnames=("p_pad", "n", "rf", "r_cap"))
         _fresh_solve_jit_impl = fn
+    try:
+        from ..utils.programstore import BucketContract, wrap_jit
+
+        fn = wrap_jit(
+            "fresh_solve", fn, ("p_pad", "n", "rf", "r_cap"),
+            BucketContract((("n",), ("n", None))),
+        )
+    except Exception as e:
+        _warn_once(f"kafka-assigner: program store unavailable ({e})")
     return fn(*args, **kwargs)
 
 
@@ -239,7 +307,8 @@ class TpuSolver:
 
         from ..faults.inject import fault_point
         from ..obs.metrics import counter_add
-        from ..ops.assignment import solve_assignment_jit
+
+        solve_assignment_jit = _program("solve_assignment")
 
         # Deterministic crash injection (KA_FAULTS_SPEC solve:i=crash): the
         # compile-failure/OOM stand-in the fallback chain is tested against.
@@ -332,8 +401,9 @@ class TpuSolver:
         from ..faults.inject import fault_point
         from ..obs.metrics import gauge_set, obs_active
         from ..obs.trace import span
-        from ..ops.assignment import solve_batched_jit
         from ..utils.logging import get_logger
+
+        solve_batched_jit = _program("solve_batched")
 
         # Deterministic crash injection (KA_FAULTS_SPEC solve:i=crash): the
         # compile-failure/OOM stand-in the fallback chain is tested against.
@@ -544,7 +614,8 @@ class TpuSolver:
         import jax
         import jax.numpy as jnp
 
-        from ..ops.assignment import place_chunked_jit, place_scan_narrow_jit
+        place_chunked_jit = _program("place_chunked")
+        place_scan_narrow_jit = _program("place_scan_narrow")
 
         mode, chunk = place_tuning()
         # The rescue path below reuses the ORIGINAL int32 array.
@@ -613,7 +684,7 @@ class TpuSolver:
             # have computed for these topics: a stranded leg restarts the
             # next from the post-sticky state (spread_orphans), and the
             # scan chain's first leg is the same fast leg that just ran.
-            from ..ops.assignment import place_scan_jit
+            place_scan_jit = _program("place_scan")
 
             k = int(bad.size)
             bucket = 1 << (k - 1).bit_length()
@@ -669,7 +740,7 @@ class TpuSolver:
                 np.asarray(jax.device_get(acc_count)),
                 jhashes, p_reals, counters_before,
             )
-        from ..ops.assignment import order_batched_jit
+        order_batched_jit = _program("order_batched")
 
         return jax.device_get(
             order_batched_jit(
@@ -725,7 +796,8 @@ class TpuSolver:
             # which at giant partition counts is the whole wall-clock
             # (measured 133 s of a 200k-partition fresh placement).
             from ..native.leadership import order_many
-            from ..ops.assignment import place_scan_jit
+
+            place_scan_jit = _program("place_scan")
 
             acc_nodes, acc_count, infeasible, deficits, _ = jax.device_get(
                 place_scan_jit(

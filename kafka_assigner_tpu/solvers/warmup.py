@@ -1,0 +1,156 @@
+"""Ingest-overlapped device warm-up: make the solve's programs resident
+while ZooKeeper responses are still streaming in.
+
+``generator.stream_initial_assignment`` learns most of the solve's bucketed
+program signature long before the solve runs: the broker set and rack map
+arrive first (so N_pad and r_cap are exact), the topic list is an input (so
+the batch bucket is exact), and the first encoded chunk reveals the
+partition/width buckets the group encode is converging to. This module turns
+that partial knowledge into the concrete dummy-array signatures the solver's
+dispatch would build, and asks the program store (``utils/programstore.py``)
+to make those executables resident — a store load (~ms) or, cold, the full
+compile — on a background thread, concurrently with the remaining ingest and
+host encode. By the time ``TpuSolver.assign_many`` dispatches, the program
+is (usually) already in memory.
+
+Prediction, not promise: a later topic can widen the partition bucket or the
+replica width, in which case the warm-up compiled a signature the solve does
+not use — wasted background work, zero correctness impact (the store's LRU
+cap bounds the disk cost). A warm-up failure of ANY kind degrades to the
+normal cold path (``warmup.failures`` counter, stderr warning) and never
+fails the solve; ``KA_WARMUP=0`` kills the whole feature.
+
+The same signature builder backs the ``ka-warm`` CLI entry point (seed the
+store for a cluster snapshot or a synthetic bucket set, ``cli.py``).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..models.problem import ClusterEncoding, batch_bucket
+
+
+def predict_group_signature(
+    cluster: ClusterEncoding,
+    n_topics: int,
+    p_pad: int,
+    width: int,
+    rf: int,
+) -> Dict[str, int]:
+    """The bucketed solve signature implied by what ingest knows so far:
+    exact batch bucket (the topic list is an input), exact node bucket and
+    rack cap (brokers arrive before topics), and the partition/width buckets
+    observed on the topics encoded so far (``GroupEncodeAccumulator``)."""
+    return {
+        "b_pad": batch_bucket(max(n_topics, 1)),
+        "p_pad": int(p_pad),
+        "width": max(int(width), 2),
+        "rf": max(int(rf), 1),
+        "n": cluster.n,
+        "n_pad": cluster.n_pad,
+    }
+
+
+def warm_solver_programs(
+    cluster: ClusterEncoding,
+    n_topics: int,
+    p_pad: int,
+    width: int,
+    rf: int,
+    r_cap: Optional[int] = None,
+) -> Dict[str, str]:
+    """Make the batched-solve programs for this signature resident.
+
+    Mirrors ``TpuSolver.assign_many``'s dispatch resolution (leadership
+    backend, place mode, wave chain, upload narrowing) on dummy arrays of
+    the predicted buckets, so the warmed key equals the key the real solve
+    will compute. Returns ``{program_name: outcome}`` (outcomes from
+    ``StoredJit.warm``: hit/warmed/jit/error). Raises nothing on its own
+    behalf — callers (the ingest warm-up thread, ``ka-warm``) treat any
+    escape as a degradation, never a failure.
+    """
+    import jax.numpy as jnp
+
+    from ..models.problem import rack_cap
+    from ..ops.pallas_leadership import pallas_leadership_enabled
+    from .tpu import (
+        _narrow_upload,
+        _program,
+        _resolve_native_order,
+        _resolve_pallas,
+        place_tuning,
+        solver_tuning,
+    )
+
+    sig = predict_group_signature(cluster, n_topics, p_pad, width, rf)
+    b_pad, p_pad, width = sig["b_pad"], sig["p_pad"], sig["width"]
+    rf = sig["rf"]
+    if r_cap is None:
+        r_cap = rack_cap(cluster.n_racks)
+
+    # The exact host arrays the encode produces, in miniature semantics:
+    # all-empty topics (current -1, p_real 0) are inert, so tracing/compiling
+    # against them builds the same program the real batch uses — and warm()
+    # never executes the store-backed path anyway.
+    currents = np.full((b_pad, p_pad, width), -1, dtype=np.int32)
+    up_currents = _narrow_upload(currents, cluster.rack_idx)
+    jhashes = np.zeros(b_pad, dtype=np.int32)
+    p_reals = np.zeros(b_pad, dtype=np.int32)
+
+    use_pallas = _resolve_pallas(pallas_leadership_enabled(), None)
+    native_order = _resolve_native_order(use_pallas)
+    wave_mode, leader_chunk = solver_tuning()
+    mode, chunk = place_tuning()
+
+    outcomes: Dict[str, str] = {}
+    if native_order:
+        # Heterogeneous split: placement on device, leadership in host C++
+        # (no device ordering program to warm).
+        if mode == "vmap" and wave_mode == "auto":
+            outcomes["place_chunked"] = _program("place_chunked").warm(
+                jnp.asarray(up_currents),
+                jnp.asarray(cluster.rack_idx),
+                jnp.asarray(jhashes),
+                jnp.asarray(p_reals),
+                n=sig["n"],
+                rf=rf,
+                chunk=chunk,
+                rfs=None,
+                r_cap=r_cap,
+                width=None,
+            )
+        else:
+            outcomes["place_scan_narrow"] = _program(
+                "place_scan_narrow"
+            ).warm(
+                jnp.asarray(up_currents),
+                jnp.asarray(cluster.rack_idx),
+                jnp.asarray(jhashes),
+                jnp.asarray(p_reals),
+                n=sig["n"],
+                rf=rf,
+                wave_mode=wave_mode,
+                rfs=None,
+                r_cap=r_cap,
+                width=None,
+            )
+    else:
+        counters = np.zeros((cluster.n_pad, rf), dtype=np.int32)
+        outcomes["solve_batched"] = _program("solve_batched").warm(
+            jnp.asarray(up_currents),
+            jnp.asarray(cluster.rack_idx),
+            jnp.asarray(counters),
+            jnp.asarray(jhashes),
+            jnp.asarray(p_reals),
+            n=sig["n"],
+            rf=rf,
+            wave_mode=wave_mode,
+            use_pallas=use_pallas,
+            rfs=None,
+            leader_chunk=leader_chunk,
+            r_cap=r_cap,
+            width=None,
+        )
+    return outcomes

@@ -240,8 +240,9 @@ _knob(
     "KA_FAULTS_SPEC", "str", None, default_doc="unset (no injection)",
     doc="fault-injection schedule for the harness in `faults/inject.py`: "
         "semicolon-separated `scope:index=kind[:arg]` events "
-        "(scopes connect/handshake/reply/solve; kinds blackhole, expire, "
-        "drop, trunc, slow, nonode, crash), or the word `random` for a "
+        "(scopes connect/handshake/reply/solve/warmup; kinds blackhole, "
+        "expire, drop, trunc, slow, nonode, crash), or the word `random` "
+        "for a "
         "seed-deterministic schedule (`KA_FAULTS_SEED`/`KA_FAULTS_RATE`). "
         "Malformed specs are ignored loudly and injection stays off",
 )
@@ -262,6 +263,37 @@ _knob(
     "KA_COMPILE_CACHE", "bool", True,
     doc="persistent XLA compile-cache kill-switch (`utils/compilecache.py`); "
         "set to 0 to disable",
+)
+_knob(
+    "KA_PROGRAM_STORE", "bool", True,
+    doc="persistent AOT program store (`utils/programstore.py`): solver "
+        "executables are serialized per bucketed signature and reloaded by "
+        "later processes, so a fresh process skips retrace+compile entirely "
+        "(the XLA cache of `KA_COMPILE_CACHE` still pays tracing and "
+        "per-process jit overhead). Set to 0 to fall back to plain jit "
+        "dispatch — byte-identical output either way (test-pinned)",
+)
+_knob(
+    "KA_PROGRAM_STORE_DIR", "str", None, default_doc="`<repo>/.ka_programs`",
+    doc="program-store location; one directory per fingerprint (solver/jax/"
+        "device versions — trace-time knob values key per entry) so stale "
+        "executables are clean misses, never wrong answers",
+)
+_knob(
+    "KA_PROGRAM_STORE_MAX_MB", "int", 512, floor=1,
+    doc="program-store size cap in MB: after each write the store evicts "
+        "least-recently-used entries (load hits refresh recency) until "
+        "under the cap — a shape explosion ages out old programs instead "
+        "of filling the disk",
+)
+_knob(
+    "KA_WARMUP", "bool", True,
+    doc="ingest-overlapped device warm-up (`solvers/warmup.py`): as soon as "
+        "the first streamed topic chunk reveals the bucket signatures the "
+        "solve will need, a background thread loads/compiles those programs "
+        "concurrently with the remaining metadata ingest. Kill-switch; a "
+        "failed warm-up always degrades to the normal cold path "
+        "(`warmup.failures` counter), never fails the solve",
 )
 _knob(
     "KA_COMPILE_CACHE_DIR", "str", None, default_doc="`<repo>/.jax_cache`",

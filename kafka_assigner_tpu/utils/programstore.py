@@ -1,0 +1,674 @@
+"""Persistent AOT program store: compiled solver executables, across processes.
+
+The XLA compile cache (``utils/compilecache.py``) already persists *backend
+compilation*, but a fresh process still pays jaxpr tracing, lowering, and the
+cache's own fingerprinting on every jit entry — ~16 s of the deployment
+target's cold start against a 542.7 ms solve (``BENCH_onchip_r05.json:
+tpu_cold_ms``). This module removes the remaining term: the solver's jitted
+entry points are compiled once per *bucketed signature* via
+``jax.jit(...).lower().compile()``, serialized with JAX's executable
+serialization, and reloaded byte-for-byte by later processes — load is
+deserialization, not retrace.
+
+Layering (this module sits ON TOP of the XLA cache, never replaces it):
+
+- ``enable_persistent_cache`` stays on for every OTHER compile in the
+  process (mesh paths, scripts, plain-jit fallbacks). The store's own
+  miss-compiles bypass it (:func:`_aot_compile`): an executable rehydrated
+  from the XLA cache re-serializes without its object code — "Symbols not
+  found" on every later load — so store entries must come from genuine
+  backend compiles (regression-pinned in ``tests/test_programstore.py``,
+  which runs with the suite's XLA cache warm);
+- the store keys on the *call* signature (entry name + static args + input
+  avals), the granularity the solver already buckets on
+  (``models/problem.py``: P/N axes multiples of 8, batch axis powers of two,
+  exact replica width) — one entry per ``(P-bucket, N-bucket, L, RF,
+  wave-mode)`` class, reused across topics and runs.
+
+Safety contract (every path is belt-and-braces, the store is an optimization):
+
+- **fingerprinted**: entries live under a directory named by a hash of
+  (store schema version, package version, jax/jaxlib versions, backend
+  platform + compiler version, device kind + count); trace-time ``KA_*``
+  knob values (which can change mid-process) are read fresh on every
+  dispatch and participate in the entry key instead. Any mismatch is a
+  clean miss — a stale executable can never be *loaded*, let alone
+  produce a wrong answer;
+- **corruption-tolerant**: an unreadable/undeserializable entry warns on
+  stderr, is unlinked best-effort, and falls back to a fresh compile;
+- **atomic**: writes go to a same-directory temp file and ``os.replace`` in,
+  so concurrent writers (or a crash mid-write) can never torch the store;
+- **bounded**: after each write the store evicts least-recently-used entries
+  (mtime, refreshed on load hits) until under ``KA_PROGRAM_STORE_MAX_MB``;
+- **bucket-guarded**: entries carry a shape contract (``BucketContract``)
+  mirroring the encode-side bucketing rules; an ad-hoc shape is dispatched
+  through plain jit (and warned about) instead of persisting — the runtime
+  half of kalint rule KA009, so unbucketed call sites cannot silently
+  explode the store with one-shot programs.
+
+Observability: ``compile.store.hits`` / ``compile.store.misses`` counters and
+the ``compile.store.loads_ms`` / ``compile.store.compiles_ms`` histograms give
+every run report cold-vs-warm compile attribution.
+
+``KA_PROGRAM_STORE=0`` disables the whole layer: every wrapped entry degrades
+to its plain jit call, byte-identical output (test-pinned).
+"""
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import os
+import pickle
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from .env import env_bool, env_int, env_str
+
+#: Bump when the stored payload format or the keying scheme changes — old
+#: stores become clean misses instead of deserialization errors.
+STORE_SCHEMA_VERSION = 1
+
+#: Trace-time knobs whose values are baked into the compiled program without
+#: appearing in any static argument (their reads happen inside the traced
+#: code, see utils/env.py docs) — they MUST participate in the entry KEY,
+#: and they are read fresh on every dispatch: a mid-process knob change
+#: (tests flip KA_DENSE_MASK_BUDGET around ``jax.clear_caches()``; the
+#: boundary tests depend on it) must re-key immediately, exactly like jax's
+#: own trace cache re-traces. Process-stable facts (versions, devices) live
+#: in the cached fingerprint instead.
+TRACE_TIME_KNOBS = (
+    "KA_DENSE_MASK_BUDGET", "KA_QUOTA_WAVE_TARGET", "KA_QUOTA_ENDGAME",
+)
+
+
+def _trace_knob_key() -> str:
+    from .env import env_int
+
+    return ",".join(f"{k}={env_int(k)}" for k in TRACE_TIME_KNOBS)
+
+#: Default store location: sibling of the package, like `.jax_cache`
+#: (gitignored). Override with KA_PROGRAM_STORE_DIR.
+_DEFAULT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    ".ka_programs",
+)
+
+_warned: set = set()
+
+#: Unique temp-file suffix per write: pid alone is not enough — concurrent
+#: THREADS of one process (warm-up + solve) write the same entry.
+_tmp_seq = itertools.count()
+
+
+def _tmp_name(path: str) -> str:
+    return f"{path}.tmp.{os.getpid()}.{next(_tmp_seq)}"
+
+
+def _warn_once(msg: str) -> None:
+    """Loud-but-not-spammy stderr warning (the compile path can run inside
+    per-topic loops; each distinct condition prints once per process)."""
+    if msg not in _warned:
+        print(f"kafka-assigner: {msg}", file=sys.stderr)
+        _warned.add(msg)
+
+
+# --- bucket contracts (runtime half of kalint KA009) -------------------------
+
+@dataclass(frozen=True)
+class BucketContract:
+    """Axis-bucketing contract for one ops/ entry point's positional args.
+
+    ``axes[i]`` describes positional arg i as a tuple of per-dimension codes:
+    ``"b"`` (batch axis: power of two, ``models/problem.py:batch_bucket``),
+    ``"p"``/``"n"`` (partition/node axis: multiple of 8, ``_pad8``), or
+    ``None`` (unconstrained, e.g. the exact replica width). Args beyond
+    ``axes`` and keyword args are unconstrained.
+    """
+
+    axes: Tuple[Optional[Tuple[Optional[str], ...]], ...] = ()
+
+    def violations(self, args: Sequence[Any]) -> Tuple[str, ...]:
+        out = []
+        for i, spec in enumerate(self.axes):
+            if spec is None or i >= len(args):
+                continue
+            shape = getattr(args[i], "shape", None)
+            if shape is None or len(shape) != len(spec):
+                continue  # scalar / unexpected rank: not this contract's job
+            for dim, code in zip(shape, spec):
+                if code == "b" and (dim < 1 or (dim & (dim - 1)) != 0):
+                    out.append(f"arg{i} batch axis {dim} is not a power of 2")
+                elif code in ("p", "n") and dim % 8 != 0:
+                    out.append(
+                        f"arg{i} {'partition' if code == 'p' else 'node'} "
+                        f"axis {dim} is not a multiple of 8"
+                    )
+        return tuple(out)
+
+
+# --- fingerprint -------------------------------------------------------------
+
+_FP_LOCK = threading.Lock()
+_FP_CACHE: Optional[Tuple[str, Dict[str, Any]]] = None
+
+
+def _fingerprint_facts() -> Dict[str, Any]:
+    """The raw fingerprint inputs (also written to the store's meta.json so a
+    human can see WHY an old entry stopped matching)."""
+    import jax
+    import jaxlib
+
+    from .. import __version__ as pkg_version
+
+    try:
+        from jax.extend import backend as jex_backend
+
+        b = jex_backend.get_backend()
+        platform = b.platform
+        platform_version = getattr(b, "platform_version", "")
+    except Exception as e:  # very old/new jax: degrade to the device view
+        _warn_once(f"program store: backend probe failed ({e})")
+        platform, platform_version = jax.default_backend(), ""
+    devices = jax.devices()
+    return {
+        "store_schema": STORE_SCHEMA_VERSION,
+        "package": pkg_version,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": platform,
+        "platform_version": platform_version,
+        "device_kind": devices[0].device_kind if devices else "none",
+        "device_count": len(devices),
+    }
+
+
+def fingerprint() -> str:
+    """Hex digest naming this process's compatibility class (cached — the
+    backend cannot change mid-process)."""
+    global _FP_CACHE
+    with _FP_LOCK:
+        if _FP_CACHE is None:
+            facts = _fingerprint_facts()
+            digest = hashlib.sha256(
+                # kalint: disable=KA005 -- fingerprint hash input, not a Kafka plan payload
+                json.dumps(facts, sort_keys=True).encode()
+            ).hexdigest()[:24]
+            _FP_CACHE = (digest, facts)
+        return _FP_CACHE[0]
+
+
+def _reset_fingerprint_cache() -> None:
+    """Test hook: forget the cached fingerprint (e.g. after monkeypatching
+    the fingerprint inputs)."""
+    global _FP_CACHE
+    with _FP_LOCK:
+        _FP_CACHE = None
+
+
+# --- the on-disk store -------------------------------------------------------
+
+class ProgramStore:
+    """One on-disk executable store rooted at ``root`` (layout:
+    ``<root>/<fingerprint>/<keyhash>.exe`` + a human-readable meta.json per
+    fingerprint directory)."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+
+    def _dir(self) -> str:
+        return os.path.join(self.root, fingerprint())
+
+    def _path(self, key: str) -> str:
+        keyhash = hashlib.sha256(key.encode()).hexdigest()[:32]
+        return os.path.join(self._dir(), f"{keyhash}.exe")
+
+    def load(self, key: str):
+        """The deserialized executable for ``key``, or None (clean miss /
+        corrupted entry). Never raises."""
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                blob = pickle.load(f)
+            if blob.get("schema") != STORE_SCHEMA_VERSION or \
+                    blob.get("key") != key:
+                raise ValueError("key/schema mismatch inside entry")
+            from jax.experimental.serialize_executable import (
+                deserialize_and_load,
+            )
+
+            exe = deserialize_and_load(
+                blob["payload"], blob["in_tree"], blob["out_tree"]
+            )
+        except FileNotFoundError:
+            return None
+        except Exception as e:
+            _warn_once(
+                f"program store: dropping corrupted entry {path} "
+                f"({type(e).__name__}: {e}); falling back to fresh compile"
+            )
+            try:
+                os.unlink(path)
+            except OSError as ue:
+                _warn_once(f"program store: could not unlink {path}: {ue}")
+            return None
+        try:
+            # Recency for the LRU cap: a loaded program is a live program.
+            os.utime(path, None)
+        except OSError:  # kalint: disable=KA008 -- recency refresh is advisory; a read-only store must still serve loads
+            pass
+        return exe
+
+    def save(self, key: str, compiled) -> bool:
+        """Serialize ``compiled`` under ``key`` (atomic rename; concurrent
+        writers of the same key both write valid files and one wins).
+        The payload is VERIFIED (deserialized back) before it is written: an
+        executable that was rehydrated from jax's persistent compilation
+        cache anywhere up the stack serializes without its object code and
+        would fail every later load ("Symbols not found") — such a payload
+        must never enter the store (the caller retries with a forced-fresh
+        compile, see ``StoredJit._resolve``). Returns success; never
+        raises."""
+        try:
+            from jax.experimental.serialize_executable import (
+                deserialize_and_load,
+                serialize,
+            )
+
+            payload, in_tree, out_tree = serialize(compiled)
+            deserialize_and_load(payload, in_tree, out_tree)  # verify
+            blob = pickle.dumps({
+                "schema": STORE_SCHEMA_VERSION,
+                "key": key,
+                "payload": payload,
+                "in_tree": in_tree,
+                "out_tree": out_tree,
+            })
+            d = self._dir()
+            os.makedirs(d, exist_ok=True)
+            meta = os.path.join(d, "meta.json")
+            if not os.path.exists(meta):
+                tmp_meta = _tmp_name(meta)
+                with open(tmp_meta, "w", encoding="utf-8") as f:
+                    # kalint: disable=KA005 -- store metadata, not a Kafka plan payload
+                    json.dump(_fingerprint_facts(), f, indent=2, default=str)
+                os.replace(tmp_meta, meta)
+            path = self._path(key)
+            tmp = _tmp_name(path)
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except Exception as e:
+            _warn_once(
+                f"program store: could not persist executable ({type(e).__name__}: "
+                f"{e}); this process keeps its in-memory copy"
+            )
+            return False
+        self._evict()
+        return True
+
+    def _evict(self) -> None:
+        """LRU size cap over the whole store (all fingerprints): drop
+        oldest-mtime entries until under ``KA_PROGRAM_STORE_MAX_MB``."""
+        cap_bytes = env_int("KA_PROGRAM_STORE_MAX_MB") * (1 << 20)
+        entries = []
+        total = 0
+        try:
+            for dirpath, _dirnames, filenames in os.walk(self.root):
+                for name in filenames:
+                    if not name.endswith(".exe"):
+                        continue
+                    p = os.path.join(dirpath, name)
+                    try:
+                        st = os.stat(p)
+                    except OSError:  # kalint: disable=KA008 -- entry raced away (concurrent eviction); nothing to size
+                        continue
+                    entries.append((st.st_mtime, st.st_size, p))
+                    total += st.st_size
+            if total <= cap_bytes:
+                return
+            evicted = 0
+            for _mtime, size, p in sorted(entries):
+                try:
+                    os.unlink(p)
+                    total -= size
+                    evicted += 1
+                except OSError:  # kalint: disable=KA008 -- a concurrent evictor won the unlink; the size goal still converges
+                    continue
+                if total <= cap_bytes:
+                    break
+            if evicted:
+                _warn_once(
+                    f"program store: size cap reached "
+                    f"(KA_PROGRAM_STORE_MAX_MB); evicted {evicted} LRU "
+                    "entr(y/ies)"
+                )
+        except Exception as e:
+            _warn_once(f"program store: eviction sweep failed ({e})")
+
+
+_STORE_LOCK = threading.Lock()
+_STORE: Optional[Tuple[str, ProgramStore]] = None
+
+
+def store_enabled() -> bool:
+    return env_bool("KA_PROGRAM_STORE")
+
+
+def get_store() -> ProgramStore:
+    """The process store (rebuilt when ``KA_PROGRAM_STORE_DIR`` changes —
+    tests repoint it per tmp_path)."""
+    global _STORE
+    root = env_str("KA_PROGRAM_STORE_DIR") or _DEFAULT_DIR
+    with _STORE_LOCK:
+        if _STORE is None or _STORE[0] != root:
+            _STORE = (root, ProgramStore(root))
+        return _STORE[1]
+
+
+#: Guards the global compilation-cache toggle in :func:`_aot_compile` (the
+#: warm-up thread and the solve can compile concurrently).
+_COMPILE_LOCK = threading.Lock()
+
+
+def _aot_compile(jit_fn, args, kwargs, force_fresh: bool = False):
+    """``lower().compile()`` with the XLA persistent compilation cache
+    BYPASSED for this one compile.
+
+    Why: an executable rehydrated from that cache re-serializes without its
+    jitted object code (XLA CPU drops it on the cache path — every later
+    ``deserialize_and_load`` fails with "Symbols not found"), so a store
+    entry must always come from a genuine backend compile. The toggle is
+    global, hence the lock; a concurrent unrelated compile merely loses one
+    cache lookup, never correctness. Paid once per signature per store —
+    after that every process loads the serialized program directly.
+
+    ``force_fresh``: escape hatch when the default compile STILL came back
+    unserializable (a rehydrated executable served from jax's in-memory
+    executable cache, which ignores the toggle): an explicit no-op compiler
+    option changes the cache key, forcing a genuine backend compile.
+    Returns None (with a warning) when even that fails — the caller keeps
+    its working in-memory executable and simply doesn't persist."""
+    import jax
+
+    def _compile():
+        lowered = jit_fn.lower(*args, **kwargs)
+        if not force_fresh:
+            return lowered.compile()
+        return lowered.compile(
+            compiler_options={"xla_embed_ir_in_executable": False}
+        )
+
+    try:
+        with _COMPILE_LOCK:
+            try:
+                from jax.experimental.compilation_cache.compilation_cache import (
+                    reset_cache,
+                )
+
+                enabled = bool(jax.config.jax_enable_compilation_cache)
+            except (AttributeError, ImportError):
+                # ancient jax: no toggle, no persistent cache either
+                return _compile()
+            if not enabled:
+                return _compile()
+            # The disable toggle alone is NOT enough: jax memoizes
+            # "is the cache used" per process at first compile, so a flag
+            # flip after that is ignored. reset_cache() clears the memo (the
+            # on-disk cache is untouched); the trailing reset lets the next
+            # plain-path compile re-initialize and use the cache normally.
+            jax.config.update("jax_enable_compilation_cache", False)
+            reset_cache()
+            try:
+                return _compile()
+            finally:
+                jax.config.update("jax_enable_compilation_cache", True)
+                reset_cache()
+    except Exception as e:
+        if force_fresh:
+            _warn_once(
+                f"program store: forced-fresh compile failed "
+                f"({type(e).__name__}: {e}); entry stays unpersisted"
+            )
+            return None
+        raise
+
+
+# --- the dispatch wrapper ----------------------------------------------------
+
+class StoredJit:
+    """A ``jax.jit``-ed entry point routed through the program store.
+
+    Call it exactly like the wrapped jit function. Per bucketed signature
+    (static args + input avals) the first call resolves an executable —
+    in-memory cache, then store load, then ``lower().compile()`` + persist —
+    and every later call dispatches the resolved executable directly. Any
+    failure anywhere degrades to the plain jit call (byte-identical output;
+    the store is an optimization, never a correctness dependency).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        jit_fn,
+        static_argnames: Tuple[str, ...],
+        contract: Optional[BucketContract] = None,
+    ) -> None:
+        self.name = name
+        self._jit = jit_fn
+        self._static = frozenset(static_argnames)
+        self._contract = contract
+        self._mem: Dict[str, Any] = {}
+        self._unbucketed: set = set()  # keys rejected by the contract
+        self._mem_lock = threading.Lock()
+        self._key_locks: Dict[str, threading.Lock] = {}
+
+    # -- keying ---------------------------------------------------------------
+
+    def _split(self, kwargs):
+        statics = {k: v for k, v in kwargs.items() if k in self._static}
+        dyn = {k: v for k, v in kwargs.items() if k not in self._static}
+        return statics, dyn
+
+    def _key(self, args, dyn, statics) -> str:
+        import jax
+        from jax.api_util import shaped_abstractify
+
+        leaves, treedef = jax.tree_util.tree_flatten((args, dyn))
+        avals = ",".join(str(shaped_abstractify(x)) for x in leaves)
+        stat = ",".join(f"{k}={statics[k]!r}" for k in sorted(statics))
+        return f"{self.name}|{stat}|{_trace_knob_key()}|{treedef}|{avals}"
+
+    def _multi_device(self, args, dyn) -> bool:
+        """Mesh-sharded inputs bypass the store: a serialized executable is
+        sharding-specific and the mesh path already amortizes its compiles
+        per process. (Single-device arrays — the CLI path — qualify.)"""
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves((args, dyn)):
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is not None and len(sharding.device_set) > 1:
+                return True
+        return False
+
+    def _lock_for(self, key: str) -> threading.Lock:
+        with self._mem_lock:
+            lock = self._key_locks.get(key)
+            if lock is None:
+                lock = self._key_locks[key] = threading.Lock()
+            return lock
+
+    # -- resolution -----------------------------------------------------------
+
+    def _resolve(self, key, args, kwargs):
+        """The executable for this signature, resolving store-load before
+        compile; None when this signature must go through plain jit (bucket
+        contract violation). Thread-safe per key: the ingest warm-up thread
+        and the solve can race on the same signature and the loser reuses
+        the winner's executable instead of compiling twice."""
+        from ..obs.metrics import counter_add, hist_observe
+
+        with self._mem_lock:
+            if key in self._unbucketed:
+                return None
+            exe = self._mem.get(key)
+        if exe is not None:
+            return exe
+        with self._lock_for(key):
+            with self._mem_lock:
+                if key in self._unbucketed:
+                    return None
+                exe = self._mem.get(key)
+            if exe is not None:
+                return exe
+            # Contract gate BEFORE any store traffic: an unbucketed shape is
+            # not a miss (it was never eligible), and the verdict is
+            # memoized so repeated ad-hoc dispatches don't re-probe disk.
+            if self._contract is not None:
+                bad = self._contract.violations(args)
+                if bad:
+                    counter_add("compile.store.unbucketed")
+                    _warn_once(
+                        f"program store: {self.name} called with "
+                        f"unbucketed shapes ({'; '.join(bad)}); "
+                        "dispatching through plain jit and NOT "
+                        "persisting (see kalint rule KA009 / "
+                        "models/problem.py bucketing)"
+                    )
+                    with self._mem_lock:
+                        self._unbucketed.add(key)
+                    return None
+            store = get_store()
+            t0 = time.perf_counter()
+            exe = store.load(key)
+            if exe is not None:
+                counter_add("compile.store.hits")
+                hist_observe(
+                    "compile.store.loads_ms",
+                    (time.perf_counter() - t0) * 1000.0,
+                )
+            else:
+                counter_add("compile.store.misses")
+                t0 = time.perf_counter()
+                exe = _aot_compile(self._jit, args, kwargs)
+                hist_observe(
+                    "compile.store.compiles_ms",
+                    (time.perf_counter() - t0) * 1000.0,
+                )
+                if not store.save(key, exe):
+                    # Unserializable (a cache-rehydrated executable leaked in
+                    # through jax's in-memory executable cache): retry once
+                    # with a forced-fresh backend compile so the store gets a
+                    # loadable entry; the solve works either way.
+                    fresh = _aot_compile(
+                        self._jit, args, kwargs, force_fresh=True
+                    )
+                    if fresh is not None and store.save(key, fresh):
+                        exe = fresh
+            with self._mem_lock:
+                self._mem[key] = exe
+            return exe
+
+    # -- public surface -------------------------------------------------------
+
+    def __call__(self, *args, **kwargs):
+        if not store_enabled():
+            return self._jit(*args, **kwargs)
+        statics, dyn = self._split(kwargs)
+        try:
+            if self._multi_device(args, dyn):
+                return self._jit(*args, **kwargs)
+            key = self._key(args, dyn, statics)
+            exe = self._resolve(key, args, kwargs)
+        except Exception as e:
+            _warn_once(
+                f"program store: {self.name} resolution failed "
+                f"({type(e).__name__}: {e}); using plain jit dispatch"
+            )
+            exe = None
+        if exe is None:
+            return self._jit(*args, **kwargs)
+        try:
+            return exe(*args, **dyn)
+        except Exception as e:
+            # Aval/layout mismatch or a stale executable that loaded but
+            # cannot run here: drop it and recover through plain jit.
+            _warn_once(
+                f"program store: stored executable for {self.name} failed to "
+                f"run ({type(e).__name__}: {e}); recompiling via jit"
+            )
+            from ..obs.metrics import counter_add
+
+            counter_add("compile.store.exec_fallbacks")
+            with self._mem_lock:
+                self._mem.pop(key, None)
+            return self._jit(*args, **kwargs)
+
+    def warm(self, *args, **kwargs) -> str:
+        """Ensure this signature's executable is resident (load or compile)
+        WITHOUT executing it. Returns one of ``"hit"`` (already in memory),
+        ``"warmed"`` (loaded/compiled now), ``"jit"`` (store disabled or
+        unbucketed: the plain jit function was traced+compiled instead), or
+        ``"error"`` — warm-up must never raise."""
+        try:
+            if not store_enabled():
+                # Populate jax's own jit cache so the real call is still warm.
+                self._jit(*args, **kwargs)
+                return "jit"
+            statics, dyn = self._split(kwargs)
+            if self._multi_device(args, dyn):
+                self._jit(*args, **kwargs)
+                return "jit"
+            key = self._key(args, dyn, statics)
+            with self._mem_lock:
+                hit = key in self._mem
+            if hit:
+                return "hit"
+            exe = self._resolve(key, args, kwargs)
+            if exe is None:
+                self._jit(*args, **kwargs)
+                return "jit"
+            return "warmed"
+        except Exception as e:
+            _warn_once(
+                f"program store: warm({self.name}) failed "
+                f"({type(e).__name__}: {e}); cold path unaffected"
+            )
+            return "error"
+
+
+_WRAPPERS: Dict[str, StoredJit] = {}
+_WRAPPERS_LOCK = threading.Lock()
+
+
+def wrap_jit(
+    name: str,
+    jit_fn,
+    static_argnames: Sequence[str],
+    contract: Optional[BucketContract] = None,
+) -> StoredJit:
+    """The process-wide :class:`StoredJit` for ``name`` (created on first
+    use; later calls return the same wrapper so its executable cache is
+    shared by every call site, warm-up thread included)."""
+    with _WRAPPERS_LOCK:
+        w = _WRAPPERS.get(name)
+        if w is None:
+            w = _WRAPPERS[name] = StoredJit(
+                name, jit_fn, tuple(static_argnames), contract
+            )
+        return w
+
+
+def clear_memory() -> None:
+    """Drop every wrapper's in-memory executables (NOT the on-disk store).
+    Used by tests to force the store-load path, and by long test sessions to
+    bound live-executable memory next to ``jax.clear_caches()``."""
+    with _WRAPPERS_LOCK:
+        wrappers = list(_WRAPPERS.values())
+    for w in wrappers:
+        with w._mem_lock:
+            w._mem.clear()
+            w._unbucketed.clear()

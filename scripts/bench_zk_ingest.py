@@ -29,6 +29,9 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# This bench times INGEST; the ingest-overlapped program warm-up (ISSUE 6)
+# would burn background CPU compiling solver programs mid-measurement.
+os.environ.setdefault("KA_WARMUP", "0")
 
 
 def build_tree(n_topics: int, n_brokers: int = 12, partitions: int = 8):

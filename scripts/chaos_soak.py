@@ -70,6 +70,10 @@ MATRIX = [
      {"strict": ([EXIT_SOLVE], False),
       # The greedy fallback is parity-pinned: degraded code, SAME bytes.
       "best-effort": ([EXIT_DEGRADED], True)}),
+    # A dead warm-up thread (ISSUE 6) must be invisible in the plan: the
+    # solve proceeds on the cold path, byte-identical, exit 0, BOTH policies.
+    ("warmup", "warmup:0=crash", "tpu",
+     {"strict": ([EXIT_OK], True), "best-effort": ([EXIT_OK], True)}),
 ]
 
 DOCUMENTED_FAILURE_RCS = (1, EXIT_INGEST, EXIT_SOLVE, 5)
@@ -125,6 +129,11 @@ def with_server(fn):
 
 
 def set_schedule(env, spec=None, seed=None):
+    # Drain the previous run's warm-up thread (ISSUE 6) first: a stale
+    # background compile must not write metrics into this run's report.
+    from kafka_assigner_tpu.generator import join_warmup_threads
+
+    join_warmup_threads()
     for k in ("KA_FAULTS_SPEC", "KA_FAULTS_SEED", "KA_FAULTS_RATE"):
         os.environ.pop(k, None)
     os.environ.update(env)

@@ -24,6 +24,10 @@ sys.exit(main(['--check-fixture', 'tests/golden/run_report_v1.json']))"
 # degradation classes must exit with the documented codes. The full
 # randomized 200-schedule soak is the slow-marked tests/test_chaos_soak.py.
 python scripts/chaos_soak.py --matrix
+# Warm-start smoke (ISSUE 6): program store populate -> clear-memory -> hit
+# on the CPU backend, byte-identical output, compile.store.hits >= 1. The
+# fresh-process bench is the slow-marked tests/test_bench_warmstart.py.
+python scripts/warmstart_smoke.py
 
 if command -v ruff >/dev/null 2>&1; then
     ruff check kafka_assigner_tpu tests

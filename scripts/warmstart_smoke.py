@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Warm-start smoke (tier-1, via scripts/lint.sh): the program store's
+populate→hit cycle on the CPU backend, asserted, in under a dozen seconds.
+
+Sequence (fresh temp store, so the outcome is deterministic):
+
+1. first batched TPU solve → ``compile.store.misses`` ≥ 1, executables
+   serialized to the store;
+2. in-memory executables dropped (``programstore.clear_memory()`` — the
+   stand-in for a fresh process, same trick the test suite uses);
+3. second solve → ``compile.store.hits`` ≥ 1 (the load path actually ran)
+   and output byte-identical to the first solve.
+
+The full fresh-process measurement lives in ``scripts/bench_warmstart.py``
+(slow-marked as ``tests/test_bench_warmstart.py``).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="ka_warmsmoke_") as store_dir:
+        os.environ["KA_PROGRAM_STORE_DIR"] = store_dir
+        os.environ["KA_PROGRAM_STORE"] = "1"
+
+        from kafka_assigner_tpu.obs import run_capture
+        from kafka_assigner_tpu.solvers.base import Context
+        from kafka_assigner_tpu.solvers.tpu import TpuSolver
+        from kafka_assigner_tpu.utils import programstore
+
+        racks = {100 + i: f"r{i % 3}" for i in range(6)}
+        nodes = set(racks)
+        topics = [
+            (
+                f"t{i}",
+                {p: [100 + (p + i + r) % 6 for r in range(3)]
+                 for p in range(8)},
+            )
+            for i in range(5)
+        ]
+
+        with run_capture() as cold:
+            out_cold = TpuSolver().assign_many(topics, racks, nodes, 3,
+                                               Context())
+        misses = cold.counters.get("compile.store.misses", 0)
+        if misses < 1:
+            print(f"FAIL: expected >=1 store miss on a fresh store, got "
+                  f"{misses}", file=sys.stderr)
+            return 1
+
+        programstore.clear_memory()  # fresh-process stand-in
+
+        with run_capture() as warm:
+            out_warm = TpuSolver().assign_many(topics, racks, nodes, 3,
+                                               Context())
+        hits = warm.counters.get("compile.store.hits", 0)
+        if hits < 1:
+            print(f"FAIL: expected >=1 store hit on the second solve, got "
+                  f"{hits} (counters: {warm.counters})", file=sys.stderr)
+            return 1
+        if out_cold != out_warm:
+            print("FAIL: store-loaded solve diverged from the compiled one",
+                  file=sys.stderr)
+            return 1
+        loads = warm.hists.get("compile.store.loads_ms", {})
+        print(
+            f"warmstart_smoke: PASS (misses={misses} hits={hits} "
+            f"load_ms={loads.get('sum', 0):.1f})", file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+"""Slow-marked wrapper around ``scripts/bench_warmstart.py`` (ISSUE 6
+acceptance): fresh-process warm start — cold compile vs store load across
+real process boundaries, ≥5× acquisition speedup asserted by the script
+itself, plans byte-identical. Kept out of tier-1 (four child interpreters,
+one full cold compile); the fast in-process cycle is the
+``scripts/warmstart_smoke.py`` lint-gate smoke."""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_warmstart_fresh_process(tmp_path):
+    out = tmp_path / "BENCH_warmstart.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_warmstart.py"),
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert out.exists()

@@ -376,6 +376,53 @@ def test_ka008_reasoned_suppression_silences():
     assert kalint.lint_source(src, "foo.py") == []
 
 
+# --- KA009: ops/ jit dispatch confined to bucket-boundary modules ------------
+
+KA009_SNIPPET = (
+    "from ..ops.assignment import solve_batched_jit\n"
+    "\n"
+    "def run(currents, rack_idx, counters, jhashes, p_reals):\n"
+    "    return solve_batched_jit(\n"
+    "        currents, rack_idx, counters, jhashes, p_reals, n=8, rf=3)\n"
+)
+
+
+def test_ka009_trips_outside_boundary_modules():
+    findings = kalint.lint_source(KA009_SNIPPET, "generator.py")
+    assert any(
+        f.rule == "KA009" and "bucket-boundary" in f.message
+        for f in findings
+    )
+
+
+def test_ka009_boundary_modules_are_allowed():
+    for relpath in sorted(kalint.BUCKET_BOUNDARY_MODULES):
+        assert "KA009" not in rules_of(
+            kalint.lint_source(KA009_SNIPPET, relpath)
+        )
+
+
+def test_ka009_module_attribute_dispatch_also_trips():
+    src = (
+        "from ..ops import assignment\n"
+        "\n"
+        "def run(c, r, j, p):\n"
+        "    return assignment.place_scan_jit(c, r, j, p, n=8, rf=3)\n"
+    )
+    assert "KA009" in rules_of(kalint.lint_source(src, "io/zk.py"))
+
+
+def test_ka009_non_jit_ops_imports_are_clean():
+    # Importing helpers (constants, host-side utilities) is not a dispatch.
+    src = (
+        "from ..ops.assignment import WAVE_MODES\n"
+        "\n"
+        "def modes():\n"
+        "    return tuple(WAVE_MODES)\n"
+    )
+    assert "KA009" not in rules_of(kalint.lint_source(src, "generator.py"))
+
+
 # --- suppressions ------------------------------------------------------------
 
 def test_suppression_with_reason_silences_the_finding():

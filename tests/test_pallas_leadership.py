@@ -56,6 +56,12 @@ def test_solver_end_to_end_with_pallas_flag(monkeypatch):
 def test_flag_routing_is_per_call(monkeypatch):
     # The env flag must take effect per solver call (static jit arg), not be
     # frozen into a shared compilation cache entry.
+    # The spy below observes TRACING; the persistent program store (ISSUE 6)
+    # deliberately skips retrace on a hit, so force the plain-jit dispatch
+    # for this test. Store-side routing of the flag is covered separately:
+    # use_pallas is a static argument and therefore part of the store key
+    # (tests/test_programstore.py pins distinct-static => distinct-entry).
+    monkeypatch.setenv("KA_PROGRAM_STORE", "0")
     from kafka_assigner_tpu.ops import assignment as ops
     from kafka_assigner_tpu.ops import pallas_leadership as pk
 

@@ -1,0 +1,443 @@
+"""The persistent AOT program store and the ingest-overlapped warm-up
+(ISSUE 6): round-trip byte-identity, fingerprint hygiene, corruption
+tolerance, write atomicity, the LRU size cap, the bucket-shape contract
+(runtime half of kalint KA009), and warm-up failure degradation."""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kafka_assigner_tpu.obs import run_capture
+from kafka_assigner_tpu.solvers.base import Context
+from kafka_assigner_tpu.solvers.tpu import TpuSolver
+from kafka_assigner_tpu.utils import programstore
+from kafka_assigner_tpu.utils.programstore import (
+    BucketContract,
+    StoredJit,
+    wrap_jit,
+)
+
+_uniq = iter(range(10**6))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_store(tmp_path, monkeypatch):
+    """Every test gets its own store directory and empty in-memory caches
+    (the wrapper registry is process-global by design)."""
+    from kafka_assigner_tpu.generator import join_warmup_threads
+
+    monkeypatch.setenv("KA_PROGRAM_STORE_DIR", str(tmp_path / "store"))
+    monkeypatch.setenv("KA_PROGRAM_STORE", "1")
+    join_warmup_threads()
+    programstore.clear_memory()
+    programstore._reset_fingerprint_cache()
+    yield
+    join_warmup_threads()
+    programstore.clear_memory()
+    programstore._reset_fingerprint_cache()
+
+
+def _toy_wrapper(contract=None) -> StoredJit:
+    """A fresh store-backed wrapper around a trivial jitted function (unique
+    name per call: the wrapper registry is keyed by name)."""
+
+    def f(x, n):
+        return x * n + 1
+
+    return StoredJit(
+        f"toy_{next(_uniq)}", jax.jit(f, static_argnames=("n",)), ("n",),
+        contract,
+    )
+
+
+def _exe_files(tmp_path):
+    root = tmp_path / "store"
+    if not root.exists():
+        return []
+    return sorted(p for p in root.rglob("*.exe"))
+
+
+# --- store lifecycle ---------------------------------------------------------
+
+def test_round_trip_is_byte_identical_and_hits(tmp_path):
+    w = _toy_wrapper()
+    x = jnp.asarray(np.arange(8, dtype=np.int32))
+    with run_capture() as cold:
+        r1 = np.asarray(w(x, n=3))
+    assert cold.counters.get("compile.store.misses") == 1
+    assert len(_exe_files(tmp_path)) == 1
+
+    # Fresh wrapper over the same entry = a fresh process's view.
+    w2 = StoredJit(w.name, w._jit, ("n",))
+    with run_capture() as warm:
+        r2 = np.asarray(w2(x, n=3))
+    assert warm.counters.get("compile.store.hits") == 1
+    assert "compile.store.loads_ms" in warm.hists
+    np.testing.assert_array_equal(r1, r2)
+
+
+def test_distinct_signatures_get_distinct_entries(tmp_path):
+    w = _toy_wrapper()
+    w(jnp.asarray(np.arange(8, dtype=np.int32)), n=3)
+    w(jnp.asarray(np.arange(16, dtype=np.int32)), n=3)  # new shape
+    w(jnp.asarray(np.arange(8, dtype=np.int32)), n=4)   # new static
+    assert len(_exe_files(tmp_path)) == 3
+
+
+def test_fingerprint_mismatch_is_a_clean_miss(tmp_path, monkeypatch):
+    w = _toy_wrapper()
+    x = jnp.asarray(np.arange(8, dtype=np.int32))
+    r1 = np.asarray(w(x, n=3))
+    # A different process-stable fingerprint (jax/device/version change) =
+    # a different compatibility class: the old entry must not load.
+    monkeypatch.setattr(programstore, "STORE_SCHEMA_VERSION", 999)
+    programstore._reset_fingerprint_cache()
+    w2 = StoredJit(w.name, w._jit, ("n",))
+    with run_capture() as run:
+        r2 = np.asarray(w2(x, n=3))
+    assert run.counters.get("compile.store.misses") == 1
+    assert not run.counters.get("compile.store.hits")
+    np.testing.assert_array_equal(r1, r2)
+    # Two fingerprint directories now coexist.
+    fp_dirs = [p for p in (tmp_path / "store").iterdir() if p.is_dir()]
+    assert len(fp_dirs) == 2
+
+
+def test_trace_time_knob_change_rekeys_immediately(tmp_path, monkeypatch):
+    """The boundary tests' contract (tests/test_wave_boundaries.py): a
+    mid-process `KA_DENSE_MASK_BUDGET` flip bracketed by
+    ``jax.clear_caches()`` must never be served a program traced under the
+    old value — the knob is part of the entry key, read per dispatch, so
+    the SAME wrapper re-keys without any cache reset."""
+    w = _toy_wrapper()
+    x = jnp.asarray(np.arange(8, dtype=np.int32))
+    w(x, n=3)
+    assert len(_exe_files(tmp_path)) == 1
+    monkeypatch.setenv("KA_DENSE_MASK_BUDGET", "4096")
+    with run_capture() as run:
+        w(x, n=3)
+    assert run.counters.get("compile.store.misses") == 1  # re-keyed
+    assert len(_exe_files(tmp_path)) == 2
+    monkeypatch.delenv("KA_DENSE_MASK_BUDGET")
+    with run_capture() as run:
+        w(x, n=3)  # original key again: in-memory, no traffic
+    assert not run.counters.get("compile.store.misses")
+    assert not run.counters.get("compile.store.hits")
+
+
+def test_corrupted_entry_falls_back_with_warning(tmp_path, capsys):
+    w = _toy_wrapper()
+    x = jnp.asarray(np.arange(8, dtype=np.int32))
+    r1 = np.asarray(w(x, n=3))
+    (entry,) = _exe_files(tmp_path)
+    entry.write_bytes(b"definitely not a pickled executable")
+    w2 = StoredJit(w.name, w._jit, ("n",))
+    with run_capture() as run:
+        r2 = np.asarray(w2(x, n=3))
+    np.testing.assert_array_equal(r1, r2)
+    assert run.counters.get("compile.store.misses") == 1
+    assert "dropping corrupted entry" in capsys.readouterr().err
+    # The bad file was replaced by the fresh compile's entry.
+    assert len(_exe_files(tmp_path)) == 1
+
+
+def test_concurrent_writers_never_torch_the_store(tmp_path):
+    w = _toy_wrapper()
+    x = jnp.asarray(np.arange(8, dtype=np.int32))
+    compiled = w._jit.lower(x, n=2).compile()
+    store = programstore.get_store()
+    errs = []
+
+    def _write(i):
+        try:
+            for _ in range(5):
+                store.save("shared-key", compiled)
+        except Exception as e:  # save() must never raise, let alone corrupt
+            errs.append(e)
+
+    threads = [threading.Thread(target=_write, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errs == []
+    exe = store.load("shared-key")
+    assert exe is not None
+    np.testing.assert_array_equal(np.asarray(exe(x)), np.arange(8) * 2 + 1)
+    # No temp-file debris survived the os.replace dance.
+    assert not [p for p in (tmp_path / "store").rglob("*.tmp.*")]
+
+
+def test_lru_cap_evicts_oldest(tmp_path, monkeypatch):
+    store = programstore.get_store()
+    d = tmp_path / "store" / "somefp"
+    d.mkdir(parents=True)
+    for i, name in enumerate(["old.exe", "mid.exe", "new.exe"]):
+        p = d / name
+        p.write_bytes(b"x" * 600_000)
+        os.utime(p, (1_000_000 + i, 1_000_000 + i))
+    monkeypatch.setenv("KA_PROGRAM_STORE_MAX_MB", "1")
+    store._evict()
+    left = {p.name for p in d.glob("*.exe")}
+    assert "new.exe" in left and "old.exe" not in left
+
+
+def test_store_disabled_is_plain_jit(tmp_path, monkeypatch):
+    monkeypatch.setenv("KA_PROGRAM_STORE", "0")
+    w = _toy_wrapper()
+    x = jnp.asarray(np.arange(8, dtype=np.int32))
+    with run_capture() as run:
+        r = np.asarray(w(x, n=3))
+    np.testing.assert_array_equal(r, np.arange(8) * 3 + 1)
+    assert not run.counters  # no store traffic at all
+    assert _exe_files(tmp_path) == []
+
+
+# --- bucket contract (runtime half of KA009) ---------------------------------
+
+def test_bucket_contract_flags_unbucketed_axes():
+    c = BucketContract(((("b", "p", None)), ("n",)))
+    ok = [np.zeros((8, 16, 3)), np.zeros(24)]
+    assert c.violations(ok) == ()
+    bad = c.violations([np.zeros((3, 7, 3)), np.zeros(5)])
+    assert len(bad) == 3  # batch not pow2, partition not %8, node not %8
+
+
+def test_unbucketed_call_dispatches_plain_jit_and_does_not_persist(
+    tmp_path, capsys
+):
+    w = _toy_wrapper(contract=BucketContract((("b",),)))
+    x = jnp.asarray(np.arange(5, dtype=np.int32))  # 5 is not a power of two
+    with run_capture() as run:
+        r = np.asarray(w(x, n=2))
+    np.testing.assert_array_equal(r, np.arange(5) * 2 + 1)
+    assert run.counters.get("compile.store.unbucketed") == 1
+    assert "unbucketed shapes" in capsys.readouterr().err
+    assert _exe_files(tmp_path) == []  # ad-hoc shapes never persist
+
+
+def test_warm_makes_the_signature_resident(tmp_path):
+    w = _toy_wrapper()
+    x = jnp.asarray(np.arange(8, dtype=np.int32))
+    assert w.warm(x, n=5) == "warmed"
+    assert len(_exe_files(tmp_path)) == 1
+    with run_capture() as run:
+        r = np.asarray(w(x, n=5))
+    np.testing.assert_array_equal(r, np.arange(8) * 5 + 1)
+    # Resident: the call neither hit disk nor compiled.
+    assert not run.counters.get("compile.store.hits")
+    assert not run.counters.get("compile.store.misses")
+    assert w.warm(x, n=5) == "hit"
+
+
+# --- the real solver through the store ---------------------------------------
+
+def _cluster():
+    racks = {100 + i: f"r{i % 3}" for i in range(6)}
+    topics = [
+        (
+            f"t{i}",
+            {p: [100 + (p + i + r) % 6 for r in range(3)] for p in range(8)},
+        )
+        for i in range(4)
+    ]
+    return topics, racks, set(racks)
+
+
+def test_solver_round_trip_through_the_store():
+    # Doubles as the XLA-cache-interaction regression: the suite's
+    # persistent compile cache (conftest) is usually WARM for this
+    # signature, and a store entry serialized from a cache-rehydrated
+    # executable would fail every load with "Symbols not found" — the
+    # store's miss-compile must bypass that cache (_aot_compile).
+    topics, racks, nodes = _cluster()
+    with run_capture() as cold:
+        out1 = TpuSolver().assign_many(topics, racks, nodes, 3, Context())
+    assert cold.counters.get("compile.store.misses", 0) >= 1
+    programstore.clear_memory()  # fresh-process stand-in
+    with run_capture() as warm:
+        out2 = TpuSolver().assign_many(topics, racks, nodes, 3, Context())
+    assert warm.counters.get("compile.store.hits", 0) >= 1
+    assert out1 == out2  # byte-identical decode either way
+
+
+def test_solver_output_identical_with_store_off(monkeypatch):
+    topics, racks, nodes = _cluster()
+    out_on = TpuSolver().assign_many(topics, racks, nodes, 3, Context())
+    monkeypatch.setenv("KA_PROGRAM_STORE", "0")
+    out_off = TpuSolver().assign_many(topics, racks, nodes, 3, Context())
+    assert out_on == out_off
+
+
+# --- warm-up thread: prediction, overlap, degradation ------------------------
+
+@pytest.fixture()
+def snapshot(tmp_path):
+    cluster = {
+        "brokers": [
+            {"id": 100 + i, "host": f"h{i}", "port": 9092, "rack": f"r{i % 3}"}
+            for i in range(6)
+        ],
+        "topics": {
+            f"topic-{t}": {
+                str(p): [100 + (p + t + r) % 6 for r in range(3)]
+                for p in range(8)
+            }
+            for t in range(5)
+        },
+    }
+    path = tmp_path / "cluster.json"
+    path.write_text(json.dumps(cluster))
+    return str(path)
+
+
+def _run_cli(snapshot, capsys, extra=()):
+    from kafka_assigner_tpu.cli import run
+
+    rc = run([
+        "--zk_string", f"file://{snapshot}",
+        "--mode", "PRINT_REASSIGNMENT", "--solver", "tpu", *extra,
+    ])
+    out = capsys.readouterr()
+    return rc, out.out
+
+
+def test_warmup_predicts_the_real_signature(snapshot, tmp_path):
+    """The warm-up thread's predicted program key must equal the solve's:
+    one miss total (the warm-up's), zero extra compiles, and a ``warmup``
+    span in the report."""
+    from kafka_assigner_tpu.cli import run
+
+    report = tmp_path / "report.json"
+    rc = run([
+        "--zk_string", f"file://{snapshot}",
+        "--mode", "PRINT_REASSIGNMENT", "--solver", "tpu",
+        "--report-json", str(report),
+    ])
+    assert rc == 0
+    rep = json.loads(report.read_text())
+    counters = rep["metrics"]["counters"]
+    assert counters.get("compile.store.misses", 0) == 1
+    # "warmed" when the thread won the race to the program, "hit" when the
+    # solve got there first — either way the prediction matched the key.
+    assert (
+        counters.get("warmup.warmed", 0) + counters.get("warmup.hit", 0) == 1
+    )
+    if counters.get("warmup.warmed"):
+        # The thread that warmed the program records its span before the
+        # solve's per-key lock releases, so it is always in the report; on
+        # the (rare) hit path the span write can race report emission.
+        warm_spans = [s for s in rep["spans"] if s["name"] == "warmup"]
+        assert warm_spans and warm_spans[0]["status"] == "ok"
+
+
+def test_warmup_crash_degrades_to_cold_path(snapshot, capsys):
+    """The injected ``warmup:0=crash`` fault (chaos-matrix class): the solve
+    must proceed on the cold path with byte-identical stdout and exit 0."""
+    from kafka_assigner_tpu import faults
+    from kafka_assigner_tpu.faults.inject import FaultInjector, parse_spec
+
+    faults.reset()
+    try:
+        rc_base, out_base = _run_cli(snapshot, capsys)
+        assert rc_base == 0
+        faults.install(FaultInjector(parse_spec("warmup:0=crash")))
+        rc, out = _run_cli(snapshot, capsys)
+        assert rc == 0
+        assert out == out_base
+    finally:
+        faults.reset()
+
+
+def test_warmup_crash_is_not_retried_by_the_tail_site(
+    snapshot, tmp_path, monkeypatch
+):
+    """One start attempt per run: when the injected crash consumes the
+    in-loop start site (chunk=1 forces it), the tail-chunk site must NOT
+    quietly launch a real warm-up — the faulted run stays cold."""
+    from kafka_assigner_tpu import faults
+    from kafka_assigner_tpu.cli import run
+    from kafka_assigner_tpu.faults.inject import FaultInjector, parse_spec
+
+    monkeypatch.setenv("KA_ZK_INGEST_CHUNK", "1")
+    faults.install(FaultInjector(parse_spec("warmup:0=crash")))
+    try:
+        report = tmp_path / "report.json"
+        rc = run([
+            "--zk_string", f"file://{snapshot}",
+            "--mode", "PRINT_REASSIGNMENT", "--solver", "tpu",
+            "--report-json", str(report),
+        ])
+    finally:
+        faults.reset()
+    assert rc == 0
+    counters = json.loads(report.read_text())["metrics"]["counters"]
+    assert counters.get("warmup.failures") == 1
+    assert not counters.get("warmup.warmed")
+    assert not counters.get("warmup.hit")
+
+
+def test_warmup_kill_switch(snapshot, tmp_path, monkeypatch):
+    monkeypatch.setenv("KA_WARMUP", "0")
+    from kafka_assigner_tpu.cli import run
+
+    report = tmp_path / "report.json"
+    rc = run([
+        "--zk_string", f"file://{snapshot}",
+        "--mode", "PRINT_REASSIGNMENT", "--solver", "tpu",
+        "--report-json", str(report),
+    ])
+    assert rc == 0
+    rep = json.loads(report.read_text())
+    assert not any(s["name"] == "warmup" for s in rep["spans"])
+    assert not any(
+        k.startswith("warmup.") for k in rep["metrics"]["counters"]
+    )
+
+
+# --- ka-warm -----------------------------------------------------------------
+
+def test_ka_warm_seeds_store_for_snapshot(snapshot, tmp_path, capsys):
+    from kafka_assigner_tpu.cli import run_warm
+
+    rc = run_warm(["--zk_string", f"file://{snapshot}"])
+    assert rc == 0
+    assert "store seeded" in capsys.readouterr().err
+    assert len(_exe_files(tmp_path)) >= 1
+    # The seeded signature is the one the real solve uses: a fresh-process
+    # CLI run must hit, not compile.
+    programstore.clear_memory()
+    report = tmp_path / "report.json"
+    from kafka_assigner_tpu.cli import run
+
+    rc = run([
+        "--zk_string", f"file://{snapshot}",
+        "--mode", "PRINT_REASSIGNMENT", "--solver", "tpu",
+        "--report-json", str(report),
+    ])
+    assert rc == 0
+    rep = json.loads(report.read_text())
+    assert rep["metrics"]["counters"].get("compile.store.hits", 0) >= 1
+    assert rep["metrics"]["counters"].get("compile.store.misses", 0) == 0
+
+
+def test_ka_warm_buckets_mode(tmp_path, capsys):
+    from kafka_assigner_tpu.cli import run_warm
+
+    rc = run_warm(["--buckets", "8,16,3,12,3"])
+    assert rc == 0
+    assert len(_exe_files(tmp_path)) >= 1
+
+
+def test_ka_warm_usage_errors(capsys):
+    from kafka_assigner_tpu.cli import run_warm
+
+    assert run_warm([]) == 1
+    assert run_warm(["--buckets", "not,numbers"]) == 1
