@@ -42,6 +42,12 @@ KA009  a jitted ``ops/`` entry point (a ``*_jit`` name from
        store contract-checks their shapes at runtime,
        ``utils/programstore.py:BucketContract``). An ad-hoc dispatch site
        would silently explode the per-signature compile/program caches
+KA010  a ZooKeeper WRITE opcode (``OP_CREATE``/``OP_SET_DATA``/
+       ``OP_DELETE``) referenced outside the wire client's serial write
+       methods (``io/zkwire.py``: ``create``/``set_data``/``delete``) —
+       the write-safety rule (ISSUE 7): writes are never pipelined through
+       the xid window and never blindly replayed after session
+       re-establishment, so no other code may build a write frame
 ====== =====================================================================
 
 Suppression: put ``# kalint: disable=KA002 -- <reason>`` on the offending
@@ -75,6 +81,7 @@ RULES = {
     "KA007": "jit-traced function closes over a mutable module-level global",
     "KA008": "except clause swallows the exception silently (pass/continue)",
     "KA009": "ops/ jit entry dispatched outside a bucket-boundary module",
+    "KA010": "ZooKeeper write opcode outside the serial write path",
 }
 
 #: Modules whose ENTIRE body is treated as traced kernel code (KA002): these
@@ -93,6 +100,13 @@ JSON_BOUNDARY_MODULE = "io/json_io.py"
 BUCKET_BOUNDARY_MODULES = frozenset({
     "solvers/tpu.py", "solvers/warmup.py", "parallel/whatif.py",
 })
+#: The wire-client module and the only functions in it allowed to reference
+#: the ZooKeeper WRITE opcodes (KA010): the serial, read-back-then-decide
+#: write methods. The pipelined window helpers and every other module must
+#: never see a write opcode.
+WIRE_MODULE = "io/zkwire.py"
+WRITE_OPCODES = frozenset({"OP_CREATE", "OP_SET_DATA", "OP_DELETE"})
+SERIAL_WRITE_FUNCS = frozenset({"create", "set_data", "delete"})
 
 _KNOB_RE = re.compile(r"KA_[A-Z][A-Z0-9_]*")
 _SUPPRESS_RE = re.compile(
@@ -642,6 +656,45 @@ def _check_ka009(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
     return out
 
 
+def _check_ka010(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
+    """A WRITE opcode reference (``OP_CREATE``/``OP_SET_DATA``/
+    ``OP_DELETE``, as a bare name or an attribute like
+    ``zkwire.OP_CREATE``) is legal only inside the wire client's serial
+    write methods. The module-level constant DEFINITIONS (Store context)
+    are exempt; every Load anywhere else — including zkwire's own pipelined
+    helpers — is a finding."""
+    out: List[Finding] = []
+
+    def visit(node: ast.AST, func: str | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_func = func
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_func = child.name
+            name = None
+            if isinstance(child, ast.Name) \
+                    and isinstance(child.ctx, ast.Load) \
+                    and child.id in WRITE_OPCODES:
+                name = child.id
+            elif isinstance(child, ast.Attribute) \
+                    and child.attr in WRITE_OPCODES:
+                name = child.attr
+            if name is not None and not (
+                relpath == WIRE_MODULE and child_func in SERIAL_WRITE_FUNCS
+            ):
+                out.append(Finding(
+                    "KA010", path, child.lineno, child.col_offset + 1,
+                    f"ZooKeeper write opcode {name} referenced outside the "
+                    "serial write path (io/zkwire.py "
+                    f"{sorted(SERIAL_WRITE_FUNCS)}): writes are never "
+                    "pipelined and never blindly replayed — route mutations "
+                    "through the wire client's write methods",
+                ))
+            visit(child, child_func)
+
+    visit(tree, None)
+    return out
+
+
 def _check_ka008(tree: ast.AST, path: str) -> List[Finding]:
     """An ``except`` body that is exactly one ``pass`` or one bare
     ``continue`` handles nothing and records nothing — the exception
@@ -721,6 +774,7 @@ def lint_source(
         + _check_ka007(tree, path)
         + _check_ka008(tree, path)
         + _check_ka009(tree, relpath, path)
+        + _check_ka010(tree, relpath, path)
     )
     for f in raw:
         if f.rule in suppress.get(f.line, ()):  # reasoned suppression

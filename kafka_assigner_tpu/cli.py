@@ -49,12 +49,16 @@ from .solvers.base import get_solver
 # every failure into one generic nonzero JVM exit, so a supervising process
 # cannot distinguish "the quorum was down" from "the plan is infeasible"
 # without scraping stderr. 2 is left to argparse (its own usage-error code).
-EXIT_OK = 0            # plan emitted, nothing degraded
+EXIT_OK = 0            # plan emitted / executed+verified, nothing degraded
 EXIT_USAGE = 1         # bad flag combination / unavailable backend refusal
 EXIT_INGEST = 3        # metadata ingest failed past the retry budget
 EXIT_SOLVE = 4         # solver crashed (and best-effort fallback too)
 EXIT_VALIDATION = 5    # input/validation failure (RF bounds, unknown hosts)
-EXIT_DEGRADED = 6      # best-effort success: plan emitted, but degraded
+EXIT_DEGRADED = 6      # best-effort success: plan emitted/executed, degraded
+EXIT_VERIFY = 7        # ka-execute: verify-after-move found the cluster
+                       # diverged from the plan (beyond recorded skips)
+EXIT_EXECUTE = 8       # ka-execute: halted mid-plan under strict policy;
+                       # the journal is resumable via --resume
 
 # The reference's three modes (KafkaAssignmentGenerator.java:86-101) plus
 # RANK_DECOMMISSION, which exposes the what-if fleet: it solves one candidate
@@ -465,6 +469,193 @@ def warm_main() -> None:
     except (ValueError, KeyError) as e:
         print(f"error: {e}", file=sys.stderr)
         sys.exit(EXIT_VALIDATION)
+
+
+def build_execute_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ka-execute",
+        description="Execute an emitted reassignment plan against the "
+        "cluster: throttled waves, ISR-convergence polling between waves, "
+        "a crash-safe journal (resume with --resume after a kill), and a "
+        "byte-identical verify-after-move pass (exec/engine.py).",
+    )
+    p.add_argument("--zk_string", default=None,
+                   help="cluster to execute against: ZK quorum host:port "
+                        "pairs, or a file://cluster.json snapshot (hermetic "
+                        "simulated-convergence mode)")
+    p.add_argument("--plan", default=None, metavar="PATH",
+                   help="plan JSON to execute — the NEW ASSIGNMENT payload "
+                        "(a saved mode-3 stdout is accepted; the rollback "
+                        "snapshot section is ignored)")
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="crash-safe journal path (default: the "
+                        "KA_EXEC_JOURNAL knob, else <plan>.journal)")
+    p.add_argument("--resume", action="store_true",
+                   help="continue an interrupted run from its journal's "
+                        "last committed wave (refused when the journal "
+                        "belongs to a different plan)")
+    p.add_argument("--wave-size", dest="wave_size", type=int, default=None,
+                   help="partition moves per wave (default: the "
+                        "KA_EXEC_WAVE_SIZE knob)")
+    p.add_argument("--throttle", type=float, default=None,
+                   help="seconds to pause between converged waves "
+                        "(default: the KA_EXEC_THROTTLE knob)")
+    p.add_argument("--failure-policy", dest="failure_policy", default=None,
+                   choices=("strict", "best-effort"),
+                   help="strict (default): halt resumably on the first "
+                        "wave that fails to converge (exit 8). "
+                        "best-effort: record unconverged moves as skipped "
+                        "and keep going — the run exits with the "
+                        "degraded-success code and the skips are listed in "
+                        "the run report's plan section")
+    p.add_argument("--report-json", dest="report_json", default=None,
+                   metavar="PATH",
+                   help="emit the schema-versioned run report (exec span "
+                        "family, exec.* counters, wave-latency histogram) "
+                        "to PATH")
+    return p
+
+
+def run_execute(argv: Optional[List[str]] = None) -> int:
+    """``ka-execute``: drive a plan to convergence. Library callers get the
+    raw typed exceptions; :func:`execute` maps them to the documented exit
+    codes. Returns EXIT_OK, EXIT_DEGRADED (best-effort skips) or
+    EXIT_VERIFY (post-move cluster state diverges from the plan)."""
+    parser = build_execute_parser()
+    args = parser.parse_args(argv)
+    if args.zk_string is None or args.plan is None:
+        print("error: --zk_string and --plan are required", file=sys.stderr)
+        parser.print_usage(sys.stderr)
+        return EXIT_USAGE
+
+    from .utils.env import env_bool, env_str
+
+    report_path = args.report_json or env_str("KA_OBS_REPORT")
+    if report_path is None and not env_bool("KA_OBS_ENABLE"):
+        return _dispatch_execute(args)
+
+    from . import obs
+
+    with obs.run_capture() as run:
+        status, error, rc = "error", None, 1
+        try:
+            with obs.span("mode/EXECUTE_REASSIGNMENT") as sp:
+                rc = _dispatch_execute(args)
+                if rc not in (EXIT_OK, EXIT_DEGRADED):
+                    sp.fail()
+            status = (
+                "ok" if rc == EXIT_OK
+                else "degraded" if rc == EXIT_DEGRADED
+                else "error"
+            )
+            return rc
+        except BaseException as e:
+            # Same flush contract as run_tool: a crash mid-execution (or
+            # the injected wave kill) must still emit the report — the
+            # journal forensics need the spans most on exactly those runs.
+            error = e
+            raise
+        finally:
+            try:
+                report = obs.build_report(
+                    run, status=status, mode="EXECUTE_REASSIGNMENT",
+                    argv=list(argv) if argv is not None else sys.argv[1:],
+                    error=error,
+                )
+                obs.emit_report(report, report_path)
+            except Exception as e:
+                print(f"obs: could not emit run report: {e}",
+                      file=sys.stderr)
+
+
+def _dispatch_execute(args) -> int:
+    """Plan load → backend open → engine drive → exit-code mapping."""
+    from .exec.engine import PlanExecutor, load_plan_file
+    from .utils.env import env_choice, env_str
+
+    plan, topic_order = load_plan_file(args.plan)
+    journal_path = (
+        args.journal or env_str("KA_EXEC_JOURNAL") or args.plan + ".journal"
+    )
+    policy = args.failure_policy or env_choice("KA_FAILURE_POLICY")
+    backend = open_backend(args.zk_string)
+    try:
+        executor = PlanExecutor(
+            backend, plan, topic_order, journal_path,
+            failure_policy=policy, resume=args.resume,
+            wave_size=args.wave_size, throttle=args.throttle,
+        )
+        outcome = executor.execute()
+    finally:
+        backend.close()
+    n_moves = outcome.moves_submitted
+    print(
+        f"ka-execute: {outcome.waves_run}/{outcome.waves_total} wave(s) "
+        f"run ({n_moves} move(s) submitted, {outcome.noops} already in "
+        f"place{', resumed' if outcome.resumed else ''})",
+        file=sys.stderr,
+    )
+    if outcome.mismatches:
+        for m in outcome.mismatches[:10]:
+            print(
+                f"ka-execute: VERIFY MISMATCH [{m['kind']}] "
+                f"{m['topic']!r}/{m['partition']}: expected "
+                f"{m['expected']}, observed {m['observed']}",
+                file=sys.stderr,
+            )
+        extra = len(outcome.mismatches) - 10
+        if extra > 0:
+            print(f"ka-execute: ... and {extra} more mismatch(es)",
+                  file=sys.stderr)
+        print(
+            f"ka-execute: verify-after-move FAILED "
+            f"({len(outcome.mismatches)} mismatch(es)); exiting "
+            f"{EXIT_VERIFY}",
+            file=sys.stderr,
+        )
+        return EXIT_VERIFY
+    if outcome.skipped:
+        print(
+            f"ka-execute: degraded success: {len(set(outcome.skipped))} "
+            f"move(s) skipped under best-effort; exiting {EXIT_DEGRADED}",
+            file=sys.stderr,
+        )
+        return EXIT_DEGRADED
+    print("ka-execute: verify-after-move OK: cluster state is "
+          "byte-identical to the plan", file=sys.stderr)
+    return EXIT_OK
+
+
+def execute(argv: Optional[List[str]] = None) -> int:
+    """:func:`run_execute` with the documented exit-code mapping — the
+    process entry point (and the chaos harness) call this; anything
+    unrecognized (including the injected wave-boundary kill) propagates
+    with its traceback, never laundered into a documented code."""
+    from .errors import ExecuteError, IngestError
+
+    try:
+        return run_execute(argv)
+    except ExecuteError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_EXECUTE
+    except IngestError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_INGEST
+    except BrokenPipeError:
+        raise
+    except (ZkWireError, OSError) as e:
+        print(f"error: metadata ingest failed: {e}", file=sys.stderr)
+        return EXIT_INGEST
+    except (ValueError, KeyError) as e:
+        # Includes JournalError (corrupt/mismatched journal) and plan-file
+        # validation failures.
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_VALIDATION
+
+
+def execute_main() -> None:
+    """Console entry point for ``ka-execute`` (pyproject.toml)."""
+    sys.exit(execute())
 
 
 def run(argv: Optional[List[str]] = None) -> int:

@@ -17,6 +17,15 @@ type                      meaning / exit code
                           (exit 4)
 ``ValueError``/``KeyError`` input/validation failures keep their plain
                           stdlib types for library callers (exit 5)
+:class:`ExecuteError`     the plan execution engine halted MID-plan
+                          (convergence timeout past the retry budget,
+                          write retry budget exhausted, a reassignment
+                          stuck in flight) — the journal holds every
+                          committed wave, so the run is resumable via
+                          ``ka-execute --resume`` (exit 8). Pre-journal
+                          refusals (read-only backend, plan topic not on
+                          the cluster) are plain ``ValueError`` instead:
+                          exit 8's resume promise would be a lie there
 ========================= ===========================================
 
 Both types chain the original exception (``raise ... from e``), so library
@@ -38,3 +47,14 @@ class IngestError(KafkaAssignerError):
 class SolveError(KafkaAssignerError):
     """The solver backend crashed (compile failure, device OOM) and no
     fallback produced a plan."""
+
+
+class ExecuteError(KafkaAssignerError):
+    """The plan execution engine halted MID-plan: a wave failed to converge
+    within the poll budget under ``--failure-policy strict``, a
+    reassignment write exhausted its read-back/resubmit budget, or another
+    reassignment stayed in flight past the wait budget. The crash-safe
+    journal retains every committed wave — the run resumes idempotently
+    via ``ka-execute --resume``. Pre-journal refusals (read-only backend,
+    plan/cluster mismatch) raise plain ``ValueError`` — validation, since
+    there is nothing to resume."""

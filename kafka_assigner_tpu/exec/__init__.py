@@ -1,0 +1,18 @@
+"""``kafka_assigner_tpu.exec`` — the plan execution engine (ISSUE 7).
+
+Public surface: :class:`~.engine.PlanExecutor` (throttled, journaled,
+verify-after-move execution of an emitted reassignment plan),
+:func:`~.engine.load_plan_file`, :class:`~.journal.ExecutionJournal` and
+the ``ka-execute`` CLI entry (``cli.run_execute``).
+"""
+from .engine import ExecOutcome, PlanExecutor, load_plan_file
+from .journal import ExecutionJournal, JournalError, plan_fingerprint
+
+__all__ = [
+    "ExecOutcome",
+    "ExecutionJournal",
+    "JournalError",
+    "PlanExecutor",
+    "load_plan_file",
+    "plan_fingerprint",
+]

@@ -1,0 +1,187 @@
+"""The crash-safe execution journal — ``ka-execute``'s resume contract.
+
+One JSON file per execution run, committed with the same atomic tmp+rename
+discipline as the program store (``utils/programstore.py``): a reader can
+NEVER observe a torn journal, only the state before or after a wave commit.
+The journal is written once up front (the frozen wave partition) and then
+re-written after every converged wave, so at any kill point it answers the
+two questions resume needs:
+
+- *which plan?* — ``plan`` is the SHA-256 of the plan's canonical bytes
+  (``format_reassignment_json`` over the parsed plan); ``--resume`` against
+  a different plan file is refused loudly instead of silently executing the
+  wrong moves;
+- *how far did it get?* — ``waves_committed`` counts fully CONVERGED waves.
+  A crash between a wave's submit and its commit resumes by resubmitting
+  that wave, which is safe because wave submission is idempotent
+  (set-to-same-value; ``io/base.py:apply_assignment`` contract).
+
+The move list itself is frozen into the journal (``moves``), not recomputed
+on resume: the wave partition an interrupted run committed against must be
+the one the resumed run continues, even though the cluster state has
+meanwhile moved under it.
+
+Schema (version 1)::
+
+    {
+      "version": 1,
+      "plan": "<sha256 hex>",
+      "wave_size": 8,
+      "status": "in-progress" | "complete",
+      "waves_committed": 2,
+      "moves": [["topic", 0, [1, 2, 3]], ...],   # frozen wave partition
+      "skipped": [["topic", 0], ...]             # best-effort unconverged
+    }
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, List, Sequence, Tuple
+
+JOURNAL_VERSION = 1
+
+Move = Tuple[str, int, List[int]]
+
+
+class JournalError(ValueError):
+    """The journal cannot be used: unreadable/corrupt file, schema or plan
+    mismatch. A ``ValueError`` so the CLI maps it to the documented
+    validation exit code."""
+
+
+def plan_fingerprint(
+    plan: Dict[str, Dict[int, List[int]]], topic_order: Sequence[str]
+) -> str:
+    """SHA-256 over the plan's canonical reassignment-JSON bytes — the
+    identity ``--resume`` validates, insensitive to the whitespace/key-order
+    freedom ``parse_reassignment_json`` forgives on input."""
+    from ..io.json_io import format_reassignment_json
+
+    canonical = format_reassignment_json(plan, topic_order=list(topic_order))
+    return hashlib.sha256(canonical.encode("utf-8")).hexdigest()
+
+
+class ExecutionJournal:
+    """In-memory handle over one journal file; every mutation persists
+    atomically before the engine proceeds (commit-then-advance)."""
+
+    def __init__(
+        self,
+        path: str,
+        plan_hash: str,
+        wave_size: int,
+        moves: List[Move],
+        *,
+        waves_committed: int = 0,
+        skipped: List[Tuple[str, int]] | None = None,
+        status: str = "in-progress",
+    ) -> None:
+        self.path = path
+        self.plan_hash = plan_hash
+        self.wave_size = max(1, int(wave_size))
+        self.moves = [(t, int(p), [int(r) for r in reps])
+                      for t, p, reps in moves]
+        self.waves_committed = int(waves_committed)
+        self.skipped: List[Tuple[str, int]] = [
+            (t, int(p)) for t, p in (skipped or [])
+        ]
+        self.status = status
+
+    # -- wave partition ----------------------------------------------------
+
+    @property
+    def waves_total(self) -> int:
+        return -(-len(self.moves) // self.wave_size) if self.moves else 0
+
+    def wave(self, index: int) -> List[Move]:
+        lo = index * self.wave_size
+        return self.moves[lo:lo + self.wave_size]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    def fresh(
+        cls, path: str, plan_hash: str, wave_size: int, moves: List[Move]
+    ) -> "ExecutionJournal":
+        """Start a new run: the journal is persisted BEFORE the first wave
+        is submitted, so even a kill inside wave 0 leaves a resumable
+        record."""
+        j = cls(path, plan_hash, wave_size, moves)
+        j.save()
+        return j
+
+    @classmethod
+    def load(cls, path: str) -> "ExecutionJournal":
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+        except OSError as e:
+            raise JournalError(f"cannot read journal {path!r}: {e}") from e
+        except ValueError as e:
+            raise JournalError(
+                f"journal {path!r} is corrupt (not JSON: {e}); a torn "
+                "write is impossible by construction — this file was "
+                "damaged externally"
+            ) from e
+        if not isinstance(data, dict) \
+                or data.get("version") != JOURNAL_VERSION:
+            raise JournalError(
+                f"journal {path!r} has unsupported version "
+                f"{data.get('version') if isinstance(data, dict) else '?'!r}"
+            )
+        try:
+            j = cls(
+                path,
+                str(data["plan"]),
+                int(data["wave_size"]),
+                [(t, int(p), [int(r) for r in reps])
+                 for t, p, reps in data["moves"]],
+                waves_committed=int(data["waves_committed"]),
+                skipped=[(t, int(p)) for t, p in data.get("skipped", [])],
+                status=str(data.get("status", "in-progress")),
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            raise JournalError(
+                f"journal {path!r} is structurally invalid: {e}"
+            ) from e
+        if not 0 <= j.waves_committed <= j.waves_total:
+            raise JournalError(
+                f"journal {path!r} claims {j.waves_committed} committed "
+                f"wave(s) of {j.waves_total}"
+            )
+        return j
+
+    def commit_wave(
+        self, waves_committed: int,
+        skipped: Sequence[Tuple[str, int]] = (),
+    ) -> None:
+        """Persist a wave boundary: ``waves_committed`` waves are fully
+        converged (or, under best-effort, recorded as skipped). The engine
+        only advances past the atomic rename."""
+        self.waves_committed = int(waves_committed)
+        for t, p in skipped:
+            key = (t, int(p))
+            if key not in self.skipped:
+                self.skipped.append(key)
+        self.save()
+
+    def complete(self) -> None:
+        self.status = "complete"
+        self.save()
+
+    def save(self) -> None:
+        from ..utils.atomicwrite import atomic_write_text
+
+        payload = {
+            "version": JOURNAL_VERSION,
+            "plan": self.plan_hash,
+            "wave_size": self.wave_size,
+            "status": self.status,
+            "waves_committed": self.waves_committed,
+            "moves": [[t, p, reps] for t, p, reps in self.moves],
+            "skipped": [[t, p] for t, p in self.skipped],
+        }
+        # kalint: disable=KA005 -- execution journal, not a Kafka plan payload
+        text = json.dumps(payload, indent=1, sort_keys=True) + "\n"
+        atomic_write_text(self.path, text, prefix=".ka_journal_")

@@ -34,6 +34,21 @@ crash      warmup           the ingest-overlapped warm-up thread raises
                             ``InjectedWarmupCrash`` before it touches the
                             program store (the warm-up must degrade to the
                             cold path, byte-identically — ISSUE 6)
+drop       write            a reassignment write raises ConnectionReset
+                            BEFORE the backend applies it — the engine must
+                            read the state back and resubmit, never blindly
+                            replay (ISSUE 7 write-safety rule)
+lost       write            the write is ACKED but never applied (a quorum
+                            member crashed after the ack) — the convergence
+                            poll must time out; the old assignment stays
+                            complete, never half-moved
+stall      converge         one convergence poll observes frozen state (the
+                            controller is busy); the engine must retry with
+                            backoff, not declare failure
+crash      wave             the execution engine dies at a wave boundary
+                            (``InjectedExecCrash`` — the chaos stand-in for
+                            kill -9 between waves); the journal must resume
+                            the run to a byte-identical final state
 ========== ================ ==============================================
 
 Spec grammar (``KA_FAULTS_SPEC``): semicolon-separated events
@@ -73,16 +88,30 @@ FAULT_SCOPES: Dict[str, Tuple[str, ...]] = {
     "reply": ("drop", "trunc", "slow", "nonode"),
     "solve": ("crash",),
     "warmup": ("crash",),
+    "write": ("drop", "lost"),
+    "converge": ("stall",),
+    "wave": ("crash",),
 }
 FAULT_KINDS = tuple(k for kinds in FAULT_SCOPES.values() for k in kinds)
 
 #: ``random`` mode draws events over this many indexes per scope — enough to
 #: cover any realistic mode-3 run against the test fixtures while keeping the
-#: schedule finite and printable. (``warmup`` sorts last, so adding it left
-#: every pre-existing scope's seed-deterministic draws unchanged.)
+#: schedule finite and printable.
 RANDOM_HORIZON: Dict[str, int] = {
     "connect": 3, "handshake": 3, "reply": 64, "solve": 2, "warmup": 2,
+    "write": 8, "converge": 8, "wave": 4,
 }
+
+#: The scope iteration order of :func:`random_schedule`. Frozen EXPLICITLY —
+#: new scopes append at the end (never alphabetical insertion), so a
+#: pre-existing seed keeps drawing the exact same events for the scopes it
+#: already covered. (A ``sorted(FAULT_SCOPES)`` walk would have reshuffled
+#: every historical schedule the moment ``converge`` landed before
+#: ``handshake``.)
+RANDOM_ORDER: Tuple[str, ...] = (
+    "connect", "handshake", "reply", "solve", "warmup",
+    "write", "converge", "wave",
+)
 
 ERR_NONODE = -101
 
@@ -101,6 +130,15 @@ class InjectedWarmupCrash(RuntimeError):
     ingest-overlapped warm-up thread (store corruption, compile failure on
     the background thread). The contract under test: the solve must proceed
     on the cold path, byte-identically."""
+
+
+class InjectedExecCrash(RuntimeError):
+    """The ``wave`` fault point fired — the execution engine "process" dies
+    at a wave boundary (the deterministic stand-in for kill -9 between
+    waves). Deliberately NOT mapped to a documented exit code: a killed
+    process has no exit path, and the harnesses catch this class exactly
+    where a supervisor would observe the dead process. The contract under
+    test: the journal must resume the run to a byte-identical final state."""
 
 
 @dataclass(frozen=True)
@@ -176,7 +214,7 @@ def random_schedule(seed: int, rate: float) -> List[FaultEvent]:
     uniformly from the scope's kinds. Same seed ⇒ identical schedule."""
     rng = random.Random(int(seed))
     events: List[FaultEvent] = []
-    for scope in sorted(FAULT_SCOPES):
+    for scope in RANDOM_ORDER:
         kinds = FAULT_SCOPES[scope]
         for index in range(RANDOM_HORIZON[scope]):
             if rng.random() < rate:
@@ -286,6 +324,74 @@ class FaultInjector:
                 "failure stand-in)"
             )
 
+    def backend_reply(self, missing_exc=None):
+        """Backend-level twin of :meth:`filter_reply` for metadata adapters
+        that never see raw frames (the kazoo client, the Kafka AdminClient):
+        the SAME ``reply`` scope and schedule fire regardless of backend,
+        with each kind mapped onto the adapter's failure surface — ``slow``
+        delays the op, ``drop``/``trunc`` become a connection loss, and
+        ``nonode`` becomes the adapter's missing-entity error
+        (``missing_exc``, default the wire client's ``NoNodeError``; the
+        AdminClient passes ``KeyError``, its unknown-topic class)."""
+        ev = self._next("reply")
+        if ev is None:
+            return
+        if ev.kind == "slow":
+            self._fire(ev)
+            time.sleep(ev.arg if ev.arg is not None else 0.05)
+            return
+        if ev.kind in ("drop", "trunc"):
+            self._fire(ev)
+            raise ConnectionResetError(
+                "injected fault: backend connection lost mid-read"
+            )
+        if ev.kind == "nonode":
+            self._fire(ev)
+            if missing_exc is None:
+                from ..io.zkwire import NoNodeError as missing_exc
+            raise missing_exc("injected fault: entity vanished mid-read")
+
+    def write_attempt(self) -> Optional[str]:
+        """Called by each backend's reassignment-write path (the ISSUE 7
+        write seam). ``drop`` raises before the write applies — the engine
+        must read back and resubmit, never blindly replay. ``lost`` returns
+        ``"lost"``: the backend acks the write but never applies it (the
+        caller skips the apply), so the convergence poll must time out with
+        the OLD assignment still complete."""
+        ev = self._next("write")
+        if ev is None:
+            return None
+        if ev.kind == "drop":
+            self._fire(ev)
+            raise ConnectionResetError(
+                "injected fault: reassignment write dropped before apply"
+            )
+        if ev.kind == "lost":
+            self._fire(ev)
+            return "lost"
+        return None
+
+    def converge_poll(self) -> bool:
+        """Called once per convergence-state read; a ``stall`` event freezes
+        that one poll (the backend reports no progress), so the engine's
+        retry/backoff loop — not its failure path — is what's exercised."""
+        ev = self._next("converge")
+        if ev is not None and ev.kind == "stall":
+            self._fire(ev)
+            return True
+        return False
+
+    def wave_boundary(self) -> None:
+        """Called by the execution engine between waves; ``crash`` raises
+        :class:`InjectedExecCrash` — the kill-between-waves stand-in the
+        resume contract is proven against."""
+        ev = self._next("wave")
+        if ev is not None and ev.kind == "crash":
+            self._fire(ev)
+            raise InjectedExecCrash(
+                "injected fault: execution engine killed at a wave boundary"
+            )
+
 
 #: Programmatic override (tests) — wins over the env knob when set.
 _INSTALLED: Optional[FaultInjector] = None
@@ -342,8 +448,8 @@ def active_injector() -> Optional[FaultInjector]:
 
 def fault_point(scope: str) -> None:
     """Generic crash-style fault point for non-wire call sites (``solve`` in
-    the TPU solver, ``warmup`` in the ingest warm-up thread). No-op without
-    an active injector."""
+    the TPU solver, ``warmup`` in the ingest warm-up thread, ``wave`` at the
+    execution engine's wave boundaries). No-op without an active injector."""
     inj = active_injector()
     if inj is None:
         return
@@ -351,3 +457,5 @@ def fault_point(scope: str) -> None:
         inj.solve_attempt()
     elif scope == "warmup":
         inj.warmup_attempt()
+    elif scope == "wave":
+        inj.wave_boundary()

@@ -705,6 +705,11 @@ def print_least_disruptive_reassignment(
         skipped = [t for t in skipped if t not in initial]
         if obs_active():
             gauge_set("ingest.topics_skipped", len(skipped))
+            # The degraded-run DIFF, not just the count (ISSUE 7 satellite):
+            # the run report's plan section names exactly which topics the
+            # plan does NOT cover, so the execute-side verify pass (and any
+            # supervisor) can separate "unplanned by policy" from "drifted".
+            gauge_set("plan.unplanned_topics", sorted(set(skipped)))
     if skipped:
         print(
             f"kafka-assigner: best-effort: {len(skipped)} topic read(s) "

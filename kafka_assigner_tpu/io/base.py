@@ -18,7 +18,9 @@ snapshot, anything else a live ZK quorum.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Iterator, List, Optional, Protocol, Sequence, Tuple
+from typing import (
+    Dict, Iterator, List, NamedTuple, Optional, Protocol, Sequence, Tuple,
+)
 
 
 @dataclass(frozen=True)
@@ -30,6 +32,17 @@ class BrokerInfo:
     host: str
     port: int
     rack: Optional[str] = None
+
+
+class PartitionState(NamedTuple):
+    """One partition's convergence-relevant state, as the execution engine
+    polls it (ISSUE 7): the assigned replica list and the in-sync subset.
+    Backends without ISR visibility (snapshot files, old admin clients)
+    report ``isr == replicas`` — their notion of "assigned" IS "applied",
+    so the weaker signal is still truthful for convergence."""
+
+    replicas: List[int]
+    isr: List[int]
 
 
 class MetadataBackend(Protocol):
@@ -107,6 +120,55 @@ class MetadataBackend(Protocol):
         assignment = self.partition_assignment(topics)
         for t in topics:
             yield t, assignment[t]
+
+    # -- plan execution surface (ISSUE 7) ---------------------------------
+
+    def supports_execution(self) -> bool:
+        """True when this backend can WRITE a reassignment and report
+        convergence state. Default False: a read-only backend stays safe,
+        and ``ka-execute`` refuses it up front with a clear error instead
+        of failing mid-plan."""
+        return False
+
+    def apply_assignment(
+        self, moves: Dict[str, Dict[int, List[int]]]
+    ) -> None:
+        """Submit one wave of the reassignment: ``{topic: {partition:
+        [target replicas]}}``. MUST be idempotent — the engine resubmits a
+        wave after a crash or a dropped write, and submitting an
+        already-applied target must be a no-op (set-to-same-value
+        semantics). Transport failures raise ``ConnectionError``/
+        ``OSError``/``ZkWireError``; the engine then reads the state back
+        and decides (the write-safety rule), never blindly replays."""
+        from ..errors import ExecuteError
+
+        raise ExecuteError(
+            f"{type(self).__name__} cannot execute reassignments (read-only "
+            "metadata backend)"
+        )
+
+    def read_assignment_state(
+        self, topics: Sequence[str]
+    ) -> Dict[str, Dict[int, PartitionState]]:
+        """Convergence-state poll: per topic, per partition, the assigned
+        replicas and the in-sync subset. Topics the backend cannot resolve
+        are simply absent from the result (the engine treats absence as
+        not-converged / a verify mismatch, whichever phase asks).
+
+        Real default over the streaming read surface: backends with no ISR
+        visibility inherit ``isr == replicas`` (see
+        :class:`PartitionState`)."""
+        out: Dict[str, Dict[int, PartitionState]] = {}
+        for t, parts in self.fetch_topics(
+            list(dict.fromkeys(topics)), missing="skip"
+        ):
+            if parts is None:
+                continue
+            out[t] = {
+                p: PartitionState(list(r), list(r))
+                for p, r in parts.items()
+            }
+        return out
 
     def close(self) -> None: ...
 

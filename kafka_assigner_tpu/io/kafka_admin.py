@@ -22,8 +22,9 @@ import sys
 
 from typing import Dict, Iterator, List, Sequence, Tuple
 
+from ..faults.inject import active_injector
 from ..obs.metrics import counter_add, hist_ms
-from .base import BrokerInfo
+from .base import BrokerInfo, PartitionState
 
 
 class KafkaAdminBackend:
@@ -32,6 +33,15 @@ class KafkaAdminBackend:
     def __init__(self, bootstrap_servers: str) -> None:
         self._impl = None
         self._warned_rack_blind = False
+        # Fault-injection hooks (ISSUE 7 satellite): the AdminClient never
+        # exposes wire frames, so the backend-level twin hooks fire the
+        # same KA_FAULTS_SPEC schedule here — connect at construction,
+        # reply per metadata RPC (nonode maps to KeyError, the missing-
+        # topic class `_is_unknown_topic` recognizes), write/converge at
+        # the execution seams.
+        self._faults = active_injector()
+        if self._faults is not None:
+            self._faults.connect_attempt()
         try:
             from confluent_kafka.admin import AdminClient  # type: ignore
 
@@ -51,8 +61,15 @@ class KafkaAdminBackend:
                     "offline runs"
                 ) from e
 
+    def _fault_reply(self) -> None:
+        """Per-RPC ``reply``-scope hook: ``nonode`` becomes ``KeyError``
+        (the unknown-topic class), ``drop``/``trunc`` a connection loss."""
+        if self._faults is not None:
+            self._faults.backend_reply(missing_exc=KeyError)
+
     def brokers(self) -> List[BrokerInfo]:
         counter_add("zk.reads")  # metadata-op namespace, any backend
+        self._fault_reply()
         if self._impl == "confluent":
             with hist_ms("zk.op_ms"):
                 md = self._admin.list_topics(timeout=10)
@@ -82,6 +99,7 @@ class KafkaAdminBackend:
 
     def all_topics(self) -> List[str]:
         counter_add("zk.reads")
+        self._fault_reply()
         if self._impl == "confluent":
             with hist_ms("zk.op_ms"):
                 md = self._admin.list_topics(timeout=10)
@@ -94,6 +112,7 @@ class KafkaAdminBackend:
         self, topics: Sequence[str]
     ) -> Dict[str, Dict[int, List[int]]]:
         counter_add("zk.reads")
+        self._fault_reply()
         out: Dict[str, Dict[int, List[int]]] = {}
         if self._impl == "confluent":
             with hist_ms("zk.op_ms"):
@@ -163,6 +182,98 @@ class KafkaAdminBackend:
                         file=sys.stderr,
                     )
         return [assignment.get(t) for t in topics]
+
+    # -- plan execution surface (ISSUE 7) ---------------------------------
+
+    def supports_execution(self) -> bool:
+        """KIP-455 ``alter_partition_reassignments`` when the client carries
+        it (kafka-python duck-typed; confluent-kafka's librdkafka has no
+        reassignment API at all). A backend that cannot write says so up
+        front — ``ka-execute`` refuses before touching the journal."""
+        return self._impl == "kafka-python" and hasattr(
+            self._admin, "alter_partition_reassignments"
+        )
+
+    def apply_assignment(
+        self, moves: Dict[str, Dict[int, List[int]]]
+    ) -> None:
+        from ..errors import ExecuteError
+
+        if not self.supports_execution():
+            raise ExecuteError(
+                "this Kafka AdminClient cannot execute reassignments "
+                "(no KIP-455 alter_partition_reassignments support); "
+                "execute against the zk:// backend instead"
+            )
+        counter_add("zk.writes")
+        if self._faults is not None \
+                and self._faults.write_attempt() == "lost":
+            return
+        # Duck-typed KIP-455 call: {(topic, partition): [target replicas]}.
+        with hist_ms("zk.op_ms"):
+            self._admin.alter_partition_reassignments({
+                (t, int(p)): [int(r) for r in reps]
+                for t, parts in moves.items()
+                for p, reps in parts.items()
+            })
+
+    def read_assignment_state(
+        self, topics: Sequence[str]
+    ) -> Dict[str, Dict[int, PartitionState]]:
+        """Convergence poll over the AdminClient metadata: both client
+        impls DO expose per-partition ISR (confluent ``isrs``, kafka-python
+        describe ``isr``), so the engine gets the real in-sync signal even
+        where racks are invisible. The ``converge`` stall scope lives on
+        the snapshot backend only — it freezes PENDING state, and this
+        backend holds none; blanking the result here would misfire the
+        engine's plan/verify reads as fatal failures instead of a retried
+        poll. The ``reply`` scope still covers this RPC's failure modes."""
+        self._fault_reply()
+        unique = list(dict.fromkeys(topics))
+        out: Dict[str, Dict[int, PartitionState]] = {}
+        if self._impl == "confluent":
+            with hist_ms("zk.op_ms"):
+                md = self._admin.list_topics(timeout=10)
+            for t in unique:
+                tmeta = md.topics.get(t)
+                if tmeta is None:
+                    continue
+                out[t] = {
+                    int(p): PartitionState(
+                        [int(r) for r in pm.replicas],
+                        [int(r) for r in getattr(
+                            pm, "isrs", pm.replicas
+                        )],
+                    )
+                    for p, pm in tmeta.partitions.items()
+                }
+            return out
+        try:
+            with hist_ms("zk.op_ms"):
+                described = self._admin.describe_topics(unique)
+        except Exception as e:
+            if not self._is_unknown_topic(e):
+                raise
+            # One vanished topic must not blank the whole poll (the engine
+            # would read that as EVERY wave partition unconverged / every
+            # verify entry mismatched): probe per topic, like the
+            # skip-missing ingest lane, and omit only the vanished ones.
+            described = []
+            for t in unique:
+                try:
+                    described.extend(self._admin.describe_topics([t]))
+                except Exception as per_topic_err:
+                    if not self._is_unknown_topic(per_topic_err):
+                        raise
+        for t in described:
+            out[t["topic"]] = {
+                int(p["partition"]): PartitionState(
+                    [int(r) for r in p["replicas"]],
+                    [int(r) for r in p.get("isr", p["replicas"])],
+                )
+                for p in t["partitions"]
+            }
+        return out
 
     def close(self) -> None:
         if self._impl == "kafka-python":

@@ -13,14 +13,26 @@ metadata so the CLI, tests, and batched what-if sweeps run without a cluster:
 
 ``rack`` is optional per broker, mirroring ``broker.rack().isDefined()``
 (``KafkaAssignmentGenerator.java:122-124``).
+
+Plan execution (ISSUE 7): the snapshot backend is also the hermetic test
+cluster for the write path. ``apply_assignment`` records submitted moves as
+*pending*; each ``read_assignment_state`` poll ticks a deterministic
+convergence countdown (``KA_EXEC_SIM_POLLS`` polls per move — the stand-in
+for replica catch-up time), after which the move is applied to the
+in-memory assignment AND persisted back to the snapshot file (atomic
+tmp+rename), so a killed-and-resumed ``ka-execute`` run observes exactly
+what a real cluster would: converged waves survive the crash, in-flight
+ones do not. The write-seam fault hooks (``write``/``converge`` scopes,
+``faults/inject.py``) fire here like on any live backend.
 """
 from __future__ import annotations
 
 import json
 from typing import Dict, Iterator, List, Sequence, Tuple
 
+from ..faults.inject import active_injector
 from ..obs.metrics import counter_add
-from .base import BrokerInfo
+from .base import BrokerInfo, PartitionState
 
 
 class SnapshotBackend:
@@ -47,6 +59,12 @@ class SnapshotBackend:
             topic: {int(p): [int(x) for x in replicas] for p, replicas in parts.items()}
             for topic, parts in data.get("topics", {}).items()
         }
+        # Simulated-convergence execution state (module docstring): pending
+        # moves and their remaining poll countdowns. Resolved once per
+        # backend so a run's fault schedule is coherent.
+        self._pending: Dict[Tuple[str, int], List[int]] = {}
+        self._pending_polls: Dict[Tuple[str, int], int] = {}
+        self._faults = active_injector()
 
     def brokers(self) -> List[BrokerInfo]:
         return list(self._brokers)
@@ -82,6 +100,81 @@ class SnapshotBackend:
             raise KeyError(f"topics not in snapshot: {missing}")
         return {t: {p: list(r) for p, r in self._topics[t].items()} for t in topics}
 
+    # -- plan execution surface (simulated convergence; module docstring) --
+
+    def supports_execution(self) -> bool:
+        return True
+
+    def apply_assignment(
+        self, moves: Dict[str, Dict[int, List[int]]]
+    ) -> None:
+        from ..utils.env import env_int
+
+        # The write seam: `write:i=drop` raises before anything applies;
+        # `write:i=lost` acks the call but records nothing (the quorum
+        # member died after the ack) — the convergence poll must time out.
+        lost = False
+        if self._faults is not None:
+            lost = self._faults.write_attempt() == "lost"
+        counter_add("zk.writes")
+        unknown = [t for t in moves if t not in self._topics]
+        if unknown:
+            raise KeyError(f"topics not in snapshot: {unknown}")
+        if lost:
+            return
+        sim_polls = env_int("KA_EXEC_SIM_POLLS")
+        for t, parts in moves.items():
+            for p, reps in parts.items():
+                key = (t, int(p))
+                self._pending[key] = [int(r) for r in reps]
+                self._pending_polls[key] = sim_polls
+        # Idempotent by construction: resubmitting a move just restarts its
+        # countdown; a move already applied re-applies to the same value.
+
+    def read_assignment_state(
+        self, topics: Sequence[str]
+    ) -> Dict[str, Dict[int, PartitionState]]:
+        # `converge:i=stall` freezes ONE poll: countdowns do not tick and
+        # already-due moves stay invisible — exactly a busy controller.
+        stalled = self._faults is not None and self._faults.converge_poll()
+        if not stalled:
+            applied = False
+            for key in sorted(self._pending_polls):
+                if self._pending_polls[key] > 0:
+                    self._pending_polls[key] -= 1
+                    continue
+                t, p = key
+                self._topics[t][p] = self._pending.pop(key)
+                del self._pending_polls[key]
+                applied = True
+            if applied:
+                self._persist()
+        return {
+            t: {
+                p: PartitionState(list(r), list(r))
+                for p, r in self._topics[t].items()
+            }
+            for t in dict.fromkeys(topics)
+            if t in self._topics
+        }
+
+    def _persist(self) -> None:
+        """Write the applied assignment back to the snapshot file
+        (``write_snapshot`` is atomic + fsync'd): a converged wave must
+        survive a crash exactly like a real cluster's state does.
+        Unwritable snapshots (read-only fixture paths) degrade loudly —
+        the in-memory state is still correct for this process."""
+        import sys
+
+        try:
+            write_snapshot(self.path, self._brokers, self._topics)
+        except OSError as e:
+            print(
+                f"kafka-assigner: snapshot persist failed for "
+                f"{self.path!r} ({e}); converged state is in-memory only",
+                file=sys.stderr,
+            )
+
     def close(self) -> None:
         pass
 
@@ -91,7 +184,12 @@ def write_snapshot(
     brokers: Sequence[BrokerInfo],
     topics: Dict[str, Dict[int, List[int]]],
 ) -> None:
-    """Serialize cluster metadata to a snapshot file (inverse of the loader)."""
+    """Serialize cluster metadata to a snapshot file (inverse of the
+    loader). Atomic + fsync'd (``utils/atomicwrite.py``): the execution
+    engine persists converged waves through this, and a torn or
+    un-synced snapshot would be a corrupted "cluster" after a crash."""
+    from ..utils.atomicwrite import atomic_write_text
+
     data = {
         "brokers": [
             {
@@ -107,6 +205,6 @@ def write_snapshot(
             for t, parts in topics.items()
         },
     }
-    with open(path, "w", encoding="utf-8") as f:
-        # kalint: disable=KA005 -- snapshot capture file, not a byte-compat plan payload
-        json.dump(data, f, indent=1)
+    # kalint: disable=KA005 -- snapshot capture file, not a byte-compat plan payload
+    atomic_write_text(path, json.dumps(data, indent=1),
+                      prefix=".ka_snapshot_")

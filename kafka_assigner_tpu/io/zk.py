@@ -16,16 +16,23 @@ covers every offline use.
 from __future__ import annotations
 
 import json
+import time
 from collections import deque
-from typing import Dict, Iterator, List, Sequence, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
+from ..faults.inject import active_injector
 from ..obs.metrics import counter_add, gauge_set
 from ..obs.trace import span
-from .base import BrokerInfo
+from .base import BrokerInfo, PartitionState
 
 # Session/connect timeouts follow the reference: new ZkClient(zk, 10000, 10000)
 # (KafkaAssignmentGenerator.java:273-274).
 ZK_TIMEOUT_S = 10.0
+
+#: Kafka's classic reassignment protocol znode: the controller watches it,
+#: executes the replica moves it describes, and deletes it when every
+#: partition has caught up — one reassignment in flight at a time.
+ADMIN_REASSIGN_PATH = "/admin/reassign_partitions"
 
 
 def _resolve_endpoint(meta: dict, broker_id: str) -> tuple:
@@ -71,6 +78,16 @@ class ZkBackend:
                     ) from None
         if client_cls is None:
             from .zkwire import MiniZkClient as client_cls
+        # Fault-injection wiring (ISSUE 7 satellite): the in-tree wire
+        # client hooks the injector at its own socket seams; any OTHER
+        # client (kazoo) gets the backend-level twin hooks here, so the
+        # same KA_FAULTS_SPEC schedule fires regardless of client. The
+        # write/converge seams are backend-level for every client.
+        self._wire = client_cls.__module__.endswith("zkwire")
+        self._faults = active_injector()
+        self._binj = None if self._wire else self._faults
+        if self._binj is not None:
+            self._binj.connect_attempt()  # kazoo's connect seam
         self._zk = client_cls(hosts=connect_string, timeout=ZK_TIMEOUT_S)
         self._zk.start(timeout=ZK_TIMEOUT_S)
 
@@ -79,6 +96,16 @@ class ZkBackend:
         """True for any client's missing-znode error — the wire client's
         ``NoNodeError`` or kazoo's (matched by name: kazoo may be absent)."""
         return type(e).__name__ == "NoNodeError"
+
+    def _fault_reply(self) -> None:
+        """Backend-level ``reply``-scope hook for clients that never expose
+        raw frames (kazoo): no-op for the wire client, which injects at the
+        socket layer itself (no double-firing). ``getattr``: duck-typed
+        harnesses build this backend without ``__init__`` (``__new__`` plus
+        a fake client), and they get the plain no-op."""
+        binj = getattr(self, "_binj", None)
+        if binj is not None:
+            binj.backend_reply()
 
     def _iter_gets(
         self, paths: Sequence[str], missing_ok: bool = False
@@ -115,6 +142,7 @@ class ZkBackend:
 
             def _resolve(handle):
                 try:
+                    self._fault_reply()
                     return handle.get(timeout=ZK_TIMEOUT_S)
                 except Exception as e:
                     if missing_ok and self._is_nonode(e):
@@ -131,7 +159,33 @@ class ZkBackend:
             return
         for path in paths:
             try:
+                self._fault_reply()
                 yield self._zk.get(path)
+            except Exception as e:
+                if missing_ok and self._is_nonode(e):
+                    yield None
+                else:
+                    raise
+
+    def _iter_children(
+        self, paths: Sequence[str], missing_ok: bool = False
+    ) -> Iterator[Optional[List[str]]]:
+        """Child listings per path, in path order — the ``getChildren``
+        fan-out pipelined through the wire client's xid-matched window
+        (``iter_children``; same replay contract as ``iter_get``). Kazoo
+        and other duck-typed clients degrade to serial calls (kazoo
+        pipelines internally on its connection thread). Under
+        ``missing_ok`` a missing znode yields ``None`` at its position."""
+        if not paths:
+            return
+        iter_children = getattr(self._zk, "iter_children", None)
+        if iter_children is not None:
+            yield from iter_children(paths, missing_ok=missing_ok)
+            return
+        for path in paths:
+            try:
+                self._fault_reply()
+                yield self._zk.get_children(path)
             except Exception as e:
                 if missing_ok and self._is_nonode(e):
                     yield None
@@ -141,6 +195,7 @@ class ZkBackend:
     def brokers(self) -> List[BrokerInfo]:
         out = []
         with span("zk/brokers"):
+            self._fault_reply()
             children = sorted(self._zk.get_children("/brokers/ids"), key=int)
             counter_add("zk.reads")
             paths = [f"/brokers/ids/{bid}" for bid in children]
@@ -159,6 +214,7 @@ class ZkBackend:
 
     def all_topics(self) -> List[str]:
         counter_add("zk.reads")
+        self._fault_reply()
         return sorted(self._zk.get_children("/brokers/topics"))
 
     def fetch_topics(
@@ -198,6 +254,118 @@ class ZkBackend:
             for topic, parts in self.fetch_topics(topics):
                 out[topic] = parts
         return out
+
+    # -- plan execution surface (ISSUE 7) ---------------------------------
+
+    def supports_execution(self) -> bool:
+        return True
+
+    def apply_assignment(
+        self, moves: Dict[str, Dict[int, List[int]]]
+    ) -> None:
+        """Submit one wave through Kafka's classic reassignment protocol:
+        create ``/admin/reassign_partitions`` carrying the wave's target in
+        Kafka's own reassignment JSON; the controller moves the replicas
+        and deletes the znode when every partition caught up. One request
+        may be in flight at a time, so an existing znode (the previous
+        wave's tail, another operator) is WAITED out within the poll
+        budget, then ours is created. Idempotent: re-creating the same
+        target after a crash re-describes moves the controller has already
+        applied (set-to-same-value no-ops)."""
+        from ..errors import ExecuteError
+        from ..utils.env import env_float
+        from .json_io import format_reassignment_json
+
+        payload = format_reassignment_json(
+            moves, topic_order=list(moves)
+        ).encode("utf-8")
+        counter_add("zk.writes")
+        # The write seam (faults/inject.py): `drop` raises before anything
+        # reaches the quorum; `lost` acks without applying.
+        if self._faults is not None \
+                and self._faults.write_attempt() == "lost":
+            return
+        deadline = time.monotonic() + env_float("KA_EXEC_POLL_TIMEOUT")
+        interval = env_float("KA_EXEC_POLL_INTERVAL")
+        while True:
+            if self._zk.exists(ADMIN_REASSIGN_PATH) is None:
+                try:
+                    self._zk.create(
+                        ADMIN_REASSIGN_PATH, payload, makepath=True
+                    )
+                    return
+                except Exception as e:
+                    # Lost the create race (another writer, or the
+                    # controller re-created state): wait and retry. Any
+                    # other error propagates.
+                    if type(e).__name__ != "NodeExistsError":
+                        raise
+            if time.monotonic() >= deadline:
+                raise ExecuteError(
+                    "a partition reassignment is already in flight "
+                    f"({ADMIN_REASSIGN_PATH} never cleared within the poll "
+                    "budget); re-run with --resume once it completes"
+                )
+            time.sleep(
+                min(interval, max(0.0, deadline - time.monotonic()))
+            )
+
+    def read_assignment_state(
+        self, topics: Sequence[str]
+    ) -> Dict[str, Dict[int, PartitionState]]:
+        """Convergence poll: assigned replicas from the topic znodes plus
+        the in-sync subset from the per-partition ``state`` znodes — the
+        children fan-out and the state reads both pipelined through the
+        xid-matched window. Clusters (or fixtures) without the
+        ``partitions/<p>/state`` layout degrade to ``isr == replicas``
+        (``missing_ok`` yields ``None`` per absent znode, never an
+        error)."""
+        unique = list(dict.fromkeys(topics))
+        replicas: Dict[str, Dict[int, List[int]]] = {}
+        for t, parts in self.fetch_topics(unique, missing="skip"):
+            if parts is not None:
+                replicas[t] = parts
+        present = [t for t in unique if t in replicas]
+        # getChildren fan-out, one pipelined window across all topics.
+        kid_paths = [f"/brokers/topics/{t}/partitions" for t in present]
+        isr: Dict[Tuple[str, int], List[int]] = {}
+        keys: List[Tuple[str, int]] = []
+        state_paths: List[str] = []
+        for t, kids in zip(
+            present, self._iter_children(kid_paths, missing_ok=True)
+        ):
+            for kid in kids or ():
+                if not kid.lstrip("-").isdigit():
+                    continue
+                p = int(kid)
+                if p in replicas[t]:
+                    keys.append((t, p))
+                    state_paths.append(
+                        f"/brokers/topics/{t}/partitions/{kid}/state"
+                    )
+        for (t, p), res in zip(
+            keys, self._iter_gets(state_paths, missing_ok=True)
+        ):
+            if res is None:
+                continue
+            raw, _ = res
+            counter_add("zk.reads")
+            counter_add("zk.bytes", len(raw))
+            try:
+                got = json.loads(raw).get("isr")
+            except ValueError:  # kalint: disable=KA008 -- unparsable state znode: the replicas-as-isr fallback below IS the handling
+                continue
+            if isinstance(got, list):
+                isr[(t, p)] = [int(x) for x in got]
+        return {
+            t: {
+                p: PartitionState(
+                    list(reps), isr.get((t, p), list(reps))
+                )
+                for p, reps in parts.items()
+            }
+            for t, parts in replicas.items()
+        }
 
     def close(self) -> None:
         self._zk.stop()

@@ -15,7 +15,7 @@ protocol (ZooKeeper's jute serialization, unchanged since 3.0):
   ``ReplyHeader{xid, zxid, err}`` responses;
 - ``closeSession`` (type -11).
 
-No watches, no ephemerals, no writes, no reconnects: the CLI opens a
+No watches, no ephemerals, no reconnect-transparent writes: the CLI opens a
 session, reads the broker/topic znodes, and closes. The reference's 10 s
 timeout bounds each connect attempt and each in-session read; session
 ESTABLISHMENT may retry up to ``KA_ZK_CONNECT_RETRIES`` loudly-warned
@@ -48,6 +48,22 @@ retried — a missing znode on a healthy session is an answer, not a fault.
 The fault-injection harness (``faults/inject.py``, ``KA_FAULTS_SPEC``)
 hooks this client at the connect/handshake/reply seams to drive exactly
 these paths deterministically.
+
+Writes (ISSUE 7, the plan execution engine): the client now speaks the
+four mutation opcodes the reassignment write path needs — ``create``
+(type 1), ``delete`` (type 2), ``exists`` (type 3, a read) and ``setData``
+(type 5) — under a STRICTER safety rule than the reads, because a write is
+not idempotent-by-observation: after a transport failure the socket state
+is unknown and the request may or may not have been applied. Writes are
+therefore (a) NEVER pipelined — each goes through the serial
+:meth:`MiniZkClient._write_call` path, one request/one reply (kalint rule
+KA010 machine-checks that the write opcodes never reach the windowed
+helpers) — and (b) NEVER blindly replayed after session re-establishment:
+on a transport error the client reconnects, READS the server state back
+(a caller-supplied ``landed`` probe: does the node exist / carry the
+written bytes?), and re-issues only when the write provably did not land.
+Server-reported errors (``NodeExistsError``, ``NoNodeError``, bad version)
+propagate untouched — they are answers, not faults.
 """
 from __future__ import annotations
 
@@ -61,16 +77,34 @@ from typing import Iterator, List, NamedTuple, Optional, Sequence, Tuple
 from ..faults.inject import active_injector
 from ..obs.metrics import counter_add, gauge_set, hist_observe, hist_ms
 
-#: ZooKeeper opcodes (zookeeper.ZooDefs.OpCode).
+#: ZooKeeper opcodes (zookeeper.ZooDefs.OpCode). The three WRITE opcodes
+#: (create/delete/setData) are restricted to the serial write path — see the
+#: module docstring's write-safety rule and kalint rule KA010.
+OP_CREATE = 1
+OP_DELETE = 2
+OP_EXISTS = 3
 OP_GET_DATA = 4
+OP_SET_DATA = 5
 OP_GET_CHILDREN = 8
 OP_PING = 11
 OP_CLOSE = -11
 
 #: KeeperException codes.
 ERR_NONODE = -101
+ERR_NODEEXISTS = -110
+ERR_BADVERSION = -103
 
 PING_XID = -2
+
+#: world:anyone open ACL (ZooDefs.Ids.OPEN_ACL_UNSAFE) — the only ACL the
+#: reassignment admin znode needs; vector of one ACL{perms=ALL(31),
+#: Id{scheme="world", id="anyone"}}.
+_OPEN_ACL = (
+    struct.pack(">i", 1)
+    + struct.pack(">i", 31)
+    + struct.pack(">i", 5) + b"world"
+    + struct.pack(">i", 6) + b"anyone"
+)
 
 
 class ZkWireError(RuntimeError):
@@ -87,6 +121,17 @@ class ZkConnectionError(ZkWireError):
 
 class NoNodeError(ZkWireError):
     """The requested znode does not exist (KeeperException.NoNode)."""
+
+
+class NodeExistsError(ZkWireError):
+    """The znode a ``create`` targeted already exists
+    (KeeperException.NodeExists) — for the reassignment admin znode this
+    means another reassignment is still in flight."""
+
+
+class BadVersionError(ZkWireError):
+    """A versioned write lost its compare-and-set race
+    (KeeperException.BadVersion) — somebody else mutated the znode."""
 
 
 class ZnodeStat(NamedTuple):
@@ -166,9 +211,25 @@ def parse_hosts(connect_string: str) -> Tuple[List[Tuple[str, int]], str]:
     return endpoints, chroot
 
 
+def _decode_get(r: _Reader) -> Tuple[bytes, ZnodeStat]:
+    """getData reply body: data buffer + stat."""
+    data = r.read_buffer() or b""
+    return data, r.read_stat()
+
+
+def _decode_children(r: _Reader) -> List[str]:
+    """getChildren reply body: vector of child names."""
+    count = r.read_int()
+    if count < 0:
+        return []
+    return [r.read_str() for _ in range(count)]
+
+
 class MiniZkClient:
     """Duck-type of the ``kazoo.client.KazooClient`` surface ``ZkBackend``
-    uses: ``start`` / ``get_children`` / ``get`` / ``stop`` / ``close``."""
+    uses: ``start`` / ``get_children`` / ``get`` / ``stop`` / ``close`` —
+    plus the write subset the plan execution engine needs (``create`` /
+    ``set`` / ``delete`` / ``exists``, kazoo-compatible signatures)."""
 
     def __init__(self, hosts: str, timeout: float = 10.0) -> None:
         self._endpoints, self._chroot = parse_hosts(hosts)
@@ -342,6 +403,10 @@ class MiniZkClient:
             )
         if err == ERR_NONODE:
             raise NoNodeError(f"znode does not exist (err {err})")
+        if err == ERR_NODEEXISTS:
+            raise NodeExistsError(f"znode already exists (err {err})")
+        if err == ERR_BADVERSION:
+            raise BadVersionError(f"znode version mismatch (err {err})")
         if err != 0:
             raise ZkWireError(f"ZooKeeper error {err}")
         return r
@@ -370,10 +435,17 @@ class MiniZkClient:
         r = self._call(
             OP_GET_CHILDREN, _pack_str(self._path(path)) + b"\x00"
         )
-        count = r.read_int()
-        if count < 0:
-            return []
-        return [r.read_str() for _ in range(count)]
+        return _decode_children(r)
+
+    def exists(self, path: str) -> Optional[ZnodeStat]:
+        """``exists`` (type 3): the znode's stat, or ``None`` when absent —
+        a READ (NoNode is the answer, not an error), and the write path's
+        read-back probe."""
+        try:
+            r = self._call(OP_EXISTS, _pack_str(self._path(path)) + b"\x00")
+        except NoNodeError:
+            return None
+        return r.read_stat()
 
     def get(self, path: str) -> Tuple[bytes, ZnodeStat]:
         r = self._call(OP_GET_DATA, _pack_str(self._path(path)) + b"\x00")
@@ -424,6 +496,26 @@ class MiniZkClient:
         client — the streaming ingest hands the whole client to its producer
         thread for the duration of the batch.
         """
+        yield from self._iter_pipelined(paths, missing_ok, OP_GET_DATA,
+                                        _decode_get)
+
+    def iter_children(
+        self, paths: Sequence[str], missing_ok: bool = False
+    ) -> Iterator[Optional[List[str]]]:
+        """Pipelined ``getChildren`` over the session socket — the same
+        xid-matched window, replay and failure contract as :meth:`iter_get`
+        (ISSUE 7 satellite: the per-topic ``partitions`` children fan-out of
+        the convergence poll was the last serial read loop). Yields the
+        child-name list per path in request order; under ``missing_ok`` a
+        missing znode yields ``None`` at its position."""
+        yield from self._iter_pipelined(paths, missing_ok, OP_GET_CHILDREN,
+                                        _decode_children)
+
+    def _iter_pipelined(self, paths, missing_ok, op, decode):
+        """The shared pipelined-read driver behind :meth:`iter_get` and
+        :meth:`iter_children`: the window/replay loop, parameterized only by
+        READ opcode + body decoder. Write opcodes must never reach this path
+        (the module write-safety rule; kalint KA010)."""
         if self._sock is None:
             raise ZkWireError("ZooKeeper session is not started")
         from ..utils.env import env_int
@@ -438,7 +530,8 @@ class MiniZkClient:
         yielded = 0
         attempt = 0
         while yielded < n:
-            inner = self._iter_get_window(paths, yielded, window, missing_ok)
+            inner = self._iter_window(paths, yielded, window, missing_ok,
+                                      op, decode)
             try:
                 try:
                     for res in inner:
@@ -466,20 +559,23 @@ class MiniZkClient:
                     raise
                 self._reconnect(attempt, retries, e)
 
-    def _iter_get_window(
+    def _iter_window(
         self,
         paths: Sequence[str],
         start: int,
         window: int,
         missing_ok: bool,
-    ) -> Iterator[Optional[Tuple[bytes, ZnodeStat]]]:
+        op: int,
+        decode,
+    ) -> Iterator[object]:
         """One session's attempt at positions ``start..n-1`` of a pipelined
-        batch (the replay loop in :meth:`iter_get` re-enters here after a
-        reconnect). Yields results in position order; transport failures
-        raise :class:`ZkConnectionError`/``OSError`` to the replay loop."""
+        batch (the replay loop in :meth:`_iter_pipelined` re-enters here
+        after a reconnect). Yields results in position order; transport
+        failures raise :class:`ZkConnectionError`/``OSError`` to the replay
+        loop."""
         n = len(paths)
         pending: dict = {}   # xid -> request position
-        ready: dict = {}     # position -> (data, stat) | None | ZkWireError
+        ready: dict = {}     # position -> decoded result | None | ZkWireError
         sent = start
         yielded = start
         failed = False       # stop filling the window once an error lands
@@ -489,7 +585,7 @@ class MiniZkClient:
                 while sent < n and len(pending) < window and not failed:
                     self._xid += 1
                     self._send_frame(
-                        struct.pack(">ii", self._xid, OP_GET_DATA)
+                        struct.pack(">ii", self._xid, op)
                         + _pack_str(self._path(paths[sent])) + b"\x00"
                     )
                     pending[self._xid] = sent
@@ -532,8 +628,7 @@ class MiniZkClient:
                         )
                         failed = True
                     else:
-                        data = r.read_buffer() or b""
-                        ready[pos] = (data, r.read_stat())
+                        ready[pos] = decode(r)
                 while yielded in ready:
                     res = ready[yielded]
                     if isinstance(res, ZkWireError):
@@ -563,6 +658,140 @@ class MiniZkClient:
         """Batch primitive over :meth:`iter_get`: all results at once, in
         request order (``None`` per missing path under ``missing_ok``)."""
         return list(self.iter_get(paths, missing_ok=missing_ok))
+
+    # -- writes (serial only; never pipelined, never blindly replayed) -----
+
+    def _write_call(self, op: int, payload: bytes, landed):
+        """The serial write RPC under the module write-safety rule. One
+        request, one reply, never inside a pipelined window (kalint KA010).
+
+        On a TRANSPORT failure the socket state is unknown — the request may
+        or may not have been applied server-side — so unlike the read path
+        this never blindly re-issues: it re-establishes the session, calls
+        the ``landed`` probe (a read against the fresh session: does the
+        server already show this write's effect?), and only re-sends when
+        the write provably did not land. Returns the reply reader, or
+        ``None`` when the landed-probe confirmed the effect (the reply bytes
+        were lost with the old socket). Server-reported errors (NodeExists,
+        NoNode, BadVersion) propagate untouched — they are answers."""
+        if self._sock is None:
+            raise ZkWireError("ZooKeeper session is not started")
+        from ..utils.env import env_int
+
+        retries = env_int("KA_ZK_SESSION_RETRIES")
+        attempt = 0
+        while True:
+            self._xid += 1
+            xid = self._xid
+            try:
+                # zk.writes is owned by the BACKEND layer (one count per
+                # wave submission on every backend, comparable across
+                # them); this layer's frame counters already account the
+                # wire traffic.
+                with hist_ms("zk.op_ms"):
+                    return self._call_inner(op, xid, payload)
+            except (OSError, ZkConnectionError) as e:
+                attempt += 1
+                if attempt > retries:
+                    raise
+                self._reconnect(attempt, retries, e)
+                # Read-back, then decide (NEVER replay blind): the probe
+                # runs on the fresh session through the ordinary retrying
+                # read path.
+                if landed():
+                    counter_add("zk.write_readback_confirmed")
+                    print(
+                        "kafka-assigner: write reply lost with the session "
+                        "but the read-back shows it landed; not re-issuing",
+                        file=sys.stderr,
+                    )
+                    return None
+
+    def create(self, path: str, value: bytes = b"", makepath: bool = False,
+               **_kazoo_compat) -> str:
+        """Create a plain persistent znode (kazoo-compatible surface,
+        including ``makepath``; the world:anyone ACL the admin znodes use).
+        The landed probe treats "exists with exactly the written bytes" as
+        success — an existing node with OTHER bytes re-raises the server's
+        NodeExists on the re-issue, exactly like an uninterrupted race
+        would."""
+        full = self._path(path)
+
+        def _landed() -> bool:
+            try:
+                data, _ = self.get(path)
+            except NoNodeError:
+                return False
+            return data == value
+
+        if makepath:
+            # kazoo semantics: materialize missing parents first (empty
+            # persistent znodes; a parent created by somebody else in the
+            # meantime is fine). Serial creates, shallowest first. Parents
+            # are probed/created on the already-chrooted full path, so the
+            # raw exists opcode is used instead of the chroot-prefixing
+            # public surface.
+            segs = full.strip("/").split("/")[:-1]
+            parent = ""
+            for seg in segs:
+                parent = f"{parent}/{seg}"
+
+                def _parent_landed(p: str = parent) -> bool:
+                    try:
+                        r = self._call(OP_EXISTS, _pack_str(p) + b"\x00")
+                    except NoNodeError:
+                        return False
+                    r.read_stat()
+                    return True
+
+                try:
+                    if not _parent_landed():
+                        self._write_call(
+                            OP_CREATE,
+                            _pack_str(parent) + _pack_buffer(b"")
+                            + _OPEN_ACL + struct.pack(">i", 0),
+                            _parent_landed,
+                        )
+                except NodeExistsError:  # kalint: disable=KA008 -- lost a benign parent-create race; the parent exists, which is the goal
+                    pass
+        payload = (
+            _pack_str(full) + _pack_buffer(value) + _OPEN_ACL
+            + struct.pack(">i", 0)  # flags: persistent, non-sequential
+        )
+        r = self._write_call(OP_CREATE, payload, _landed)
+        return r.read_str() if r is not None else full
+
+    def set_data(self, path: str, value: bytes,
+                 version: int = -1) -> Optional[ZnodeStat]:
+        """``setData`` with kazoo's ``set`` semantics (version -1 = any).
+        Landed probe: the znode now carries exactly the written bytes."""
+
+        def _landed() -> bool:
+            try:
+                data, _ = self.get(path)
+            except NoNodeError:
+                return False
+            return data == value
+
+        payload = (
+            _pack_str(self._path(path)) + _pack_buffer(value)
+            + struct.pack(">i", version)
+        )
+        r = self._write_call(OP_SET_DATA, payload, _landed)
+        return r.read_stat() if r is not None else None
+
+    #: kazoo duck-type alias (``KazooClient.set``).
+    set = set_data
+
+    def delete(self, path: str, version: int = -1,
+               **_kazoo_compat) -> None:
+        """Delete a znode. Landed probe: the znode is gone."""
+
+        def _landed() -> bool:
+            return self.exists(path) is None
+
+        payload = _pack_str(self._path(path)) + struct.pack(">i", version)
+        self._write_call(OP_DELETE, payload, _landed)
 
     # -- teardown ---------------------------------------------------------
 

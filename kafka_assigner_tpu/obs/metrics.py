@@ -20,7 +20,12 @@ Namespace conventions (documented in the README "Observability" section):
   counters, loads/compiles ms histograms — the run report's cold-vs-warm
   compile attribution, ``utils/programstore.py``);
 - ``warmup.*``  ingest-overlapped warm-up outcomes per program
-  (warmed/hit/jit/error) and ``warmup.failures`` for crashed warm-ups.
+  (warmed/hit/jit/error) and ``warmup.failures`` for crashed warm-ups;
+- ``exec.*``    plan execution engine (``exec/engine.py``): ``exec.waves``/
+  ``exec.moves`` submitted, ``exec.retries`` convergence re-polls,
+  ``exec.write_retries`` read-back-then-resubmit cycles, ``exec.skipped``
+  best-effort unconverged moves, ``exec.verify`` verify-after-move passes,
+  plus the ``exec.wave_ms`` wave-latency histogram.
 
 Histogram bucket upper edges come from ``KA_OBS_HIST_EDGES`` (ms for timing
 histograms); one shared edge set keeps reports comparable across runs.

@@ -1,0 +1,32 @@
+"""One atomic-write discipline for every crash-safety-critical file.
+
+The journal, the snapshot backend's persisted cluster state, and the
+program store all depend on the same property: a reader can NEVER observe
+a torn file, only the state before or after a write. The recipe is
+same-directory mkstemp (so the final rename never crosses a filesystem),
+write + flush + fsync (the rename must not land before the bytes do), then
+``os.replace``, with the temp file unlinked on any failure. Centralized
+here so the fsync subtlety cannot silently diverge between copies.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+def atomic_write_text(path: str, text: str, *, prefix: str = ".ka_") -> None:
+    """Atomically replace ``path`` with ``text`` (UTF-8)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(prefix=prefix, suffix=".tmp", dir=d)
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:  # kalint: disable=KA008 -- cleanup of a temp file that may already be gone
+            pass
+        raise

@@ -240,9 +240,9 @@ _knob(
     "KA_FAULTS_SPEC", "str", None, default_doc="unset (no injection)",
     doc="fault-injection schedule for the harness in `faults/inject.py`: "
         "semicolon-separated `scope:index=kind[:arg]` events "
-        "(scopes connect/handshake/reply/solve/warmup; kinds blackhole, "
-        "expire, drop, trunc, slow, nonode, crash), or the word `random` "
-        "for a "
+        "(scopes connect/handshake/reply/solve/warmup plus the write seams "
+        "write/converge/wave; kinds blackhole, expire, drop, trunc, slow, "
+        "nonode, crash, lost, stall), or the word `random` for a "
         "seed-deterministic schedule (`KA_FAULTS_SEED`/`KA_FAULTS_RATE`). "
         "Malformed specs are ignored loudly and injection stays off",
 )
@@ -256,6 +256,56 @@ _knob(
     doc="per-hook fault probability for `KA_FAULTS_SPEC=random` schedules "
         "(drawn over the first few dozen indexes of each scope; see "
         "`faults/inject.py:RANDOM_HORIZON`)",
+)
+
+# --- plan execution (ka-execute) ---------------------------------------------
+_knob(
+    "KA_EXEC_WAVE_SIZE", "int", 8, floor=1,
+    doc="partition moves per execution wave (`exec/engine.py`): `ka-execute` "
+        "submits the plan in waves of this many moves, awaiting ISR/URP "
+        "convergence between waves — the reassignment throttle that keeps "
+        "replication traffic bounded (the wave-sizing tradeoff of "
+        "arXiv:1602.03770); the `--wave-size` flag overrides per run",
+)
+_knob(
+    "KA_EXEC_THROTTLE", "float", 0.0, floor=0.0,
+    doc="seconds to pause between converged waves (`--throttle` overrides): "
+        "recovery headroom for the cluster between bursts of replica "
+        "movement; 0 (default) submits the next wave immediately",
+)
+_knob(
+    "KA_EXEC_POLL_INTERVAL", "float", 0.5, floor=0.001,
+    doc="initial seconds between convergence polls of the in-flight wave; "
+        "each retry backs off 1.5x with 0.5-1.5x jitter (no thundering herd "
+        "against a recovering controller), capped at a quarter of "
+        "`KA_EXEC_POLL_TIMEOUT`",
+)
+_knob(
+    "KA_EXEC_POLL_TIMEOUT", "float", 600.0, floor=0.1,
+    doc="seconds a wave may take to converge before the engine gives up on "
+        "it: `strict` halts resumably (exit 8, journal keeps every "
+        "committed wave), `best-effort` records the wave's moves as skipped "
+        "and continues (degraded exit 6)",
+)
+_knob(
+    "KA_EXEC_WRITE_RETRIES", "int", 2, floor=0,
+    doc="resubmissions of a wave write after a transport failure, each "
+        "preceded by a state read-back (the write-safety rule: a write is "
+        "NEVER blindly replayed — re-establish, read back, and only "
+        "re-issue when it provably did not land)",
+)
+_knob(
+    "KA_EXEC_SIM_POLLS", "int", 1, floor=0,
+    doc="snapshot-backend simulated convergence: a submitted move becomes "
+        "visible to `read_assignment_state` after this many polls "
+        "(deterministic, hermetic — the harness the write-path chaos soak "
+        "and `scripts/exec_smoke.py` run against); live backends ignore it",
+)
+_knob(
+    "KA_EXEC_JOURNAL", "str", None, default_doc="`<plan path>.journal`",
+    doc="default crash-safe journal path for `ka-execute` (the `--journal` "
+        "flag overrides): atomic tmp+rename commits after each converged "
+        "wave, so a killed run resumes idempotently via `--resume`",
 )
 
 # --- runtime / observability ------------------------------------------------

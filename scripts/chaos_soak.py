@@ -9,6 +9,14 @@ Two modes:
   policies, with a per-class expected-outcome table — self-healing classes
   must stay byte-identical at exit 0, degradation classes must exit with
   the documented code and account for themselves in the run report.
+  Includes the WRITE-path matrix (ISSUE 7): every write-seam fault class
+  (dropped write, write-acked-but-lost, convergence stall, kill at a wave
+  boundary) through ``ka-execute`` against the snapshot backend's
+  simulated-convergence cluster, under both policies — the acceptance
+  invariants are **0 partitions left under-replicated or half-moved**,
+  every interrupted run **resumable via --resume to a final state
+  byte-identical** to an uninterrupted run, and degradations accounted in
+  the run report's ``plan.skipped_moves``.
 
 - ``--runs N`` (default 200; the slow soak, ``tests/test_chaos_soak.py``):
   N randomized seed-deterministic schedules (``KA_FAULTS_SPEC=random``).
@@ -40,11 +48,14 @@ sys.path.insert(0, REPO)
 from kafka_assigner_tpu import faults  # noqa: E402
 from kafka_assigner_tpu.cli import (  # noqa: E402
     EXIT_DEGRADED,
+    EXIT_EXECUTE,
     EXIT_INGEST,
     EXIT_OK,
     EXIT_SOLVE,
+    execute,
     run,
 )
+from kafka_assigner_tpu.faults.inject import InjectedExecCrash  # noqa: E402
 from tests.jute_server import JuteZkServer, cluster_tree  # noqa: E402
 
 #: The deterministic fault matrix: one schedule per fault class. Reply
@@ -218,6 +229,293 @@ def soak_matrix(args, report_dir):
     return failures
 
 
+# ---------------------------------------------------------------------------
+# The write-path matrix (ISSUE 7): ka-execute against the snapshot backend's
+# simulated-convergence cluster, one deterministic fault per write seam.
+# ---------------------------------------------------------------------------
+
+#: (name, spec, {policy: expectation}) — expectations checked per row:
+#:   ok            rc 0, final snapshot byte-identical to the baseline final
+#:   ok-retries    ok + exec.retries >= 1 in the report
+#:   halt-resume   strict halt (exit 8), then --resume to byte-identical
+#:   killed-resume run dies (InjectedExecCrash), then --resume to identical
+#:   degraded      exit 6, plan.skipped_moves accounted, report degraded
+EXEC_MATRIX = [
+    ("write-drop", "write:0=drop",
+     {"strict": "ok", "best-effort": "ok"}),
+    ("write-lost", "write:0=lost",
+     {"strict": "halt-resume", "best-effort": "degraded"}),
+    ("converge-stall", "converge:0=stall",
+     {"strict": "ok-retries", "best-effort": "ok-retries"}),
+    ("wave-crash", "wave:1=crash",
+     {"strict": "killed-resume", "best-effort": "killed-resume"}),
+]
+
+EXEC_ENV = {
+    "KA_EXEC_WAVE_SIZE": "3",
+    "KA_EXEC_POLL_INTERVAL": "0.01",
+    "KA_EXEC_POLL_TIMEOUT": "5",
+    "KA_EXEC_SIM_POLLS": "1",
+}
+
+
+class ExecResult(RunResult):
+    def __init__(self, rc, out, err, wall_s, hung=False, killed=False):
+        super().__init__(rc, out, err, wall_s, hung=hung)
+        self.killed = killed
+
+
+def run_exec(argv, timeout_s):
+    """One in-process ``ka-execute`` run in a watchdog thread. The injected
+    wave-boundary kill (``InjectedExecCrash``) is reported as
+    ``killed=True`` — the supervisor's view of a dead process — instead of
+    an exit code; any other escape re-raises (undocumented crash)."""
+    result = {}
+    out_buf, err_buf = io.StringIO(), io.StringIO()
+
+    def _target():
+        with contextlib.redirect_stdout(out_buf), \
+                contextlib.redirect_stderr(err_buf):
+            try:
+                result["rc"] = execute(argv)
+            except InjectedExecCrash:
+                result["killed"] = True
+            except BaseException as e:
+                result["exc"] = e
+
+    t0 = time.perf_counter()
+    worker = threading.Thread(target=_target, daemon=True)
+    worker.start()
+    worker.join(timeout_s)
+    wall = time.perf_counter() - t0
+    if worker.is_alive():
+        return ExecResult(None, out_buf.getvalue(), err_buf.getvalue(),
+                          wall, hung=True)
+    if "exc" in result:
+        raise result["exc"]
+    return ExecResult(result.get("rc"), out_buf.getvalue(),
+                      err_buf.getvalue(), wall,
+                      killed=result.get("killed", False))
+
+
+def _load_topics(path):
+    with open(path, "r", encoding="utf-8") as f:
+        return {
+            t: {int(p): [int(r) for r in reps] for p, reps in parts.items()}
+            for t, parts in json.load(f)["topics"].items()
+        }
+
+
+def _stranded_partitions(initial, plan, final):
+    """The headline invariant: every partition's replica list is EITHER its
+    complete initial list or its complete planned target — a partial,
+    empty, or mixed list is a stranded partition."""
+    stranded = []
+    for t, parts in final.items():
+        for p, reps in parts.items():
+            legal = [initial.get(t, {}).get(p)]
+            if t in plan and p in plan[t]:
+                legal.append(plan[t][p])
+            if reps not in [x for x in legal if x is not None]:
+                stranded.append((t, p, reps))
+    return stranded
+
+
+def _exec_baseline(report_dir, timeout_s):
+    """Cluster + plan + uninterrupted-execution final state, built once:
+    the byte-identity oracle every matrix row is compared against."""
+    import shutil
+
+    from tests.jute_server import exec_snapshot_cluster
+
+    src = os.path.join(report_dir, "exec_cluster.json")
+    with open(src, "w", encoding="utf-8") as f:
+        # kalint: disable=KA005 -- test-fixture snapshot, not a plan payload
+        json.dump(exec_snapshot_cluster(), f)
+    plan_path = os.path.join(report_dir, "exec_plan.json")
+    set_schedule({})
+    res = run_mode3_plan(src, plan_path, timeout_s)
+    if res is not None:
+        raise SystemExit(f"FAIL: could not produce the exec-matrix plan: "
+                         f"{res}")
+    base = os.path.join(report_dir, "exec_base.json")
+    shutil.copy(src, base)
+    set_schedule(dict(EXEC_ENV))
+    r = run_exec(["--zk_string", base, "--plan", plan_path,
+                  "--journal", os.path.join(report_dir, "exec_base.journal")],
+                 timeout_s)
+    if r.hung or r.killed or r.rc != EXIT_OK:
+        raise SystemExit(
+            f"FAIL: no-fault baseline execution broken (rc={r.rc} "
+            f"hung={r.hung} killed={r.killed})\n{r.err}"
+        )
+    with open(base, "r", encoding="utf-8") as f:
+        return src, plan_path, f.read()
+
+
+def run_mode3_plan(cluster_path, plan_path, timeout_s):
+    """Generate the matrix plan: mode 3 (greedy) removing broker h9;
+    returns None on success, else a failure description."""
+    res = run_mode3_snapshot(cluster_path, timeout_s)
+    if res.hung or res.rc != EXIT_OK or "NEW ASSIGNMENT:" not in res.out:
+        return f"rc={res.rc} hung={res.hung}\n{res.err}"
+    with open(plan_path, "w", encoding="utf-8") as f:
+        f.write(res.out)
+    return None
+
+
+def run_mode3_snapshot(cluster_path, timeout_s):
+    """Mode 3 against a snapshot file (no jute server), watchdogged."""
+    argv = [
+        "--zk_string", cluster_path,
+        "--mode", "PRINT_REASSIGNMENT", "--solver", "greedy",
+        "--broker_hosts_to_remove", "h9",
+    ]
+    result = {}
+    out_buf, err_buf = io.StringIO(), io.StringIO()
+
+    def _target():
+        with contextlib.redirect_stdout(out_buf), \
+                contextlib.redirect_stderr(err_buf):
+            try:
+                result["rc"] = run(argv)
+            except BaseException as e:
+                result["exc"] = e
+
+    worker = threading.Thread(target=_target, daemon=True)
+    worker.start()
+    worker.join(timeout_s)
+    if worker.is_alive():
+        return RunResult(None, out_buf.getvalue(), err_buf.getvalue(),
+                         timeout_s, hung=True)
+    if "exc" in result:
+        raise result["exc"]
+    return RunResult(result["rc"], out_buf.getvalue(), err_buf.getvalue(),
+                     0.0)
+
+
+def soak_exec_matrix(args, report_dir):
+    import shutil
+
+    failures = []
+    src, plan_path, base_final = _exec_baseline(report_dir, args.timeout)
+    initial = _load_topics(src)
+    from kafka_assigner_tpu.exec.engine import load_plan_file
+
+    plan, _ = load_plan_file(plan_path)
+    for name, spec, outcomes in EXEC_MATRIX:
+        for policy, want in outcomes.items():
+            tag = f"exec[{name}/{policy}]"
+            cluster = os.path.join(report_dir, f"exec_{name}_{policy}.json")
+            journal = cluster + ".journal"
+            report_path = os.path.join(
+                report_dir, f"exec_{name}_{policy}_report.json"
+            )
+            shutil.copy(src, cluster)
+            env = dict(EXEC_ENV)
+            if want in ("halt-resume", "degraded"):
+                # The lost-write rows PROVE the poll timeout path; a tight
+                # budget keeps the matrix fast.
+                env["KA_EXEC_POLL_TIMEOUT"] = "0.3"
+            set_schedule(env, spec=spec)
+            res = run_exec(
+                ["--zk_string", cluster, "--plan", plan_path,
+                 "--journal", journal, "--failure-policy", policy,
+                 "--report-json", report_path],
+                args.timeout,
+            )
+            if res.hung:
+                failures.append(f"{tag}: HUNG after {args.timeout}s")
+                continue
+            # Invariant 1, every row: no partition stranded mid-move.
+            stranded = _stranded_partitions(
+                initial, plan, _load_topics(cluster)
+            )
+            if stranded:
+                failures.append(f"{tag}: stranded partitions {stranded}")
+                continue
+            report = load_report(report_path)
+            counters = (report or {}).get("metrics", {}).get("counters", {})
+            if want in ("ok", "ok-retries"):
+                if res.killed or res.rc != EXIT_OK:
+                    failures.append(
+                        f"{tag}: rc={res.rc} killed={res.killed}, "
+                        f"expected clean success\n{res.err}"
+                    )
+                    continue
+                with open(cluster, "r", encoding="utf-8") as f:
+                    if f.read() != base_final:
+                        failures.append(
+                            f"{tag}: final state diverged from baseline"
+                        )
+                        continue
+                if want == "ok-retries" \
+                        and not counters.get("exec.retries"):
+                    failures.append(f"{tag}: expected exec.retries >= 1")
+                    continue
+            elif want == "degraded":
+                if res.killed or res.rc != EXIT_DEGRADED:
+                    failures.append(
+                        f"{tag}: rc={res.rc} killed={res.killed}, expected "
+                        f"degraded {EXIT_DEGRADED}\n{res.err}"
+                    )
+                    continue
+                if report is None or report["status"] != "degraded":
+                    failures.append(f"{tag}: degraded rc without degraded "
+                                    "report")
+                    continue
+                if not report["plan"].get("skipped_moves"):
+                    failures.append(
+                        f"{tag}: degraded run with empty plan.skipped_moves"
+                    )
+                    continue
+            else:  # halt-resume / killed-resume
+                if want == "halt-resume" and (res.killed
+                                              or res.rc != EXIT_EXECUTE):
+                    failures.append(
+                        f"{tag}: rc={res.rc} killed={res.killed}, expected "
+                        f"resumable halt {EXIT_EXECUTE}\n{res.err}"
+                    )
+                    continue
+                if want == "killed-resume" and not res.killed:
+                    failures.append(
+                        f"{tag}: rc={res.rc}, expected the injected "
+                        f"wave-boundary kill\n{res.err}"
+                    )
+                    continue
+                # Invariant 2: the interrupted run resumes to a final state
+                # byte-identical to the uninterrupted baseline.
+                set_schedule(dict(EXEC_ENV))
+                res2 = run_exec(
+                    ["--zk_string", cluster, "--plan", plan_path,
+                     "--journal", journal, "--failure-policy", policy,
+                     "--resume"],
+                    args.timeout,
+                )
+                if res2.hung or res2.killed or res2.rc != EXIT_OK:
+                    failures.append(
+                        f"{tag}: resume failed (rc={res2.rc} "
+                        f"hung={res2.hung} killed={res2.killed})\n{res2.err}"
+                    )
+                    continue
+                with open(cluster, "r", encoding="utf-8") as f:
+                    if f.read() != base_final:
+                        failures.append(
+                            f"{tag}: resumed final state diverged from the "
+                            "uninterrupted baseline"
+                        )
+                        continue
+                with open(journal, "r", encoding="utf-8") as f:
+                    if json.load(f).get("status") != "complete":
+                        failures.append(
+                            f"{tag}: resumed journal not marked complete"
+                        )
+                        continue
+            print(f"chaos_soak: {tag}: {want} ok ({res.wall_s:.2f}s)",
+                  file=sys.stderr)
+    return failures
+
+
 def soak_random(args, report_dir):
     base = with_server(
         lambda s: baseline_bytes(s.port, args.solver, report_dir,
@@ -320,6 +618,7 @@ def main(argv=None):
         with tempfile.TemporaryDirectory(prefix="chaos_soak_") as report_dir:
             if args.matrix:
                 failures = soak_matrix(args, report_dir)
+                failures += soak_exec_matrix(args, report_dir)
             else:
                 failures = soak_random(args, report_dir)
     finally:

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Plan-execution smoke (tier-1, via scripts/lint.sh): the crash→resume
+contract of ``ka-execute`` on the snapshot backend's simulated-convergence
+cluster, asserted end to end in a couple of seconds (ISSUE 7).
+
+Sequence (fresh temp cluster, so the outcome is deterministic):
+
+1. plan: mode 3 (greedy) over a 9-broker / 3-rack snapshot, removing one
+   broker — a real multi-wave reassignment plan;
+2. baseline: ``ka-execute`` drives a copy of the cluster to convergence
+   uninterrupted → final snapshot bytes A, exit 0, journal complete;
+3. kill: a second copy executes under ``KA_FAULTS_SPEC=wave:1=crash`` —
+   the engine dies at the wave boundary after the first committed wave
+   (``InjectedExecCrash``, the kill -9 stand-in); the journal must be
+   ``in-progress`` with exactly one committed wave;
+4. resume: ``ka-execute --resume`` finishes the run → exit 0, the final
+   snapshot is BYTE-IDENTICAL to A, the journal is complete, and the run
+   report shows the verify pass ran (``exec.verify``) with zero skipped
+   moves.
+
+The full write-seam fault matrix (drop, acked-but-lost, stall, both
+policies) runs in ``scripts/chaos_soak.py --matrix``, also tier-1.
+"""
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _capture(fn, *args):
+    out, err = io.StringIO(), io.StringIO()
+    box = {}
+
+    def _target():
+        with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+            try:
+                box["rc"] = fn(*args)
+            except BaseException as e:
+                box["exc"] = e
+
+    t = threading.Thread(target=_target, daemon=True)
+    t.start()
+    t.join(120)
+    if t.is_alive():
+        print(f"FAIL: hung\n{err.getvalue()}", file=sys.stderr)
+        raise SystemExit(1)
+    return box, out.getvalue(), err.getvalue()
+
+
+def main() -> int:
+    from kafka_assigner_tpu import faults
+    from kafka_assigner_tpu.cli import execute, run
+    from kafka_assigner_tpu.faults.inject import InjectedExecCrash
+    from tests.jute_server import exec_snapshot_cluster
+
+    saved_env = dict(os.environ)
+    try:
+        with tempfile.TemporaryDirectory(prefix="ka_execsmoke_") as d:
+            src = os.path.join(d, "cluster.json")
+            with open(src, "w", encoding="utf-8") as f:
+                # kalint: disable=KA005 -- test-fixture snapshot, not a plan payload
+                json.dump(exec_snapshot_cluster(), f)
+            plan = os.path.join(d, "plan.json")
+            box, out, err = _capture(run, [
+                "--zk_string", src, "--mode", "PRINT_REASSIGNMENT",
+                "--solver", "greedy", "--broker_hosts_to_remove", "h9",
+            ])
+            if box.get("rc") != 0 or "NEW ASSIGNMENT:" not in out:
+                print(f"FAIL: plan generation rc={box.get('rc')}\n{err}",
+                      file=sys.stderr)
+                return 1
+            with open(plan, "w", encoding="utf-8") as f:
+                f.write(out)
+
+            os.environ.update({
+                "KA_EXEC_WAVE_SIZE": "3",
+                "KA_EXEC_POLL_INTERVAL": "0.01",
+                "KA_EXEC_POLL_TIMEOUT": "10",
+                "KA_EXEC_SIM_POLLS": "1",
+            })
+            os.environ.pop("KA_FAULTS_SPEC", None)
+            faults.reset()
+
+            # 1. uninterrupted baseline → final bytes A
+            base = os.path.join(d, "base.json")
+            shutil.copy(src, base)
+            box, _, err = _capture(execute, [
+                "--zk_string", base, "--plan", plan,
+                "--journal", base + ".journal",
+            ])
+            if box.get("rc") != 0:
+                print(f"FAIL: baseline execution rc={box.get('rc')}\n{err}",
+                      file=sys.stderr)
+                return 1
+            with open(base, "r", encoding="utf-8") as f:
+                final_a = f.read()
+
+            # 2. kill at the wave boundary after wave 1
+            intr = os.path.join(d, "intr.json")
+            journal = intr + ".journal"
+            shutil.copy(src, intr)
+            os.environ["KA_FAULTS_SPEC"] = "wave:1=crash"
+            faults.reset()
+            box, _, err = _capture(execute, [
+                "--zk_string", intr, "--plan", plan, "--journal", journal,
+            ])
+            if not isinstance(box.get("exc"), InjectedExecCrash):
+                print(f"FAIL: expected the injected wave-boundary kill, got "
+                      f"rc={box.get('rc')} exc={box.get('exc')!r}\n{err}",
+                      file=sys.stderr)
+                return 1
+            with open(journal, "r", encoding="utf-8") as f:
+                j = json.load(f)
+            if j["status"] != "in-progress" or j["waves_committed"] != 1:
+                print(f"FAIL: journal after kill should be in-progress at "
+                      f"wave 1, got {j['status']}/{j['waves_committed']}",
+                      file=sys.stderr)
+                return 1
+
+            # 3. resume → byte-identical final state, verified
+            os.environ.pop("KA_FAULTS_SPEC", None)
+            faults.reset()
+            report = os.path.join(d, "resume_report.json")
+            box, _, err = _capture(execute, [
+                "--zk_string", intr, "--plan", plan, "--journal", journal,
+                "--resume", "--report-json", report,
+            ])
+            if box.get("rc") != 0:
+                print(f"FAIL: resume rc={box.get('rc')}\n{err}",
+                      file=sys.stderr)
+                return 1
+            with open(intr, "r", encoding="utf-8") as f:
+                final_b = f.read()
+            if final_a != final_b:
+                print("FAIL: resumed final state is not byte-identical to "
+                      "the uninterrupted run", file=sys.stderr)
+                return 1
+            with open(journal, "r", encoding="utf-8") as f:
+                if json.load(f)["status"] != "complete":
+                    print("FAIL: resumed journal not complete",
+                          file=sys.stderr)
+                    return 1
+            with open(report, "r", encoding="utf-8") as f:
+                rep = json.load(f)
+            counters = rep["metrics"]["counters"]
+            if not counters.get("exec.verify") or not counters.get("exec.waves"):
+                print(f"FAIL: exec counters missing from the resume report "
+                      f"({counters})", file=sys.stderr)
+                return 1
+            if rep["plan"].get("skipped_moves"):
+                print("FAIL: clean resume reported skipped moves",
+                      file=sys.stderr)
+                return 1
+            print(
+                f"exec_smoke: PASS (waves={counters['exec.waves']} "
+                f"moves={counters.get('exec.moves', 0)} resumed "
+                "byte-identical)",
+                file=sys.stderr,
+            )
+    finally:
+        os.environ.clear()
+        os.environ.update(saved_env)
+        faults.reset()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

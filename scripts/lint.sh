@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
-# The project lint gate: kalint (knob-registry + jit-boundary house rules,
-# rules KA001-KA008), the README knob-table drift check, the run-report
-# fixture schema check, the fault-matrix smoke (one injected fault per
-# class, strict + best-effort), and ruff (config in pyproject.toml) when
+# The project lint gate: kalint (knob-registry + jit-boundary + write-path
+# house rules, KA001-KA010), the README knob-table drift check, the
+# run-report fixture schema check, the fault-matrix smoke (one injected
+# fault per class — read AND write seams — strict + best-effort), the
+# exec crash→resume smoke, and ruff (config in pyproject.toml) when
 # installed. Exits non-zero on any finding; invoked by
 # tests/test_lint_gate.py so tier-1 catches regressions without separate CI
 # plumbing.
@@ -19,11 +20,16 @@ python -m kafka_assigner_tpu.analysis.knobdoc --check
 # (python -c, not -m: the package re-exports the module, and -m would warn.)
 python -c "import sys; from kafka_assigner_tpu.obs.report import main; \
 sys.exit(main(['--check-fixture', 'tests/golden/run_report_v1.json']))"
-# Fault-matrix smoke (ISSUE 5): one deterministic injected fault per class,
-# strict + best-effort — self-healing classes must stay byte-identical,
-# degradation classes must exit with the documented codes. The full
-# randomized 200-schedule soak is the slow-marked tests/test_chaos_soak.py.
+# Fault-matrix smoke (ISSUE 5 + the ISSUE 7 write seams): one deterministic
+# injected fault per class, strict + best-effort — self-healing classes must
+# stay byte-identical, degradation classes must exit with the documented
+# codes, and no write-path fault may strand a partition or leave a journal
+# unresumable. The full randomized 200-schedule soak is the slow-marked
+# tests/test_chaos_soak.py.
 python scripts/chaos_soak.py --matrix
+# Plan-execution smoke (ISSUE 7): execute → kill at a wave boundary →
+# --resume → final cluster state byte-identical to an uninterrupted run.
+python scripts/exec_smoke.py
 # Warm-start smoke (ISSUE 6): program store populate -> clear-memory -> hit
 # on the CPU backend, byte-identical output, compile.store.hits >= 1. The
 # fresh-process bench is the slow-marked tests/test_bench_warmstart.py.

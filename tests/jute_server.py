@@ -4,7 +4,13 @@ golden-frame pins, ``scripts/bench_zk_ingest.py``, and the chaos soak
 
 A minimal single-purpose server speaking the actual ZooKeeper wire protocol
 over a real TCP port: session handshake plus the read subset (getChildren /
-getData / exists / ping / closeSession) over a static znode tree.
+getData / exists / ping / closeSession) over a znode tree — and, for the
+plan execution engine (ISSUE 7), the write subset (create / setData /
+delete) that MUTATES the tree, plus a simulated Kafka controller: when
+``/admin/reassign_partitions`` is created, the server applies the described
+replica moves to the topic znodes after ``controller_delay_ops`` further
+requests and deletes the admin znode — the deterministic hermetic stand-in
+for the controller's asynchronous reassignment execution.
 """
 from __future__ import annotations
 
@@ -37,7 +43,8 @@ class JuteZkServer(threading.Thread):
     the client's ``"session expired during handshake"`` branch end-to-end.
     """
 
-    def __init__(self, tree, reply_delay_s=0.0, port=0, expire_handshakes=0):
+    def __init__(self, tree, reply_delay_s=0.0, port=0, expire_handshakes=0,
+                 controller_delay_ops=2, writes_enabled=True):
         super().__init__(daemon=True)
         self.tree = dict(tree)
         self.reply_delay_s = reply_delay_s
@@ -49,15 +56,32 @@ class JuteZkServer(threading.Thread):
         self._stop = threading.Event()
         self._expire_lock = threading.Lock()
         self._expire_remaining = int(expire_handshakes)
+        # Write/controller state: one lock guards tree + children-index
+        # mutation (writes arrive on per-connection threads) and the
+        # pending simulated-controller reassignment.
+        self._tree_lock = threading.Lock()
+        self.writes_enabled = writes_enabled
+        self.controller_delay_ops = int(controller_delay_ops)
+        self._pending_reassign = None   # (plan dict, remaining op count)
+        self.write_ops = {"create": 0, "setData": 0, "delete": 0}
         # Children index, built once: the per-request O(tree) prefix scan
         # dominated the pipelined bench (~0.4 ms/op of pure fixture cost)
         # and hid the transport latency this server exists to model.
         self._kids = {}
         for p in self.tree:
-            parent = ""
-            for seg in p.strip("/").split("/"):
-                self._kids.setdefault(parent + "/", set()).add(seg)
-                parent = f"{parent}/{seg}"
+            self._index_path(p)
+
+    def _index_path(self, p):
+        parent = ""
+        for seg in p.strip("/").split("/"):
+            self._kids.setdefault(parent + "/", set()).add(seg)
+            parent = f"{parent}/{seg}"
+
+    def _unindex_path(self, p):
+        parent, _, name = p.rpartition("/")
+        kids = self._kids.get(parent + "/")
+        if kids is not None:
+            kids.discard(name)
 
     # -- jute helpers -----------------------------------------------------
 
@@ -76,6 +100,51 @@ class JuteZkServer(threading.Thread):
 
     def _exists(self, path):
         return path in self.tree or bool(self._children(path))
+
+    # -- simulated Kafka controller ---------------------------------------
+
+    def _accept_reassignment(self, data):
+        """Record a freshly-created ``/admin/reassign_partitions`` payload;
+        the moves apply after ``controller_delay_ops`` further requests
+        (deterministic asynchrony — a client that polls sees the admin
+        znode present and the old assignment first, like a real cluster).
+        Caller holds the tree lock."""
+        try:
+            plan = json.loads(data)
+        except ValueError:
+            return  # a real controller logs and ignores garbage
+        self._pending_reassign = (plan, self.controller_delay_ops)
+
+    def _controller_tick(self):
+        """Advance the simulated controller by one observed request; at
+        zero, apply the pending moves to the topic (and state) znodes and
+        delete the admin znode — the controller's completion signal."""
+        with self._tree_lock:
+            if self._pending_reassign is None:
+                return
+            plan, remaining = self._pending_reassign
+            if remaining > 0:
+                self._pending_reassign = (plan, remaining - 1)
+                return
+            self._pending_reassign = None
+            for entry in plan.get("partitions", []):
+                t, p = entry["topic"], int(entry["partition"])
+                replicas = [int(r) for r in entry["replicas"]]
+                tpath = f"/brokers/topics/{t}"
+                if tpath in self.tree:
+                    meta = json.loads(self.tree[tpath])
+                    meta.setdefault("partitions", {})[str(p)] = replicas
+                    self.tree[tpath] = json.dumps(meta).encode()
+                spath = f"{tpath}/partitions/{p}/state"
+                if spath in self.tree:
+                    smeta = json.loads(self.tree[spath])
+                    smeta["isr"] = replicas
+                    smeta["leader"] = replicas[0] if replicas else -1
+                    self.tree[spath] = json.dumps(smeta).encode()
+            admin = "/admin/reassign_partitions"
+            if admin in self.tree:
+                del self.tree[admin]
+                self._unindex_path(admin)
 
     # -- server loop ------------------------------------------------------
 
@@ -165,9 +234,54 @@ class JuteZkServer(threading.Thread):
                 if op == -11:  # closeSession
                     send(struct.pack(">iqi", xid, 1, 0))
                     return
+                self._controller_tick()
                 (plen,) = struct.unpack(">i", body[:4])
                 path = body[4:4 + plen].decode("utf-8")
-                if op == 8:  # getChildren
+                if op == 1 and self.writes_enabled:  # create
+                    (dlen,) = struct.unpack(">i", body[4 + plen:8 + plen])
+                    data = body[8 + plen:8 + plen + max(0, dlen)]
+                    with self._tree_lock:
+                        if path in self.tree:
+                            send(struct.pack(">iqi", xid, 1, -110))
+                            continue
+                        parent = path.rpartition("/")[0]
+                        if parent and not self._exists(parent):
+                            # real ZK: creating under a missing parent is
+                            # NoNode — clients must makepath explicitly
+                            send(struct.pack(">iqi", xid, 1, -101))
+                            continue
+                        self.write_ops["create"] += 1
+                        self.tree[path] = data
+                        self._index_path(path)
+                        if path == "/admin/reassign_partitions":
+                            self._accept_reassignment(data)
+                    payload = struct.pack(">iqi", xid, 1, 0) + self._buf(
+                        path.encode("utf-8")
+                    )
+                    send(payload)
+                elif op == 5 and self.writes_enabled:  # setData
+                    (dlen,) = struct.unpack(">i", body[4 + plen:8 + plen])
+                    data = body[8 + plen:8 + plen + max(0, dlen)]
+                    with self._tree_lock:
+                        if path not in self.tree:
+                            send(struct.pack(">iqi", xid, 1, -101))
+                            continue
+                        self.write_ops["setData"] += 1
+                        self.tree[path] = data
+                    payload = struct.pack(">iqi", xid, 1, 0) + self._stat(
+                        len(data), len(self._children(path))
+                    )
+                    send(payload)
+                elif op == 2 and self.writes_enabled:  # delete
+                    with self._tree_lock:
+                        if path not in self.tree:
+                            send(struct.pack(">iqi", xid, 1, -101))
+                            continue
+                        self.write_ops["delete"] += 1
+                        del self.tree[path]
+                        self._unindex_path(path)
+                    send(struct.pack(">iqi", xid, 1, 0))
+                elif op == 8:  # getChildren
                     kids = self._children(path)
                     if not self._exists(path):
                         send(struct.pack(">iqi", xid, 1, -101))
@@ -252,4 +366,45 @@ def cluster_tree():
         tree[f"/brokers/ids/{bid}"] = json.dumps(meta).encode()
     for t, meta in topics.items():
         tree[f"/brokers/topics/{t}"] = json.dumps(meta).encode()
+    return tree
+
+
+def exec_snapshot_cluster():
+    """The shared SNAPSHOT-backend fixture for the write-path harnesses
+    (``scripts/chaos_soak.py`` exec matrix, ``scripts/exec_smoke.py``,
+    ``tests/test_exec.py``): 9 brokers over 3 racks, so draining one broker
+    always leaves every rack with capacity — the greedy plan is feasible
+    and deterministic, and it spans multiple waves at the harness wave
+    size. One copy, so the matrix and the smoke can never drift apart."""
+    return {
+        "brokers": [
+            {"id": i, "host": f"h{i}", "port": 9092,
+             "rack": f"r{(i - 1) % 3}"}
+            for i in range(1, 10)
+        ],
+        "topics": {
+            "events": {
+                str(p): [1 + (p + r * 3) % 9 for r in range(3)]
+                for p in range(6)
+            },
+            "logs": {
+                str(p): [1 + (p + r * 3) % 9 for r in range(2)]
+                for p in range(4)
+            },
+        },
+    }
+
+
+def cluster_tree_with_states():
+    """The fixture tree plus the modern per-partition ``state`` znodes
+    (``/brokers/topics/<t>/partitions/<p>/state`` carrying leader+ISR) —
+    what the execution engine's convergence poll reads on clusters that
+    have them; the plain ``cluster_tree`` covers the fallback layout."""
+    tree = cluster_tree()
+    for path in [p for p in tree if p.startswith("/brokers/topics/")]:
+        meta = json.loads(tree[path])
+        for p, reps in meta.get("partitions", {}).items():
+            tree[f"{path}/partitions/{p}/state"] = json.dumps(
+                {"isr": list(reps), "leader": reps[0] if reps else -1}
+            ).encode()
     return tree

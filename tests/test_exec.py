@@ -1,0 +1,506 @@
+"""The plan execution engine (ISSUE 7): journal lifecycle and crash
+safety, wave split + throttled convergence against the snapshot backend's
+simulated cluster, the write-safety read-back rule, the documented
+``ka-execute`` exit codes (ok / resume / degraded / verify-mismatch), and
+the degraded-run diff surfaced in the run report's plan section."""
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import shutil
+
+import pytest
+
+from kafka_assigner_tpu import faults
+from kafka_assigner_tpu.cli import (
+    EXIT_DEGRADED,
+    EXIT_EXECUTE,
+    EXIT_OK,
+    EXIT_VALIDATION,
+    EXIT_VERIFY,
+    execute,
+    run,
+)
+from kafka_assigner_tpu.exec.engine import (
+    PlanExecutor,
+    load_plan_file,
+)
+from kafka_assigner_tpu.exec.journal import (
+    ExecutionJournal,
+    JournalError,
+    plan_fingerprint,
+)
+from kafka_assigner_tpu.faults.inject import InjectedExecCrash
+from kafka_assigner_tpu.io.snapshot import SnapshotBackend
+
+
+@pytest.fixture(autouse=True)
+def _fresh_injector():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(autouse=True)
+def _fast_exec_env(monkeypatch):
+    """Tight wave/poll knobs so every test runs in milliseconds; the sim
+    convergence needs one extra poll per move (KA_EXEC_SIM_POLLS=1), which
+    keeps the retry path honest."""
+    monkeypatch.setenv("KA_EXEC_WAVE_SIZE", "3")
+    monkeypatch.setenv("KA_EXEC_POLL_INTERVAL", "0.01")
+    monkeypatch.setenv("KA_EXEC_POLL_TIMEOUT", "10")
+    monkeypatch.setenv("KA_EXEC_SIM_POLLS", "1")
+
+
+def _cluster():
+    from .jute_server import exec_snapshot_cluster
+
+    return exec_snapshot_cluster()
+
+
+@pytest.fixture(scope="module")
+def plan_text(tmp_path_factory):
+    """One real multi-wave plan (greedy mode 3, broker h9 drained), built
+    once for the module: the full mode-3 stdout, banners included — what an
+    operator actually saves."""
+    d = tmp_path_factory.mktemp("exec_plan")
+    src = d / "cluster.json"
+    src.write_text(json.dumps(_cluster()))
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = run([
+            "--zk_string", str(src), "--mode", "PRINT_REASSIGNMENT",
+            "--solver", "greedy", "--broker_hosts_to_remove", "h9",
+        ])
+    assert rc == 0 and "NEW ASSIGNMENT:" in out.getvalue()
+    return out.getvalue()
+
+
+@pytest.fixture()
+def workdir(tmp_path, plan_text):
+    """A fresh cluster copy + plan file + journal path per test."""
+    cluster = tmp_path / "cluster.json"
+    cluster.write_text(json.dumps(_cluster()))
+    plan = tmp_path / "plan.json"
+    plan.write_text(plan_text)
+    return {
+        "cluster": str(cluster),
+        "plan": str(plan),
+        "journal": str(tmp_path / "run.journal"),
+        "report": str(tmp_path / "report.json"),
+    }
+
+
+def _execute(w, *extra):
+    argv = ["--zk_string", w["cluster"], "--plan", w["plan"],
+            "--journal", w["journal"], *extra]
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = execute(argv)
+    return rc, err.getvalue()
+
+
+def _final_topics(w):
+    with open(w["cluster"], "r", encoding="utf-8") as f:
+        return {
+            t: {int(p): list(r) for p, r in parts.items()}
+            for t, parts in json.load(f)["topics"].items()
+        }
+
+
+# --- journal -----------------------------------------------------------------
+
+def test_journal_round_trip_and_wave_split(tmp_path):
+    path = str(tmp_path / "j")
+    moves = [("t", p, [1, 2, 3]) for p in range(7)]
+    j = ExecutionJournal.fresh(path, "hash", 3, moves)
+    assert j.waves_total == 3
+    assert [m[1] for m in j.wave(0)] == [0, 1, 2]
+    assert [m[1] for m in j.wave(2)] == [6]
+    j.commit_wave(2, skipped=[("t", 4)])
+    loaded = ExecutionJournal.load(path)
+    assert loaded.waves_committed == 2
+    assert loaded.skipped == [("t", 4)]
+    assert loaded.moves == moves
+    assert loaded.status == "in-progress"
+    loaded.complete()
+    assert ExecutionJournal.load(path).status == "complete"
+
+
+def test_journal_rejects_corruption_and_bad_schema(tmp_path):
+    p = tmp_path / "j"
+    p.write_text("{not json")
+    with pytest.raises(JournalError, match="corrupt"):
+        ExecutionJournal.load(str(p))
+    p.write_text(json.dumps({"version": 99}))
+    with pytest.raises(JournalError, match="version"):
+        ExecutionJournal.load(str(p))
+    p.write_text(json.dumps({
+        "version": 1, "plan": "h", "wave_size": 2, "status": "in-progress",
+        "waves_committed": 9, "moves": [["t", 0, [1]]], "skipped": [],
+    }))
+    with pytest.raises(JournalError, match="committed"):
+        ExecutionJournal.load(str(p))
+
+
+def test_plan_fingerprint_is_whitespace_insensitive(workdir):
+    plan_a, order_a = load_plan_file(workdir["plan"])
+    bare = json.dumps({
+        "partitions": [
+            {"partition": p, "replicas": plan_a[t][p], "topic": t}
+            for t in order_a for p in sorted(plan_a[t])
+        ],
+        "version": 1,
+    }, indent=3)  # kalint: disable=KA005 -- building a scratch INPUT fixture, not emitting a plan
+    from kafka_assigner_tpu.io.json_io import parse_reassignment_json
+
+    parsed = parse_reassignment_json(bare)
+    assert plan_fingerprint(parsed, list(parsed)) == \
+        plan_fingerprint(plan_a, order_a)
+
+
+def test_load_plan_file_accepts_bare_json_and_saved_stdout(
+    workdir, tmp_path
+):
+    full, order = load_plan_file(workdir["plan"])
+    bare_path = tmp_path / "bare.json"
+    from kafka_assigner_tpu.io.json_io import format_reassignment_pairs
+
+    bare_path.write_text(
+        format_reassignment_pairs([(t, full[t]) for t in order])
+    )
+    bare, bare_order = load_plan_file(str(bare_path))
+    assert bare == full and bare_order == order
+    # The rollback section must NOT be what gets executed: a saved stdout
+    # contains the CURRENT ASSIGNMENT first, and it differs from the plan.
+    with open(workdir["plan"], "r", encoding="utf-8") as f:
+        rollback = f.read().split("NEW ASSIGNMENT:")[0]
+    from kafka_assigner_tpu.io.json_io import parse_reassignment_json
+
+    current = parse_reassignment_json(rollback.split("\n", 1)[1].strip())
+    assert current != full
+
+
+# --- happy path --------------------------------------------------------------
+
+def test_execute_ok_and_verify(workdir):
+    rc, err = _execute(workdir, "--report-json", workdir["report"])
+    assert rc == EXIT_OK, err
+    assert "verify-after-move OK" in err
+    plan, _ = load_plan_file(workdir["plan"])
+    final = _final_topics(workdir)
+    for t, parts in plan.items():
+        for p, reps in parts.items():
+            assert final[t][p] == reps
+    with open(workdir["report"], "r", encoding="utf-8") as f:
+        rep = json.load(f)
+    counters = rep["metrics"]["counters"]
+    assert counters["exec.waves"] >= 2          # a real multi-wave run
+    assert counters["exec.moves"] >= counters["exec.waves"]
+    assert counters["exec.verify"] == 1
+    assert counters["zk.writes"] == counters["exec.waves"]
+    assert "exec.wave_ms" in rep["metrics"]["histograms"]
+    assert rep["plan"]["skipped_moves"] == []
+    assert rep["plan"]["verify_mismatches"] == []
+    assert [s for s in rep["spans"] if s["name"] == "exec/verify"]
+    with open(workdir["journal"], "r", encoding="utf-8") as f:
+        assert json.load(f)["status"] == "complete"
+
+
+def test_execute_is_idempotent_when_converged(workdir):
+    rc, _ = _execute(workdir)
+    assert rc == EXIT_OK
+    os.unlink(workdir["journal"])
+    rc, err = _execute(workdir)
+    assert rc == EXIT_OK
+    assert "0 move(s) submitted" in err  # everything was a noop
+
+
+def test_wave_size_flag_overrides_knob(workdir):
+    rc, err = _execute(workdir, "--wave-size", "1")
+    assert rc == EXIT_OK
+    with open(workdir["journal"], "r", encoding="utf-8") as f:
+        j = json.load(f)
+    assert j["wave_size"] == 1
+    assert len(j["moves"]) == -(-len(j["moves"]) // 1)  # one move per wave
+
+
+# --- crash / resume ----------------------------------------------------------
+
+def _baseline_final(workdir, tmp_path):
+    base = str(tmp_path / "baseline.json")
+    shutil.copy(workdir["cluster"], base)
+    w = dict(workdir, cluster=base, journal=str(tmp_path / "b.journal"))
+    rc, err = _execute(w)
+    assert rc == EXIT_OK, err
+    with open(base, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def test_kill_at_wave_boundary_resumes_byte_identical(
+    workdir, tmp_path, monkeypatch
+):
+    base_final = _baseline_final(workdir, tmp_path)
+    monkeypatch.setenv("KA_FAULTS_SPEC", "wave:1=crash")
+    faults.reset()
+    with pytest.raises(InjectedExecCrash):
+        _execute(workdir)
+    monkeypatch.delenv("KA_FAULTS_SPEC")
+    faults.reset()
+    with open(workdir["journal"], "r", encoding="utf-8") as f:
+        j = json.load(f)
+    assert j["status"] == "in-progress" and j["waves_committed"] == 1
+    # Without --resume the interrupted journal is refused loudly.
+    rc, err = _execute(workdir)
+    assert rc == EXIT_VALIDATION
+    assert "--resume" in err
+    rc, err = _execute(workdir, "--resume")
+    assert rc == EXIT_OK, err
+    assert "resuming from journal" in err
+    with open(workdir["cluster"], "r", encoding="utf-8") as f:
+        assert f.read() == base_final
+
+
+def test_resume_refuses_a_different_plan(workdir, tmp_path, monkeypatch):
+    monkeypatch.setenv("KA_FAULTS_SPEC", "wave:1=crash")
+    faults.reset()
+    with pytest.raises(InjectedExecCrash):
+        _execute(workdir)
+    monkeypatch.delenv("KA_FAULTS_SPEC")
+    faults.reset()
+    plan, order = load_plan_file(workdir["plan"])
+    t0 = order[0]
+    p0 = sorted(plan[t0])[0]
+    plan[t0][p0] = list(reversed(plan[t0][p0]))
+    from kafka_assigner_tpu.io.json_io import format_reassignment_pairs
+
+    with open(workdir["plan"], "w", encoding="utf-8") as f:
+        f.write(format_reassignment_pairs([(t, plan[t]) for t in order]))
+    rc, err = _execute(workdir, "--resume")
+    assert rc == EXIT_VALIDATION
+    assert "different plan" in err
+
+
+def test_resume_without_journal_is_a_validation_error(workdir):
+    rc, err = _execute(workdir, "--resume")
+    assert rc == EXIT_VALIDATION
+    assert "journal" in err
+
+
+def test_interrupted_journal_of_another_plan_is_never_clobbered(
+    workdir, tmp_path, monkeypatch
+):
+    monkeypatch.setenv("KA_FAULTS_SPEC", "wave:1=crash")
+    faults.reset()
+    with pytest.raises(InjectedExecCrash):
+        _execute(workdir)
+    monkeypatch.delenv("KA_FAULTS_SPEC")
+    faults.reset()
+    with open(workdir["journal"], "r", encoding="utf-8") as f:
+        before = f.read()
+    # A DIFFERENT plan pointed at the same journal path: refused, and the
+    # interrupted run's committed-wave record survives untouched.
+    from kafka_assigner_tpu.io.json_io import format_reassignment_pairs
+
+    other = tmp_path / "other_plan.json"
+    other.write_text(format_reassignment_pairs([("events", {0: [2, 1, 3]})]))
+    rc, err = _execute(dict(workdir, plan=str(other)))
+    assert rc == EXIT_VALIDATION
+    assert "DIFFERENT plan" in err
+    with open(workdir["journal"], "r", encoding="utf-8") as f:
+        assert f.read() == before
+
+
+def test_plan_time_skips_survive_a_crash_and_resume_degraded(
+    workdir, tmp_path, monkeypatch
+):
+    """A best-effort run whose plan names an unresolvable topic, killed
+    mid-execution: the plan-time skip is journaled, so the resumed run
+    still exits DEGRADED with the skip named — never reclassified as a
+    verify mismatch."""
+    plan, order = load_plan_file(workdir["plan"])
+    from kafka_assigner_tpu.io.json_io import format_reassignment_pairs
+
+    mixed = tmp_path / "mixed_plan.json"
+    mixed.write_text(format_reassignment_pairs(
+        [("ghost", {0: [1, 2, 3]})] + [(t, plan[t]) for t in order]
+    ))
+    w = dict(workdir, plan=str(mixed), journal=str(tmp_path / "m.journal"))
+    monkeypatch.setenv("KA_FAULTS_SPEC", "wave:1=crash")
+    faults.reset()
+    with pytest.raises(InjectedExecCrash):
+        _execute(w, "--failure-policy", "best-effort")
+    monkeypatch.delenv("KA_FAULTS_SPEC")
+    faults.reset()
+    with open(w["journal"], "r", encoding="utf-8") as f:
+        assert ["ghost", 0] in json.load(f)["skipped"]
+    rc, err = _execute(w, "--failure-policy", "best-effort", "--resume",
+                       "--report-json", w["report"])
+    assert rc == EXIT_DEGRADED, err
+    with open(w["report"], "r", encoding="utf-8") as f:
+        rep = json.load(f)
+    assert ["ghost", 0] in rep["plan"]["skipped_moves"]
+    assert rep["plan"]["verify_mismatches"] == []
+
+
+# --- write seams -------------------------------------------------------------
+
+def test_write_drop_reads_back_and_resubmits(workdir, monkeypatch):
+    monkeypatch.setenv("KA_FAULTS_SPEC", "write:0=drop")
+    faults.reset()
+    rc, err = _execute(workdir, "--report-json", workdir["report"])
+    assert rc == EXIT_OK, err
+    assert "never a blind replay" in err
+    with open(workdir["report"], "r", encoding="utf-8") as f:
+        counters = json.load(f)["metrics"]["counters"]
+    assert counters["exec.write_retries"] >= 1
+    assert counters["faults.injected.drop"] == 1
+
+
+def test_write_lost_strict_halts_resumably(workdir, monkeypatch, tmp_path):
+    base_final = _baseline_final(workdir, tmp_path)
+    monkeypatch.setenv("KA_FAULTS_SPEC", "write:0=lost")
+    monkeypatch.setenv("KA_EXEC_POLL_TIMEOUT", "0.3")
+    faults.reset()
+    rc, err = _execute(workdir)
+    assert rc == EXIT_EXECUTE
+    assert "--resume" in err
+    # The acked-but-lost write left the OLD assignment complete: nothing
+    # stranded, and the journal resumes to the byte-identical final state.
+    monkeypatch.delenv("KA_FAULTS_SPEC")
+    monkeypatch.setenv("KA_EXEC_POLL_TIMEOUT", "10")
+    faults.reset()
+    rc, err = _execute(workdir, "--resume")
+    assert rc == EXIT_OK, err
+    with open(workdir["cluster"], "r", encoding="utf-8") as f:
+        assert f.read() == base_final
+
+
+def test_write_lost_best_effort_degrades_with_accounting(
+    workdir, monkeypatch
+):
+    initial = _final_topics(workdir)
+    monkeypatch.setenv("KA_FAULTS_SPEC", "write:0=lost")
+    monkeypatch.setenv("KA_EXEC_POLL_TIMEOUT", "0.3")
+    faults.reset()
+    rc, err = _execute(workdir, "--failure-policy", "best-effort",
+                       "--report-json", workdir["report"])
+    assert rc == EXIT_DEGRADED, err
+    with open(workdir["report"], "r", encoding="utf-8") as f:
+        rep = json.load(f)
+    assert rep["status"] == "degraded"
+    skipped = rep["plan"]["skipped_moves"]
+    assert skipped  # the lost wave's moves, named partition by partition
+    final = _final_topics(workdir)
+    for t, p in skipped:
+        # A skipped move leaves its COMPLETE initial replica list — never
+        # a partial state.
+        assert final[t][int(p)] == initial[t][int(p)]
+
+
+def test_converge_stall_retries_through(workdir, monkeypatch):
+    monkeypatch.setenv("KA_FAULTS_SPEC", "converge:0=stall")
+    faults.reset()
+    rc, _ = _execute(workdir, "--report-json", workdir["report"])
+    assert rc == EXIT_OK
+    with open(workdir["report"], "r", encoding="utf-8") as f:
+        counters = json.load(f)["metrics"]["counters"]
+    assert counters["exec.retries"] >= 1
+    assert counters["faults.injected.stall"] == 1
+
+
+# --- verify-after-move -------------------------------------------------------
+
+def test_external_drift_fails_verify(workdir, monkeypatch):
+    monkeypatch.setenv("KA_FAULTS_SPEC", "wave:1=crash")
+    faults.reset()
+    with pytest.raises(InjectedExecCrash):
+        _execute(workdir)
+    monkeypatch.delenv("KA_FAULTS_SPEC")
+    faults.reset()
+    # Somebody else rewrites a partition the interrupted run had already
+    # committed; the resumed run's verify pass must catch it.
+    with open(workdir["journal"], "r", encoding="utf-8") as f:
+        t0, p0, _ = json.load(f)["moves"][0]
+    with open(workdir["cluster"], "r", encoding="utf-8") as f:
+        snap = json.load(f)
+    snap["topics"][t0][str(p0)] = [9] + snap["topics"][t0][str(p0)][1:]
+    with open(workdir["cluster"], "w", encoding="utf-8") as f:
+        json.dump(snap, f)  # kalint: disable=KA005 -- doctoring a test-fixture snapshot
+    rc, err = _execute(workdir, "--resume", "--report-json",
+                       workdir["report"])
+    assert rc == EXIT_VERIFY
+    assert "VERIFY MISMATCH" in err
+    with open(workdir["report"], "r", encoding="utf-8") as f:
+        rep = json.load(f)
+    assert rep["plan"]["verify_mismatches"]
+    assert rep["plan"]["verify_mismatches"][0]["topic"] == t0
+
+
+def test_read_only_backend_is_refused():
+    class ReadOnly:
+        pass
+
+    # ValueError (validation exit): refused before any journal exists.
+    with pytest.raises(ValueError, match="cannot execute"):
+        PlanExecutor(
+            ReadOnly(), {"t": {0: [1]}}, ["t"], "/nonexistent/journal"
+        ).execute()
+
+
+def test_missing_plan_topic_strict_vs_best_effort(workdir, tmp_path):
+    from kafka_assigner_tpu.io.json_io import format_reassignment_pairs
+
+    ghost_plan = tmp_path / "ghost.json"
+    ghost_plan.write_text(
+        format_reassignment_pairs([("ghost", {0: [1, 2, 3]})])
+    )
+    w = dict(workdir, plan=str(ghost_plan),
+             journal=str(tmp_path / "g.journal"))
+    # Validation, not the resumable-halt code: no journal exists yet, so
+    # exit 8's "--resume" promise would be a lie here.
+    rc, err = _execute(w)
+    assert rc == EXIT_VALIDATION
+    assert "does not exist" in err
+    assert not os.path.exists(w["journal"])
+    rc, err = _execute(w, "--failure-policy", "best-effort")
+    assert rc == EXIT_DEGRADED
+    assert "skipping" in err
+
+
+# --- usage / CLI surface -----------------------------------------------------
+
+def test_usage_requires_plan_and_zk_string(capsys):
+    assert execute([]) == 1
+    assert "required" in capsys.readouterr().err
+
+
+def test_journal_default_path_is_plan_derived(workdir):
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = execute(["--zk_string", workdir["cluster"],
+                      "--plan", workdir["plan"]])
+    assert rc == EXIT_OK
+    assert os.path.exists(workdir["plan"] + ".journal")
+
+
+# --- degraded-run diff in the plan section (ISSUE 7 satellite) ---------------
+
+def test_mode3_reports_unplanned_topics(workdir, tmp_path):
+    report = str(tmp_path / "m3_report.json")
+    err = io.StringIO()
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+        rc = run([
+            "--zk_string", workdir["cluster"],
+            "--mode", "PRINT_REASSIGNMENT", "--solver", "greedy",
+            "--topics", "events,ghost", "--failure-policy", "best-effort",
+            "--report-json", report,
+        ])
+    assert rc == EXIT_DEGRADED
+    with open(report, "r", encoding="utf-8") as f:
+        rep = json.load(f)
+    assert rep["plan"]["unplanned_topics"] == ["ghost"]
+    assert rep["metrics"]["gauges"]["ingest.topics_skipped"] == 1

@@ -448,3 +448,215 @@ def test_cli_live_wire_nonode_race_best_effort(zk_server, monkeypatch, capsys):
     from kafka_assigner_tpu.io.json_io import parse_reassignment_json
 
     assert set(parse_reassignment_json(payload)) == {"logs"}
+
+
+# --- write-seam scopes + backend-agnostic injection (ISSUE 7) ----------------
+
+def test_parse_spec_write_seam_scopes():
+    events = parse_spec(
+        "write:0=drop;write:2=lost;converge:1=stall;wave:0=crash"
+    )
+    assert [(e.scope, e.index, e.kind) for e in events] == [
+        ("write", 0, "drop"), ("write", 2, "lost"),
+        ("converge", 1, "stall"), ("wave", 0, "crash"),
+    ]
+    with pytest.raises(FaultSpecError):
+        parse_spec("write:0=stall")  # stall is a converge kind
+    with pytest.raises(FaultSpecError):
+        parse_spec("wave:0=drop")
+
+
+def test_random_schedule_order_is_frozen():
+    # New scopes APPEND to the draw order: a historical seed keeps drawing
+    # the exact same events for the scopes it already covered (the legacy
+    # five came first, in their old sorted order).
+    from kafka_assigner_tpu.faults.inject import (
+        FAULT_SCOPES,
+        RANDOM_ORDER,
+    )
+
+    assert RANDOM_ORDER[:5] == (
+        "connect", "handshake", "reply", "solve", "warmup"
+    )
+    assert set(RANDOM_ORDER) == set(FAULT_SCOPES)
+
+
+def test_backend_reply_maps_kinds_to_adapter_failures():
+    inj = FaultInjector(parse_spec(
+        "reply:0=drop;reply:1=nonode;reply:2=nonode;reply:3=slow:0.001"
+    ))
+    with pytest.raises(ConnectionResetError):
+        inj.backend_reply()
+    with pytest.raises(NoNodeError):
+        inj.backend_reply()
+    with pytest.raises(KeyError):
+        inj.backend_reply(missing_exc=KeyError)
+    inj.backend_reply()  # slow: just delays
+    inj.backend_reply()  # beyond the schedule: no-op
+    assert [e.kind for e in inj.fired] == ["drop", "nonode", "nonode", "slow"]
+
+
+def test_wave_fault_point_raises_exec_crash():
+    from kafka_assigner_tpu.faults.inject import (
+        InjectedExecCrash,
+        fault_point,
+    )
+
+    faults.install(FaultInjector(parse_spec("wave:1=crash")))
+    fault_point("wave")            # index 0: clean
+    with pytest.raises(InjectedExecCrash):
+        fault_point("wave")        # index 1: the kill
+    fault_point("wave")            # schedule exhausted
+
+
+def test_write_and_converge_hooks():
+    inj = FaultInjector(parse_spec(
+        "write:0=drop;write:1=lost;converge:0=stall"
+    ))
+    with pytest.raises(ConnectionResetError):
+        inj.write_attempt()
+    assert inj.write_attempt() == "lost"
+    assert inj.write_attempt() is None
+    assert inj.converge_poll() is True
+    assert inj.converge_poll() is False
+
+
+def test_fake_kazoo_reply_drop_is_an_ingest_failure(monkeypatch):
+    from kafka_assigner_tpu.io.zk import ZkBackend
+
+    from .test_backends import _install_fake_kazoo
+
+    znodes = {
+        "/brokers/ids": {"1": json.dumps({"host": "h1", "port": 9092})},
+        "/brokers/topics": {
+            "events": json.dumps({"partitions": {"0": [1]}}),
+        },
+    }
+    _install_fake_kazoo(monkeypatch, znodes)
+    faults.install(FaultInjector(parse_spec("reply:0=drop")))
+    backend = ZkBackend("zkhost:2181")
+    with pytest.raises(ConnectionResetError, match="injected fault"):
+        backend.brokers()
+    backend.close()
+
+
+def test_fake_kazoo_nonode_best_effort_skips_topic(monkeypatch):
+    from kafka_assigner_tpu.io.zk import ZkBackend
+
+    from .test_backends import _install_fake_kazoo
+
+    znodes = {
+        "/brokers/ids": {"1": json.dumps({"host": "h1", "port": 9092})},
+        "/brokers/topics": {
+            "events": json.dumps({"partitions": {"0": [1]}}),
+            "logs": json.dumps({"partitions": {"0": [1]}}),
+        },
+    }
+    _install_fake_kazoo(monkeypatch, znodes)
+    faults.install(FaultInjector(parse_spec("reply:0=nonode")))
+    backend = ZkBackend("zkhost:2181")
+    got = list(backend.fetch_topics(["events", "logs"], missing="skip"))
+    assert got[0] == ("events", None)      # the injected vanish
+    assert got[1] == ("logs", {0: [1]})    # the stream keeps flowing
+    backend.close()
+
+
+def test_fake_kazoo_connect_blackhole(monkeypatch):
+    from kafka_assigner_tpu.io.zk import ZkBackend
+
+    from .test_backends import _install_fake_kazoo
+
+    _install_fake_kazoo(monkeypatch, {"/brokers/ids": {}})
+    faults.install(FaultInjector(parse_spec("connect:0=blackhole")))
+    with pytest.raises(ConnectionRefusedError, match="injected fault"):
+        ZkBackend("zkhost:2181")
+
+
+def test_fake_admin_reply_drop_and_connect_blackhole(monkeypatch):
+    from kafka_assigner_tpu.io.kafka_admin import KafkaAdminBackend
+
+    from .test_backends import _install_fake_confluent
+
+    _install_fake_confluent(monkeypatch)
+    faults.install(FaultInjector(parse_spec("reply:0=drop")))
+    backend = KafkaAdminBackend("b1:9092")
+    with pytest.raises(ConnectionResetError, match="injected fault"):
+        backend.brokers()
+    faults.install(FaultInjector(parse_spec("connect:0=blackhole")))
+    with pytest.raises(ConnectionRefusedError, match="injected fault"):
+        KafkaAdminBackend("b1:9092")
+
+
+def test_fake_admin_nonode_vanishes_topic_in_skip_lane(monkeypatch, capsys):
+    from kafka_assigner_tpu.io.kafka_admin import KafkaAdminBackend
+
+    from .test_backends import _install_fake_confluent
+
+    _install_fake_confluent(monkeypatch)
+    # Index 1: the brokers() probe consumes 0, the batched skip-lane read
+    # consumes 1 — its KeyError sends every topic through per-topic probes,
+    # which resolve, so only the stream CONTRACT is degraded, not the data.
+    faults.install(FaultInjector(parse_spec("reply:1=nonode")))
+    backend = KafkaAdminBackend("b1:9092")
+    backend.brokers()
+    got = dict(backend.fetch_topics(["events", "logs"], missing="skip"))
+    assert got["events"] == {0: [1, 2], 1: [2, 1]}
+    assert got["logs"] == {0: [2]}
+
+
+def test_fake_admin_exec_surface_with_kip455(monkeypatch):
+    import sys
+    import types
+
+    from kafka_assigner_tpu.io.kafka_admin import KafkaAdminBackend
+
+    calls = []
+
+    class KafkaAdminClient:
+        def __init__(self, bootstrap_servers):
+            pass
+
+        def describe_topics(self, topics):
+            data = {"events": [
+                {"partition": 0, "replicas": [1, 2], "isr": [1]},
+            ]}
+            return [{"topic": t, "partitions": data[t]} for t in topics
+                    if t in data]
+
+        def alter_partition_reassignments(self, reassignments):
+            calls.append(reassignments)
+
+        def close(self):
+            pass
+
+    pkg = types.ModuleType("kafka")
+    pkg.KafkaAdminClient = KafkaAdminClient
+    monkeypatch.setitem(sys.modules, "kafka", pkg)
+
+    # Injectors resolve at backend construction (one coherent schedule per
+    # client): the SECOND write is the acked-but-lost one.
+    faults.install(FaultInjector(parse_spec("write:1=lost")))
+    backend = KafkaAdminBackend("b1:9092")
+    assert backend.supports_execution() is True
+    backend.apply_assignment({"events": {0: [2, 1]}})
+    assert calls == [{("events", 0): [2, 1]}]
+    state = backend.read_assignment_state(["events"])
+    assert state["events"][0].replicas == [1, 2]
+    assert state["events"][0].isr == [1]  # real ISR, not the fallback
+    # The write seam fires here like on any backend: an acked-but-lost
+    # write never reaches the client call.
+    backend.apply_assignment({"events": {0: [9, 1]}})
+    assert len(calls) == 1
+
+
+def test_fake_admin_without_kip455_refuses_execution(monkeypatch):
+    from kafka_assigner_tpu.errors import ExecuteError
+    from kafka_assigner_tpu.io.kafka_admin import KafkaAdminBackend
+
+    from .test_backends import _install_fake_confluent
+
+    _install_fake_confluent(monkeypatch)
+    backend = KafkaAdminBackend("b1:9092")
+    assert backend.supports_execution() is False
+    with pytest.raises(ExecuteError, match="cannot execute"):
+        backend.apply_assignment({"events": {0: [1]}})

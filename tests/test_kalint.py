@@ -423,6 +423,63 @@ def test_ka009_non_jit_ops_imports_are_clean():
     assert "KA009" not in rules_of(kalint.lint_source(src, "generator.py"))
 
 
+# --- KA010: write opcodes only in the serial write path ----------------------
+
+def test_ka010_trips_outside_the_wire_module():
+    src = (
+        "from .zkwire import OP_CREATE\n"
+        "\n"
+        "def sneaky_write(client, path):\n"
+        "    client._call(OP_CREATE, path)\n"
+    )
+    findings = kalint.lint_source(src, "io/zk.py")
+    assert any(
+        f.rule == "KA010" and "serial write path" in f.message
+        for f in findings
+    )
+
+
+def test_ka010_trips_on_attribute_references():
+    src = (
+        "from ..io import zkwire\n"
+        "\n"
+        "def sneaky(client, path):\n"
+        "    return client._call(zkwire.OP_SET_DATA, path)\n"
+    )
+    assert "KA010" in rules_of(kalint.lint_source(src, "generator.py"))
+
+
+def test_ka010_trips_inside_zkwire_pipelined_helpers():
+    # Even the wire module itself may only touch write opcodes from the
+    # serial write methods — a write op fed to the windowed helpers is the
+    # exact bug class the rule exists for.
+    src = (
+        "OP_DELETE = 2\n"
+        "\n"
+        "def _iter_window(self, paths):\n"
+        "    return self._send(OP_DELETE, paths)\n"
+    )
+    assert "KA010" in rules_of(kalint.lint_source(src, "io/zkwire.py"))
+
+
+def test_ka010_serial_write_methods_are_allowed():
+    src = (
+        "OP_CREATE = 1\n"   # the Store-context definition is exempt too
+        "\n"
+        "def create(self, path, value):\n"
+        "    return self._write_call(OP_CREATE, path)\n"
+    )
+    assert "KA010" not in rules_of(kalint.lint_source(src, "io/zkwire.py"))
+
+
+def test_ka010_repo_wire_module_is_clean():
+    from pathlib import Path
+
+    pkg = Path(kalint.__file__).resolve().parent.parent
+    src = (pkg / "io" / "zkwire.py").read_text(encoding="utf-8")
+    assert "KA010" not in rules_of(kalint.lint_source(src, "io/zkwire.py"))
+
+
 # --- suppressions ------------------------------------------------------------
 
 def test_suppression_with_reason_silences_the_finding():

@@ -1,0 +1,311 @@
+"""The wire client's write subset (ISSUE 7): create/setData/delete/exists
+over a real TCP socket, the write-safety rule (never pipelined, never
+blindly replayed — reconnect, read back, decide), the pipelined
+``iter_children`` fan-out with session-reestablishment replay, and the
+live-ZK execution path end to end (``ka-execute`` against the jute server's
+simulated controller)."""
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+
+import pytest
+
+from kafka_assigner_tpu import faults
+from kafka_assigner_tpu.cli import EXIT_OK, execute
+from kafka_assigner_tpu.errors import ExecuteError
+from kafka_assigner_tpu.io.zk import ZkBackend
+from kafka_assigner_tpu.io.zkwire import (
+    MiniZkClient,
+    NodeExistsError,
+    NoNodeError,
+)
+from kafka_assigner_tpu.io.json_io import format_reassignment_pairs
+
+from .jute_server import JuteZkServer, cluster_tree, cluster_tree_with_states
+
+
+@pytest.fixture(autouse=True)
+def _fresh_injector():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def zk_server():
+    server = JuteZkServer(cluster_tree(), controller_delay_ops=1)
+    server.start()
+    yield server
+    server.shutdown()
+
+
+def _client(server):
+    c = MiniZkClient(f"127.0.0.1:{server.port}")
+    c.start()
+    return c
+
+
+# --- the write opcodes over a real socket ------------------------------------
+
+def test_create_set_delete_exists_round_trip(zk_server):
+    # A neutral path: /admin/reassign_partitions would wake the server's
+    # simulated controller, which deletes the znode after applying it.
+    c = _client(zk_server)
+    try:
+        assert c.exists("/wtest") is None
+        path = c.create("/wtest", b'{"version":1}')
+        assert path == "/wtest"
+        assert c.exists("/wtest") is not None
+        data, _ = c.get("/wtest")
+        assert data == b'{"version":1}'
+        with pytest.raises(NodeExistsError):
+            c.create("/wtest", b"other")
+        c.set_data("/wtest", b'{"version":2}')
+        data, _ = c.get("/wtest")
+        assert data == b'{"version":2}'
+        c.delete("/wtest")
+        assert c.exists("/wtest") is None
+        with pytest.raises(NoNodeError):
+            c.set_data("/ghost", b"x")
+    finally:
+        c.stop()
+        c.close()
+    assert zk_server.write_ops == {"create": 1, "setData": 1, "delete": 1}
+
+
+def test_dropped_write_reply_is_not_blindly_replayed(zk_server, monkeypatch):
+    """A reply-scope drop DURING a setData: the server applied the write,
+    the client lost the ack. The write-safety rule demands reconnect →
+    read-back → DECIDE: the read-back shows the bytes landed, so the client
+    must NOT re-issue — the server sees exactly one setData op."""
+    monkeypatch.setenv("KA_ZK_SESSION_RETRIES", "2")
+    c = _client(zk_server)
+    try:
+        c.create("/wnode", b"v1")
+        faults.install(faults.FaultInjector(
+            faults.parse_spec("reply:0=drop")
+        ))
+        # fresh client so the injector is picked up at construction
+    finally:
+        c.stop()
+        c.close()
+    c = _client(zk_server)
+    err = io.StringIO()
+    try:
+        with contextlib.redirect_stderr(err):
+            c.set_data("/wnode", b"v2")
+        data, _ = c.get("/wnode")
+        assert data == b"v2"
+    finally:
+        c.stop()
+        c.close()
+    assert "read-back shows it landed" in err.getvalue()
+    assert zk_server.write_ops["setData"] == 1  # applied EXACTLY once
+    faults.install(None)
+
+
+def test_unsent_write_is_reissued_after_readback(zk_server, monkeypatch):
+    """The other half of read-back-then-decide: the transport dies BEFORE
+    the frame reaches the server, the read-back shows nothing landed, and
+    the client re-issues — one applied write, after one visible retry."""
+    monkeypatch.setenv("KA_ZK_SESSION_RETRIES", "2")
+    c = _client(zk_server)
+    real_send = MiniZkClient._send_frame
+    state = {"broken": True}
+
+    def flaky_send(self, payload):
+        if state["broken"] and b"wnode2" in payload:
+            state["broken"] = False
+            self._sock.close()
+            raise ConnectionResetError("wire cut before send")
+        return real_send(self, payload)
+
+    monkeypatch.setattr(MiniZkClient, "_send_frame", flaky_send)
+    err = io.StringIO()
+    try:
+        with contextlib.redirect_stderr(err):
+            c.create("/wnode2", b"payload")
+        data, _ = c.get("/wnode2")
+        assert data == b"payload"
+    finally:
+        c.stop()
+        c.close()
+    assert zk_server.write_ops["create"] == 1
+
+
+def test_create_makepath_materializes_parents(zk_server):
+    """Real ZK refuses a create under a missing parent (the jute server
+    does too); ``makepath=True`` must materialize the chain shallowest
+    first — the semantics ZkBackend.apply_assignment relies on for
+    /admin/reassign_partitions on a fresh cluster."""
+    c = _client(zk_server)
+    try:
+        with pytest.raises(NoNodeError):
+            c.create("/deep/nested/node", b"x")
+        c.create("/deep/nested/node", b"x", makepath=True)
+        data, _ = c.get("/deep/nested/node")
+        assert data == b"x"
+        assert c.exists("/deep") is not None
+        assert c.exists("/deep/nested") is not None
+    finally:
+        c.stop()
+        c.close()
+    assert zk_server.write_ops["create"] == 3  # two parents + the node
+
+
+# --- pipelined getChildren fan-out -------------------------------------------
+
+def test_iter_children_matches_serial(zk_server, monkeypatch):
+    monkeypatch.setenv("KA_ZK_PIPELINE", "4")
+    c = _client(zk_server)
+    try:
+        paths = ["/brokers/ids", "/brokers/topics", "/brokers",
+                 "/brokers/ids", "/brokers/topics"]
+        piped = list(c.iter_children(paths))
+        serial = [c.get_children(p) for p in paths]
+        assert piped == serial
+        assert piped[0] == ["1", "2", "3", "4"]
+    finally:
+        c.stop()
+        c.close()
+
+
+def test_iter_children_missing_ok_yields_none(zk_server):
+    c = _client(zk_server)
+    try:
+        out = list(c.iter_children(
+            ["/brokers/ids", "/ghost", "/brokers/topics"], missing_ok=True
+        ))
+        assert out[0] == ["1", "2", "3", "4"]
+        assert out[1] is None
+        assert out[2] == ["events", "logs"]
+        with pytest.raises(NoNodeError):
+            list(c.iter_children(["/brokers/ids", "/ghost"]))
+    finally:
+        c.stop()
+        c.close()
+
+
+@pytest.mark.parametrize("spec", ["reply:2=drop", "reply:3=trunc"])
+def test_iter_children_replays_only_unanswered_reads(
+    zk_server, monkeypatch, spec
+):
+    """Session death mid-window: the fan-out re-establishes and re-issues
+    ONLY the not-yet-yielded children reads — output identical to an
+    uninterrupted run (the read-path replay contract now covers
+    getChildren too)."""
+    monkeypatch.setenv("KA_ZK_PIPELINE", "3")
+    monkeypatch.setenv("KA_ZK_SESSION_RETRIES", "2")
+    paths = ["/brokers/ids", "/brokers/topics", "/brokers",
+             "/brokers/ids", "/brokers/topics", "/brokers"]
+    c = _client(zk_server)
+    try:
+        clean = list(c.iter_children(paths))
+    finally:
+        c.stop()
+        c.close()
+    faults.install(faults.FaultInjector(faults.parse_spec(spec)))
+    c = _client(zk_server)
+    err = io.StringIO()
+    try:
+        with contextlib.redirect_stderr(err):
+            healed = list(c.iter_children(paths))
+    finally:
+        c.stop()
+        c.close()
+    assert healed == clean
+    assert "re-establishing" in err.getvalue()
+    faults.install(None)
+
+
+# --- the live-ZK execution path ----------------------------------------------
+
+def _wire_env(monkeypatch):
+    monkeypatch.setenv("KA_ZK_CLIENT", "wire")
+    monkeypatch.setenv("KA_EXEC_WAVE_SIZE", "2")
+    monkeypatch.setenv("KA_EXEC_POLL_INTERVAL", "0.01")
+    monkeypatch.setenv("KA_EXEC_POLL_TIMEOUT", "10")
+
+
+@pytest.mark.parametrize("treefn", [cluster_tree, cluster_tree_with_states])
+def test_ka_execute_against_live_zk(tmp_path, monkeypatch, treefn):
+    """End to end over the real wire protocol: plan file → waves written to
+    /admin/reassign_partitions → the simulated controller applies them →
+    convergence observed (topic znodes; plus ISR state znodes when the
+    layout has them) → verify-after-move OK."""
+    _wire_env(monkeypatch)
+    server = JuteZkServer(treefn(), controller_delay_ops=1)
+    server.start()
+    try:
+        plan = {
+            "events": {0: [4, 3, 2], 1: [1, 2, 3]},
+            "logs": {0: [2, 1]},
+        }
+        plan_path = tmp_path / "plan.json"
+        plan_path.write_text(format_reassignment_pairs(
+            [(t, plan[t]) for t in plan]
+        ))
+        err = io.StringIO()
+        with contextlib.redirect_stderr(err):
+            rc = execute([
+                "--zk_string", f"127.0.0.1:{server.port}",
+                "--plan", str(plan_path),
+                "--journal", str(tmp_path / "j"),
+            ])
+        assert rc == EXIT_OK, err.getvalue()
+        assert "verify-after-move OK" in err.getvalue()
+        # The admin znode is cleaned up and the tree shows the targets.
+        assert "/admin/reassign_partitions" not in server.tree
+        events = json.loads(server.tree["/brokers/topics/events"])
+        assert events["partitions"]["0"] == [4, 3, 2]
+        if treefn is cluster_tree_with_states:
+            state = json.loads(
+                server.tree["/brokers/topics/events/partitions/0/state"]
+            )
+            assert state["isr"] == [4, 3, 2]
+        assert server.write_ops["create"] >= 2  # one admin znode per wave
+    finally:
+        server.shutdown()
+
+
+def test_apply_assignment_waits_out_a_stuck_admin_znode(monkeypatch):
+    """An /admin/reassign_partitions left by another operator that never
+    clears: apply_assignment must give up WITHIN the poll budget with the
+    resumable ExecuteError, not hang."""
+    _wire_env(monkeypatch)
+    monkeypatch.setenv("KA_EXEC_POLL_TIMEOUT", "0.2")
+    tree = cluster_tree()
+    tree["/admin/reassign_partitions"] = b'{"version":1,"partitions":[]}'
+    server = JuteZkServer(tree, controller_delay_ops=10 ** 9)
+    server.start()
+    backend = ZkBackend(f"127.0.0.1:{server.port}")
+    try:
+        with pytest.raises(ExecuteError, match="already in flight"):
+            backend.apply_assignment({"events": {0: [4, 3, 2]}})
+    finally:
+        backend.close()
+        server.shutdown()
+
+
+def test_zk_backend_state_poll_reads_isr_from_state_znodes(monkeypatch):
+    monkeypatch.setenv("KA_ZK_CLIENT", "wire")
+    tree = cluster_tree_with_states()
+    # A lagging follower: ISR smaller than the replica list.
+    tree["/brokers/topics/events/partitions/0/state"] = json.dumps(
+        {"isr": [1, 2], "leader": 1}
+    ).encode()
+    server = JuteZkServer(tree)
+    server.start()
+    backend = ZkBackend(f"127.0.0.1:{server.port}")
+    try:
+        state = backend.read_assignment_state(["events", "logs", "ghost"])
+        assert state["events"][0].replicas == [1, 2, 3]
+        assert state["events"][0].isr == [1, 2]       # from the state znode
+        assert state["logs"][0].isr == [3, 4]
+        assert "ghost" not in state
+    finally:
+        backend.close()
+        server.shutdown()
