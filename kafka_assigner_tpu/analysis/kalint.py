@@ -48,6 +48,13 @@ KA010  a ZooKeeper WRITE opcode (``OP_CREATE``/``OP_SET_DATA``/
        the write-safety rule (ISSUE 7): writes are never pipelined through
        the xid window and never blindly replayed after session
        re-establishment, so no other code may build a write frame
+KA011  a ``while True`` loop containing a blocking socket/poll call
+       (``recv*``, ``accept``, ``poll``, ``select``, ``sleep``) whose
+       enclosing function consults NO deadline: neither a registered
+       ``KA_*`` knob whose name carries TIMEOUT/INTERVAL/RETRIES/DEADLINE
+       nor a ``.settimeout(...)`` call — a resident daemon must not be
+       able to regress into an unbounded wait (ISSUE 8); loops genuinely
+       bounded elsewhere carry a reasoned suppression naming the bound
 ====== =====================================================================
 
 Suppression: put ``# kalint: disable=KA002 -- <reason>`` on the offending
@@ -82,6 +89,7 @@ RULES = {
     "KA008": "except clause swallows the exception silently (pass/continue)",
     "KA009": "ops/ jit entry dispatched outside a bucket-boundary module",
     "KA010": "ZooKeeper write opcode outside the serial write path",
+    "KA011": "unbounded blocking recv/poll loop (no deadline knob consulted)",
 }
 
 #: Modules whose ENTIRE body is treated as traced kernel code (KA002): these
@@ -695,6 +703,84 @@ def _check_ka010(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
     return out
 
 
+#: Call names that block on external progress (KA011): any ``recv*``
+#: variant plus the accept/poll/select family and bare sleeps. Deliberately
+#: name-based — the rule is a tripwire for new unbounded wait loops, not a
+#: full escape analysis.
+_BLOCKING_NAMES = frozenset({"accept", "poll", "select", "sleep"})
+#: Substrings of knob names that count as a deadline consult (KA011).
+_DEADLINE_TOKENS = ("TIMEOUT", "INTERVAL", "RETRIES", "DEADLINE")
+
+
+def _is_blocking_call(node: ast.Call) -> bool:
+    f = node.func
+    name = None
+    if isinstance(f, ast.Attribute):
+        name = f.attr
+    elif isinstance(f, ast.Name):
+        name = f.id
+    if name is None:
+        return False
+    return "recv" in name or name in _BLOCKING_NAMES
+
+
+def _scope_consults_deadline(scope: ast.AST) -> bool:
+    """True when ``scope`` (function or module) reads a deadline-shaped
+    registered knob (a ``KA_*`` literal carrying TIMEOUT/INTERVAL/RETRIES/
+    DEADLINE) or sets a socket timeout — the evidence KA011 accepts that a
+    blocking loop is bounded."""
+    for node in ast.walk(scope):
+        v = _knob_literal(node)
+        if v is not None and any(tok in v for tok in _DEADLINE_TOKENS):
+            return True
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "settimeout"
+        ):
+            return True
+    return False
+
+
+def _check_ka011(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    consult_cache: dict = {}
+
+    def consults(scope: ast.AST) -> bool:
+        key = id(scope)
+        if key not in consult_cache:
+            consult_cache[key] = _scope_consults_deadline(scope)
+        return consult_cache[key]
+
+    def visit(node: ast.AST, scope: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_scope = scope
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_scope = child
+            if (
+                isinstance(child, ast.While)
+                and isinstance(child.test, ast.Constant)
+                and child.test.value in (True, 1)
+                and any(
+                    isinstance(n, ast.Call) and _is_blocking_call(n)
+                    for n in ast.walk(child)
+                )
+                and not consults(child_scope)
+            ):
+                out.append(Finding(
+                    "KA011", path, child.lineno, child.col_offset + 1,
+                    "blocking recv/poll loop with no deadline: the "
+                    "enclosing function consults no registered KA_* "
+                    "timeout/interval/retries knob and sets no socket "
+                    "timeout — bound the wait, or suppress with a reason "
+                    "naming where the bound lives",
+                ))
+            visit(child, child_scope)
+
+    visit(tree, tree)
+    return out
+
+
 def _check_ka008(tree: ast.AST, path: str) -> List[Finding]:
     """An ``except`` body that is exactly one ``pass`` or one bare
     ``continue`` handles nothing and records nothing — the exception
@@ -775,6 +861,7 @@ def lint_source(
         + _check_ka008(tree, path)
         + _check_ka009(tree, relpath, path)
         + _check_ka010(tree, relpath, path)
+        + _check_ka011(tree, path)
     )
     for f in raw:
         if f.rule in suppress.get(f.line, ()):  # reasoned suppression

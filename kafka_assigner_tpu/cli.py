@@ -471,6 +471,81 @@ def warm_main() -> None:
         sys.exit(EXIT_VALIDATION)
 
 
+def build_daemon_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ka-daemon",
+        description="Resident assigner daemon (daemon/service.py): holds "
+        "the ZooKeeper session, the warm program store and the encoded "
+        "cluster state in memory, keeps them fresh via ZK watches with "
+        "incremental re-encode, and serves /plan, /whatif, /healthz, "
+        "/readyz and /state over HTTP. SIGTERM drains and exits 0.",
+    )
+    p.add_argument("--zk_string", default=None,
+                   help="cluster to serve: ZK quorum host:port pairs, or a "
+                        "file://cluster.json snapshot (watchless; interval "
+                        "resync only)")
+    p.add_argument("--solver", default="tpu",
+                   choices=("greedy", "native", "tpu"),
+                   help="default solver for served /plan requests "
+                        "(per-request 'solver' overrides)")
+    p.add_argument("--failure-policy", dest="failure_policy", default=None,
+                   choices=("strict", "best-effort"),
+                   help="default failure policy for served requests "
+                        "(default: the KA_FAILURE_POLICY knob; a resident "
+                        "service usually wants best-effort — a degraded "
+                        "answer beats a dead request)")
+    p.add_argument("--bind", default=None,
+                   help="bind address (default: the KA_DAEMON_BIND knob, "
+                        "loopback)")
+    p.add_argument("--port", type=int, default=None,
+                   help="listen port (default: the KA_DAEMON_PORT knob; "
+                        "0 = ephemeral, announced on stderr)")
+    return p
+
+
+def run_daemon(argv: Optional[List[str]] = None) -> int:
+    """``ka-daemon``: start the resident daemon and serve until signaled.
+    Exit 0 after a clean SIGTERM/SIGINT drain; ingest failures of the
+    initial sync map to the documented ingest code via
+    :func:`daemon_main`."""
+    from .daemon.service import run_daemon_process
+    from .utils.compilecache import enable_persistent_cache
+
+    parser = build_daemon_parser()
+    args = parser.parse_args(argv)
+    if args.zk_string is None:
+        print("error: --zk_string is required", file=sys.stderr)
+        parser.print_usage(sys.stderr)
+        return EXIT_USAGE
+    # Fail fast on an unavailable solver backend, like the one-shot CLI.
+    get_solver(args.solver)
+    enable_persistent_cache()
+    return run_daemon_process(
+        args.zk_string,
+        solver=args.solver,
+        failure_policy=args.failure_policy,
+        bind=args.bind,
+        port=args.port,
+    )
+
+
+def daemon_main() -> None:
+    """Console entry point for ``ka-daemon`` (pyproject.toml)."""
+    from .errors import IngestError
+
+    try:
+        sys.exit(run_daemon())
+    except IngestError as e:
+        print(f"error: {e}", file=sys.stderr)
+        sys.exit(EXIT_INGEST)
+    except (ZkWireError, OSError) as e:
+        print(f"error: metadata ingest failed: {e}", file=sys.stderr)
+        sys.exit(EXIT_INGEST)
+    except (ValueError, KeyError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        sys.exit(EXIT_VALIDATION)
+
+
 def build_execute_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="ka-execute",
@@ -494,6 +569,13 @@ def build_execute_parser() -> argparse.ArgumentParser:
                    help="continue an interrupted run from its journal's "
                         "last committed wave (refused when the journal "
                         "belongs to a different plan)")
+    p.add_argument("--rollback", action="store_true",
+                   help="execute the plan file's saved CURRENT ASSIGNMENT "
+                        "snapshot instead of the NEW ASSIGNMENT payload — "
+                        "drives the cluster BACK to its pre-reassignment "
+                        "state through the same wave engine (throttled, "
+                        "journaled at <plan>.rollback.journal by default, "
+                        "verified after the moves)")
     p.add_argument("--wave-size", dest="wave_size", type=int, default=None,
                    help="partition moves per wave (default: the "
                         "KA_EXEC_WAVE_SIZE knob)")
@@ -536,10 +618,13 @@ def run_execute(argv: Optional[List[str]] = None) -> int:
 
     from . import obs
 
+    mode = (
+        "ROLLBACK_REASSIGNMENT" if args.rollback else "EXECUTE_REASSIGNMENT"
+    )
     with obs.run_capture() as run:
         status, error, rc = "error", None, 1
         try:
-            with obs.span("mode/EXECUTE_REASSIGNMENT") as sp:
+            with obs.span(f"mode/{mode}") as sp:
                 rc = _dispatch_execute(args)
                 if rc not in (EXIT_OK, EXIT_DEGRADED):
                     sp.fail()
@@ -558,7 +643,7 @@ def run_execute(argv: Optional[List[str]] = None) -> int:
         finally:
             try:
                 report = obs.build_report(
-                    run, status=status, mode="EXECUTE_REASSIGNMENT",
+                    run, status=status, mode=mode,
                     argv=list(argv) if argv is not None else sys.argv[1:],
                     error=error,
                 )
@@ -573,10 +658,26 @@ def _dispatch_execute(args) -> int:
     from .exec.engine import PlanExecutor, load_plan_file
     from .utils.env import env_choice, env_str
 
-    plan, topic_order = load_plan_file(args.plan)
-    journal_path = (
-        args.journal or env_str("KA_EXEC_JOURNAL") or args.plan + ".journal"
+    plan, topic_order = load_plan_file(
+        args.plan, section="current" if args.rollback else "new"
     )
+    # A rollback is a DIFFERENT plan (different canonical bytes, different
+    # journal identity): every DEFAULT journal source — the plan-derived
+    # path AND the KA_EXEC_JOURNAL knob — gets a rollback-specific name, so
+    # a forward run's journal is never refused or clobbered. Only an
+    # explicit --journal takes the operator's path verbatim.
+    if args.journal:
+        journal_path = args.journal
+    else:
+        env_journal = env_str("KA_EXEC_JOURNAL")
+        if env_journal:
+            journal_path = env_journal + (
+                ".rollback" if args.rollback else ""
+            )
+        else:
+            journal_path = args.plan + (
+                ".rollback.journal" if args.rollback else ".journal"
+            )
     policy = args.failure_policy or env_choice("KA_FAILURE_POLICY")
     backend = open_backend(args.zk_string)
     try:

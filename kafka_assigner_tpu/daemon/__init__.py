@@ -1,0 +1,15 @@
+"""``kafka_assigner_tpu.daemon`` — the resident assigner daemon (ISSUE 8).
+
+See :mod:`.service` for the lifecycle and HTTP surface, :mod:`.state` for
+the watch-maintained metadata cache + incremental group encode. The console
+entry point is ``ka-daemon`` (``cli.daemon_main``).
+"""
+from .service import AssignerDaemon, run_daemon_process
+from .state import CacheBackend, DaemonState
+
+__all__ = [
+    "AssignerDaemon",
+    "CacheBackend",
+    "DaemonState",
+    "run_daemon_process",
+]

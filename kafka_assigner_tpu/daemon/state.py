@@ -1,0 +1,224 @@
+"""The daemon's resident cluster state: metadata cache + incremental encode.
+
+One :class:`DaemonState` lives for the daemon's whole life. It holds what a
+fresh CLI run would re-derive from scratch — the broker list, every topic's
+partition assignment, and the batched group encode — and keeps them fresh
+via DELTA updates: a watch event names the touched topic, the daemon
+re-reads just that znode, and :meth:`apply_topic` re-encodes just that
+topic into the ``GroupEncodeAccumulator`` delta store
+(``models/problem.py``). A served ``/plan`` then assembles its exact
+encode via ``merge(topic_order)`` — byte-identical to a from-scratch
+``encode_topic_group`` of the same state (test-pinned under randomized
+churn) — instead of re-ingesting the world (the dynamic-reconfiguration
+posture of arXiv:1602.03770).
+
+Thread model: the watch thread mutates, request threads read; one lock
+guards both. ``plan_inputs`` copies everything it returns while holding the
+lock, so the solve itself runs lock-free on private arrays.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from ..io.base import BrokerInfo
+from ..models.problem import GroupEncodeAccumulator
+
+#: Topics per batched encode chunk during a full resync (the delta store is
+#: seeded through the same batched encode path a streamed ingest uses).
+RESYNC_CHUNK = 64
+
+
+class CacheBackend:
+    """A read-only ``MetadataBackend`` over the daemon's cache: the served
+    ``/plan`` and ``/whatif`` pipelines run against THIS, so the planning
+    code path is the CLI's own (``generator.py``), byte for byte — only the
+    metadata reads are answered from memory."""
+
+    rack_blind = False
+
+    def __init__(self, state: "DaemonState") -> None:
+        self._state = state
+
+    def brokers(self) -> List[BrokerInfo]:
+        return self._state.brokers()
+
+    def all_topics(self) -> List[str]:
+        return self._state.topic_names()
+
+    def partition_assignment(
+        self, topics: Sequence[str]
+    ) -> Dict[str, Dict[int, List[int]]]:
+        return self._state.assignments(topics)
+
+    def fetch_topics(
+        self, topics: Sequence[str], missing: str = "raise"
+    ) -> Iterator[Tuple[str, Optional[Dict[int, List[int]]]]]:
+        if missing == "skip":
+            # Atomic filter+copy: a watch-thread delete between a separate
+            # membership check and the read would turn the never-raise skip
+            # path into a KeyError (TOCTOU).
+            known = self._state.assignments_present(topics)
+            for t in topics:
+                yield t, known.get(t)
+            return
+        assignment = self._state.assignments(list(topics))
+        for t in topics:
+            yield t, assignment[t]
+
+    def close(self) -> None:
+        pass
+
+
+class DaemonState:
+    """The cache + delta encode, with one coarse lock (see module doc)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._brokers: List[BrokerInfo] = []
+        self._topics: Dict[str, Dict[int, List[int]]] = {}
+        self._acc: Optional[GroupEncodeAccumulator] = None
+        #: Monotonic cache version: bumped per applied change; /state shows
+        #: it so an operator can see churn landing.
+        self.version = 0
+        #: True while the cache is known (or suspected) behind the cluster:
+        #: set on session loss/resync failure, cleared by a completed
+        #: resync. Served responses carry it as ``status: "degraded"``.
+        self.stale = True
+        self.synced_once = False
+
+    # -- readers -----------------------------------------------------------
+
+    def brokers(self) -> List[BrokerInfo]:
+        with self._lock:
+            return list(self._brokers)
+
+    def topic_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._topics)
+
+    def has_topic(self, topic: str) -> bool:
+        with self._lock:
+            return topic in self._topics
+
+    def assignments(
+        self, topics: Sequence[str]
+    ) -> Dict[str, Dict[int, List[int]]]:
+        with self._lock:
+            missing = [t for t in topics if t not in self._topics]
+            if missing:
+                raise KeyError(f"topics not in the daemon cache: {missing}")
+            return {
+                t: {p: list(r) for p, r in self._topics[t].items()}
+                for t in topics
+            }
+
+    def assignments_present(
+        self, topics: Sequence[str]
+    ) -> Dict[str, Dict[int, List[int]]]:
+        """The known subset of ``topics``, filtered and copied under ONE
+        lock acquisition (the best-effort skip path's atomic read)."""
+        with self._lock:
+            return {
+                t: {p: list(r) for p, r in self._topics[t].items()}
+                for t in topics if t in self._topics
+            }
+
+    def broker_id_set(self) -> Set[int]:
+        with self._lock:
+            return {b.id for b in self._brokers}
+
+    def rack_map(self) -> Dict[int, str]:
+        with self._lock:
+            return {
+                b.id: b.rack for b in self._brokers if b.rack is not None
+            }
+
+    def encode_cluster(self):
+        """The shared broker/rack encoding underneath the delta store (the
+        post-resync warm hook predicts program signatures from it)."""
+        with self._lock:
+            return self._acc.cluster if self._acc is not None else None
+
+    def all_assignments(self) -> Dict[str, Dict[int, List[int]]]:
+        with self._lock:
+            return {
+                t: {p: list(r) for p, r in parts.items()}
+                for t, parts in self._topics.items()
+            }
+
+    def encode_shape(self) -> Optional[tuple]:
+        with self._lock:
+            if self._acc is None:
+                return None
+            return self._acc.delta_shape() or (0, 0)
+
+    # -- mutations (watch thread) ------------------------------------------
+
+    def reset(
+        self,
+        brokers: Sequence[BrokerInfo],
+        topics: Dict[str, Dict[int, List[int]]],
+    ) -> None:
+        """Full resync: replace the cache and re-seed the delta encode
+        store from scratch (chunked through the batched group encode). The
+        swap is atomic under the lock — a concurrent ``plan_inputs`` sees
+        the old world or the new one, never a mix."""
+        acc = GroupEncodeAccumulator(
+            {b.id: b.rack for b in brokers if b.rack is not None},
+            {b.id for b in brokers},
+        )
+        items = list(topics.items())
+        for i in range(0, len(items), RESYNC_CHUNK):
+            acc.update_topics(items[i:i + RESYNC_CHUNK])
+        with self._lock:
+            self._brokers = list(brokers)
+            self._topics = {
+                t: {int(p): [int(r) for r in reps] for p, reps in parts.items()}
+                for t, parts in topics.items()
+            }
+            self._acc = acc
+            self.version += 1
+            self.stale = False
+            self.synced_once = True
+
+    def apply_topic(
+        self, topic: str, parts: Optional[Dict[int, List[int]]]
+    ) -> bool:
+        """One delta: topic added/changed (``parts``) or deleted (None).
+        Re-encodes only the touched topic; returns True when a re-encode
+        happened (the service counts it as ``daemon.reencode.topics``)."""
+        with self._lock:
+            if self._acc is None:
+                return False  # never synced; the pending full resync covers it
+            if parts is None:
+                self._topics.pop(topic, None)
+                self._acc.delete_topic(topic)
+                self.version += 1
+                return False
+            clean = {
+                int(p): [int(r) for r in reps]
+                for p, reps in parts.items()
+            }
+            self._topics[topic] = clean
+            self._acc.update_topics([(topic, clean)])
+            self.version += 1
+            return True
+
+    def mark_stale(self) -> None:
+        with self._lock:
+            self.stale = True
+
+    # -- the request-side read ---------------------------------------------
+
+    def plan_inputs(self, topic_list: Sequence[str], want_encode: bool):
+        """The ``(initial, preencoded)`` pair ``stream_initial_assignment``
+        would have produced for this topic order — ``initial`` copied out,
+        ``preencoded`` assembled by ``merge`` (fresh arrays), both under
+        the lock so a concurrent delta cannot tear them."""
+        with self._lock:
+            initial = self.assignments(topic_list)
+            preencoded = None
+            if want_encode and self._acc is not None:
+                preencoded = self._acc.merge(list(topic_list))
+            return initial, preencoded

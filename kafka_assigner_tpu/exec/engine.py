@@ -36,7 +36,6 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import random
 import sys
 import time
 from typing import Dict, List, Optional, Sequence, TextIO, Tuple
@@ -67,17 +66,27 @@ from .journal import ExecutionJournal, Move, plan_fingerprint
 
 
 def load_plan_file(
-    path: str,
+    path: str, section: str = "new",
 ) -> Tuple[Dict[str, Dict[int, List[int]]], List[str]]:
     """Read a plan file into ``({topic: {partition: replicas}}, topic
     order)``. Accepts the bare reassignment JSON object, or a saved mode-3
-    stdout (the ``NEW ASSIGNMENT:`` payload is taken — NOT the rollback
-    snapshot above it). Topic order is the payload's own entry order, which
-    the verify pass reproduces byte-for-byte."""
+    stdout: ``section="new"`` (default) takes the ``NEW ASSIGNMENT:``
+    payload, ``section="current"`` takes the ``CURRENT ASSIGNMENT:``
+    rollback snapshot above it — the target ``ka-execute --rollback``
+    drives the cluster BACK to. Topic order is the payload's own entry
+    order, which the verify pass reproduces byte-for-byte."""
     with open(path, "r", encoding="utf-8") as f:
         text = f.read()
-    marker = "NEW ASSIGNMENT:"
+    marker = (
+        "NEW ASSIGNMENT:" if section == "new" else "CURRENT ASSIGNMENT:"
+    )
     had_marker = marker in text
+    if section != "new" and not had_marker:
+        raise ValueError(
+            f"plan file {path!r} carries no {marker!r} snapshot to roll "
+            "back to (a saved mode-3 stdout does; a bare plan JSON does "
+            "not)"
+        )
     if had_marker:
         # Take the payload line itself: our emitter writes it as one line,
         # and anything after it (trailing logs in a captured session) must
@@ -329,13 +338,19 @@ class PlanExecutor:
     def _await_convergence(self, index: int,
                            wave: Sequence[Move]) -> List[Move]:
         """Poll until the wave's partitions all show target replicas with a
-        covering ISR, with jittered exponential backoff; returns the moves
-        still unconverged at the poll deadline (empty = converged)."""
+        covering ISR, with jittered exponential backoff (the shared
+        ``utils/backoff.py`` progression — 0.5-1.5x jitter so many operators
+        polling one recovering controller never re-arrive in lockstep);
+        returns the moves still unconverged at the poll deadline (empty =
+        converged)."""
+        from ..utils.backoff import JitteredBackoff
         from ..utils.env import env_float
 
         timeout = env_float("KA_EXEC_POLL_TIMEOUT")
         interval = env_float("KA_EXEC_POLL_INTERVAL")
-        cap = max(timeout / 4.0, interval)
+        backoff = JitteredBackoff(
+            interval, factor=1.5, cap=max(timeout / 4.0, interval)
+        )
         deadline = time.monotonic() + timeout
         while True:
             with span("exec/poll"):
@@ -346,11 +361,7 @@ class PlanExecutor:
             if now >= deadline:
                 return pending
             counter_add("exec.retries")
-            # 0.5-1.5x jitter: many operators polling one recovering
-            # controller must not re-arrive in lockstep.
-            delay = interval * (0.5 + random.random())
-            time.sleep(min(delay, max(0.0, deadline - now)))
-            interval = min(interval * 1.5, cap)
+            time.sleep(min(backoff.next_delay(), max(0.0, deadline - now)))
 
     # -- verify ------------------------------------------------------------
 

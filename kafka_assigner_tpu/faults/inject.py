@@ -49,6 +49,19 @@ crash      wave             the execution engine dies at a wave boundary
                             (``InjectedExecCrash`` — the chaos stand-in for
                             kill -9 between waves); the journal must resume
                             the run to a byte-identical final state
+drop       watch            a watch notification is discarded before the
+                            daemon processes it — the periodic full-resync
+                            escape hatch must reconverge the cache (ISSUE 8)
+expire     session          the daemon's ZooKeeper session expires mid-
+                            request — re-establishment + watch re-arm + a
+                            bounded resync, serving stale-marked responses
+                            meanwhile
+stall      resync           one daemon resync attempt dies mid-flight
+                            (``InjectedResyncStall``); retried with backoff
+                            while responses stay degraded, never an error
+solver-crash daemon         the solve crashes inside a served request
+                            (``InjectedSolverCrash``); the request degrades
+                            to the greedy fallback in isolation
 ========== ================ ==============================================
 
 Spec grammar (``KA_FAULTS_SPEC``): semicolon-separated events
@@ -91,6 +104,14 @@ FAULT_SCOPES: Dict[str, Tuple[str, ...]] = {
     "write": ("drop", "lost"),
     "converge": ("stall",),
     "wave": ("crash",),
+    # The daemon seams (ISSUE 8): a lost watch notification, a session
+    # expiry landing mid-request, a stalled resync attempt, and a solver
+    # crash inside a served request — each consulted by the resident
+    # assigner daemon (`daemon/service.py`), never by the one-shot CLI.
+    "watch": ("drop",),
+    "session": ("expire",),
+    "resync": ("stall",),
+    "daemon": ("solver-crash",),
 }
 FAULT_KINDS = tuple(k for kinds in FAULT_SCOPES.values() for k in kinds)
 
@@ -100,6 +121,7 @@ FAULT_KINDS = tuple(k for kinds in FAULT_SCOPES.values() for k in kinds)
 RANDOM_HORIZON: Dict[str, int] = {
     "connect": 3, "handshake": 3, "reply": 64, "solve": 2, "warmup": 2,
     "write": 8, "converge": 8, "wave": 4,
+    "watch": 8, "session": 4, "resync": 4, "daemon": 4,
 }
 
 #: The scope iteration order of :func:`random_schedule`. Frozen EXPLICITLY —
@@ -111,6 +133,7 @@ RANDOM_HORIZON: Dict[str, int] = {
 RANDOM_ORDER: Tuple[str, ...] = (
     "connect", "handshake", "reply", "solve", "warmup",
     "write", "converge", "wave",
+    "watch", "session", "resync", "daemon",
 )
 
 ERR_NONODE = -101
@@ -130,6 +153,14 @@ class InjectedWarmupCrash(RuntimeError):
     ingest-overlapped warm-up thread (store corruption, compile failure on
     the background thread). The contract under test: the solve must proceed
     on the cold path, byte-identically."""
+
+
+class InjectedResyncStall(RuntimeError):
+    """The ``resync`` fault point fired — one daemon resync attempt dies
+    mid-flight (a flapping quorum during the re-read). The contract under
+    test: the daemon retries with backoff, keeps serving STALE-MARKED
+    responses meanwhile (``status: "degraded"``, never an error), and
+    converges once an attempt succeeds."""
 
 
 class InjectedExecCrash(RuntimeError):
@@ -392,6 +423,54 @@ class FaultInjector:
                 "injected fault: execution engine killed at a wave boundary"
             )
 
+    # -- daemon seams (ISSUE 8) --------------------------------------------
+
+    def watch_delivery(self) -> bool:
+        """Called by the daemon per received watch notification; a ``drop``
+        event makes the daemon DISCARD it (a notification lost between the
+        quorum and the client) — the periodic full-resync escape hatch, not
+        the watch, must then reconverge the cache."""
+        ev = self._next("watch")
+        if ev is not None and ev.kind == "drop":
+            self._fire(ev)
+            return True
+        return False
+
+    def session_check(self) -> bool:
+        """Called by the daemon at the top of each served request; an
+        ``expire`` event tells the daemon to kill its own ZooKeeper session
+        NOW (the deterministic stand-in for a server-side session expiry
+        landing mid-request) — re-establishment, watch re-arm and the
+        bounded resync are what's under test."""
+        ev = self._next("session")
+        if ev is not None and ev.kind == "expire":
+            self._fire(ev)
+            return True
+        return False
+
+    def resync_attempt(self) -> None:
+        """Called at the top of each daemon resync pass; ``stall`` raises
+        :class:`InjectedResyncStall` — the daemon must retry with backoff
+        and serve stale-marked responses meanwhile, never an error."""
+        ev = self._next("resync")
+        if ev is not None and ev.kind == "stall":
+            self._fire(ev)
+            raise InjectedResyncStall(
+                "injected fault: daemon resync attempt stalled"
+            )
+
+    def daemon_solve(self) -> None:
+        """Called at the daemon's per-request solve dispatch boundary;
+        ``solver-crash`` raises :class:`InjectedSolverCrash` — the request
+        must degrade to the greedy fallback in isolation (other requests,
+        and the daemon itself, unaffected)."""
+        ev = self._next("daemon")
+        if ev is not None and ev.kind == "solver-crash":
+            self._fire(ev)
+            raise InjectedSolverCrash(
+                "injected fault: solver crash inside a served daemon request"
+            )
+
 
 #: Programmatic override (tests) — wins over the env knob when set.
 _INSTALLED: Optional[FaultInjector] = None
@@ -459,3 +538,7 @@ def fault_point(scope: str) -> None:
         inj.warmup_attempt()
     elif scope == "wave":
         inj.wave_boundary()
+    elif scope == "resync":
+        inj.resync_attempt()
+    elif scope == "daemon":
+        inj.daemon_solve()

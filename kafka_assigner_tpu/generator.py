@@ -642,6 +642,7 @@ def print_least_disruptive_reassignment(
     context_file: Optional[str] = None,
     failure_policy: str = "strict",
     degradation: Optional[Degradation] = None,
+    ingest=None,
 ) -> Dict[str, Dict[int, List[int]]]:
     """Mode 3 — the reassignment driver (``KafkaAssignmentGenerator.java:131-187``):
     resolve the broker set (all-live default, minus exclusions), choose topics,
@@ -659,7 +660,15 @@ def print_least_disruptive_reassignment(
     is written into the caller-supplied ``degradation`` record, which the
     CLI turns into the degraded-success exit code. Unrecoverable failures
     are re-raised phase-tagged (:class:`~.errors.IngestError` /
-    :class:`~.errors.SolveError`) so the CLI exit code names the phase."""
+    :class:`~.errors.SolveError`) so the CLI exit code names the phase.
+
+    ``ingest``: optional replacement for the metadata read — a callable
+    ``(topic_list) -> (initial, preencoded)`` with exactly
+    :func:`stream_initial_assignment`'s return contract. The resident
+    daemon (ISSUE 8) injects its watch-maintained cache + incremental
+    group encode here, so a served ``/plan`` runs the identical pipeline
+    (same rollback snapshot, feasibility pass, solve and emission —
+    byte-identical stdout) without re-reading or re-encoding the world."""
     out = out if out is not None else sys.stdout
     broker_set = set(specified_brokers)
     if not broker_set:
@@ -678,12 +687,15 @@ def print_least_disruptive_reassignment(
         # solver then skips its own encode — identical arrays by
         # construction); other solvers still get the pipelined fetch.
         try:
-            initial, preencoded = stream_initial_assignment(
-                backend, topic_list, brokers, rack_assignment,
-                want_encode=(solver == "tpu"),
-                failure_policy=failure_policy, skipped=skipped,
-                desired_rf=desired_replication_factor,
-            )
+            if ingest is not None:
+                initial, preencoded = ingest(topic_list)
+            else:
+                initial, preencoded = stream_initial_assignment(
+                    backend, topic_list, brokers, rack_assignment,
+                    want_encode=(solver == "tpu"),
+                    failure_policy=failure_policy, skipped=skipped,
+                    desired_rf=desired_replication_factor,
+                )
         except Exception as e:
             if not _is_ingest_failure(e):
                 raise

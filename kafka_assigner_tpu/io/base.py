@@ -121,6 +121,20 @@ class MetadataBackend(Protocol):
         for t in topics:
             yield t, assignment[t]
 
+    # -- watch surface (ISSUE 8) ------------------------------------------
+
+    def supports_watches(self) -> bool:
+        """True when this backend can feed the resident daemon's
+        watch-driven incremental re-encode: armed reads
+        (``watch_topic_list`` / ``watch_brokers`` / ``watch_topic`` /
+        ``fetch_topics(..., watch=True)``), ``poll_watch_events`` and
+        ``session_generation``. Default False: a watchless backend
+        (snapshots, AdminClient, kazoo) still serves the daemon — it
+        degrades to interval-only full resync, identical responses, more
+        metadata I/O. The live ZooKeeper backend overrides this when the
+        in-tree wire client is underneath (``io/zk.py``)."""
+        return False
+
     # -- plan execution surface (ISSUE 7) ---------------------------------
 
     def supports_execution(self) -> bool:

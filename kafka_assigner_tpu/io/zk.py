@@ -108,7 +108,8 @@ class ZkBackend:
             binj.backend_reply()
 
     def _iter_gets(
-        self, paths: Sequence[str], missing_ok: bool = False
+        self, paths: Sequence[str], missing_ok: bool = False,
+        watch: bool = False,
     ) -> Iterator[Tuple[bytes, object]]:
         """``(data, stat)`` per path, in path order — pipelined where the
         client allows it. Wire client: the xid-matched ``iter_get`` window.
@@ -126,7 +127,11 @@ class ZkBackend:
             return
         iter_get = getattr(self._zk, "iter_get", None)
         if iter_get is not None:
-            yield from iter_get(paths, missing_ok=missing_ok)
+            if watch:  # wire client only (supports_watches gates callers)
+                yield from iter_get(paths, missing_ok=missing_ok,
+                                    watch=True)
+            else:
+                yield from iter_get(paths, missing_ok=missing_ok)
             return
         get_async = getattr(self._zk, "get_async", None)
         if get_async is not None:
@@ -218,7 +223,8 @@ class ZkBackend:
         return sorted(self._zk.get_children("/brokers/topics"))
 
     def fetch_topics(
-        self, topics: Sequence[str], missing: str = "raise"
+        self, topics: Sequence[str], missing: str = "raise",
+        watch: bool = False,
     ) -> Iterator[Tuple[str, Dict[int, List[int]]]]:
         """Batched topic-metadata fetch: yields ``(topic, {partition:
         [replica ids]})`` per input entry, in input order, as pipelined
@@ -228,10 +234,13 @@ class ZkBackend:
         — raises the wire client's ``NoNodeError`` (kazoo: its own
         ``NoNodeError``) at that topic's position, or under
         ``missing="skip"`` yields ``(topic, None)`` and keeps streaming
-        (the ``--failure-policy best-effort`` degradation path)."""
+        (the ``--failure-policy best-effort`` degradation path).
+        ``watch=True`` (wire client only; the daemon's pipelined resync)
+        arms a one-shot data watch per topic read."""
         topics = list(topics)
         paths = [f"/brokers/topics/{topic}" for topic in topics]
-        stream = self._iter_gets(paths, missing_ok=(missing == "skip"))
+        stream = self._iter_gets(paths, missing_ok=(missing == "skip"),
+                                 watch=watch)
         for topic, res in zip(topics, stream):
             if res is None:
                 counter_add("zk.topics_missing")
@@ -253,6 +262,89 @@ class ZkBackend:
         with span("zk/partition_assignment"):
             for topic, parts in self.fetch_topics(topics):
                 out[topic] = parts
+        return out
+
+    # -- watch surface (ISSUE 8: the daemon's churn feed) ------------------
+
+    TOPICS_PATH = "/brokers/topics"
+    BROKERS_PATH = "/brokers/ids"
+
+    def supports_watches(self) -> bool:
+        """True when the underlying client speaks the wire watch surface
+        (the in-tree MiniZkClient). Kazoo has its own watch machinery, but
+        the daemon's poll-driven loop is built on the wire client's explicit
+        ``poll_watches``; other clients degrade to interval resync."""
+        return all(
+            hasattr(self._zk, m)
+            for m in ("poll_watches", "session_generation", "ping")
+        )
+
+    def session_generation(self) -> int:
+        return getattr(self._zk, "session_generation", 0)
+
+    def watch_topic_list(self) -> List[str]:
+        """The sorted topic list, arming a one-shot CHILD watch on the
+        topics znode (topic created/deleted → NodeChildrenChanged)."""
+        counter_add("zk.reads")
+        return sorted(self._zk.get_children(self.TOPICS_PATH, watch=True))
+
+    def watch_brokers(self) -> List[str]:
+        """The broker-id children, arming a CHILD watch on ``/brokers/ids``
+        (broker joined/left → the daemon must fully resync: the cluster
+        encoding itself changes)."""
+        counter_add("zk.reads")
+        return sorted(
+            self._zk.get_children(self.BROKERS_PATH, watch=True), key=int
+        )
+
+    def watch_topic(self, topic: str) -> Optional[Dict[int, List[int]]]:
+        """One topic's partition assignment, arming a one-shot DATA watch
+        on its znode (partition reassigned/added → NodeDataChanged). A
+        topic deleted between listing and read returns None — the caller
+        drops it from the cache, exactly like the best-effort scan."""
+        try:
+            raw, _ = self._zk.get(
+                f"{self.TOPICS_PATH}/{topic}", watch=True
+            )
+        except Exception as e:
+            if self._is_nonode(e):
+                return None
+            raise
+        counter_add("zk.reads")
+        counter_add("zk.bytes", len(raw))
+        meta = json.loads(raw)
+        return {
+            int(p): [int(x) for x in replicas]
+            for p, replicas in meta.get("partitions", {}).items()
+        }
+
+    #: Idle keepalive cadence for the watch-poll loop: a third of the
+    #: session timeout, like real ZK clients. Pinging EVERY poll would make
+    #: each blocking read return on its own ping reply (~RTT) instead of
+    #: pacing at the poll timeout — a busy loop against the quorum.
+    PING_INTERVAL_S = ZK_TIMEOUT_S / 3.0
+
+    def poll_watch_events(self, timeout: float = 0.25) -> List[tuple]:
+        """Drain watch notifications into normalized daemon events:
+        ``("topics", None)`` — the topic set changed (re-list + diff);
+        ``("topic", name)`` — one topic's data changed or it was deleted
+        (re-read-with-watch tells which); ``("brokers", None)`` — the
+        broker set changed (full resync). Unknown paths are ignored."""
+        now = time.monotonic()
+        if now - getattr(self, "_last_ping", 0.0) >= self.PING_INTERVAL_S:
+            self._zk.ping()
+            self._last_ping = now
+        out: List[tuple] = []
+        for ev in self._zk.poll_watches(timeout):
+            if ev.path == self.TOPICS_PATH:
+                out.append(("topics", None))
+            elif ev.path == self.BROKERS_PATH \
+                    or ev.path.startswith(self.BROKERS_PATH + "/"):
+                out.append(("brokers", None))
+            elif ev.path.startswith(self.TOPICS_PATH + "/"):
+                rest = ev.path[len(self.TOPICS_PATH) + 1:]
+                if "/" not in rest:  # the topic znode itself
+                    out.append(("topic", rest))
         return out
 
     # -- plan execution surface (ISSUE 7) ---------------------------------

@@ -95,6 +95,15 @@ ERR_NODEEXISTS = -110
 ERR_BADVERSION = -103
 
 PING_XID = -2
+#: The server-initiated notification "xid" (ClientCnxn.NOTIFICATION_XID):
+#: a WatcherEvent frame, not a reply to any request.
+NOTIFICATION_XID = -1
+
+#: WatcherEvent types (org.apache.zookeeper.Watcher.Event.EventType).
+EVENT_CREATED = 1
+EVENT_DELETED = 2
+EVENT_DATA_CHANGED = 3
+EVENT_CHILDREN_CHANGED = 4
 
 #: world:anyone open ACL (ZooDefs.Ids.OPEN_ACL_UNSAFE) — the only ACL the
 #: reassignment admin znode needs; vector of one ACL{perms=ALL(31),
@@ -132,6 +141,17 @@ class NodeExistsError(ZkWireError):
 class BadVersionError(ZkWireError):
     """A versioned write lost its compare-and-set race
     (KeeperException.BadVersion) — somebody else mutated the znode."""
+
+
+class WatchEvent(NamedTuple):
+    """One server-pushed WatcherEvent (type, keeper state, chroot-stripped
+    path). ZooKeeper watches are one-shot: after an event the caller must
+    re-read WITH a fresh watch flag to stay subscribed — which conveniently
+    is also the re-read the daemon's delta re-encode needs (ISSUE 8)."""
+
+    type: int
+    state: int
+    path: str
 
 
 class ZnodeStat(NamedTuple):
@@ -237,6 +257,14 @@ class MiniZkClient:
         self._sock: Optional[socket.socket] = None
         self._xid = 0
         self._max_in_flight = 0  # high-water mark across this session
+        #: Pending server-pushed WatcherEvents (drained by poll_watches).
+        self._watch_events: List[WatchEvent] = []
+        #: Bumped on every successful (re-)establishment: watches do NOT
+        #: survive a session, so a caller that armed watches compares this
+        #: against the value it saw at arm time to detect that a transparent
+        #: in-client reconnect invalidated them (the daemon's resync
+        #: trigger, ISSUE 8).
+        self.session_generation = 0
         # Fault-injection harness hook (None in production: one attribute
         # read per frame). Resolved once per client so a run's schedule is
         # coherent across reconnects.
@@ -250,6 +278,7 @@ class MiniZkClient:
         fleet of callers does not pile onto the first quorum member), with
         exponential backoff between passes. Every failed pass is warned on
         stderr — a silent half-minute of retries looks exactly like a hang."""
+        from ..utils.backoff import JitteredBackoff
         from ..utils.env import env_int
 
         deadline_t = timeout if timeout is not None else self._timeout
@@ -257,6 +286,10 @@ class MiniZkClient:
         endpoints = list(self._endpoints)
         random.shuffle(endpoints)
         last_err: Optional[Exception] = None
+        # Jittered backoff (0.5x-1.5x the nominal step): a fleet of
+        # parallel what-if workers retrying a flapped quorum member must
+        # not re-arrive in lockstep (thundering herd).
+        pass_backoff = JitteredBackoff(0.1, cap=2.0)
         for attempt in range(1, retries + 1):
             for host, port in endpoints:
                 try:
@@ -273,6 +306,7 @@ class MiniZkClient:
                     )
                     self._sock = sock
                     self._handshake(int(deadline_t * 1000))
+                    self.session_generation += 1
                     return
                 except (OSError, ZkWireError) as e:
                     last_err = e
@@ -280,11 +314,7 @@ class MiniZkClient:
                         self._sock.close()
                         self._sock = None
             if attempt < retries:
-                # Jittered backoff (0.5x-1.5x the nominal step): a fleet of
-                # parallel what-if workers retrying a flapped quorum member
-                # must not re-arrive in lockstep (thundering herd).
-                backoff = min(0.1 * (2 ** (attempt - 1)), 2.0)
-                backoff *= 0.5 + random.random()
+                backoff = pass_backoff.next_delay()
                 print(
                     f"kafka-assigner: ZooKeeper connect pass {attempt}/"
                     f"{retries} failed over {len(endpoints)} endpoint(s) "
@@ -351,6 +381,8 @@ class MiniZkClient:
         """Tear down the dead socket and establish a fresh session (which
         itself retries over the endpoint list): the in-session half of the
         resilience layer. Jittered backoff, loud stderr, counted."""
+        from ..utils.backoff import JitteredBackoff
+
         counter_add("zk.session.reestablished")
         if self._sock is not None:
             try:
@@ -358,7 +390,7 @@ class MiniZkClient:
             except OSError:  # kalint: disable=KA008 -- socket already dead; the reconnect below is the recovery
                 pass
             self._sock = None
-        backoff = min(0.05 * (2 ** (attempt - 1)), 1.0) * (0.5 + random.random())
+        backoff = JitteredBackoff(0.05, cap=1.0).delay_for(attempt)
         print(
             f"kafka-assigner: ZooKeeper session lost mid-read "
             f"({type(err).__name__}: {err}); re-establishing and replaying "
@@ -413,7 +445,9 @@ class MiniZkClient:
 
     def _recv_reply(self) -> Tuple[int, int, _Reader]:
         """One reply frame's ``ReplyHeader`` (xid, err) plus its body reader,
-        skipping stray ping replies (the session-keepalive xid)."""
+        skipping stray ping replies (the session-keepalive xid) and queueing
+        watch notifications (xid -1) for ``poll_watches``."""
+        # kalint: disable=KA011 -- bounded by the session socket timeout set at connect (settimeout in start)
         while True:
             raw = self._recv_frame()
             if self._faults is not None:
@@ -424,16 +458,32 @@ class MiniZkClient:
             err = r.read_int()
             if rxid == PING_XID:  # stray ping reply; not ours
                 continue
+            if rxid == NOTIFICATION_XID:  # server-pushed WatcherEvent
+                self._watch_events.append(self._decode_watch_event(r))
+                continue
             return rxid, err, r
+
+    def _decode_watch_event(self, r: _Reader) -> WatchEvent:
+        ev_type = r.read_int()
+        state = r.read_int()
+        path = r.read_str()
+        if self._chroot and path.startswith(self._chroot):
+            path = path[len(self._chroot):] or "/"
+        counter_add("zk.watch_events")
+        return WatchEvent(ev_type, state, path)
 
     def _path(self, path: str) -> str:
         return (self._chroot + path) if self._chroot else path
 
     # -- reads ------------------------------------------------------------
 
-    def get_children(self, path: str) -> List[str]:
+    def get_children(self, path: str, watch: bool = False) -> List[str]:
+        """Child listing; ``watch=True`` additionally arms a one-shot CHILD
+        watch on the znode (NodeChildrenChanged / NodeDeleted events arrive
+        via :meth:`poll_watches`)."""
         r = self._call(
-            OP_GET_CHILDREN, _pack_str(self._path(path)) + b"\x00"
+            OP_GET_CHILDREN,
+            _pack_str(self._path(path)) + (b"\x01" if watch else b"\x00"),
         )
         return _decode_children(r)
 
@@ -447,15 +497,109 @@ class MiniZkClient:
             return None
         return r.read_stat()
 
-    def get(self, path: str) -> Tuple[bytes, ZnodeStat]:
-        r = self._call(OP_GET_DATA, _pack_str(self._path(path)) + b"\x00")
+    def get(self, path: str, watch: bool = False) -> Tuple[bytes, ZnodeStat]:
+        """``getData``; ``watch=True`` additionally arms a one-shot DATA
+        watch (NodeDataChanged / NodeDeleted events via
+        :meth:`poll_watches`)."""
+        r = self._call(
+            OP_GET_DATA,
+            _pack_str(self._path(path)) + (b"\x01" if watch else b"\x00"),
+        )
         data = r.read_buffer() or b""
         return data, r.read_stat()
+
+    # -- watches (ISSUE 8: the daemon's churn feed) ------------------------
+
+    def ping(self) -> None:
+        """Session keepalive (opcode 11, xid -2): the daemon's idle watch
+        loop sends one per poll so a quiet session never expires server-side.
+        The reply is consumed (and skipped) by whichever read runs next —
+        ``_recv_reply`` and ``poll_watches`` both ignore ping replies."""
+        if self._sock is None:
+            raise ZkWireError("ZooKeeper session is not started")
+        self._send_frame(struct.pack(">ii", PING_XID, OP_PING))
+
+    def poll_watches(self, timeout: float = 0.25) -> List[WatchEvent]:
+        """Drain pending watch notifications, blocking up to ``timeout``
+        seconds for the first event. Returns the (possibly empty) event
+        list; transport death raises :class:`ZkConnectionError` — watches do
+        not survive the session, so the caller must re-establish, RE-ARM and
+        resync (``session_generation`` tells it when a transparent reconnect
+        did this underneath).
+
+        Only server-initiated frames are legal here (no request is in
+        flight): WatcherEvents are collected, ping replies are dropped
+        WITHOUT ending the wait (an idle keepalive must not turn the poll
+        into a busy loop), anything else is a desynced session. Readability
+        is tested with ``select`` before any byte is consumed, so a quiet
+        window can never abandon a half-read frame — once a frame's header
+        is on the wire, the body read runs under the ordinary session
+        socket timeout."""
+        import select
+
+        events, self._watch_events = self._watch_events, []
+        if events or self._sock is None:
+            return events
+        deadline = time.monotonic() + max(timeout, 0.0)
+        # kalint: disable=KA011 -- bounded by the caller-passed timeout: every select waits at most the remaining deadline and an empty poll returns
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return events
+            try:
+                ready, _, _ = select.select([self._sock], [], [], remaining)
+            except OSError as e:
+                raise ZkConnectionError(
+                    f"ZooKeeper session died while polling watches: {e}"
+                ) from e
+            if not ready:
+                return events
+            raw = self._recv_frame()
+            r = _Reader(raw)
+            rxid = r.read_int()
+            r.read_long()  # zxid
+            r.read_int()   # err
+            if rxid == NOTIFICATION_XID:
+                events.append(self._decode_watch_event(r))
+                events.extend(self._drain_ready_watches())
+                return events
+            if rxid != PING_XID:
+                raise ZkConnectionError(
+                    f"unexpected reply xid {rxid} on an idle session while "
+                    "polling watches (desynced)"
+                )
+
+    def _drain_ready_watches(self) -> List[WatchEvent]:
+        """Collect frames already queued behind a just-read notification:
+        zero-timeout readability probes, so nothing blocks and no frame is
+        ever half-read."""
+        import select
+
+        out: List[WatchEvent] = []
+        assert self._sock is not None
+        # kalint: disable=KA011 -- select() with zero timeout bounds every iteration; the loop exits on the first empty probe
+        while True:
+            ready, _, _ = select.select([self._sock], [], [], 0)
+            if not ready:
+                return out
+            raw = self._recv_frame()
+            r = _Reader(raw)
+            rxid = r.read_int()
+            r.read_long()
+            r.read_int()
+            if rxid == NOTIFICATION_XID:
+                out.append(self._decode_watch_event(r))
+            elif rxid != PING_XID:
+                raise ZkConnectionError(
+                    f"unexpected reply xid {rxid} while draining "
+                    "watch notifications"
+                )
 
     # -- pipelined reads --------------------------------------------------
 
     def iter_get(
-        self, paths: Sequence[str], missing_ok: bool = False
+        self, paths: Sequence[str], missing_ok: bool = False,
+        watch: bool = False,
     ) -> Iterator[Optional[Tuple[bytes, ZnodeStat]]]:
         """Pipelined ``getData`` over the session socket: up to
         ``KA_ZK_PIPELINE`` requests in flight at once, responses matched by
@@ -492,12 +636,16 @@ class MiniZkClient:
         serial ``zk.op_ms`` histogram (which therefore covers serial ops
         only).
 
+        ``watch=True`` arms a one-shot DATA watch per read (the daemon's
+        pipelined resync re-arm, ISSUE 8) — notifications arrive via
+        :meth:`poll_watches`.
+
         Not thread-safe: one pipelined batch (or serial call) at a time per
         client — the streaming ingest hands the whole client to its producer
         thread for the duration of the batch.
         """
         yield from self._iter_pipelined(paths, missing_ok, OP_GET_DATA,
-                                        _decode_get)
+                                        _decode_get, watch)
 
     def iter_children(
         self, paths: Sequence[str], missing_ok: bool = False
@@ -511,11 +659,12 @@ class MiniZkClient:
         yield from self._iter_pipelined(paths, missing_ok, OP_GET_CHILDREN,
                                         _decode_children)
 
-    def _iter_pipelined(self, paths, missing_ok, op, decode):
+    def _iter_pipelined(self, paths, missing_ok, op, decode, watch=False):
         """The shared pipelined-read driver behind :meth:`iter_get` and
         :meth:`iter_children`: the window/replay loop, parameterized only by
-        READ opcode + body decoder. Write opcodes must never reach this path
-        (the module write-safety rule; kalint KA010)."""
+        READ opcode + body decoder (+ the read watch flag). Write opcodes
+        must never reach this path (the module write-safety rule; kalint
+        KA010)."""
         if self._sock is None:
             raise ZkWireError("ZooKeeper session is not started")
         from ..utils.env import env_int
@@ -531,7 +680,7 @@ class MiniZkClient:
         attempt = 0
         while yielded < n:
             inner = self._iter_window(paths, yielded, window, missing_ok,
-                                      op, decode)
+                                      op, decode, watch)
             try:
                 try:
                     for res in inner:
@@ -567,6 +716,7 @@ class MiniZkClient:
         missing_ok: bool,
         op: int,
         decode,
+        watch: bool = False,
     ) -> Iterator[object]:
         """One session's attempt at positions ``start..n-1`` of a pipelined
         batch (the replay loop in :meth:`_iter_pipelined` re-enters here
@@ -586,7 +736,8 @@ class MiniZkClient:
                     self._xid += 1
                     self._send_frame(
                         struct.pack(">ii", self._xid, op)
-                        + _pack_str(self._path(paths[sent])) + b"\x00"
+                        + _pack_str(self._path(paths[sent]))
+                        + (b"\x01" if watch else b"\x00")
                     )
                     pending[self._xid] = sent
                     sent += 1

@@ -411,6 +411,11 @@ class GroupEncodeAccumulator:
         self._chunks: List[tuple] = []  # (encs, currents, jhashes, p_reals)
         self._total = 0
         self.encode_ms = 0.0  # host time spent in add() — the overlap numerator
+        # Delta store (the daemon's incremental re-encode, ISSUE 8): one
+        # entry per LIVE topic, each trimmed to the topic's OWN buckets so
+        # a later merge() computes group buckets from real shapes — a big
+        # topic that has since been deleted can never inflate them.
+        self._delta: Dict[str, tuple] = {}  # topic -> (enc, cur2d, jh, p)
 
     def add(self, named_currents: Sequence[tuple], rfs: int = 0) -> None:
         """Encode one chunk of ``(topic, current_assignment)`` pairs (in
@@ -470,6 +475,103 @@ class GroupEncodeAccumulator:
                 )
             i += b
         self._chunks = []
+        return encs, currents, jhashes, p_reals
+
+    # -- delta API (watch-driven incremental re-encode, ISSUE 8) -----------
+
+    def update_topics(
+        self, named_currents: Sequence[tuple], rfs: int = 0
+    ) -> int:
+        """(Re-)encode the given topics into the delta store — the touched
+        set of one churn event (topic created, partitions reassigned/grown),
+        batched through :func:`encode_topic_group` like a streamed chunk.
+        Each topic's slab is then trimmed to its OWN buckets
+        (``_pad8(p)`` x ``max(width, 2)``), so :meth:`merge` recovers
+        exactly the group buckets a from-scratch encode of the final state
+        would compute, no matter which topics shared a chunk or have since
+        been deleted. Replaces any prior entry per topic (last write wins).
+        Returns the number of topics (re-)encoded."""
+        if not named_currents:
+            return 0
+        t0 = time.perf_counter()
+        encs, currents, _jh, _pr = encode_topic_group(
+            named_currents, {}, set(), [rfs] * len(named_currents),
+            cluster=self.cluster,
+        )
+        for i, (topic, cur) in enumerate(named_currents):
+            enc = encs[i]
+            own_p_pad = _pad8(enc.p)
+            own_width = max(
+                max((len(r) for r in cur.values()), default=0), 2
+            )
+            trimmed = np.array(
+                currents[i][:own_p_pad, :own_width], copy=True
+            )
+            self._delta[topic] = (
+                dataclasses.replace(enc, current=trimmed, p_pad=own_p_pad),
+                trimmed,
+                enc.jhash,
+                enc.p,
+            )
+        self.encode_ms += (time.perf_counter() - t0) * 1000.0
+        return len(named_currents)
+
+    def delete_topic(self, topic: str) -> bool:
+        """Drop one topic from the delta store (topic deleted on the
+        cluster). Returns whether it was present."""
+        return self._delta.pop(topic, None) is not None
+
+    def delta_topics(self) -> List[str]:
+        """The topics currently in the delta store, insertion-ordered."""
+        return list(self._delta)
+
+    def delta_shape(self) -> tuple | None:
+        """(p_pad, width) bucket maxima over the delta store's LIVE topics
+        — what a ``merge`` over all of them would bucket to — or ``None``
+        when the store is empty. The delta twin of :meth:`peek_shape` (the
+        daemon's warm-signature input)."""
+        if not self._delta:
+            return None
+        shapes = [cur.shape for _, cur, _, _ in self._delta.values()]
+        return (max(s[0] for s in shapes), max(s[1] for s in shapes))
+
+    def merge(self, topic_order: Sequence[str]) -> tuple:
+        """Assemble the delta store into group-bucketed arrays for
+        ``topic_order`` — the same ``(encs, currents, jhashes, p_reals)``
+        tuple (and the same BYTES, test-pinned under randomized churn) as
+        one-shot :func:`encode_topic_group` over the final state in that
+        order. Non-destructive: the store keeps serving later merges.
+        Unknown topics raise ``KeyError`` — the daemon resyncs rather than
+        plan against a topic it never encoded."""
+        entries = []
+        for t in topic_order:
+            try:
+                entries.append(self._delta[t])
+            except KeyError:
+                raise KeyError(
+                    f"topic {t!r} is not in the delta encode store"
+                ) from None
+        if not entries:
+            return (
+                [],
+                np.full((1, 8, 2), -1, dtype=np.int32),
+                np.zeros(1, dtype=np.int32),
+                np.zeros(1, dtype=np.int32),
+            )
+        p_pad = max(cur.shape[0] for _, cur, _, _ in entries)
+        width = max(cur.shape[1] for _, cur, _, _ in entries)
+        b_pad = batch_bucket(len(entries))
+        currents = np.full((b_pad, p_pad, width), -1, dtype=np.int32)
+        jhashes = np.zeros(b_pad, dtype=np.int32)
+        p_reals = np.zeros(b_pad, dtype=np.int32)
+        encs: List[ProblemEncoding] = []
+        for i, (enc, cur, jh, p) in enumerate(entries):
+            currents[i, : cur.shape[0], : cur.shape[1]] = cur
+            jhashes[i] = jh
+            p_reals[i] = p
+            encs.append(
+                dataclasses.replace(enc, current=currents[i], p_pad=p_pad)
+            )
         return encs, currents, jhashes, p_reals
 
 

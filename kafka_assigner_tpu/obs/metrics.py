@@ -25,7 +25,14 @@ Namespace conventions (documented in the README "Observability" section):
   ``exec.moves`` submitted, ``exec.retries`` convergence re-polls,
   ``exec.write_retries`` read-back-then-resubmit cycles, ``exec.skipped``
   best-effort unconverged moves, ``exec.verify`` verify-after-move passes,
-  plus the ``exec.wave_ms`` wave-latency histogram.
+  plus the ``exec.wave_ms`` wave-latency histogram;
+- ``daemon.*``  the resident daemon (``daemon/service.py``): requests
+  served/degraded/shed, ``daemon.reencode.topics`` delta re-encodes,
+  resyncs and their failures, watch events/drops, sessions lost,
+  in-request solver fallbacks, watchdog overruns. Daemon-LIFETIME totals
+  live on the daemon itself (``/state``); these obs mirrors land in
+  whichever request capture is active, so each response's report envelope
+  carries the per-request deltas.
 
 Histogram bucket upper edges come from ``KA_OBS_HIST_EDGES`` (ms for timing
 histograms); one shared edge set keeps reports comparable across runs.

@@ -53,6 +53,39 @@ def predict_group_signature(
     }
 
 
+def warm_for_assignments(
+    cluster: ClusterEncoding,
+    topics,  # Mapping[str, Mapping[int, Sequence[int]]]
+    desired_rf: int = -1,
+) -> Dict[str, str]:
+    """Derive the bucketed solve signature from a FULL topic map and make
+    its programs resident — the resident daemon's post-resync warm hook
+    (ISSUE 8): after a cache (re)sync the daemon knows the exact group
+    buckets its next ``/plan`` will dispatch, so warming here means the
+    first served request after a restart or a bucket-changing churn is
+    load-bound, not compile-bound. Same outcome contract as
+    :func:`warm_solver_programs` (and the same 'prediction, not promise':
+    a per-request topic subset can only shrink the batch bucket, which
+    re-keys — wasted background work, zero correctness impact)."""
+    from ..assigner import infer_topic_rf
+    from ..models.problem import group_pads
+
+    n_topics = len(topics)
+    if n_topics == 0:
+        return {}
+    p_pad, width = group_pads(list(topics.values()))
+    rfs = []
+    for t, cur in topics.items():
+        try:
+            rf = infer_topic_rf(t, cur, desired_rf)
+        except ValueError:  # kalint: disable=KA008 -- a non-uniform-RF topic simply casts no vote; the solve itself re-raises this loudly
+            continue
+        if rf > 0:
+            rfs.append(rf)
+    rf = max(rfs, default=max(width, 2))
+    return warm_solver_programs(cluster, n_topics, p_pad, width, rf)
+
+
 def warm_solver_programs(
     cluster: ClusterEncoding,
     n_topics: int,

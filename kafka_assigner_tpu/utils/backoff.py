@@ -1,0 +1,91 @@
+"""The one jittered-exponential-backoff implementation (ISSUE 8 satellite).
+
+Three hand-rolled copies of the same loop had grown across the I/O and
+execution layers — the wire client's connect passes and in-session
+re-establishment (``io/zkwire.py``) and the execution engine's convergence
+poll (``exec/engine.py``) — each re-deriving ``min(base * factor**k, cap)``
+with 0.5–1.5x jitter inline. Divergence between them is exactly the kind of
+silent timing drift the knob registry exists to prevent, so the progression
+lives here once, with the observable timing contract pinned by
+``tests/test_backoff.py``:
+
+- attempt ``k`` (1-based) draws ``min(base * factor**(k-1), cap) * j`` with
+  ``j`` uniform in ``[0.5, 1.5)`` — the anti-thundering-herd jitter every
+  call site already used (a fleet of retriers must not re-arrive in
+  lockstep);
+- the nominal (pre-jitter) progression is deterministic and knob-driven;
+  jitter is the ONLY randomness, so a seeded ``rng`` reproduces a schedule
+  exactly.
+
+Callers own their retry COUNTING and their sleeps (the engine clamps each
+delay to its poll deadline; the wire client warns per attempt): this class
+only answers "how long is the next pause?".
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Optional
+
+
+class JitteredBackoff:
+    """Successive jittered delays: ``min(base * factor**k, cap) * jitter``.
+
+    ``factor`` defaults to the doubling every prior call site used;
+    ``cap`` bounds the nominal delay (None = uncapped); ``rng`` defaults to
+    the module-global ``random`` (pass a seeded ``random.Random`` for
+    reproducible schedules in tests).
+    """
+
+    def __init__(
+        self,
+        base: float,
+        *,
+        factor: float = 2.0,
+        cap: Optional[float] = None,
+        rng=None,
+    ) -> None:
+        if base < 0:
+            raise ValueError(f"backoff base must be >= 0, got {base}")
+        if factor < 1.0:
+            raise ValueError(f"backoff factor must be >= 1, got {factor}")
+        self.base = float(base)
+        self.factor = float(factor)
+        self.cap = None if cap is None else float(cap)
+        self._rng = rng if rng is not None else random
+        self._nominal = self.base
+
+    def peek_nominal(self) -> float:
+        """The next delay BEFORE jitter (capped) — what a log line or a
+        deadline clamp should quote, since the jittered value is drawn only
+        when the delay is actually taken."""
+        if self.cap is None:
+            return self._nominal
+        return min(self._nominal, self.cap)
+
+    def next_delay(self) -> float:
+        """Draw the next jittered delay and advance the progression."""
+        nominal = self.peek_nominal()
+        self._nominal *= self.factor
+        if self.cap is not None:
+            self._nominal = min(self._nominal, self.cap)
+        return nominal * (0.5 + self._rng.random())
+
+    def delay_for(self, attempt: int) -> float:
+        """Stateless variant: the jittered delay for 1-based ``attempt``
+        (``min(base * factor**(attempt-1), cap) * jitter``), independent of
+        the instance's own progression. For call sites whose retry counter
+        lives elsewhere (the wire client's session-reestablishment loops
+        pass their attempt number down into one shared ``_reconnect``)."""
+        if attempt < 1:
+            raise ValueError(f"attempt is 1-based, got {attempt}")
+        nominal = self.base * (self.factor ** (attempt - 1))
+        if self.cap is not None:
+            nominal = min(nominal, self.cap)
+        return nominal * (0.5 + self._rng.random())
+
+    def sleep(self) -> float:
+        """``time.sleep(next_delay())``; returns the slept delay."""
+        delay = self.next_delay()
+        time.sleep(delay)
+        return delay

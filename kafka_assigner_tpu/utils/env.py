@@ -308,6 +308,62 @@ _knob(
         "wave, so a killed run resumes idempotently via `--resume`",
 )
 
+# --- resident daemon (ka-daemon) ---------------------------------------------
+_knob(
+    "KA_DAEMON_BIND", "str", "127.0.0.1",
+    doc="address `ka-daemon` binds its HTTP surface to (the `--bind` flag "
+        "overrides). Default loopback: the daemon is an operator tool, not "
+        "an internet service — front it yourself before widening this",
+)
+_knob(
+    "KA_DAEMON_PORT", "int", 0, floor=0,
+    doc="`ka-daemon` listen port (`--port` overrides); 0 (default) picks an "
+        "ephemeral port, announced as `ka-daemon: listening on ...` on "
+        "stderr at startup",
+)
+_knob(
+    "KA_DAEMON_MAX_INFLIGHT", "int", 8, floor=1,
+    doc="backpressure gate: concurrent requests the daemon admits; beyond "
+        "it requests are shed with 503 + `Retry-After` (counted as "
+        "`daemon.requests_shed`) instead of queueing unboundedly",
+)
+_knob(
+    "KA_DAEMON_REQUEST_TIMEOUT", "float", 30.0, floor=0.1,
+    doc="watchdog budget per served request: a request exceeding it is "
+        "flagged (`daemon.watchdog_exceeded` + stderr + a failed "
+        "`daemon/request` span) so a wedged solve is visible; combined "
+        "with the inflight gate this bounds queue growth",
+)
+_knob(
+    "KA_DAEMON_RESYNC_INTERVAL", "float", 30.0, floor=0.05,
+    doc="seconds between the daemon's periodic full resyncs — the escape "
+        "hatch that reconverges the cache even when every watch "
+        "notification was lost (`watch:drop` chaos class); also the "
+        "retry cadence once prompt post-expiry resyncs are exhausted",
+)
+_knob(
+    "KA_DAEMON_RESYNC_RETRIES", "int", 3, floor=1,
+    doc="prompt bounded-resync attempts (jittered backoff) after a session "
+        "re-establishment before falling back to the "
+        "`KA_DAEMON_RESYNC_INTERVAL` cadence; the daemon serves "
+        "stale-marked (`status: degraded`) responses until a resync lands, "
+        "never an error",
+)
+_knob(
+    "KA_DAEMON_DRAIN_TIMEOUT", "float", 10.0, floor=0.0,
+    doc="seconds SIGTERM waits for in-flight requests to finish (new ones "
+        "are refused on `/readyz` immediately) before the daemon exits 0 "
+        "anyway",
+)
+_knob(
+    "KA_DAEMON_WATCH", "bool", True,
+    doc="watch-driven incremental re-encode (`daemon/`): ZooKeeper watches "
+        "feed topic churn into the group-encode delta store so only "
+        "touched topics re-encode (`daemon.reencode.topics`). Set to 0 "
+        "(or run on a watchless backend) to fall back to interval-only "
+        "full resync — identical responses, more metadata I/O",
+)
+
 # --- runtime / observability ------------------------------------------------
 _knob(
     "KA_COMPILE_CACHE", "bool", True,

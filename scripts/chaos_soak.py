@@ -516,6 +516,164 @@ def soak_exec_matrix(args, report_dir):
     return failures
 
 
+# ---------------------------------------------------------------------------
+# The daemon matrix (ISSUE 8): the resident assigner daemon under one
+# deterministic fault per daemon seam, both policies. The acceptance
+# invariants per row: every response is either byte-identical to a
+# fresh-process CLI run on the same metadata or explicitly degraded
+# (status "degraded"), zero hangs (every request bounded by the HTTP
+# timeout), and zero stranded sockets after shutdown.
+# ---------------------------------------------------------------------------
+
+DAEMON_MATRIX = [
+    ("watch-drop", "watch:0=drop"),
+    ("session-expire", "session:1=expire"),
+    ("resync-stall", "resync:1=stall"),
+    ("solver-crash", "daemon:0=solver-crash"),
+]
+
+DAEMON_ENV = {"KA_ZK_CLIENT": "wire", "KA_DAEMON_RESYNC_INTERVAL": "0.5"}
+
+
+def _daemon_post(port, timeout_s):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout_s)
+    try:
+        conn.request("POST", "/plan", body="{}")
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _daemon_await_ok(port, base, timeout_s, deadline_s=20.0,
+                     stale_window=False):
+    """Poll /plan until a non-stale ok response matching ``base``; returns
+    the failure string or None. ``stale_window=True`` (the dropped-watch
+    row) tolerates byte-divergent responses DURING the poll — a lost
+    notification means the daemon consistently serves the pre-churn world
+    until the interval resync lands, which is exactly the contract — and
+    only requires convergence by the deadline."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        status, body = _daemon_post(port, timeout_s)
+        if status != 200:
+            return f"http {status} while awaiting reconvergence"
+        diverged = body["result"]["stdout"] != base
+        if diverged and not stale_window:
+            return "response diverged from the fresh-CLI baseline"
+        if body["status"] == "ok" and not diverged:
+            return None
+        time.sleep(0.25)
+    return "never reconverged to an ok response"
+
+
+def soak_daemon_matrix(args, report_dir):
+    import socket as socket_mod
+
+    from kafka_assigner_tpu.daemon import AssignerDaemon
+    from kafka_assigner_tpu.io.zkwire import MiniZkClient
+
+    failures = []
+    for name, spec in DAEMON_MATRIX:
+        for policy in ("strict", "best-effort"):
+            tag = f"daemon[{name}/{policy}]"
+            server = JuteZkServer(cluster_tree())
+            server.start()
+            daemon = None
+            t0 = time.perf_counter()
+            try:
+                base = baseline_bytes(
+                    server.port, "greedy", report_dir, args.timeout
+                )
+                set_schedule(dict(DAEMON_ENV), spec=spec)
+                daemon = AssignerDaemon(
+                    f"127.0.0.1:{server.port}", solver="greedy",
+                    failure_policy=policy,
+                )
+                daemon.start()
+                port = daemon.http_port
+                row_fail = None
+                degraded_seen = 0
+                try:
+                    for i in range(3):
+                        try:
+                            status, body = _daemon_post(port, args.timeout)
+                        except (socket_mod.timeout, TimeoutError):
+                            row_fail = f"request {i} HUNG"
+                            break
+                        if status != 200:
+                            row_fail = f"request {i} http {status}"
+                            break
+                        if body["result"]["stdout"] != base:
+                            row_fail = f"request {i} diverged from baseline"
+                            break
+                        if body["status"] == "degraded":
+                            degraded_seen += 1
+                        elif body["status"] != "ok":
+                            row_fail = (
+                                f"request {i} status {body['status']!r}"
+                            )
+                            break
+                    if row_fail is None and name == "watch-drop":
+                        # Churn under a dropped notification: the interval
+                        # full-resync escape hatch must reconverge the
+                        # cache to the NEW cluster truth.
+                        w = MiniZkClient(f"127.0.0.1:{server.port}")
+                        w.start()
+                        w.create(
+                            "/brokers/topics/churn",
+                            b'{"partitions": {"0": [1, 2]}}',
+                        )
+                        w.stop()
+                        w.close()
+                        base = baseline_bytes(
+                            server.port, "greedy", report_dir, args.timeout
+                        )
+                        # Re-arm the row's schedule: baseline_bytes reset it.
+                        set_schedule(dict(DAEMON_ENV), spec=spec)
+                    if row_fail is None:
+                        row_fail = _daemon_await_ok(
+                            port, base, args.timeout,
+                            stale_window=(name == "watch-drop"),
+                        )
+                    if row_fail is None \
+                            and name in ("session-expire", "solver-crash") \
+                            and not degraded_seen:
+                        counters = daemon.counters()
+                        # The fault must have been survived EXPLICITLY:
+                        # either a stale-marked response or the counted
+                        # in-request fallback — never silently.
+                        if not counters.get("daemon.solve_fallbacks") \
+                                and not counters.get("daemon.session_lost"):
+                            row_fail = (
+                                "fault class never surfaced as an explicit "
+                                "degradation"
+                            )
+                finally:
+                    daemon.shutdown()
+                zk = getattr(daemon.backend, "_zk", None)
+                if getattr(zk, "_sock", None) is not None:
+                    row_fail = row_fail or "ZK socket stranded after shutdown"
+                if daemon.httpd is not None \
+                        and daemon.httpd.socket.fileno() != -1:
+                    row_fail = row_fail or \
+                        "HTTP socket stranded after shutdown"
+                if row_fail:
+                    failures.append(f"{tag}: {row_fail}")
+                else:
+                    print(
+                        f"chaos_soak: {tag}: ok "
+                        f"({time.perf_counter() - t0:.2f}s, "
+                        f"degraded={degraded_seen})",
+                        file=sys.stderr,
+                    )
+            finally:
+                server.shutdown()
+    return failures
+
+
 def soak_random(args, report_dir):
     base = with_server(
         lambda s: baseline_bytes(s.port, args.solver, report_dir,
@@ -619,6 +777,7 @@ def main(argv=None):
             if args.matrix:
                 failures = soak_matrix(args, report_dir)
                 failures += soak_exec_matrix(args, report_dir)
+                failures += soak_daemon_matrix(args, report_dir)
             else:
                 failures = soak_random(args, report_dir)
     finally:

@@ -1,12 +1,12 @@
 #!/usr/bin/env bash
 # The project lint gate: kalint (knob-registry + jit-boundary + write-path
-# house rules, KA001-KA010), the README knob-table drift check, the
-# run-report fixture schema check, the fault-matrix smoke (one injected
-# fault per class — read AND write seams — strict + best-effort), the
-# exec crash→resume smoke, and ruff (config in pyproject.toml) when
-# installed. Exits non-zero on any finding; invoked by
-# tests/test_lint_gate.py so tier-1 catches regressions without separate CI
-# plumbing.
+# + deadline house rules, KA001-KA011), the README knob-table drift check,
+# the run-report fixture schema check, the fault-matrix smoke (one injected
+# fault per class — read, write AND daemon seams — strict + best-effort),
+# the exec crash→resume smoke, the daemon lifecycle smoke, and ruff
+# (config in pyproject.toml) when installed. Exits non-zero on any finding;
+# invoked by tests/test_lint_gate.py so tier-1 catches regressions without
+# separate CI plumbing.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -30,6 +30,10 @@ python scripts/chaos_soak.py --matrix
 # Plan-execution smoke (ISSUE 7): execute → kill at a wave boundary →
 # --resume → final cluster state byte-identical to an uninterrupted run.
 python scripts/exec_smoke.py
+# Daemon lifecycle smoke (ISSUE 8): real subprocess — start → /plan →
+# injected session expiry mid-request (stale-marked, byte-identical) →
+# /plan byte-identical after resync → SIGTERM → drained exit 0.
+python scripts/daemon_smoke.py
 # Warm-start smoke (ISSUE 6): program store populate -> clear-memory -> hit
 # on the CPU backend, byte-identical output, compile.store.hits >= 1. The
 # fresh-process bench is the slow-marked tests/test_bench_warmstart.py.

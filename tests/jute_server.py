@@ -11,6 +11,11 @@ delete) that MUTATES the tree, plus a simulated Kafka controller: when
 replica moves to the topic znodes after ``controller_delay_ops`` further
 requests and deletes the admin znode — the deterministic hermetic stand-in
 for the controller's asynchronous reassignment execution.
+
+Watches (ISSUE 8, the resident daemon's churn feed): one-shot data/child
+watches registered by the watch flag on getData/getChildren, fired as
+WatcherEvent frames (xid -1) on create/setData/delete AND on the simulated
+controller's own applies, exactly like a real server.
 """
 from __future__ import annotations
 
@@ -70,6 +75,14 @@ class JuteZkServer(threading.Thread):
         self._kids = {}
         for p in self.tree:
             self._index_path(p)
+        # Watch registries (ISSUE 8): one-shot, like real ZooKeeper — a
+        # getData/getChildren request with the watch flag set registers its
+        # connection's send fn; a mutation (client write OR the simulated
+        # controller's apply) fires-and-forgets a WatcherEvent frame and
+        # removes the registration.
+        self._watch_lock = threading.Lock()
+        self._data_watches = {}   # path -> [send fn, ...]
+        self._child_watches = {}  # path -> [send fn, ...]
 
     def _index_path(self, p):
         parent = ""
@@ -101,6 +114,41 @@ class JuteZkServer(threading.Thread):
     def _exists(self, path):
         return path in self.tree or bool(self._children(path))
 
+    # -- watches (one-shot, like real ZooKeeper) ---------------------------
+
+    def _register_watch(self, table, path, send):
+        with self._watch_lock:
+            table.setdefault(path, []).append(send)
+
+    def _fire_watches(self, table, path, ev_type):
+        """Send a WatcherEvent (xid -1, zxid -1) to every one-shot watcher
+        of ``path`` in ``table`` and drop the registrations. Dead
+        connections are skipped — real servers fire and forget too."""
+        with self._watch_lock:
+            senders = table.pop(path, [])
+        if not senders:
+            return
+        frame = (
+            struct.pack(">iqi", -1, -1, 0)          # xid, zxid, err
+            + struct.pack(">ii", ev_type, 3)         # type, SyncConnected
+            + self._buf(path.encode("utf-8"))
+        )
+        for send in senders:
+            try:
+                send(frame)
+            except OSError:
+                continue  # watcher's connection is gone; nothing to notify
+
+    def _fire_mutation(self, path, ev_type):
+        """The watch fan-out for one znode mutation: the node's DATA watch
+        with the given type, plus the parent's CHILD watch when the child
+        set changed (create/delete)."""
+        self._fire_watches(self._data_watches, path, ev_type)
+        if ev_type in (1, 2):  # NodeCreated / NodeDeleted
+            parent = path.rpartition("/")[0]
+            if parent:
+                self._fire_watches(self._child_watches, parent, 4)
+
     # -- simulated Kafka controller ---------------------------------------
 
     def _accept_reassignment(self, data):
@@ -118,7 +166,10 @@ class JuteZkServer(threading.Thread):
     def _controller_tick(self):
         """Advance the simulated controller by one observed request; at
         zero, apply the pending moves to the topic (and state) znodes and
-        delete the admin znode — the controller's completion signal."""
+        delete the admin znode — the controller's completion signal. The
+        mutations fire watches like any client write would (the daemon's
+        churn feed sees controller-applied reassignments, ISSUE 8)."""
+        fired = []
         with self._tree_lock:
             if self._pending_reassign is None:
                 return
@@ -135,16 +186,21 @@ class JuteZkServer(threading.Thread):
                     meta = json.loads(self.tree[tpath])
                     meta.setdefault("partitions", {})[str(p)] = replicas
                     self.tree[tpath] = json.dumps(meta).encode()
+                    fired.append((tpath, 3))
                 spath = f"{tpath}/partitions/{p}/state"
                 if spath in self.tree:
                     smeta = json.loads(self.tree[spath])
                     smeta["isr"] = replicas
                     smeta["leader"] = replicas[0] if replicas else -1
                     self.tree[spath] = json.dumps(smeta).encode()
+                    fired.append((spath, 3))
             admin = "/admin/reassign_partitions"
             if admin in self.tree:
                 del self.tree[admin]
                 self._unindex_path(admin)
+                fired.append((admin, 2))
+        for path, ev_type in dict.fromkeys(fired):
+            self._fire_mutation(path, ev_type)
 
     # -- server loop ------------------------------------------------------
 
@@ -187,9 +243,15 @@ class JuteZkServer(threading.Thread):
             sender = threading.Thread(target=_sender, daemon=True)
             sender.start()
 
+        # One lock per connection: watch notifications arrive from OTHER
+        # connections' threads, and two un-serialized sendall calls could
+        # interleave partial frames.
+        send_lock = threading.Lock()
+
         def send(payload):
             if sender_q is None:
-                self._send_frame(conn, payload)
+                with send_lock:
+                    self._send_frame(conn, payload)
             else:
                 sender_q.put(
                     (time.monotonic() + self.reply_delay_s, payload)
@@ -259,6 +321,7 @@ class JuteZkServer(threading.Thread):
                         path.encode("utf-8")
                     )
                     send(payload)
+                    self._fire_mutation(path, 1)  # NodeCreated
                 elif op == 5 and self.writes_enabled:  # setData
                     (dlen,) = struct.unpack(">i", body[4 + plen:8 + plen])
                     data = body[8 + plen:8 + plen + max(0, dlen)]
@@ -272,6 +335,7 @@ class JuteZkServer(threading.Thread):
                         len(data), len(self._children(path))
                     )
                     send(payload)
+                    self._fire_mutation(path, 3)  # NodeDataChanged
                 elif op == 2 and self.writes_enabled:  # delete
                     with self._tree_lock:
                         if path not in self.tree:
@@ -281,11 +345,14 @@ class JuteZkServer(threading.Thread):
                         del self.tree[path]
                         self._unindex_path(path)
                     send(struct.pack(">iqi", xid, 1, 0))
+                    self._fire_mutation(path, 2)  # NodeDeleted
                 elif op == 8:  # getChildren
                     kids = self._children(path)
                     if not self._exists(path):
                         send(struct.pack(">iqi", xid, 1, -101))
                         continue
+                    if len(body) > 4 + plen and body[4 + plen]:
+                        self._register_watch(self._child_watches, path, send)
                     payload = struct.pack(">iqi", xid, 1, 0)
                     payload += struct.pack(">i", len(kids))
                     for k in kids:
@@ -296,6 +363,8 @@ class JuteZkServer(threading.Thread):
                     if data is None:
                         send(struct.pack(">iqi", xid, 1, -101))
                         continue
+                    if len(body) > 4 + plen and body[4 + plen]:
+                        self._register_watch(self._data_watches, path, send)
                     payload = (
                         struct.pack(">iqi", xid, 1, 0)
                         + self._buf(data)

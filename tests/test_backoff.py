@@ -1,0 +1,85 @@
+"""The consolidated jittered-backoff progression (``utils/backoff.py``,
+ISSUE 8 satellite): the one implementation behind the wire client's connect
+passes and session re-establishment and the execution engine's convergence
+poll. These tests pin the OBSERVABLE timing contract the three call sites
+previously hand-rolled, so the consolidation cannot have drifted it."""
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from kafka_assigner_tpu.utils.backoff import JitteredBackoff
+
+
+def _nominal(base, factor, cap, k):
+    n = base * (factor ** (k - 1))
+    return n if cap is None else min(n, cap)
+
+
+def test_stateful_progression_matches_closed_form():
+    rng = random.Random(42)
+    oracle = random.Random(42)
+    b = JitteredBackoff(0.1, cap=2.0, rng=rng)
+    for k in range(1, 12):
+        want = _nominal(0.1, 2.0, 2.0, k) * (0.5 + oracle.random())
+        assert b.next_delay() == pytest.approx(want)
+
+
+def test_stateless_delay_for_matches_closed_form():
+    # The wire client's _reconnect shape: min(0.05 * 2**(k-1), 1.0) * j.
+    rng = random.Random(7)
+    oracle = random.Random(7)
+    b = JitteredBackoff(0.05, cap=1.0, rng=rng)
+    for k in (1, 2, 3, 4, 5, 9):
+        want = _nominal(0.05, 2.0, 1.0, k) * (0.5 + oracle.random())
+        assert b.delay_for(k) == pytest.approx(want)
+
+
+def test_poll_shape_factor_and_cap():
+    # The engine's convergence poll: base=interval, factor 1.5, cap=t/4.
+    rng = random.Random(0)
+    oracle = random.Random(0)
+    b = JitteredBackoff(0.5, factor=1.5, cap=2.5, rng=rng)
+    for k in range(1, 10):
+        want = _nominal(0.5, 1.5, 2.5, k) * (0.5 + oracle.random())
+        assert b.next_delay() == pytest.approx(want)
+
+
+def test_jitter_bounds():
+    b = JitteredBackoff(1.0, cap=1.0)  # nominal pinned at 1.0 throughout
+    for _ in range(200):
+        d = b.next_delay()
+        assert 0.5 <= d < 1.5
+
+
+def test_peek_nominal_does_not_advance():
+    b = JitteredBackoff(0.2, cap=10.0, rng=random.Random(1))
+    assert b.peek_nominal() == pytest.approx(0.2)
+    assert b.peek_nominal() == pytest.approx(0.2)
+    b.next_delay()
+    assert b.peek_nominal() == pytest.approx(0.4)
+
+
+def test_cap_respected_forever():
+    b = JitteredBackoff(0.1, cap=0.3, rng=random.Random(3))
+    for _ in range(50):
+        assert b.next_delay() < 0.3 * 1.5
+    assert b.peek_nominal() == pytest.approx(0.3)
+
+
+def test_invalid_parameters_raise():
+    with pytest.raises(ValueError):
+        JitteredBackoff(-1.0)
+    with pytest.raises(ValueError):
+        JitteredBackoff(1.0, factor=0.5)
+    with pytest.raises(ValueError):
+        JitteredBackoff(1.0).delay_for(0)
+
+
+def test_seeded_rng_reproduces_schedule():
+    a = [JitteredBackoff(0.1, cap=2.0, rng=random.Random(99)).delay_for(k)
+         for k in range(1, 6)]
+    b = [JitteredBackoff(0.1, cap=2.0, rng=random.Random(99)).delay_for(k)
+         for k in range(1, 6)]
+    assert a == b

@@ -1,0 +1,471 @@
+"""The resident assigner daemon (ISSUE 8): watch-fed cache, incremental
+re-encode, the HTTP surface, the supervised lifecycle, and the zkwire watch
+protocol underneath it — all against the in-repo jute server (real TCP) or
+the hermetic snapshot backend."""
+from __future__ import annotations
+
+import contextlib
+import http.client
+import io
+import json
+import time
+
+import pytest
+
+from kafka_assigner_tpu import faults
+from kafka_assigner_tpu.cli import run
+from kafka_assigner_tpu.daemon import AssignerDaemon, CacheBackend, DaemonState
+from kafka_assigner_tpu.io.base import BrokerInfo
+from kafka_assigner_tpu.io.zkwire import (
+    EVENT_CHILDREN_CHANGED,
+    EVENT_DATA_CHANGED,
+    EVENT_DELETED,
+    MiniZkClient,
+)
+from kafka_assigner_tpu.obs.report import validate_report
+
+from .jute_server import JuteZkServer, cluster_tree
+
+
+@pytest.fixture(autouse=True)
+def _fresh_injector():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(autouse=True)
+def _daemon_env(monkeypatch):
+    monkeypatch.setenv("KA_ZK_CLIENT", "wire")
+    monkeypatch.setenv("KA_DAEMON_RESYNC_INTERVAL", "0.5")
+
+
+@pytest.fixture()
+def server():
+    s = JuteZkServer(cluster_tree())
+    s.start()
+    yield s
+    s.shutdown()
+
+
+@contextlib.contextmanager
+def running_daemon(server, **kwargs):
+    kwargs.setdefault("solver", "greedy")
+    d = AssignerDaemon(f"127.0.0.1:{server.port}", **kwargs)
+    d.start()
+    try:
+        yield d
+    finally:
+        d.shutdown()
+
+
+def fresh_cli(port_or_path, *extra):
+    """A fresh in-process CLI mode-3 run — the byte-identity oracle."""
+    zk = (
+        port_or_path if isinstance(port_or_path, str)
+        else f"127.0.0.1:{port_or_path}"
+    )
+    out, err = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+        rc = run(["--zk_string", zk, "--mode", "PRINT_REASSIGNMENT",
+                  *extra])
+    assert rc == 0, err.getvalue()
+    return out.getvalue()
+
+
+def req(port, method, path, payload=None, timeout=60.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        body = None if payload is None else json.dumps(payload)
+        conn.request(method, path, body=body)
+        resp = conn.getresponse()
+        data = json.loads(resp.read())
+        return resp.status, data, dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+# --- zkwire watch protocol ---------------------------------------------------
+
+def test_wire_data_watch_fires_on_set_and_delete(server):
+    c = MiniZkClient(f"127.0.0.1:{server.port}")
+    c.start()
+    w = MiniZkClient(f"127.0.0.1:{server.port}")
+    w.start()
+    try:
+        c.get("/brokers/topics/events", watch=True)
+        w.set_data("/brokers/topics/events", b'{"partitions": {"0": [1]}}')
+        events = c.poll_watches(timeout=5.0)
+        assert [(e.type, e.path) for e in events] == [
+            (EVENT_DATA_CHANGED, "/brokers/topics/events")
+        ]
+        # One-shot: a second mutation without re-arming fires nothing.
+        w.set_data("/brokers/topics/events", b'{"partitions": {"0": [2]}}')
+        assert c.poll_watches(timeout=0.3) == []
+        # Re-arm, then delete → NodeDeleted.
+        c.get("/brokers/topics/events", watch=True)
+        w.delete("/brokers/topics/events")
+        events = c.poll_watches(timeout=5.0)
+        assert [(e.type, e.path) for e in events] == [
+            (EVENT_DELETED, "/brokers/topics/events")
+        ]
+    finally:
+        c.close()
+        w.close()
+
+
+def test_wire_child_watch_fires_on_create(server):
+    c = MiniZkClient(f"127.0.0.1:{server.port}")
+    c.start()
+    w = MiniZkClient(f"127.0.0.1:{server.port}")
+    w.start()
+    try:
+        kids = c.get_children("/brokers/topics", watch=True)
+        assert kids == ["events", "logs"]
+        w.create("/brokers/topics/zzz", b'{"partitions": {"0": [1, 2]}}')
+        events = c.poll_watches(timeout=5.0)
+        assert (EVENT_CHILDREN_CHANGED, "/brokers/topics") in [
+            (e.type, e.path) for e in events
+        ]
+    finally:
+        c.close()
+        w.close()
+
+
+def test_wire_watch_notification_between_replies_is_queued(server):
+    """A notification landing while a normal read is in flight must not
+    desync the xid matching: it queues for poll_watches."""
+    c = MiniZkClient(f"127.0.0.1:{server.port}")
+    c.start()
+    w = MiniZkClient(f"127.0.0.1:{server.port}")
+    w.start()
+    try:
+        c.get("/brokers/topics/logs", watch=True)
+        w.set_data("/brokers/topics/logs", b'{"partitions": {"0": [3]}}')
+        time.sleep(0.2)  # let the notification hit c's socket buffer
+        data, _ = c.get("/brokers/topics/events")  # normal read still works
+        assert b"partitions" in data
+        events = c.poll_watches(timeout=1.0)
+        assert [e.path for e in events] == ["/brokers/topics/logs"]
+    finally:
+        c.close()
+        w.close()
+
+
+def test_session_generation_bumps_on_restart(server):
+    c = MiniZkClient(f"127.0.0.1:{server.port}")
+    c.start()
+    try:
+        g0 = c.session_generation
+        assert g0 >= 1
+        c.stop()
+        c.close()
+        c.start()
+        assert c.session_generation == g0 + 1
+    finally:
+        c.close()
+
+
+# --- DaemonState / CacheBackend ---------------------------------------------
+
+def _state_fixture():
+    st = DaemonState()
+    brokers = [
+        BrokerInfo(id=i, host=f"h{i}", port=9092, rack=f"r{i % 2}")
+        for i in range(1, 5)
+    ]
+    st.reset(brokers, {
+        "events": {0: [1, 2], 1: [2, 3]},
+        "logs": {0: [3, 4]},
+    })
+    return st
+
+
+def test_cache_backend_serves_metadata():
+    st = _state_fixture()
+    be = CacheBackend(st)
+    assert [b.id for b in be.brokers()] == [1, 2, 3, 4]
+    assert be.all_topics() == ["events", "logs"]
+    assert be.partition_assignment(["logs"]) == {"logs": {0: [3, 4]}}
+    assert list(be.fetch_topics(["logs", "ghost"], missing="skip")) == [
+        ("logs", {0: [3, 4]}), ("ghost", None),
+    ]
+    with pytest.raises(KeyError):
+        be.partition_assignment(["ghost"])
+
+
+def test_state_delta_and_plan_inputs():
+    st = _state_fixture()
+    v0 = st.version
+    assert st.apply_topic("fresh", {0: [1, 2, 3]})
+    assert st.version == v0 + 1
+    initial, pre = st.plan_inputs(["events", "fresh"], want_encode=True)
+    assert initial["fresh"] == {0: [1, 2, 3]}
+    encs, currents, jh, pr = pre
+    assert [e.topic for e in encs] == ["events", "fresh"]
+    # delete
+    assert not st.apply_topic("fresh", None)
+    with pytest.raises(KeyError):
+        st.plan_inputs(["fresh"], want_encode=False)
+
+
+# --- the HTTP surface --------------------------------------------------------
+
+def test_endpoints_and_plan_byte_identity(server):
+    base = fresh_cli(server.port, "--solver", "greedy")
+    with running_daemon(server) as d:
+        port = d.http_port
+        s, health, _ = req(port, "GET", "/healthz")
+        assert s == 200 and health["status"] == "ready"
+        s, ready, _ = req(port, "GET", "/readyz")
+        assert s == 200 and ready["ready"]
+        s, body, _ = req(port, "POST", "/plan", {})
+        assert s == 200 and body["status"] == "ok"
+        assert body["result"]["stdout"] == base
+        # The envelope IS a schema-v1 run report (plus the result section).
+        assert validate_report(body) == []
+        assert any(
+            sp["name"] == "daemon/request" for sp in body["spans"]
+        )
+        s, view, _ = req(port, "GET", "/state")
+        assert s == 200 and view["lifecycle"] == "ready"
+        assert view["topics"] == 2 and view["brokers"] == 4
+        s, nf, _ = req(port, "GET", "/nope")
+        assert s == 404
+
+
+def test_plan_params_mirror_cli_flags(server):
+    base = fresh_cli(
+        server.port, "--solver", "greedy",
+        "--broker_hosts_to_remove", "h4", "--topics", "events",
+    )
+    with running_daemon(server) as d:
+        s, body, _ = req(d.http_port, "POST", "/plan", {
+            "solver": "greedy",
+            "broker_hosts_to_remove": "h4",
+            "topics": ["events"],
+        })
+        assert s == 200
+        assert body["result"]["stdout"] == base
+
+
+def test_plan_tpu_solver_uses_cached_preencode(server):
+    base = fresh_cli(server.port, "--solver", "tpu")
+    with running_daemon(server, solver="tpu") as d:
+        # The post-resync warm hook made the solve programs resident in
+        # the background (or failed loudly into its counter).
+        assert _await(
+            lambda: d.counters().get("daemon.warmups", 0) >= 1
+            or d.counters().get("daemon.warmup_failures", 0) >= 1,
+            timeout=60,
+        )
+        assert not d.counters().get("daemon.warmup_failures")
+        s, body, _ = req(d.http_port, "POST", "/plan", {})
+        assert s == 200 and body["status"] == "ok"
+        assert body["result"]["stdout"] == base
+        # Narrowing the broker set must ALSO match (preencode bypassed,
+        # in-solver encode): identical bytes either way.
+        base2 = fresh_cli(
+            server.port, "--solver", "tpu",
+            "--broker_hosts_to_remove", "h4",
+        )
+        s, body2, _ = req(d.http_port, "POST", "/plan",
+                          {"broker_hosts_to_remove": "h4"})
+        assert s == 200 and body2["result"]["stdout"] == base2
+
+
+def test_bad_requests_are_400_never_500(server):
+    with running_daemon(server) as d:
+        port = d.http_port
+        s, body, _ = req(port, "POST", "/plan", {"topics": ["ghost"]})
+        assert s == 400 and body["status"] == "error"
+        assert "ghost" in body["error"]["message"]
+        s, body, _ = req(port, "POST", "/plan", {"topics": "not-a-list"})
+        assert s == 400
+        s, body, _ = req(port, "POST", "/plan",
+                         {"desired_replication_factor": "three"})
+        assert s == 400
+        # An explicit JSON null means "infer", exactly like the CLI default.
+        s, body, _ = req(port, "POST", "/plan",
+                         {"desired_replication_factor": None})
+        assert s == 200
+        s, body, _ = req(port, "POST", "/plan",
+                         {"broker_hosts": "unknown-host"})
+        assert s == 400
+        # Malformed JSON body.
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("POST", "/plan", body="{nope")
+        assert conn.getresponse().status == 400
+        conn.close()
+        # The daemon survived all of it.
+        s, body, _ = req(port, "GET", "/readyz")
+        assert s == 200
+
+
+def test_whatif_matches_cli_ranking(server):
+    out, err = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+        rc = run(["--zk_string", f"127.0.0.1:{server.port}",
+                  "--mode", "RANK_DECOMMISSION"])
+    assert rc == 0
+    with running_daemon(server) as d:
+        s, body, _ = req(d.http_port, "POST", "/whatif", {})
+        assert s == 200
+        assert body["result"]["stdout"] == out.getvalue()
+        s, body, _ = req(d.http_port, "POST", "/whatif",
+                         {"scenarios": [[1], ["h3", "h4"]]})
+        assert s == 200
+        assert "DECOMMISSION RANKING:" in body["result"]["stdout"]
+
+
+def test_backpressure_sheds_with_retry_after(server):
+    with running_daemon(server) as d:
+        # Exhaust the inflight gate from outside: every admission slot
+        # taken, the next request must shed, not queue.
+        for _ in range(d.max_inflight):
+            assert d._inflight.acquire(blocking=False)
+        try:
+            s, body, headers = req(d.http_port, "POST", "/plan", {})
+            assert s == 503
+            assert headers.get("Retry-After") == "1"
+            assert d.counters().get("daemon.requests_shed") == 1
+        finally:
+            for _ in range(d.max_inflight):
+                d._inflight.release()
+        s, body, _ = req(d.http_port, "POST", "/plan", {})
+        assert s == 200
+
+
+def test_watchdog_flags_slow_requests(server):
+    with running_daemon(server) as d:
+        d.request_timeout = 0.0  # every request overruns a zero budget
+        s, body, _ = req(d.http_port, "POST", "/plan", {})
+        assert s == 200  # flagged, not failed
+        assert body["result"]["watchdog_exceeded"] is True
+        assert d.counters().get("daemon.watchdog_exceeded") == 1
+
+
+def test_drain_refuses_and_exits_clean(server):
+    d = AssignerDaemon(f"127.0.0.1:{server.port}", solver="greedy")
+    d.start()
+    port = d.http_port
+    d.request_stop()
+    s, body, _ = req(port, "GET", "/readyz")
+    assert s == 503 and not body["ready"]
+    s, body, headers = req(port, "POST", "/plan", {})
+    assert s == 503 and body["error"] == "draining"
+    d.shutdown()
+    assert d.lifecycle() == "stopped"
+    # No stranded sockets: the ZK session and the HTTP listener are gone.
+    assert getattr(d.backend._zk, "_sock", None) is None
+    assert d.httpd.socket.fileno() == -1
+
+
+# --- watch-driven churn ------------------------------------------------------
+
+def _await(predicate, timeout=10.0, every=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(every)
+    return False
+
+
+def test_churn_updates_cache_and_stays_cli_identical(server):
+    with running_daemon(server) as d:
+        w = MiniZkClient(f"127.0.0.1:{server.port}")
+        w.start()
+        try:
+            # create (same shape class as the fixture's own topics — the
+            # reference-faithful greedy can dead-end on slack-0 topics,
+            # which is not what this test is about)
+            w.create("/brokers/topics/fresh",
+                     b'{"partitions": {"0": [1, 2, 3], "1": [2, 3, 4]}}')
+            assert _await(lambda: "fresh" in d.state.topic_names())
+            # reassign (data change)
+            w.set_data("/brokers/topics/logs",
+                       b'{"partitions": {"0": [1, 2]}}')
+            assert _await(
+                lambda: d.state.assignments(["logs"])["logs"] == {0: [1, 2]}
+            )
+            # delete
+            w.delete("/brokers/topics/events")
+            assert _await(lambda: "events" not in d.state.topic_names())
+            assert d.counters().get("daemon.reencode.topics", 0) >= 2
+            # and the served plan equals a fresh CLI run on the NEW truth
+            assert _await(lambda: not d.state.stale)
+            base = fresh_cli(server.port, "--solver", "greedy")
+            s, body, _ = req(d.http_port, "POST", "/plan", {})
+            assert s == 200 and body["result"]["stdout"] == base
+        finally:
+            w.close()
+
+
+def test_churn_race_mid_request_retries_to_fresh_truth(server):
+    """A topic deleted by the watch thread BETWEEN the request's topic-list
+    snapshot and its cache read must not surface as an error: the implicit
+    whole-cluster request retries once against the new truth."""
+    with running_daemon(server) as d:
+        orig = d.state.plan_inputs
+        fired = {"n": 0}
+
+        def racy(topic_list, want_encode):
+            if fired["n"] == 0:
+                fired["n"] += 1
+                d.state.apply_topic("logs", None)  # churn wins the race
+            return orig(topic_list, want_encode)
+
+        d.state.plan_inputs = racy
+        s, body, _ = req(d.http_port, "POST", "/plan", {})
+        assert s == 200
+        assert '"topic":"logs"' not in body["result"]["stdout"]
+        assert '"topic":"events"' in body["result"]["stdout"]
+        assert d.counters().get("daemon.churn_retries") == 1
+
+
+def test_session_loss_recovers_via_resync(server):
+    with running_daemon(server) as d:
+        assert _await(lambda: not d.state.stale)
+        d._expire_session()  # the session:expire seam's mechanics
+        assert d.state.stale  # stale-marked immediately
+        assert _await(lambda: not d.state.stale)  # re-established + resynced
+        assert d.counters().get("daemon.resyncs", 0) >= 2
+        base = fresh_cli(server.port, "--solver", "greedy")
+        s, body, _ = req(d.http_port, "POST", "/plan", {})
+        assert s == 200 and body["status"] == "ok"
+        assert body["result"]["stdout"] == base
+
+
+def test_watchless_interval_resync(server, monkeypatch):
+    monkeypatch.setenv("KA_DAEMON_WATCH", "0")
+    monkeypatch.setenv("KA_DAEMON_RESYNC_INTERVAL", "0.2")
+    with running_daemon(server) as d:
+        assert not d._use_watches
+        w = MiniZkClient(f"127.0.0.1:{server.port}")
+        w.start()
+        try:
+            w.create("/brokers/topics/later",
+                     b'{"partitions": {"0": [1, 2]}}')
+            assert _await(lambda: "later" in d.state.topic_names())
+        finally:
+            w.close()
+
+
+def test_snapshot_backend_daemon(tmp_path):
+    """The daemon serves a snapshot cluster too (watchless): hermetic
+    deployments and tests get the same surface."""
+    from .jute_server import exec_snapshot_cluster
+
+    snap = tmp_path / "cluster.json"
+    snap.write_text(json.dumps(exec_snapshot_cluster()))
+    base = fresh_cli(str(snap), "--solver", "greedy")
+    d = AssignerDaemon(str(snap), solver="greedy")
+    d.start()
+    try:
+        assert not d._use_watches
+        s, body, _ = req(d.http_port, "POST", "/plan", {})
+        assert s == 200 and body["status"] == "ok"
+        assert body["result"]["stdout"] == base
+    finally:
+        d.shutdown()

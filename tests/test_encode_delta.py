@@ -1,0 +1,157 @@
+"""Delta-update equivalence of the group encode (ISSUE 8 satellite): after
+ANY churn sequence applied through ``GroupEncodeAccumulator``'s delta API
+(topic added / deleted / grown / reassigned), ``merge(topic_order)`` must be
+byte-identical to a from-scratch ``encode_topic_group`` of the final state —
+the daemon's incremental re-encode can never drift from what a fresh process
+would compute."""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from kafka_assigner_tpu.models.problem import (
+    GroupEncodeAccumulator,
+    encode_topic_group,
+)
+
+BROKERS = set(range(1, 10))
+RACKS = {b: f"r{(b - 1) % 3}" for b in BROKERS}
+
+
+def _random_topic(rng, name):
+    p = rng.randint(1, 30)
+    rf = rng.randint(1, 3)
+    return name, {
+        pid: rng.sample(sorted(BROKERS), rf) for pid in range(p)
+    }
+
+
+def _assert_merge_equals_scratch(acc, topics):
+    order = sorted(topics)
+    encs_d, cur_d, jh_d, pr_d = acc.merge(order)
+    encs_s, cur_s, jh_s, pr_s = encode_topic_group(
+        [(t, topics[t]) for t in order], RACKS, BROKERS,
+        [0] * len(order),
+    )
+    np.testing.assert_array_equal(cur_d, cur_s)
+    np.testing.assert_array_equal(jh_d, jh_s)
+    np.testing.assert_array_equal(pr_d, pr_s)
+    assert cur_d.tobytes() == cur_s.tobytes()  # byte identity, literally
+    assert [e.topic for e in encs_d] == [e.topic for e in encs_s]
+    for ed, es in zip(encs_d, encs_s):
+        assert ed.p == es.p and ed.p_pad == es.p_pad
+        assert ed.jhash == es.jhash
+        np.testing.assert_array_equal(ed.partition_ids, es.partition_ids)
+        np.testing.assert_array_equal(ed.current, es.current)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_randomized_churn_matches_from_scratch(seed):
+    rng = random.Random(seed)
+    acc = GroupEncodeAccumulator(RACKS, BROKERS)
+    topics = {}
+    next_id = 0
+    # seed population
+    for _ in range(rng.randint(1, 6)):
+        name, cur = _random_topic(rng, f"t{next_id}")
+        next_id += 1
+        topics[name] = cur
+        acc.update_topics([(name, cur)])
+    for _step in range(25):
+        op = rng.random()
+        if op < 0.35 or not topics:  # add
+            name, cur = _random_topic(rng, f"t{next_id}")
+            next_id += 1
+            topics[name] = cur
+            acc.update_topics([(name, cur)])
+        elif op < 0.55:  # delete
+            name = rng.choice(sorted(topics))
+            del topics[name]
+            assert acc.delete_topic(name)
+        else:  # grow / reassign in place
+            name = rng.choice(sorted(topics))
+            _, cur = _random_topic(rng, name)
+            topics[name] = cur
+            acc.update_topics([(name, cur)])
+    _assert_merge_equals_scratch(acc, topics)
+
+
+def test_merge_is_non_destructive_and_order_sensitive():
+    acc = GroupEncodeAccumulator(RACKS, BROKERS)
+    a = {0: [1, 2], 1: [2, 3]}
+    b = {0: [4, 5, 6]}
+    acc.update_topics([("a", a), ("b", b)])
+    first = acc.merge(["a", "b"])
+    again = acc.merge(["a", "b"])
+    np.testing.assert_array_equal(first[1], again[1])
+    # A different order is a different (still exact) encode.
+    swapped = acc.merge(["b", "a"])
+    _, cur_s, jh_s, _ = encode_topic_group(
+        [("b", b), ("a", a)], RACKS, BROKERS, [0, 0]
+    )
+    np.testing.assert_array_equal(swapped[1], cur_s)
+    np.testing.assert_array_equal(swapped[2], jh_s)
+
+
+def test_shrink_after_giant_topic_shrinks_buckets():
+    """A deleted giant topic must not inflate later merges: the delta store
+    trims each entry to its OWN buckets, so group buckets come from the
+    live topics only — exactly like a from-scratch encode."""
+    acc = GroupEncodeAccumulator(RACKS, BROKERS)
+    giant = {p: [1, 2, 3] for p in range(200)}
+    small = {0: [1, 2]}
+    # Encoded TOGETHER in one chunk: the giant's slab must not leak into
+    # the small topic's stored entry.
+    acc.update_topics([("giant", giant), ("small", small)])
+    acc.delete_topic("giant")
+    encs, cur, jh, pr = acc.merge(["small"])
+    _, cur_s, jh_s, pr_s = encode_topic_group(
+        [("small", small)], RACKS, BROKERS, [0]
+    )
+    assert cur.shape == cur_s.shape  # 8-row bucket, not 200+
+    np.testing.assert_array_equal(cur, cur_s)
+
+
+def test_merge_unknown_topic_raises():
+    acc = GroupEncodeAccumulator(RACKS, BROKERS)
+    acc.update_topics([("known", {0: [1, 2]})])
+    with pytest.raises(KeyError, match="ghost"):
+        acc.merge(["known", "ghost"])
+
+
+def test_duplicate_topic_occurrences_in_order():
+    acc = GroupEncodeAccumulator(RACKS, BROKERS)
+    cur = {0: [1, 2], 1: [3, 4]}
+    acc.update_topics([("dup", cur)])
+    encs, cur_d, jh_d, pr_d = acc.merge(["dup", "dup"])
+    _, cur_s, jh_s, pr_s = encode_topic_group(
+        [("dup", cur), ("dup", cur)], RACKS, BROKERS, [0, 0]
+    )
+    np.testing.assert_array_equal(cur_d, cur_s)
+    np.testing.assert_array_equal(pr_d, pr_s)
+
+
+def test_empty_merge_matches_empty_finish_shape():
+    acc = GroupEncodeAccumulator(RACKS, BROKERS)
+    encs, cur, jh, pr = acc.merge([])
+    assert encs == [] and cur.shape == (1, 8, 2)
+
+
+def test_delta_and_streaming_chunks_coexist():
+    """The streaming add()/finish() path and the delta store are
+    independent: using one never corrupts the other."""
+    acc = GroupEncodeAccumulator(RACKS, BROKERS)
+    stream = [(f"s{i}", {0: [1, 2], 1: [2, 3]}) for i in range(3)]
+    acc.add(stream)
+    acc.update_topics([("d0", {0: [4, 5]})])
+    encs, cur, jh, pr = acc.finish()
+    _, cur_s, _, _ = encode_topic_group(stream, RACKS, BROKERS, [0] * 3)
+    np.testing.assert_array_equal(cur, cur_s)
+    # The delta store still serves after finish() cleared the chunks.
+    d = acc.merge(["d0"])
+    _, cur_d, _, _ = encode_topic_group(
+        [("d0", {0: [4, 5]})], RACKS, BROKERS, [0]
+    )
+    np.testing.assert_array_equal(d[1], cur_d)

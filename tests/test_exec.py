@@ -504,3 +504,101 @@ def test_mode3_reports_unplanned_topics(workdir, tmp_path):
         rep = json.load(f)
     assert rep["plan"]["unplanned_topics"] == ["ghost"]
     assert rep["metrics"]["gauges"]["ingest.topics_skipped"] == 1
+
+
+# --- ka-execute --rollback (ISSUE 8 satellite) -------------------------------
+
+def _canonical_snapshot_bytes(tmp_path, data):
+    """The original cluster serialized through the snapshot writer — the
+    byte-identity oracle for 'rollback restored the initial state' (the
+    execution engine re-persists through the same writer)."""
+    from kafka_assigner_tpu.io.base import BrokerInfo
+    from kafka_assigner_tpu.io.snapshot import write_snapshot
+
+    path = str(tmp_path / "canonical_initial.json")
+    write_snapshot(
+        path,
+        [BrokerInfo(id=b["id"], host=b["host"], port=b["port"],
+                    rack=b.get("rack")) for b in data["brokers"]],
+        {t: {int(p): list(r) for p, r in parts.items()}
+         for t, parts in data["topics"].items()},
+    )
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def test_rollback_restores_byte_identical_state(workdir, tmp_path):
+    canonical = _canonical_snapshot_bytes(tmp_path, _cluster())
+    initial = _final_topics(workdir)
+
+    rc, _ = _execute(workdir)
+    assert rc == EXIT_OK
+    moved = _final_topics(workdir)
+    assert moved != initial  # the forward run really moved replicas
+
+    # Rollback through the same wave engine, default rollback journal.
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = execute(["--zk_string", workdir["cluster"],
+                      "--plan", workdir["plan"], "--rollback"])
+    assert rc == EXIT_OK, err.getvalue()
+    assert "verify-after-move OK" in err.getvalue()
+    with open(workdir["cluster"], "r", encoding="utf-8") as f:
+        assert f.read() == canonical  # byte-identical restore
+    # Its own journal identity: the forward journal is untouched, the
+    # rollback journal is complete.
+    assert os.path.exists(workdir["plan"] + ".rollback.journal")
+    with open(workdir["plan"] + ".rollback.journal", encoding="utf-8") as f:
+        assert json.load(f)["status"] == "complete"
+
+
+def test_rollback_refuses_bare_plan_json(tmp_path, capsys):
+    bare = tmp_path / "bare_plan.json"
+    bare.write_text(
+        '{"partitions": [{"topic": "events", "partition": 0, '
+        '"replicas": [1, 2, 3]}], "version": 1}'
+    )
+    cluster = tmp_path / "cluster.json"
+    cluster.write_text(json.dumps(_cluster()))
+    rc = execute(["--zk_string", str(cluster), "--plan", str(bare),
+                  "--rollback"])
+    assert rc == EXIT_VALIDATION
+    assert "no 'CURRENT ASSIGNMENT:'" in capsys.readouterr().err
+
+
+def test_load_plan_file_current_section(workdir):
+    from kafka_assigner_tpu.io.json_io import parse_reassignment_json
+
+    fwd, _ = load_plan_file(workdir["plan"])
+    cur, _ = load_plan_file(workdir["plan"], section="current")
+    with open(workdir["plan"], "r", encoding="utf-8") as f:
+        text = f.read()
+    snapshot_line = text.split("CURRENT ASSIGNMENT:", 1)[1].strip()
+    snapshot_line = snapshot_line.splitlines()[0]
+    assert cur == parse_reassignment_json(snapshot_line)
+    assert cur != fwd  # the plan really changes something
+
+
+def test_rollback_env_journal_gets_own_identity(workdir, tmp_path,
+                                                monkeypatch):
+    """KA_EXEC_JOURNAL must not make forward and rollback runs share one
+    journal: the env default gets the rollback suffix too."""
+    shared = str(tmp_path / "env.journal")
+    monkeypatch.setenv("KA_EXEC_JOURNAL", shared)
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = execute(["--zk_string", workdir["cluster"],
+                      "--plan", workdir["plan"]])
+    assert rc == EXIT_OK, err.getvalue()
+    with contextlib.redirect_stderr(err):
+        rc = execute(["--zk_string", workdir["cluster"],
+                      "--plan", workdir["plan"], "--rollback"])
+    assert rc == EXIT_OK, err.getvalue()
+    assert os.path.exists(shared)
+    assert os.path.exists(shared + ".rollback")
+    with open(shared, encoding="utf-8") as f:
+        fwd = json.load(f)
+    with open(shared + ".rollback", encoding="utf-8") as f:
+        rb = json.load(f)
+    assert fwd["plan"] != rb["plan"]  # two journal identities, both complete
+    assert fwd["status"] == rb["status"] == "complete"

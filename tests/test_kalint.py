@@ -640,3 +640,74 @@ def test_registry_defaults_match_kernel_constants():
     assert DENSE_MASK_BUDGET == knob_default("KA_DENSE_MASK_BUDGET")
     assert QUOTA_WAVE_TARGET == knob_default("KA_QUOTA_WAVE_TARGET")
     assert QUOTA_ENDGAME_HEADROOM == knob_default("KA_QUOTA_ENDGAME")
+
+
+# --- KA011: blocking recv/poll loops must consult a deadline -----------------
+
+def test_ka011_trips_on_undeadlined_recv_loop():
+    src = (
+        "def pump(sock):\n"
+        "    while True:\n"
+        "        data = sock.recv(4)\n"
+    )
+    findings = [
+        f for f in kalint.lint_source(src, "io/foo.py")
+        if f.rule == "KA011"
+    ]
+    assert len(findings) == 1
+    assert "no deadline" in findings[0].message
+
+
+def test_ka011_trips_on_poll_and_accept_and_sleep_loops():
+    for call in ("conn.accept()", "selector.select()", "time.sleep(1)"):
+        src = (
+            "def pump(x, conn, selector, time):\n"
+            "    while True:\n"
+            f"        {call}\n"
+        )
+        assert "KA011" in rules_of(kalint.lint_source(src, "foo.py")), call
+
+
+def test_ka011_satisfied_by_deadline_knob_consult():
+    src = (
+        "from .utils.env import env_float\n"
+        "\n"
+        "def pump(sock):\n"
+        '    deadline = env_float("KA_EXEC_POLL_TIMEOUT")\n'
+        "    while True:\n"
+        "        data = sock.recv(4)\n"
+    )
+    assert "KA011" not in rules_of(kalint.lint_source(src, "foo.py"))
+
+
+def test_ka011_satisfied_by_settimeout():
+    src = (
+        "def pump(sock):\n"
+        "    sock.settimeout(5.0)\n"
+        "    while True:\n"
+        "        data = sock.recv(4)\n"
+    )
+    assert "KA011" not in rules_of(kalint.lint_source(src, "foo.py"))
+
+
+def test_ka011_ignores_bounded_while_and_nonblocking_bodies():
+    src = (
+        "def pump(sock, n, q):\n"
+        "    while n:\n"            # not a forever loop
+        "        sock.recv(4)\n"
+        "        n -= 1\n"
+        "    while True:\n"         # forever, but nothing blocking
+        "        q.put(1)\n"
+        "        break\n"
+    )
+    assert "KA011" not in rules_of(kalint.lint_source(src, "foo.py"))
+
+
+def test_ka011_reasoned_suppression_holds():
+    src = (
+        "def pump(sock):\n"
+        "    # kalint: disable=KA011 -- bounded by the caller-owned socket timeout\n"
+        "    while True:\n"
+        "        data = sock.recv(4)\n"
+    )
+    assert "KA011" not in rules_of(kalint.lint_source(src, "foo.py"))
