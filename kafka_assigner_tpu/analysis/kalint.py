@@ -55,6 +55,14 @@ KA011  a ``while True`` loop containing a blocking socket/poll call
        nor a ``.settimeout(...)`` call — a resident daemon must not be
        able to regress into an unbounded wait (ISSUE 8); loops genuinely
        bounded elsewhere carry a reasoned suppression naming the bound
+KA012  daemon request-handling code (any module under ``daemon/`` except
+       ``supervisor.py``/``state.py``) reading a ``.backend`` or ``.state``
+       attribute — reaching into a supervisor's session or cache from the
+       routing/service layer is CROSS-BULKHEAD access (ISSUE 9): one
+       cluster's failure domain must stay behind its owning
+       ``ClusterSupervisor``'s methods, or a handler can trivially couple
+       two clusters' fates (the exact coupling the bulkheads exist to
+       forbid)
 ====== =====================================================================
 
 Suppression: put ``# kalint: disable=KA002 -- <reason>`` on the offending
@@ -90,6 +98,8 @@ RULES = {
     "KA009": "ops/ jit entry dispatched outside a bucket-boundary module",
     "KA010": "ZooKeeper write opcode outside the serial write path",
     "KA011": "unbounded blocking recv/poll loop (no deadline knob consulted)",
+    "KA012": "cross-bulkhead access: daemon handler reaches into a "
+             "supervisor's backend/cache",
 }
 
 #: Modules whose ENTIRE body is treated as traced kernel code (KA002): these
@@ -115,6 +125,16 @@ BUCKET_BOUNDARY_MODULES = frozenset({
 WIRE_MODULE = "io/zkwire.py"
 WRITE_OPCODES = frozenset({"OP_CREATE", "OP_SET_DATA", "OP_DELETE"})
 SERIAL_WRITE_FUNCS = frozenset({"create", "set_data", "delete"})
+#: KA012: the daemon package's bulkhead boundary. ``supervisor.py`` OWNS a
+#: cluster's backend/cache; ``state.py`` IS the cache. Everything else
+#: under ``daemon/`` (the routing/service layer, future middleware) must go
+#: through supervisor methods — a ``.backend``/``.state`` attribute read
+#: there is cross-bulkhead access.
+DAEMON_PKG_PREFIX = "daemon/"
+DAEMON_BULKHEAD_MODULES = frozenset({
+    "daemon/supervisor.py", "daemon/state.py",
+})
+BULKHEAD_ATTRS = frozenset({"backend", "state"})
 
 _KNOB_RE = re.compile(r"KA_[A-Z][A-Z0-9_]*")
 _SUPPRESS_RE = re.compile(
@@ -781,6 +801,34 @@ def _check_ka011(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+def _check_ka012(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
+    """Daemon modules outside the bulkhead boundary must not read a
+    ``.backend`` or ``.state`` attribute: the supervisor's session and
+    cache are its failure domain, and the service/routing layer touching
+    them directly couples clusters the bulkheads exist to isolate. Store
+    contexts (assignments) are not reads and stay legal; genuinely-needed
+    exceptions carry a reasoned suppression."""
+    if not relpath.startswith(DAEMON_PKG_PREFIX) \
+            or relpath in DAEMON_BULKHEAD_MODULES:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.ctx, ast.Load)
+            and node.attr in BULKHEAD_ATTRS
+        ):
+            out.append(Finding(
+                "KA012", path, node.lineno, node.col_offset + 1,
+                f".{node.attr} read outside the bulkhead boundary "
+                "(cross-bulkhead access): a supervisor's session/cache "
+                "belongs to daemon/supervisor.py — route through the "
+                "owning ClusterSupervisor's methods (handle, lifecycle, "
+                "state_view, healthz_view, counters, ...)",
+            ))
+    return out
+
+
 def _check_ka008(tree: ast.AST, path: str) -> List[Finding]:
     """An ``except`` body that is exactly one ``pass`` or one bare
     ``continue`` handles nothing and records nothing — the exception
@@ -862,6 +910,7 @@ def lint_source(
         + _check_ka009(tree, relpath, path)
         + _check_ka010(tree, relpath, path)
         + _check_ka011(tree, path)
+        + _check_ka012(tree, relpath, path)
     )
     for f in raw:
         if f.rule in suppress.get(f.line, ()):  # reasoned suppression

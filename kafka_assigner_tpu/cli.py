@@ -475,15 +475,29 @@ def build_daemon_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="ka-daemon",
         description="Resident assigner daemon (daemon/service.py): holds "
-        "the ZooKeeper session, the warm program store and the encoded "
+        "ZooKeeper sessions, the warm program store and the encoded "
         "cluster state in memory, keeps them fresh via ZK watches with "
-        "incremental re-encode, and serves /plan, /whatif, /healthz, "
-        "/readyz and /state over HTTP. SIGTERM drains and exits 0.",
+        "incremental re-encode, and serves /plan, /whatif, /execute, "
+        "/healthz, /readyz and /state over HTTP — for ONE cluster "
+        "(--zk_string) or a whole fleet (--clusters, one supervised "
+        "bulkhead per cluster, requests routed by /clusters/<name>/... "
+        "prefix). SIGTERM drains and exits 0.",
     )
     p.add_argument("--zk_string", default=None,
-                   help="cluster to serve: ZK quorum host:port pairs, or a "
-                        "file://cluster.json snapshot (watchless; interval "
-                        "resync only)")
+                   help="single cluster to serve: ZK quorum host:port "
+                        "pairs, or a file://cluster.json snapshot "
+                        "(watchless; interval resync only)")
+    p.add_argument("--clusters", default=None, metavar="SPEC",
+                   help="serve SEVERAL clusters from one daemon: "
+                        "semicolon-separated name=connect pairs (connect "
+                        "strings may contain commas), e.g. "
+                        "'west=zk1:2181,zk2:2181;east=file://east.json', "
+                        "or a path to a JSON file mapping names to connect "
+                        "strings. One ClusterSupervisor per entry: own "
+                        "session, watch loop, cache, inflight gate, "
+                        "watchdog and circuit breaker — one sick quorum "
+                        "never takes down planning for the others. "
+                        "Mutually exclusive with --zk_string")
     p.add_argument("--solver", default="tpu",
                    choices=("greedy", "native", "tpu"),
                    help="default solver for served /plan requests "
@@ -503,25 +517,79 @@ def build_daemon_parser() -> argparse.ArgumentParser:
     return p
 
 
+def parse_clusters_spec(spec: str) -> dict:
+    """Parse the ``--clusters`` value: a ``*.json``/``file://`` path to a
+    ``{name: connect}`` mapping, or inline semicolon-separated
+    ``name=connect`` pairs (connect strings keep their commas)."""
+    import json as json_mod
+
+    # Inline entries always carry '='; a bare path never does (a connect
+    # string with '=' in a PATH would be ambiguous — name it in a file).
+    if "=" not in spec and (
+        spec.startswith("file://") or spec.endswith(".json")
+    ):
+        path = spec[len("file://"):] if spec.startswith("file://") else spec
+        with open(path, "r", encoding="utf-8") as f:
+            raw = json_mod.load(f)
+        if not isinstance(raw, dict) or not raw or not all(
+            isinstance(k, str) and isinstance(v, str)
+            for k, v in raw.items()
+        ):
+            raise ValueError(
+                f"--clusters file {path!r} must be a non-empty JSON "
+                "object mapping cluster names to connect strings"
+            )
+        return dict(raw)
+    clusters = {}
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, eq, connect = entry.partition("=")
+        name, connect = name.strip(), connect.strip()
+        if not eq or not name or not connect:
+            raise ValueError(
+                f"--clusters entry {entry!r} is not of the form "
+                "name=connect"
+            )
+        if name in clusters:
+            raise ValueError(f"--clusters names {name!r} twice")
+        clusters[name] = connect
+    if not clusters:
+        raise ValueError("--clusters names no clusters")
+    return clusters
+
+
 def run_daemon(argv: Optional[List[str]] = None) -> int:
     """``ka-daemon``: start the resident daemon and serve until signaled.
     Exit 0 after a clean SIGTERM/SIGINT drain; ingest failures of the
-    initial sync map to the documented ingest code via
+    initial sync (single-cluster mode only — a multi-cluster daemon keeps
+    serving the healthy clusters) map to the documented ingest code via
     :func:`daemon_main`."""
     from .daemon.service import run_daemon_process
     from .utils.compilecache import enable_persistent_cache
 
     parser = build_daemon_parser()
     args = parser.parse_args(argv)
-    if args.zk_string is None:
-        print("error: --zk_string is required", file=sys.stderr)
+    if (args.zk_string is None) == (args.clusters is None):
+        print("error: pass exactly one of --zk_string or --clusters",
+              file=sys.stderr)
         parser.print_usage(sys.stderr)
         return EXIT_USAGE
+    clusters = None
+    if args.clusters is not None:
+        try:
+            clusters = parse_clusters_spec(args.clusters)
+        except (ValueError, OSError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            parser.print_usage(sys.stderr)
+            return EXIT_USAGE
     # Fail fast on an unavailable solver backend, like the one-shot CLI.
     get_solver(args.solver)
     enable_persistent_cache()
     return run_daemon_process(
         args.zk_string,
+        clusters=clusters,
         solver=args.solver,
         failure_policy=args.failure_policy,
         bind=args.bind,
@@ -685,6 +753,10 @@ def _dispatch_execute(args) -> int:
             backend, plan, topic_order, journal_path,
             failure_policy=policy, resume=args.resume,
             wave_size=args.wave_size, throttle=args.throttle,
+            # Journal identity = (cluster, plan sha): the connect spec
+            # stamps the journal so the same plan bytes on another cluster
+            # can never cross-resume (ISSUE 9 satellite).
+            cluster=args.zk_string,
         )
         outcome = executor.execute()
     finally:

@@ -1,15 +1,22 @@
-"""``kafka_assigner_tpu.daemon`` — the resident assigner daemon (ISSUE 8).
+"""``kafka_assigner_tpu.daemon`` — the resident assigner daemon (ISSUE 8),
+multi-cluster since ISSUE 9.
 
-See :mod:`.service` for the lifecycle and HTTP surface, :mod:`.state` for
-the watch-maintained metadata cache + incremental group encode. The console
-entry point is ``ka-daemon`` (``cli.daemon_main``).
+See :mod:`.service` for the HTTP surface and routing, :mod:`.supervisor`
+for the per-cluster bulkhead (session, watch loop, lifecycle, circuit
+breaker, /execute single-flight), :mod:`.state` for the watch-maintained
+metadata cache + incremental group encode. The console entry point is
+``ka-daemon`` (``cli.daemon_main``).
 """
-from .service import AssignerDaemon, run_daemon_process
+from .service import DEFAULT_CLUSTER, AssignerDaemon, run_daemon_process
 from .state import CacheBackend, DaemonState
+from .supervisor import CircuitBreaker, ClusterSupervisor
 
 __all__ = [
     "AssignerDaemon",
     "CacheBackend",
+    "CircuitBreaker",
+    "ClusterSupervisor",
+    "DEFAULT_CLUSTER",
     "DaemonState",
     "run_daemon_process",
 ]

@@ -1,223 +1,197 @@
-"""``assignerd`` — the resident assigner daemon (ISSUE 8 tentpole).
+"""``assignerd`` — the multi-cluster resident assigner daemon (ISSUE 8
+single-cluster core, ISSUE 9 multi-cluster tentpole).
 
-The CLI pays its whole pipeline per invocation; the daemon holds the three
-expensive residents — the ZooKeeper session, the warm program store's
-executables, and the encoded cluster state — in one long-lived process and
-answers plan/what-if requests over a small JSON-over-HTTP surface:
+One daemon process now fronts MANY clusters: ``--clusters`` (name →
+zk_string/backend spec) spawns one :class:`~.supervisor.ClusterSupervisor`
+per cluster — each owning its own wire session, watch loop, metadata cache,
+delta accumulator, lifecycle, inflight gate, watchdog and circuit breaker —
+and requests route by path prefix:
 
-========== ====== ======================================================
-endpoint   method behavior
-========== ====== ======================================================
-/plan      POST   mode-3 reassignment against the cached state; body
-                  mirrors the CLI flags (``topics``, ``broker_hosts``,
-                  ``broker_hosts_to_remove``, ``integer_broker_ids``,
-                  ``desired_replication_factor``, ``solver``,
-                  ``failure_policy``, ``disable_rack_awareness``);
-                  response = the schema-v1 run report as envelope with a
-                  ``result`` section carrying the CLI-byte-identical
-                  stdout payload
-/whatif    POST   RANK_DECOMMISSION against the cached state
-                  (``scenarios`` = arrays of broker ids/hostnames)
-/healthz   GET    liveness (always 200 while the process serves)
-/readyz    GET    readiness: 503 before the first sync and while
-                  draining; 200 otherwise (degraded included — stale
-                  answers are still answers)
-/state     GET    cache introspection: lifecycle, version, staleness,
-                  sizes, daemon counters
-========== ====== ======================================================
+=============================== ====== ==================================
+endpoint                        method behavior
+=============================== ====== ==================================
+/clusters/<name>/plan           POST   mode-3 reassignment against that
+                                       cluster's cache (body mirrors the
+                                       CLI flags; response = schema-v1 run
+                                       report envelope, ``result.stdout``
+                                       byte-identical to a fresh CLI run)
+/clusters/<name>/whatif         POST   RANK_DECOMMISSION ditto
+/clusters/<name>/execute        POST   drive a reassignment plan to
+                                       convergence via exec/engine.py:
+                                       single-flight per cluster (409 on a
+                                       concurrent attempt), journaled like
+                                       ``ka-execute`` (journal identity =
+                                       cluster × plan sha), streaming
+                                       wave-by-wave NDJSON progress
+                                       events; a daemon kill mid-run
+                                       resumes via ``resume`` (body or
+                                       ``?resume=1``) or offline
+                                       ``ka-execute --resume``
+/clusters/<name>/healthz        GET    that cluster's lifecycle + breaker
+/clusters/<name>/readyz         GET    that cluster's readiness
+/clusters/<name>/state          GET    that cluster's cache introspection
+/healthz                        GET    single-cluster: byte-identical to
+                                       PR 8; multi: worst-of aggregate +
+                                       per-cluster statuses and breaker
+                                       states
+/readyz                         GET    single: as before; multi: 200 when
+                                       ANY cluster serves (bulkheads —
+                                       one dead quorum must not unready
+                                       the healthy ones)
+/state                          GET    single: as before; multi: per-
+                                       cluster views
+/plan /whatif /execute          POST   single-cluster mode only (routed to
+                                       the one cluster, byte-identical to
+                                       PR 8); under ``--clusters`` they
+                                       400 with the cluster list
+=============================== ====== ==================================
 
-Supervised lifecycle (the robustness core):
+Isolation is enforced as bulkheads (per-cluster inflight gates/watchdogs,
+per-cluster sessions — see ``supervisor.py``) with ONE shared solve lock
+(one accelerator). A stalled resync or quorum blackout on cluster A sheds
+or stale-serves only A's requests; B's stay ``ok`` and byte-identical —
+proven by the multi-cluster rows of ``scripts/chaos_soak.py --matrix`` and
+the two-cluster ``scripts/daemon_smoke.py --multi``.
 
-- **session expiry** → the wire client re-establishes; the daemon detects
-  the generation change (watches do not survive a session), re-arms its
-  watches and runs a BOUNDED resync (``KA_DAEMON_RESYNC_RETRIES`` prompt
-  attempts, then the ``KA_DAEMON_RESYNC_INTERVAL`` cadence), serving
-  stale-marked responses meanwhile — ``status: "degraded"``, never an
-  error;
-- **metadata churn** → ZK watches feed delta updates into the group-encode
-  store: only the touched topics re-encode (``daemon.reencode.topics``),
-  with the interval full-resync as the escape hatch for lost
-  notifications;
-- **solver crash** → isolated per request: a ``/plan`` request re-runs on
-  the greedy solver (parity-pinned) and reports degraded; the daemon and
-  other requests are untouched. (``/whatif`` has no greedy twin — the
-  ranking sweep IS the batched JAX path — so a crash there is an HTTP 500
-  for that one request, daemon still untouched);
-- **SIGTERM** → ``/readyz`` flips 503, in-flight requests drain
-  (``KA_DAEMON_DRAIN_TIMEOUT``), exit 0 with the program store intact;
-- **overload** → ``KA_DAEMON_MAX_INFLIGHT`` gate sheds with
-  503 + ``Retry-After``; a watchdog flags requests exceeding
-  ``KA_DAEMON_REQUEST_TIMEOUT`` (``daemon.watchdog_exceeded``).
-
-Chaos seams (``faults/inject.py``): ``watch:drop``, ``session:expire``,
-``resync:stall``, ``daemon:solver-crash`` — driven one-per-class by
-``scripts/chaos_soak.py --matrix`` daemon rows and end-to-end (real
-process, real SIGTERM) by ``scripts/daemon_smoke.py``.
+Single-cluster invocations (``--zk_string``, no ``--clusters``) keep PR 8's
+surface byte-identical: same endpoints, same bodies, same exit codes
+(pinned by the existing daemon smoke).
 """
 from __future__ import annotations
 
-import io
 import json
-import socket
 import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
 
-from ..errors import IngestError, SolveError
-from ..faults.inject import InjectedSolverCrash, active_injector, fault_point
-from ..generator import (
-    Degradation,
-    build_rack_assignment,
-    print_decommission_ranking,
-    print_least_disruptive_reassignment,
-    resolve_broker_ids,
-    resolve_excluded_broker_ids,
-)
-from ..io.base import open_backend
-from ..io.zkwire import ZkConnectionError, ZkWireError
-from ..obs.metrics import counter_add
-from ..obs.trace import record_span
-from ..utils.backoff import JitteredBackoff
-from .state import CacheBackend, DaemonState
+from .supervisor import POLL_S, ClusterSupervisor
 
-#: Watch-poll block per loop iteration (also the drain-check cadence).
-POLL_S = 0.25
+#: The implicit cluster name of a single-cluster (``--zk_string``) daemon.
+DEFAULT_CLUSTER = "default"
+
+#: Worst-first lifecycle order for the /healthz aggregate.
+_LIFECYCLE_ORDER = ("stopped", "draining", "syncing", "degraded", "ready")
+
+
+def _valid_cluster_name(name: str) -> bool:
+    return bool(name) and all(
+        c.isalnum() or c in "_.-" for c in name
+    )
 
 
 class AssignerDaemon:
-    """One resident daemon instance: cache, watch loop, request surface."""
+    """The daemon service: cluster supervisors + the shared HTTP surface.
+
+    ``clusters`` (name → connect spec) selects multi-cluster mode;
+    ``zk_string`` alone is the PR 8 single-cluster mode, byte-identical."""
 
     def __init__(
         self,
-        zk_string: str,
+        zk_string: Optional[str] = None,
         *,
+        clusters: Optional[Dict[str, str]] = None,
         solver: str = "tpu",
         failure_policy: Optional[str] = None,
         bind: Optional[str] = None,
         port: Optional[int] = None,
         err=None,
     ) -> None:
-        from ..utils.env import env_bool, env_choice, env_float, env_int
+        from ..utils.env import env_float, env_int, env_str
 
-        self.zk_string = zk_string
+        if (zk_string is None) == (clusters is None):
+            raise ValueError(
+                "pass exactly one of zk_string (single-cluster) or "
+                "clusters (name -> connect spec)"
+            )
+        self.single = clusters is None
+        if self.single:
+            clusters = {DEFAULT_CLUSTER: zk_string}
+        if not clusters:
+            raise ValueError("clusters must name at least one cluster")
+        for name in clusters:
+            if not _valid_cluster_name(name):
+                raise ValueError(
+                    f"invalid cluster name {name!r} (letters, digits, "
+                    "'_', '.', '-' only)"
+                )
         self.solver = solver
-        # Policy follows the KA_FAILURE_POLICY knob (strict unless the
-        # operator configures otherwise) — same default as the CLI. The
-        # daemon-level crash isolation below (greedy re-run of a crashed
-        # /plan) applies under EITHER policy; the knob governs the
-        # pipeline-internal degradations (topic skips, in-solve fallback).
-        self.failure_policy = (
-            failure_policy or env_choice("KA_FAILURE_POLICY")
-        )
-        self.bind = bind if bind is not None else self._env_str("KA_DAEMON_BIND")
+        self.bind = bind if bind is not None else env_str("KA_DAEMON_BIND")
         self.port = port if port is not None else env_int("KA_DAEMON_PORT")
-        self.max_inflight = env_int("KA_DAEMON_MAX_INFLIGHT")
-        self.request_timeout = env_float("KA_DAEMON_REQUEST_TIMEOUT")
-        self.resync_interval = env_float("KA_DAEMON_RESYNC_INTERVAL")
-        self.resync_retries = env_int("KA_DAEMON_RESYNC_RETRIES")
         self.drain_timeout = env_float("KA_DAEMON_DRAIN_TIMEOUT")
-        self.watch_enabled = env_bool("KA_DAEMON_WATCH")
         self.err = err if err is not None else sys.stderr
 
-        self.state = DaemonState()
-        self.backend = None
-        self.httpd: Optional[ThreadingHTTPServer] = None
         self.draining = threading.Event()
         self.stopped = threading.Event()
-        self._watch_thread: Optional[threading.Thread] = None
+        #: ONE solve lock across every cluster: one device, one capture
+        #: discipline. Admission/shedding stay per-cluster (bulkheads).
+        self._solve_lock = threading.Lock()
+        self.supervisors: Dict[str, ClusterSupervisor] = {
+            name: ClusterSupervisor(
+                name, spec,
+                solver=solver,
+                failure_policy=failure_policy,
+                label="" if self.single else name,
+                draining=self.draining,
+                stopped=self.stopped,
+                solve_lock=self._solve_lock,
+                err=self.err,
+            )
+            for name, spec in clusters.items()
+        }
+        self.httpd: Optional[ThreadingHTTPServer] = None
         self._serve_thread: Optional[threading.Thread] = None
-        #: Serializes the solve path (one device, one obs capture at a
-        #: time); the inflight semaphore above it bounds the queue.
-        self._request_lock = threading.Lock()
-        self._inflight = threading.Semaphore(self.max_inflight)
-        self._active = 0
-        self._active_lock = threading.Lock()
-        self._counters: Dict[str, int] = {}
-        self._counters_lock = threading.Lock()
-        self._faults = active_injector()
-        self._use_watches = False
-        self._armed_generation = -1
-        self._warmed_sig = None
-        #: Live warm threads, ALL joined at shutdown (a bucket-changing
-        #: churn can start a second warm while the first still compiles —
-        #: none may outlive the process's daemon and bleed store writes
-        #: into a later in-process run).
-        self._warm_threads: list = []
-        #: Prompt-resync request from the request path (session seam) for
-        #: the watchless case, and the failure cooldown that paces retry
-        #: bursts against a quorum that stays down.
-        self._prompt_resync = False
-        self._resync_cooldown_until = 0.0
 
-    @staticmethod
-    def _env_str(name: str):
-        from ..utils.env import env_str
+    # -- accessors ----------------------------------------------------------
 
-        return env_str(name)
-
-    # -- counters (daemon-lifetime; mirrored into any active obs capture) --
-
-    def _count(self, name: str, n: int = 1) -> None:
-        with self._counters_lock:
-            self._counters[name] = self._counters.get(name, 0) + n
-        counter_add(name, n)
+    def supervisor(self, name: Optional[str] = None) -> ClusterSupervisor:
+        """The named supervisor (single-cluster mode: the only one)."""
+        if name is None:
+            if not self.single:
+                raise KeyError(
+                    "multi-cluster daemon: name one of "
+                    f"{sorted(self.supervisors)}"
+                )
+            name = DEFAULT_CLUSTER
+        return self.supervisors[name]
 
     def counters(self) -> Dict[str, int]:
-        with self._counters_lock:
-            return dict(self._counters)
-
-    def _log(self, msg: str) -> None:
-        print(f"ka-daemon: {msg}", file=self.err)
-
-    # -- lifecycle ---------------------------------------------------------
+        """Aggregated counters: plain names in single-cluster mode,
+        ``name@cluster`` in multi-cluster mode."""
+        out: Dict[str, int] = {}
+        for name, sup in self.supervisors.items():
+            for k, v in sup.counters().items():
+                key = k if self.single else f"{k}@{name}"
+                out[key] = out.get(key, 0) + v
+        return out
 
     def lifecycle(self) -> str:
+        """Daemon-level lifecycle: the worst cluster's state (single-mode:
+        the one cluster's, byte-identical to PR 8)."""
         if self.stopped.is_set():
             return "stopped"
         if self.draining.is_set():
             return "draining"
-        if not self.state.synced_once:
-            return "syncing"
-        return "degraded" if self.state.stale else "ready"
+        states = [sup.lifecycle() for sup in self.supervisors.values()]
+        for s in _LIFECYCLE_ORDER:
+            if s in states:
+                return s
+        return "ready"
+
+    def _log(self, msg: str) -> None:
+        print(f"ka-daemon: {msg}", file=self.err)
+
+    # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
-        """Open the backend, complete the FIRST sync (bounded retries —
-        a daemon that cannot read the cluster once has nothing to serve:
-        :class:`IngestError`), arm watches, start the watch loop and the
-        HTTP surface. Returns with the daemon serving."""
-        self.backend = open_backend(self.zk_string)
-        self._use_watches = self.watch_enabled and bool(
-            getattr(self.backend, "supports_watches", lambda: False)()
-        )
-        last_err: Optional[Exception] = None
-        backoff = JitteredBackoff(0.05, cap=1.0)
-        attempts = max(self.resync_retries, 1)
-        for attempt in range(attempts):
-            try:
-                self._sync_once()
-                last_err = None
-                break
-            except Exception as e:
-                last_err = e
-                self._count("daemon.resync_failures")
-                self._log(
-                    f"initial sync failed ({type(e).__name__}: {e}); "
-                    "retrying"
-                )
-                if attempt + 1 < attempts:  # no pause after the last try
-                    backoff.sleep()
-        if last_err is not None:
-            self.backend.close()
-            raise IngestError(
-                f"daemon could not complete its initial cluster sync: "
-                f"{last_err}"
-            ) from last_err
-        self._watch_thread = threading.Thread(
-            target=self._watch_loop, name="ka-daemon-watch", daemon=True
-        )
-        self._watch_thread.start()
+        """Start every supervisor and the HTTP surface. Single-cluster: the
+        first sync must complete (bounded retries, then ``IngestError`` —
+        PR 8 behavior). Multi-cluster: a cluster that cannot sync starts
+        degraded behind its breaker and the daemon serves the rest."""
+        for sup in self.supervisors.values():
+            sup.start(require_sync=self.single)
         self.httpd = _build_http_server(self, self.bind, self.port)
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever,
@@ -226,11 +200,21 @@ class AssignerDaemon:
             daemon=True,
         )
         self._serve_thread.start()
-        self._log(
-            f"listening on http://{self.bind}:{self.httpd.server_address[1]}"
-            f" (solver={self.solver}, watches="
-            f"{'on' if self._use_watches else 'off'})"
-        )
+        if self.single:
+            sup = self.supervisor()
+            self._log(
+                f"listening on "
+                f"http://{self.bind}:{self.httpd.server_address[1]}"
+                f" (solver={self.solver}, watches="
+                f"{'on' if sup.uses_watches() else 'off'})"
+            )
+        else:
+            self._log(
+                f"listening on "
+                f"http://{self.bind}:{self.httpd.server_address[1]}"
+                f" (solver={self.solver}, clusters="
+                f"{','.join(sorted(self.supervisors))})"
+            )
 
     @property
     def http_port(self) -> int:
@@ -243,40 +227,37 @@ class AssignerDaemon:
         self.draining.set()
 
     def shutdown(self) -> None:
-        """Drain and stop: refuse new requests, wait out in-flight ones up
-        to ``KA_DAEMON_DRAIN_TIMEOUT``, then tear everything down. Always
-        exits cleanly — the program store and journal files on disk are
-        process-independent and stay intact."""
+        """Drain and stop: refuse new requests, wait out in-flight ones
+        (including /execute runs) up to ``KA_DAEMON_DRAIN_TIMEOUT``, then
+        tear everything down. Always exits cleanly — journals and the
+        program store on disk are process-independent and stay intact (a
+        mid-execution exit resumes from its journal)."""
         self.draining.set()
         deadline = time.monotonic() + self.drain_timeout
         while time.monotonic() < deadline:
-            with self._active_lock:
-                if self._active == 0:
-                    break
+            if self._active_total() == 0:
+                break
             time.sleep(0.01)
-        with self._active_lock:
-            if self._active:
-                self._log(
-                    f"drain timeout: {self._active} request(s) still in "
-                    "flight; exiting anyway"
-                )
+        left = self._active_total()
+        if left:
+            self._log(
+                f"drain timeout: {left} request(s) still in flight; "
+                "exiting anyway"
+            )
         self.stopped.set()
         if self.httpd is not None:
             self.httpd.shutdown()
             self.httpd.server_close()
-        if self._watch_thread is not None:
-            self._watch_thread.join(timeout=5.0)
+        for sup in self.supervisors.values():
+            sup.teardown()
         if self._serve_thread is not None:
             self._serve_thread.join(timeout=5.0)
-        for t in self._warm_threads:
-            # In-process harness hygiene (same contract as the ingest
-            # warm-up's join): no stray background compile may bleed
-            # metrics or store writes into a later run in this process.
-            t.join(timeout=30.0)
-        self._warm_threads = []
-        if self.backend is not None:
-            self.backend.close()
         self._log("drained; exiting 0")
+
+    def _active_total(self) -> int:
+        return sum(
+            sup.active_requests() for sup in self.supervisors.values()
+        )
 
     def serve(self) -> int:
         """Block until a stop is requested (SIGTERM handler calls
@@ -286,525 +267,37 @@ class AssignerDaemon:
         self.shutdown()
         return 0
 
-    # -- sync + watch loop (the single ZK-owning thread after start) -------
+    # -- aggregate views (multi-cluster) ------------------------------------
 
-    def _sync_once(self) -> None:
-        """One full resync attempt: re-read brokers + topics (watch-armed
-        when supported) and atomically swap the cache. Raises on any
-        failure — callers own the retry policy."""
-        t0 = time.perf_counter()
-        ok = False
-        try:
-            fault_point("resync")
-            backend = self.backend
-            if self._use_watches:
-                # Generation FIRST: if any read below reconnects
-                # transparently (the wire client's replay layer), watches
-                # armed before the reconnect died with the old session —
-                # the post-read check turns that into a loud retry instead
-                # of a cache that silently believes its watches are live.
-                gen_before = backend.session_generation()
-                backend.watch_brokers()
-                names = backend.watch_topic_list()
-                stream = backend.fetch_topics(
-                    names, missing="skip", watch=True
-                )
-            else:
-                names = backend.all_topics()
-                stream = backend.fetch_topics(names, missing="skip")
-            brokers = backend.brokers()
-            topics = {}
-            for t, parts in stream:
-                if parts is not None:
-                    topics[t] = parts
-            if self._use_watches \
-                    and backend.session_generation() != gen_before:
-                raise ZkConnectionError(
-                    "session re-established mid-resync; watches from the "
-                    "old session are dead — re-arming from scratch"
-                )
-            self.state.reset(brokers, topics)
-            if self._use_watches:
-                self._armed_generation = gen_before
-            self._count("daemon.resyncs")
-            self._maybe_warm()
-            ok = True
-        finally:
-            record_span("daemon/resync", (time.perf_counter() - t0) * 1e3, ok)
-
-    def _maybe_warm(self) -> None:
-        """Post-resync program warm-up (``solvers/warmup.py``): the cache
-        now pins the exact group buckets the next whole-cluster ``/plan``
-        will dispatch, so make those executables resident on a background
-        thread — the first request after a restart or a bucket-changing
-        churn is then load-bound, not compile-bound. Fire-and-forget:
-        failures degrade to the cold path, never to a failed resync."""
-        if self.solver != "tpu":
-            return
-        sig = (
-            self.state.encode_shape(),
-            len(self.state.topic_names()),
-            len(self.state.brokers()),
-        )
-        if sig == self._warmed_sig:
-            return
-        self._warmed_sig = sig
-        cluster = self.state.encode_cluster()
-        topics = self.state.all_assignments()
-        if cluster is None or not topics:
-            return
-
-        def _warm() -> None:
-            try:
-                from ..solvers.warmup import warm_for_assignments
-
-                warm_for_assignments(cluster, topics)
-                self._count("daemon.warmups")
-            except Exception as e:
-                self._count("daemon.warmup_failures")
-                self._log(
-                    f"cache warm-up failed ({type(e).__name__}: {e}); "
-                    "the next solve stays on the cold path"
-                )
-
-        t = threading.Thread(target=_warm, name="ka-daemon-warm",
-                             daemon=True)
-        self._warm_threads = [
-            w for w in self._warm_threads if w.is_alive()
-        ] + [t]
-        t.start()
-
-    def _resync_with_retries(self) -> bool:
-        """The bounded post-expiry resync: ``KA_DAEMON_RESYNC_RETRIES``
-        prompt attempts with jittered backoff; on exhaustion the cache
-        stays stale (responses degraded) and the interval cadence keeps
-        retrying. Never raises."""
-        backoff = JitteredBackoff(0.05, cap=1.0)
-        attempts = max(self.resync_retries, 1)
-        for attempt in range(attempts):
-            try:
-                self._sync_once()
-                return True
-            except Exception as e:
-                self._count("daemon.resync_failures")
-                self._log(
-                    f"resync failed ({type(e).__name__}: {e}); cache stays "
-                    "stale (responses degraded)"
-                )
-                if self.stopped.is_set():
-                    return False
-                if attempt + 1 < attempts:  # no pause after the last try
-                    backoff.sleep()
-        return False
-
-    def _watch_loop(self) -> None:
-        last_sync = time.monotonic()
-        while not self.stopped.is_set():
-            try:
-                if self._use_watches:
-                    events = self.backend.poll_watch_events(POLL_S)
-                    if (
-                        self.backend.session_generation()
-                        != self._armed_generation
-                    ):
-                        # A read inside event handling reconnected
-                        # transparently: the watches died with the old
-                        # session even though no poll ever failed.
-                        raise ZkConnectionError(
-                            "session re-established underneath; watches "
-                            "lost"
-                        )
-                    for kind, arg in events:
-                        self._count("daemon.watch_events")
-                        if (
-                            self._faults is not None
-                            and self._faults.watch_delivery()
-                        ):
-                            self._count("daemon.watch_dropped")
-                            continue
-                        if self._apply_event(kind, arg):
-                            # The event handler ran a FULL resync (broker
-                            # churn): restart the interval from it, or the
-                            # periodic check below immediately doubles the
-                            # whole-cluster re-read.
-                            last_sync = time.monotonic()
-                else:
-                    self.stopped.wait(POLL_S)
-                if time.monotonic() - last_sync >= self.resync_interval \
-                        or (self._prompt_resync and self.state.stale):
-                    self._prompt_resync = False
-                    self._resync_with_retries()
-                    # Cadence from THIS attempt, success or not: a quorum
-                    # that stays down gets one bounded retry burst per
-                    # interval, never back-to-back hammering.
-                    last_sync = time.monotonic()
-            except (ZkConnectionError, ZkWireError, OSError) as e:
-                if self.stopped.is_set():
-                    return
-                self.state.mark_stale()
-                now = time.monotonic()
-                if now < self._resync_cooldown_until:
-                    # A recent bounded retry burst already failed: pace at
-                    # the interval cadence instead of hammering a down
-                    # quorum (the dead socket re-raises per iteration).
-                    self.stopped.wait(POLL_S)
-                    continue
-                self._count("daemon.session_lost")
-                self._log(
-                    f"ZooKeeper session lost ({type(e).__name__}: {e}); "
-                    "re-establishing, re-arming watches and resyncing "
-                    "(stale-marked responses meanwhile)"
-                )
-                ok = self._resync_with_retries()
-                last_sync = time.monotonic()
-                self._resync_cooldown_until = (
-                    0.0 if ok else last_sync + self.resync_interval
-                )
-            except Exception as e:
-                # The watch loop must never die: an unexpected error marks
-                # the cache stale and the interval resync reconverges it.
-                self.state.mark_stale()
-                self._count("daemon.watch_errors")
-                self._log(
-                    f"watch loop error ({type(e).__name__}: {e}); cache "
-                    "marked stale"
-                )
-                self.stopped.wait(POLL_S)
-
-    def _apply_event(self, kind: str, arg) -> bool:
-        """Apply one normalized watch event; returns True when the handler
-        performed a FULL resync (the caller restarts its interval)."""
-        backend = self.backend
-        if kind == "topic":
-            parts = backend.watch_topic(arg)  # re-read + re-arm (one-shot)
-            if self.state.apply_topic(arg, parts):
-                self._count("daemon.reencode.topics")
-        elif kind == "topics":
-            names = set(backend.watch_topic_list())  # re-arm children watch
-            cached = set(self.state.topic_names())
-            for t in sorted(names - cached):
-                if self.state.apply_topic(t, backend.watch_topic(t)):
-                    self._count("daemon.reencode.topics")
-            for t in sorted(cached - names):
-                self.state.apply_topic(t, None)
-        elif kind == "brokers":
-            # The broker set is baked into every encoding: delta updates
-            # cannot express it — full resync.
-            return self._resync_with_retries()
-        return False
-
-    # -- request surface ---------------------------------------------------
-
-    def handle(self, path: str, params: dict) -> Tuple[int, dict, dict]:
-        """One POST request: backpressure gate → serialized dispatch.
-        Returns ``(http_code, body, extra_headers)``."""
-        if self.draining.is_set():
-            return 503, {"error": "draining"}, {"Retry-After": "5"}
-        if not self._inflight.acquire(blocking=False):
-            self._count("daemon.requests_shed")
-            return (
-                503,
-                {"error": "overloaded",
-                 "max_inflight": self.max_inflight},
-                {"Retry-After": "1"},
-            )
-        with self._active_lock:
-            self._active += 1
-        try:
-            with self._request_lock:
-                return self._handle_locked(path, params)
-        finally:
-            with self._active_lock:
-                self._active -= 1
-            self._inflight.release()
-
-    def _handle_locked(self, path: str, params: dict) -> Tuple[int, dict, dict]:
-        from .. import obs
-
-        t0 = time.perf_counter()
-        self._count("daemon.requests")
-        if self._faults is not None and self._faults.session_check():
-            self._expire_session()
-        out = io.StringIO()
-        code = 200
-        error: Optional[BaseException] = None
-        degraded = False
-        # The watchdog must fire WHILE a wedged request is still running —
-        # a post-hoc elapsed check can never see a solve that never
-        # returns — so a timer thread flags the overrun live (counter +
-        # stderr); the post-completion check below only stamps the result
-        # field (and covers a request that finished just past the budget
-        # before the timer thread was scheduled).
-        overran = threading.Event()
-
-        def _overrun() -> None:
-            overran.set()
-            self._count("daemon.watchdog_exceeded")
-            self._log(
-                f"watchdog: {path} exceeded its "
-                f"{self.request_timeout:.1f} s budget and is still running"
-            )
-
-        watchdog_timer = threading.Timer(self.request_timeout, _overrun)
-        watchdog_timer.daemon = True
-        watchdog_timer.start()
-        with obs.run_capture() as run:
-            try:
-                with obs.span("daemon/request") as sp:
-                    if path == "/plan":
-                        degraded = self._run_plan(params, out)
-                    elif path == "/whatif":
-                        degraded = self._run_whatif(params, out)
-                    else:
-                        raise ValueError(f"unknown endpoint {path!r}")
-                    if degraded or self.state.stale:
-                        sp.fail()
-            except (ValueError, KeyError) as e:
-                error, code = e, 400
-            except IngestError as e:
-                # From a memory-backed request this is a cache miss (topic
-                # the daemon never saw), i.e. a client error — real
-                # transport ingest cannot happen on the request path.
-                error, code = e, 400
-            except SolveError as e:
-                error, code = e, 500
-            except Exception as e:  # a bug, not a request problem
-                error, code = e, 500
-                self._count("daemon.request_errors")
-            status = (
-                "error" if error is not None
-                else "degraded" if degraded or self.state.stale
-                else "ok"
-            )
-            report = obs.build_report(
-                run, status=status,
-                mode="DAEMON_PLAN" if path == "/plan" else "DAEMON_WHATIF",
-                argv=[], error=error,
-            )
-        watchdog_timer.cancel()
-        elapsed_ms = (time.perf_counter() - t0) * 1000.0
-        watchdog = overran.is_set() \
-            or elapsed_ms > self.request_timeout * 1000.0
-        if watchdog and not overran.is_set():
-            # Finished just past the budget before the timer thread ran:
-            # still count it, once.
-            self._count("daemon.watchdog_exceeded")
-            self._log(
-                f"watchdog: {path} took {elapsed_ms:.0f} ms "
-                f"(budget {self.request_timeout:.1f} s)"
-            )
-        report["result"] = {
-            "stdout": out.getvalue(),
-            "stale": self.state.stale,
-            "cache_version": self.state.version,
-            "elapsed_ms": round(elapsed_ms, 3),
-        }
-        if watchdog:
-            report["result"]["watchdog_exceeded"] = True
-        if degraded:
-            self._count("daemon.requests_degraded")
-        return code, report, {}
-
-    def _expire_session(self) -> None:
-        """The ``session:expire`` seam: kill the live ZooKeeper socket
-        under the client (a server-side expiry's client-visible effect).
-        The watch loop's next poll errors out, re-establishes and resyncs;
-        this request serves from the (now stale-marked) cache. The prompt
-        flag covers the watchless case, where no poll exists to raise."""
-        self.state.mark_stale()
-        self._prompt_resync = True
-        zk = getattr(self.backend, "_zk", None)
-        sock = getattr(zk, "_sock", None)
-        if sock is not None:
-            try:
-                sock.shutdown(socket.SHUT_RDWR)
-            except OSError:  # kalint: disable=KA008 -- the socket may already be dead, which IS the state this seam wants
-                pass
-
-    def _plan_kwargs(self, params: dict) -> dict:
-        live = self.state.brokers()
-        broker_ids = resolve_broker_ids(
-            live,
-            params.get("integer_broker_ids"),
-            params.get("broker_hosts"),
-        )
-        excluded = resolve_excluded_broker_ids(
-            live, params.get("broker_hosts_to_remove")
-        )
-        rack = build_rack_assignment(
-            live, bool(params.get("disable_rack_awareness"))
-        )
-        topics = params.get("topics")
-        if topics is not None and not (
-            isinstance(topics, list)
-            and all(isinstance(t, str) for t in topics)
-        ):
-            raise ValueError("topics must be a list of topic names")
-        rf_raw = params.get("desired_replication_factor", -1)
-        if rf_raw is None:
-            rf_raw = -1  # an explicit JSON null means "infer", like the CLI default
-        try:
-            rf = int(rf_raw)
-        except (TypeError, ValueError):
-            raise ValueError(
-                f"desired_replication_factor must be an integer, got "
-                f"{rf_raw!r}"
-            ) from None
+    def healthz_aggregate(self) -> dict:
         return {
-            "live": live,
-            "broker_ids": broker_ids,
-            "excluded": excluded,
-            "rack": rack,
-            "topics": topics,
-            "rf": rf,
+            "status": self.lifecycle(),
+            "clusters": {
+                name: sup.healthz_view()
+                for name, sup in self.supervisors.items()
+            },
         }
 
-    def _run_plan(self, params: dict, out: io.StringIO) -> bool:
-        """The mode-3 pipeline against the cache (byte-identical stdout to
-        a fresh CLI run on the same metadata). Returns whether the request
-        degraded. A solver crash at the daemon seam re-runs on the greedy
-        solver — per-request isolation, never a dead request."""
-        solver = params.get("solver") or self.solver
-        policy = params.get("failure_policy") or self.failure_policy
-        pk = self._plan_kwargs(params)
-        effective = (
-            pk["broker_ids"] or {b.id for b in pk["live"]}
-        ) - pk["excluded"]
-
-        def run_once(chosen_solver: str) -> Degradation:
-            # The cached preencode bakes in the FULL broker set + rack map
-            # and only the tpu backend consumes it; any narrowing
-            # (exclusions, rack-blind request) — or the greedy fallback —
-            # skips the merge entirely: identical output, no wasted
-            # assembly under the cache lock.
-            want_encode = (
-                chosen_solver == "tpu"
-                and effective == self.state.broker_id_set()
-                and not params.get("disable_rack_awareness")
-            )
-            deg = Degradation()
-            print_least_disruptive_reassignment(
-                CacheBackend(self.state),
-                pk["topics"],
-                pk["broker_ids"],
-                pk["excluded"],
-                pk["rack"],
-                pk["rf"],
-                solver=chosen_solver,
-                out=out,
-                live_brokers=pk["live"],
-                failure_policy=policy,
-                degradation=deg,
-                ingest=lambda topic_list: self.state.plan_inputs(
-                    topic_list, want_encode
-                ),
-            )
-            return deg
-
-        try:
-            try:
-                fault_point("daemon")
-                deg = run_once(solver)
-            except IngestError:
-                # Churn race: the pipeline snapshotted the topic list, then
-                # a watch-thread delete removed one before plan_inputs read
-                # it. With an implicit (whole-cluster) topic list a single
-                # retry re-snapshots against the NEW truth — the answer a
-                # fresh CLI run would now give. A topic the CLIENT named
-                # re-raises instead: that is a 400, not a race.
-                if pk["topics"] is not None:
-                    raise
-                self._count("daemon.churn_retries")
-                out.seek(0)
-                out.truncate()
-                deg = run_once(solver)
-        except (InjectedSolverCrash, SolveError) as e:
-            self._count("daemon.solve_fallbacks")
-            self._log(
-                f"solve crashed in-request ({type(e).__name__}: {e}); "
-                "re-running this request on the greedy solver"
-            )
-            out.seek(0)
-            out.truncate()
-            run_once("greedy")
-            return True
-        return deg.any()
-
-    def _run_whatif(self, params: dict, out: io.StringIO) -> bool:
-        import tempfile
-
-        pk = self._plan_kwargs(params)
-        scenario_file = None
-        tmp = None
-        scenarios = params.get("scenarios")
-        if scenarios is not None:
-            tmp = tempfile.NamedTemporaryFile(
-                "w", suffix=".json", delete=False
-            )
-            # kalint: disable=KA005 -- request-scoped scenario handoff, not a plan payload
-            json.dump(scenarios, tmp)
-            tmp.close()
-            scenario_file = tmp.name
-        try:
-            live = [b for b in pk["live"] if b.id not in pk["excluded"]]
-
-            def rank_once() -> None:
-                print_decommission_ranking(
-                    CacheBackend(self.state),
-                    pk["topics"],
-                    (pk["broker_ids"] - pk["excluded"]) or None,
-                    {
-                        k: v for k, v in pk["rack"].items()
-                        if k not in pk["excluded"]
-                    },
-                    pk["rf"],
-                    out=out,
-                    live_brokers=live,
-                    scenario_file=scenario_file,
-                )
-
-            try:
-                rank_once()
-            except KeyError:
-                # Same churn race as /plan: the ranking snapshots the topic
-                # list and reads assignments as two cache reads; a
-                # watch-thread delete in between must retry against the
-                # fresh truth, not blame the client — unless the client
-                # NAMED the vanished topic.
-                if pk["topics"] is not None:
-                    raise
-                self._count("daemon.churn_retries")
-                out.seek(0)
-                out.truncate()
-                rank_once()
-        finally:
-            if tmp is not None:
-                import os
-
-                os.unlink(tmp.name)
-        return False
-
-    # -- introspection -----------------------------------------------------
-
-    def state_view(self) -> dict:
-        shape = self.state.encode_shape()
-        return {
-            "lifecycle": self.lifecycle(),
-            "stale": self.state.stale,
-            "cache_version": self.state.version,
-            "brokers": len(self.state.brokers()),
-            "topics": len(self.state.topic_names()),
-            "encode_shape": list(shape) if shape else None,
-            "watches": self._use_watches,
-            "solver": self.solver,
-            "failure_policy": self.failure_policy,
-            "counters": self.counters(),
+    def readyz_aggregate(self) -> Tuple[bool, dict]:
+        per = {n: s.lifecycle() for n, s in self.supervisors.items()}
+        # Bulkhead semantics: the daemon is ready while ANY cluster can
+        # answer (a dead quorum must not unready the healthy ones); the
+        # per-cluster readyz is the strict signal.
+        ready = not self.draining.is_set() and any(
+            s in ("ready", "degraded") for s in per.values()
+        )
+        return ready, {
+            "ready": ready, "status": self.lifecycle(), "clusters": per,
         }
 
 
 # --------------------------------------------------------------------------
 # HTTP plumbing
 # --------------------------------------------------------------------------
+
+#: Per-cluster path suffixes the router accepts.
+_POST_SUFFIXES = ("/plan", "/whatif", "/execute")
+_GET_SUFFIXES = ("/healthz", "/readyz", "/state")
 
 
 def _build_http_server(daemon: AssignerDaemon, bind: str,
@@ -830,27 +323,92 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
             except (BrokenPipeError, ConnectionResetError):  # kalint: disable=KA008 -- client went away mid-reply; nothing left to tell it
                 pass
 
-        def do_GET(self) -> None:
-            if self.path == "/healthz":
-                self._reply(200, {
-                    "status": daemon.lifecycle(),
-                    "stale": daemon.state.stale,
+        def _route(self, path: str):
+            """Resolve a request path to ``(supervisor, suffix)`` or reply
+            and return None. Bare suffixes map to the single cluster; under
+            ``--clusters`` they require the ``/clusters/<name>`` prefix."""
+            if path.startswith("/clusters/"):
+                rest = path[len("/clusters/"):]
+                name, slash, suffix = rest.partition("/")
+                suffix = "/" + suffix if slash else ""
+                sup = daemon.supervisors.get(name)
+                if sup is None:
+                    self._reply(404, {
+                        "error": f"unknown cluster {name!r}",
+                        "clusters": sorted(daemon.supervisors),
+                    })
+                    return None
+                return sup, suffix
+            if daemon.single:
+                return daemon.supervisor(), path
+            if path in _POST_SUFFIXES:
+                self._reply(400, {
+                    "error": "this daemon serves multiple clusters; use "
+                             f"/clusters/<name>{path}",
+                    "clusters": sorted(daemon.supervisors),
                 })
-            elif self.path == "/readyz":
-                life = daemon.lifecycle()
+                return None
+            return None, path  # bare GET aggregates
+
+        def do_GET(self) -> None:
+            path = urlsplit(self.path).path
+            routed = self._route(path)
+            if routed is None:
+                return
+            sup, suffix = routed
+            if sup is None:  # multi-cluster bare-path aggregates
+                if suffix == "/healthz":
+                    self._reply(200, daemon.healthz_aggregate())
+                elif suffix == "/readyz":
+                    ready, body = daemon.readyz_aggregate()
+                    self._reply(
+                        200 if ready else 503, body,
+                        None if ready else {"Retry-After": "5"},
+                    )
+                elif suffix == "/state":
+                    self._reply(200, {
+                        "lifecycle": daemon.lifecycle(),
+                        "clusters": {
+                            n: s.state_view()
+                            for n, s in daemon.supervisors.items()
+                        },
+                    })
+                else:
+                    self._reply(
+                        404, {"error": f"unknown path {self.path!r}"}
+                    )
+                return
+            if suffix == "/healthz":
+                if daemon.single and not path.startswith("/clusters/"):
+                    # PR 8 byte-compat body; the per-cluster form below
+                    # adds the breaker view.
+                    self._reply(200, {
+                        "status": sup.lifecycle(),
+                        "stale": sup.stale(),
+                    })
+                else:
+                    self._reply(200, sup.healthz_view())
+            elif suffix == "/readyz":
+                life = sup.lifecycle()
                 ready = life in ("ready", "degraded")
                 self._reply(
                     200 if ready else 503,
                     {"ready": ready, "status": life},
                     None if ready else {"Retry-After": "5"},
                 )
-            elif self.path == "/state":
-                self._reply(200, daemon.state_view())
+            elif suffix == "/state":
+                self._reply(200, sup.state_view())
             else:
                 self._reply(404, {"error": f"unknown path {self.path!r}"})
 
         def do_POST(self) -> None:
-            if self.path not in ("/plan", "/whatif"):
+            split = urlsplit(self.path)
+            path = split.path
+            routed = self._route(path)
+            if routed is None:
+                return
+            sup, suffix = routed
+            if sup is None or suffix not in _POST_SUFFIXES:
                 self._reply(404, {"error": f"unknown path {self.path!r}"})
                 return
             try:
@@ -862,8 +420,73 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
             except (ValueError, TypeError) as e:
                 self._reply(400, {"error": f"bad request body: {e}"})
                 return
-            code, body, headers = daemon.handle(self.path, params)
+            # Query-string conveniences (?resume=1) merge under the body;
+            # boolean spellings normalize BOTH ways — ?resume=0 must mean
+            # False, not the truthy string "0".
+            for key, vals in parse_qs(split.query).items():
+                raw_v = vals[-1]
+                low = raw_v.lower()
+                if low in ("1", "true", "yes", "on"):
+                    value = True
+                elif low in ("0", "false", "no", "off"):
+                    value = False
+                else:
+                    value = raw_v
+                params.setdefault(key, value)
+            if suffix == "/execute":
+                self._execute(sup, params)
+                return
+            code, body, headers = sup.handle(suffix, params)
             self._reply(code, body, headers)
+
+        def _execute(self, sup, params: dict) -> None:
+            """The streaming /execute path: refusals reply JSON; an
+            admitted run streams newline-delimited JSON events until the
+            terminal ``exec/done`` / ``exec/error`` event (connection
+            closes at end of stream — no Content-Length)."""
+            prep = sup.prepare_execute(params)
+            if prep[0] == "error":
+                _, code, body = prep
+                self._reply(code, body)
+                return
+            _, ctx = prep
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.close_connection = True
+            except Exception as e:
+                # The client vanished before the stream even opened: the
+                # claimed single-flight slot MUST come back, or this
+                # cluster 409s forever.
+                sup.abort_execute()
+                print(
+                    f"ka-daemon: /execute client gone before the stream "
+                    f"opened ({type(e).__name__}: {e}); slot released",
+                    file=daemon.err,
+                )
+                return
+
+            def emit(event: dict) -> None:
+                # kalint: disable=KA005 -- NDJSON progress event, not a Kafka plan payload
+                line = json.dumps(event, sort_keys=True) + "\n"
+                self.wfile.write(line.encode("utf-8"))
+                self.wfile.flush()
+
+            try:
+                sup.run_execute(ctx, emit)
+            except Exception as e:
+                # The chaos kill stand-in (InjectedExecCrash) and any
+                # unexpected engine escape land here: the stream just ends
+                # without a terminal event — exactly what a killed daemon
+                # looks like to the client; the journal carries the resume.
+                print(
+                    f"ka-daemon: /execute aborted "
+                    f"({type(e).__name__}: {e}); journal retains every "
+                    "committed wave",
+                    file=daemon.err,
+                )
 
     httpd = ThreadingHTTPServer((bind, port), Handler)
     httpd.daemon_threads = True
@@ -876,8 +499,9 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
 
 
 def run_daemon_process(
-    zk_string: str,
+    zk_string: Optional[str] = None,
     *,
+    clusters: Optional[Dict[str, str]] = None,
     solver: str = "tpu",
     failure_policy: Optional[str] = None,
     bind: Optional[str] = None,
@@ -888,8 +512,8 @@ def run_daemon_process(
     import signal
 
     daemon = AssignerDaemon(
-        zk_string, solver=solver, failure_policy=failure_policy,
-        bind=bind, port=port,
+        zk_string, clusters=clusters, solver=solver,
+        failure_policy=failure_policy, bind=bind, port=port,
     )
 
     def _sig(_signo, _frame):

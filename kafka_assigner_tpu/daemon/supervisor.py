@@ -1,0 +1,1191 @@
+"""``ClusterSupervisor`` — one cluster's bulkhead inside the multi-cluster
+daemon (ISSUE 9 tentpole).
+
+PR 8's ``AssignerDaemon`` owned ONE ZooKeeper session, one metadata cache,
+one watch loop. Real fleets run many clusters, and the robustness bar from
+the consumer-group autoscaling literature (PAPERS.md: 2402.06085 treats each
+group/cluster as an independently supervised control loop; 2206.11170's
+reactive scaling assumes per-tenant failure isolation) is that one sick
+quorum must never take down planning for the healthy ones. So everything
+cluster-scoped moved HERE, one instance per configured cluster:
+
+- the wire session / metadata backend and the single watch-loop thread;
+- the :class:`~..daemon.state.DaemonState` cache + group-encode delta store;
+- the supervised lifecycle (syncing → ready ⇄ degraded → draining);
+- the **bulkhead**: a per-cluster inflight gate (``KA_DAEMON_MAX_INFLIGHT``,
+  re-read per request so operators can loosen it on a running fleet) and a
+  per-cluster request watchdog — a stalled resync or quorum blackout on
+  cluster A sheds or stale-serves only A's requests;
+- the **circuit breaker** on the cluster session: consecutive
+  reconnect/resync failures open it (``KA_DAEMON_BREAKER_THRESHOLD``);
+  while open, resync attempts are skipped for a jittered, doubling cooldown
+  (``KA_DAEMON_BREAKER_COOLDOWN`` on the shared ``JitteredBackoff``
+  envelope, capped at the resync interval) so a dead quorum is probed, not
+  hammered; the cooldown's expiry half-opens the breaker for exactly one
+  probe — success closes it, failure re-opens with a longer cooldown.
+  Breaker state is surfaced per cluster (``/clusters/<name>/healthz``) and
+  in the ``/healthz`` aggregate;
+- the supervised **``/execute``** half: a per-cluster single-flight
+  execution lock (409 on concurrent attempts), a FRESH backend session per
+  execution (the write path never shares the watch session — bulkheads
+  again), the ``exec/engine.py`` PlanExecutor journaled exactly like
+  ``ka-execute`` (journal identity = cluster × plan sha), and wave-by-wave
+  NDJSON progress events.
+
+What is deliberately SHARED across supervisors (``daemon/service.py`` owns
+it): the HTTP surface, the drain/stop events, and one solve lock — there is
+one accelerator and one obs capture discipline, so solves serialize
+process-wide; admission, shedding, watchdogs and all I/O are per-cluster.
+
+Cross-bulkhead access is machine-checked: kalint rule KA012 flags daemon
+request-handling code (anything under ``daemon/`` except this module and
+``state.py``) that reaches into a supervisor's ``.backend`` or ``.state``
+instead of going through the owning supervisor's methods.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from ..errors import ExecuteError, IngestError, SolveError
+from ..faults.inject import (
+    InjectedExecCrash,
+    InjectedSolverCrash,
+    active_injector,
+    fault_point,
+)
+from ..generator import (
+    Degradation,
+    build_rack_assignment,
+    print_decommission_ranking,
+    print_least_disruptive_reassignment,
+    resolve_broker_ids,
+    resolve_excluded_broker_ids,
+)
+from ..io.base import open_backend
+from ..io.zkwire import ZkConnectionError, ZkWireError
+from ..obs.metrics import counter_add
+from ..obs.trace import record_span
+from ..utils.backoff import JitteredBackoff
+from .state import CacheBackend, DaemonState
+
+#: Watch-poll block per loop iteration (also the drain-check cadence).
+POLL_S = 0.25
+
+
+class CircuitBreaker:
+    """Per-cluster session breaker: closed → (``threshold`` consecutive
+    failures) open → (cooldown elapsed) half-open → one probe → closed or
+    back to open with a longer cooldown. The cooldown progression is the
+    shared :class:`JitteredBackoff` envelope — doubling, 0.5–1.5x jitter,
+    capped — so many daemons fronting one dead quorum never probe in
+    lockstep. Thread-safe; the watch loop is the only prober but request
+    threads read :meth:`snapshot` concurrently."""
+
+    def __init__(self, threshold: int, cooldown: float, cap: float) -> None:
+        self.threshold = max(1, int(threshold))
+        self._cooldown = max(0.05, float(cooldown))
+        self._cap = max(self._cooldown, float(cap))
+        self._lock = threading.Lock()
+        self._backoff = self._fresh_backoff()
+        self.state = "closed"
+        self.consecutive_failures = 0
+        self._open_until = 0.0
+
+    def _fresh_backoff(self) -> JitteredBackoff:
+        return JitteredBackoff(self._cooldown, cap=self._cap)
+
+    def allow_attempt(self) -> bool:
+        """May the caller try the session now? Closed/half-open: yes. Open:
+        only once the cooldown elapsed — which transitions to half-open (the
+        single probe slot)."""
+        with self._lock:
+            if self.state != "open":
+                return True
+            if time.monotonic() >= self._open_until:
+                self.state = "half-open"
+                return True
+            return False
+
+    def record_failure(self) -> bool:
+        """Count one session/resync failure; returns True when this failure
+        OPENED the breaker (a half-open probe failure always re-opens)."""
+        with self._lock:
+            self.consecutive_failures += 1
+            opening = (
+                self.state == "half-open"
+                or (self.state == "closed"
+                    and self.consecutive_failures >= self.threshold)
+            )
+            if opening:
+                self.state = "open"
+                self._open_until = (
+                    time.monotonic() + self._backoff.next_delay()
+                )
+            return opening
+
+    def record_success(self) -> bool:
+        """A session attempt succeeded: close and reset the cooldown
+        progression; returns True when the breaker was open/half-open (the
+        close is a state transition worth counting)."""
+        with self._lock:
+            was_tripped = self.state != "closed"
+            self.state = "closed"
+            self.consecutive_failures = 0
+            self._open_until = 0.0
+            self._backoff = self._fresh_backoff()
+            return was_tripped
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {
+                "state": self.state,
+                "consecutive_failures": self.consecutive_failures,
+                "threshold": self.threshold,
+            }
+            if self.state == "open":
+                out["retry_in_s"] = round(
+                    max(0.0, self._open_until - time.monotonic()), 3
+                )
+            return out
+
+
+class ClusterSupervisor:
+    """One cluster's resident state, lifecycle and request handling."""
+
+    def __init__(
+        self,
+        name: str,
+        spec: str,
+        *,
+        solver: str = "tpu",
+        failure_policy: Optional[str] = None,
+        label: str = "",
+        draining: threading.Event,
+        stopped: threading.Event,
+        solve_lock: threading.Lock,
+        err=None,
+    ) -> None:
+        from ..utils.env import env_bool, env_choice, env_float, env_int
+
+        self.name = name
+        self.spec = spec
+        #: Metric/span label: empty in single-cluster mode (names stay
+        #: byte-identical to PR 8), the cluster name under ``--clusters``.
+        self.label = label
+        self.solver = solver
+        # Policy follows the KA_FAILURE_POLICY knob (strict unless the
+        # operator configures otherwise) — same default as the CLI. The
+        # per-request crash isolation below (greedy re-run of a crashed
+        # /plan) applies under EITHER policy.
+        self.failure_policy = (
+            failure_policy or env_choice("KA_FAILURE_POLICY")
+        )
+        self.draining = draining
+        self.stopped = stopped
+        self.err = err if err is not None else sys.stderr
+        #: Watchdog budget override for tests; None = the live
+        #: KA_DAEMON_REQUEST_TIMEOUT knob, re-read per request.
+        self.request_timeout: Optional[float] = None
+        self.resync_interval = env_float("KA_DAEMON_RESYNC_INTERVAL")
+        self.resync_retries = env_int("KA_DAEMON_RESYNC_RETRIES")
+        self.watch_enabled = env_bool("KA_DAEMON_WATCH")
+        self.breaker = CircuitBreaker(
+            env_int("KA_DAEMON_BREAKER_THRESHOLD"),
+            env_float("KA_DAEMON_BREAKER_COOLDOWN"),
+            cap=self.resync_interval,
+        )
+
+        self.state = DaemonState()
+        self.backend = None
+        self._watch_thread: Optional[threading.Thread] = None
+        #: The SHARED solve serialization (one device, one obs-capture
+        #: discipline): admission and shedding are per-cluster, the solve
+        #: itself is not.
+        self._solve_lock = solve_lock
+        #: The per-cluster bulkhead: admitted-request count, gated per
+        #: request against the LIVE KA_DAEMON_MAX_INFLIGHT knob.
+        self._active = 0
+        self._active_lock = threading.Lock()
+        #: Single-flight /execute gate: one execution per cluster at a time
+        #: (HTTP 409 on concurrent attempts).
+        self._exec_lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._counters_lock = threading.Lock()
+        self._faults = active_injector()
+        self._use_watches = False
+        self._armed_generation = -1
+        self._warmed_sig = None
+        #: Live warm threads, ALL joined at teardown (a bucket-changing
+        #: churn can start a second warm while the first still compiles —
+        #: none may outlive the process's daemon and bleed store writes
+        #: into a later in-process run).
+        self._warm_threads: list = []
+        #: Prompt-resync request from the request path (session seam) for
+        #: the watchless case, where no poll exists to raise.
+        self._prompt_resync = False
+
+    # -- counters (cluster-lifetime; mirrored into any active obs capture) --
+
+    def _metric(self, name: str) -> str:
+        return f"{name}@{self.label}" if self.label else name
+
+    def _count(self, name: str, n: int = 1) -> None:
+        with self._counters_lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+        counter_add(self._metric(name), n)
+
+    def counters(self) -> Dict[str, int]:
+        with self._counters_lock:
+            return dict(self._counters)
+
+    def _log(self, msg: str) -> None:
+        prefix = f"ka-daemon[{self.name}]" if self.label else "ka-daemon"
+        print(f"{prefix}: {msg}", file=self.err)
+
+    # -- live knobs ---------------------------------------------------------
+
+    def max_inflight(self) -> int:
+        """The LIVE backpressure gate: re-read from the environment per
+        request (like the program store's trace-time knobs), so an operator
+        can loosen/tighten the gate on a running fleet without a restart."""
+        from ..utils.env import env_int
+
+        return env_int("KA_DAEMON_MAX_INFLIGHT")
+
+    def _request_budget(self) -> float:
+        from ..utils.env import env_float
+
+        if self.request_timeout is not None:
+            return self.request_timeout
+        return env_float("KA_DAEMON_REQUEST_TIMEOUT")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def lifecycle(self) -> str:
+        if self.stopped.is_set():
+            return "stopped"
+        if self.draining.is_set():
+            return "draining"
+        if not self.state.synced_once:
+            return "syncing"
+        return "degraded" if self.state.stale else "ready"
+
+    def stale(self) -> bool:
+        return self.state.stale
+
+    def uses_watches(self) -> bool:
+        """Whether this cluster's backend feeds the watch-driven delta
+        re-encode (the service banner reads this — the bulkhead accessor
+        discipline of KA012, kept even for attributes the rule does not
+        yet name)."""
+        return self._use_watches
+
+    def active_requests(self) -> int:
+        with self._active_lock:
+            return self._active
+
+    def start(self, *, require_sync: bool) -> None:
+        """Open the backend and run the FIRST sync. ``require_sync=True``
+        (the single-cluster case, byte-compatible with PR 8): bounded
+        retries, then :class:`IngestError` — a daemon with one cluster it
+        cannot read has nothing to serve. ``require_sync=False`` (the
+        multi-cluster bulkhead): a cluster that cannot sync starts in
+        ``syncing``, trips its breaker, and keeps retrying on the interval
+        cadence — the daemon serves the healthy clusters regardless."""
+        try:
+            self._open_backend()
+            synced = self._resync_with_retries()
+        except Exception as e:
+            if require_sync:
+                raise IngestError(
+                    "daemon could not complete its initial cluster sync: "
+                    f"{e}"
+                ) from e
+            self._log(
+                f"cluster backend unavailable at startup "
+                f"({type(e).__name__}: {e}); serving others, retrying "
+                "on the resync cadence"
+            )
+            synced = False
+        if require_sync and not synced:
+            if self.backend is not None:
+                self.backend.close()
+            raise IngestError(
+                "daemon could not complete its initial cluster sync "
+                f"for {self.spec!r} (see retries above)"
+            )
+        self._watch_thread = threading.Thread(
+            target=self._watch_loop,
+            name=f"ka-daemon-watch-{self.name}",
+            daemon=True,
+        )
+        self._watch_thread.start()
+
+    def _open_backend(self) -> None:
+        self.backend = open_backend(self.spec)
+        self._use_watches = self.watch_enabled and bool(
+            getattr(self.backend, "supports_watches", lambda: False)()
+        )
+
+    def _reopen_backend(self) -> None:
+        """Rebuild the cluster session from scratch. A reconnect that
+        exhausts its connect passes leaves the wire client in a TERMINAL
+        'session is not started' state — a breaker probe poking that corpse
+        would fail forever even after the quorum returns, so the probe
+        always starts from a fresh session (watches re-arm on the next
+        successful sync). Raises when the quorum is still down — the
+        caller records the failure against the breaker."""
+        old, self.backend = self.backend, None
+        self._armed_generation = -1
+        if old is not None:
+            try:
+                old.close()
+            except Exception as e:
+                self._log(
+                    f"old session close failed ({type(e).__name__}: {e}); "
+                    "proceeding with the fresh one"
+                )
+        self._open_backend()
+
+    def teardown(self) -> None:
+        """Post-drain teardown (the service owns the drain itself): join
+        the watch loop, join any live warm threads, close the backend."""
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=5.0)
+        for t in self._warm_threads:
+            # In-process harness hygiene (same contract as the ingest
+            # warm-up's join): no stray background compile may bleed
+            # metrics or store writes into a later run in this process.
+            t.join(timeout=30.0)
+        self._warm_threads = []
+        if self.backend is not None:
+            self.backend.close()
+
+    # -- sync + watch loop (the single session-owning thread after start) ---
+
+    def _sync_once(self) -> None:
+        """One full resync attempt: re-read brokers + topics (watch-armed
+        when supported) and atomically swap the cache. Raises on any
+        failure — callers own the retry policy and the breaker."""
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            fault_point("resync", cluster=self.name)
+            backend = self.backend
+            if self._use_watches:
+                # Generation FIRST: if any read below reconnects
+                # transparently (the wire client's replay layer), watches
+                # armed before the reconnect died with the old session —
+                # the post-read check turns that into a loud retry instead
+                # of a cache that silently believes its watches are live.
+                gen_before = backend.session_generation()
+                backend.watch_brokers()
+                names = backend.watch_topic_list()
+                stream = backend.fetch_topics(
+                    names, missing="skip", watch=True
+                )
+            else:
+                names = backend.all_topics()
+                stream = backend.fetch_topics(names, missing="skip")
+            brokers = backend.brokers()
+            topics = {}
+            for t, parts in stream:
+                if parts is not None:
+                    topics[t] = parts
+            if self._use_watches \
+                    and backend.session_generation() != gen_before:
+                raise ZkConnectionError(
+                    "session re-established mid-resync; watches from the "
+                    "old session are dead — re-arming from scratch"
+                )
+            self.state.reset(brokers, topics)
+            if self._use_watches:
+                self._armed_generation = gen_before
+            self._count("daemon.resyncs")
+            self._maybe_warm()
+            ok = True
+        finally:
+            record_span(
+                self._metric("daemon/resync"),
+                (time.perf_counter() - t0) * 1e3, ok,
+            )
+
+    def _maybe_warm(self) -> None:
+        """Post-resync program warm-up (``solvers/warmup.py``): the cache
+        now pins the exact group buckets the next whole-cluster ``/plan``
+        will dispatch, so make those executables resident on a background
+        thread. Fire-and-forget: failures degrade to the cold path, never
+        to a failed resync."""
+        if self.solver != "tpu":
+            return
+        sig = (
+            self.state.encode_shape(),
+            len(self.state.topic_names()),
+            len(self.state.brokers()),
+        )
+        if sig == self._warmed_sig:
+            return
+        self._warmed_sig = sig
+        cluster = self.state.encode_cluster()
+        topics = self.state.all_assignments()
+        if cluster is None or not topics:
+            return
+
+        def _warm() -> None:
+            try:
+                from ..solvers.warmup import warm_for_assignments
+
+                warm_for_assignments(cluster, topics)
+                self._count("daemon.warmups")
+            except Exception as e:
+                self._count("daemon.warmup_failures")
+                self._log(
+                    f"cache warm-up failed ({type(e).__name__}: {e}); "
+                    "the next solve stays on the cold path"
+                )
+
+        t = threading.Thread(
+            target=_warm, name=f"ka-daemon-warm-{self.name}", daemon=True
+        )
+        self._warm_threads = [
+            w for w in self._warm_threads if w.is_alive()
+        ] + [t]
+        t.start()
+
+    def _resync_with_retries(self) -> bool:
+        """The bounded resync: ``KA_DAEMON_RESYNC_RETRIES`` prompt attempts
+        with jittered backoff, each failure counted against the breaker; on
+        exhaustion the cache stays stale (responses degraded) and the
+        breaker/interval cadence keeps retrying. Never raises once the
+        backend is open."""
+        backoff = JitteredBackoff(0.05, cap=1.0)
+        attempts = max(self.resync_retries, 1)
+        for attempt in range(attempts):
+            try:
+                self._sync_once()
+            except Exception as e:
+                self._count("daemon.resync_failures")
+                if self.breaker.record_failure():
+                    self._count("daemon.breaker_opened")
+                    self._log(
+                        "circuit breaker OPEN after "
+                        f"{self.breaker.consecutive_failures} consecutive "
+                        f"session failure(s) ({type(e).__name__}: {e}); "
+                        "probing on the cooldown envelope"
+                    )
+                else:
+                    self._log(
+                        f"resync failed ({type(e).__name__}: {e}); cache "
+                        "stays stale (responses degraded)"
+                    )
+                if self.stopped.is_set():
+                    return False
+                if not self.breaker.allow_attempt():
+                    return False  # open: the cooldown owns the cadence now
+                if attempt + 1 < attempts:  # no pause after the last try
+                    backoff.sleep()
+            else:
+                if self.breaker.record_success():
+                    self._count("daemon.breaker_closed")
+                    self._log("circuit breaker CLOSED (session recovered)")
+                return True
+        return False
+
+    def _probe_or_resync(self, fresh_session: bool = False) -> bool:
+        """One breaker-gated recovery attempt: closed → the full bounded
+        retry burst; half-open (cooldown elapsed) → exactly one probe.
+        ``fresh_session=True``: the caller JUST opened the backend (the
+        startup-recovery branch) — the probe must not tear it down and pay
+        a second connect+handshake against a just-recovered quorum."""
+        if not self.breaker.allow_attempt():
+            return False
+        if self.breaker.state == "half-open":
+            self._count("daemon.breaker_probes")
+            try:
+                if not fresh_session:
+                    self._reopen_backend()
+                self._sync_once()
+            except Exception as e:
+                self._count("daemon.resync_failures")
+                self.breaker.record_failure()  # half-open failure re-opens
+                self._log(
+                    f"breaker probe failed ({type(e).__name__}: {e}); "
+                    "re-opened with a longer cooldown"
+                )
+                return False
+            if self.breaker.record_success():
+                self._count("daemon.breaker_closed")
+                self._log("circuit breaker CLOSED (probe succeeded)")
+            return True
+        return self._resync_with_retries()
+
+    def _watch_loop(self) -> None:
+        last_sync = time.monotonic()
+        while not self.stopped.is_set():
+            try:
+                if self.backend is None:
+                    # The startup open failed (multi-cluster bulkhead):
+                    # retry it on the breaker/interval cadence.
+                    self.stopped.wait(POLL_S)
+                    if time.monotonic() - last_sync < self.resync_interval \
+                            or not self.breaker.allow_attempt():
+                        continue
+                    last_sync = time.monotonic()
+                    try:
+                        self._open_backend()
+                    except Exception as e:
+                        if self.breaker.record_failure():
+                            self._count("daemon.breaker_opened")
+                        self._count("daemon.resync_failures")
+                        self._log(
+                            f"backend still unavailable "
+                            f"({type(e).__name__}: {e})"
+                        )
+                        continue
+                    self._probe_or_resync(fresh_session=True)
+                    continue
+                if self._use_watches and self.state.synced_once:
+                    events = self.backend.poll_watch_events(POLL_S)
+                    if (
+                        self.backend.session_generation()
+                        != self._armed_generation
+                    ):
+                        # A read inside event handling reconnected
+                        # transparently: the watches died with the old
+                        # session even though no poll ever failed.
+                        raise ZkConnectionError(
+                            "session re-established underneath; watches "
+                            "lost"
+                        )
+                    for kind, arg in events:
+                        self._count("daemon.watch_events")
+                        if (
+                            self._faults is not None
+                            and self._faults.watch_delivery(
+                                cluster=self.name
+                            )
+                        ):
+                            self._count("daemon.watch_dropped")
+                            continue
+                        if self._apply_event(kind, arg):
+                            # The event handler ran a FULL resync (broker
+                            # churn): restart the interval from it, or the
+                            # periodic check below immediately doubles the
+                            # whole-cluster re-read.
+                            last_sync = time.monotonic()
+                else:
+                    self.stopped.wait(POLL_S)
+                if time.monotonic() - last_sync >= self.resync_interval \
+                        or (self._prompt_resync and self.state.stale):
+                    prompted = self._prompt_resync
+                    self._prompt_resync = False
+                    if prompted or self.state.stale \
+                            or not self.state.synced_once:
+                        self._probe_or_resync()
+                    else:
+                        # Routine interval resync of a HEALTHY cluster: the
+                        # lost-notification escape hatch, not a recovery —
+                        # the breaker only meters recovery probes.
+                        self._resync_with_retries()
+                    # Cadence from THIS attempt, success or not: a quorum
+                    # that stays down gets one bounded burst (or one
+                    # breaker probe) per interval, never back-to-back
+                    # hammering.
+                    last_sync = time.monotonic()
+            except (ZkConnectionError, ZkWireError, OSError) as e:
+                if self.stopped.is_set():
+                    return
+                self.state.mark_stale()
+                if not self.breaker.allow_attempt():
+                    # Open breaker: the dead socket re-raises per
+                    # iteration; pace at the poll cadence, probe when the
+                    # cooldown says so.
+                    self.stopped.wait(POLL_S)
+                    continue
+                self._count("daemon.session_lost")
+                self._log(
+                    f"ZooKeeper session lost ({type(e).__name__}: {e}); "
+                    "re-establishing, re-arming watches and resyncing "
+                    "(stale-marked responses meanwhile)"
+                )
+                self._probe_or_resync()
+                last_sync = time.monotonic()
+            except Exception as e:
+                # The watch loop must never die: an unexpected error marks
+                # the cache stale and the interval resync reconverges it.
+                self.state.mark_stale()
+                self._count("daemon.watch_errors")
+                self._log(
+                    f"watch loop error ({type(e).__name__}: {e}); cache "
+                    "marked stale"
+                )
+                self.stopped.wait(POLL_S)
+
+    def _apply_event(self, kind: str, arg) -> bool:
+        """Apply one normalized watch event; returns True when the handler
+        performed a FULL resync (the caller restarts its interval)."""
+        backend = self.backend
+        if kind == "topic":
+            parts = backend.watch_topic(arg)  # re-read + re-arm (one-shot)
+            if self.state.apply_topic(arg, parts):
+                self._count("daemon.reencode.topics")
+        elif kind == "topics":
+            names = set(backend.watch_topic_list())  # re-arm children watch
+            cached = set(self.state.topic_names())
+            for t in sorted(names - cached):
+                if self.state.apply_topic(t, backend.watch_topic(t)):
+                    self._count("daemon.reencode.topics")
+            for t in sorted(cached - names):
+                self.state.apply_topic(t, None)
+        elif kind == "brokers":
+            # The broker set is baked into every encoding: delta updates
+            # cannot express it — full resync.
+            return self._resync_with_retries()
+        return False
+
+    # -- request surface ----------------------------------------------------
+
+    def handle(self, path: str, params: dict) -> Tuple[int, dict, dict]:
+        """One POST request: per-cluster backpressure gate (the LIVE
+        inflight knob) → shared-solve-lock dispatch. Returns
+        ``(http_code, body, extra_headers)``."""
+        if self.draining.is_set():
+            return 503, {"error": "draining"}, {"Retry-After": "5"}
+        if not self.state.synced_once:
+            # The multi-cluster bulkhead's unsynced state (single-cluster
+            # startup refuses to serve before the first sync instead).
+            self._count("daemon.requests_unsynced")
+            return (
+                503,
+                {"error": "cluster not synced yet", "cluster": self.name},
+                {"Retry-After": "5"},
+            )
+        limit = self.max_inflight()
+        with self._active_lock:
+            if self._active >= limit:
+                admitted = False
+            else:
+                admitted = True
+                self._active += 1
+        if not admitted:
+            self._count("daemon.requests_shed")
+            return (
+                503,
+                {"error": "overloaded", "max_inflight": limit},
+                {"Retry-After": "1"},
+            )
+        try:
+            return self._handle_admitted(path, params)
+        finally:
+            with self._active_lock:
+                self._active -= 1
+
+    def _handle_admitted(
+        self, path: str, params: dict
+    ) -> Tuple[int, dict, dict]:
+        from .. import obs
+
+        t0 = time.perf_counter()
+        self._count("daemon.requests")
+        if self._faults is not None \
+                and self._faults.session_check(cluster=self.name):
+            self._expire_session()
+        out = io.StringIO()
+        code = 200
+        error: Optional[BaseException] = None
+        degraded = False
+        budget = self._request_budget()
+        # The watchdog must fire WHILE a wedged request is still running —
+        # a post-hoc elapsed check can never see a solve that never
+        # returns — so a timer thread flags the overrun live (counter +
+        # stderr); the post-completion check below only stamps the result
+        # field. Armed BEFORE the shared solve lock: a request wedged
+        # BEHIND another cluster's solve is flagged too (the bulkhead's
+        # visibility guarantee).
+        overran = threading.Event()
+
+        def _overrun() -> None:
+            overran.set()
+            self._count("daemon.watchdog_exceeded")
+            self._log(
+                f"watchdog: {path} exceeded its "
+                f"{budget:.1f} s budget and is still running"
+            )
+
+        watchdog_timer = threading.Timer(budget, _overrun)
+        watchdog_timer.daemon = True
+        watchdog_timer.start()
+        # Per-request capture is THREAD-LOCAL (obs/trace.py): concurrent
+        # requests from other clusters can never tear each other's span
+        # stacks or steal each other's metrics.
+        with self._solve_lock, obs.run_capture(local=True) as run:
+            try:
+                with obs.span(self._metric("daemon/request")) as sp:
+                    if path == "/plan":
+                        degraded = self._run_plan(params, out)
+                    elif path == "/whatif":
+                        degraded = self._run_whatif(params, out)
+                    else:
+                        raise ValueError(f"unknown endpoint {path!r}")
+                    if degraded or self.state.stale:
+                        sp.fail()
+            except (ValueError, KeyError) as e:
+                error, code = e, 400
+            except IngestError as e:
+                # From a memory-backed request this is a cache miss (topic
+                # the daemon never saw), i.e. a client error — real
+                # transport ingest cannot happen on the request path.
+                error, code = e, 400
+            except SolveError as e:
+                error, code = e, 500
+            except Exception as e:  # a bug, not a request problem
+                error, code = e, 500
+                self._count("daemon.request_errors")
+            status = (
+                "error" if error is not None
+                else "degraded" if degraded or self.state.stale
+                else "ok"
+            )
+            report = obs.build_report(
+                run, status=status,
+                mode="DAEMON_PLAN" if path == "/plan" else "DAEMON_WHATIF",
+                argv=[], error=error,
+            )
+        watchdog_timer.cancel()
+        elapsed_ms = (time.perf_counter() - t0) * 1000.0
+        watchdog = overran.is_set() or elapsed_ms > budget * 1000.0
+        if watchdog and not overran.is_set():
+            # Finished just past the budget before the timer thread ran:
+            # still count it, once.
+            self._count("daemon.watchdog_exceeded")
+            self._log(
+                f"watchdog: {path} took {elapsed_ms:.0f} ms "
+                f"(budget {budget:.1f} s)"
+            )
+        report["result"] = {
+            "stdout": out.getvalue(),
+            "stale": self.state.stale,
+            "cache_version": self.state.version,
+            "elapsed_ms": round(elapsed_ms, 3),
+        }
+        if self.label:
+            report["result"]["cluster"] = self.name
+        if watchdog:
+            report["result"]["watchdog_exceeded"] = True
+        if degraded:
+            self._count("daemon.requests_degraded")
+        return code, report, {}
+
+    def _expire_session(self) -> None:
+        """The ``session:expire`` seam: kill the live ZooKeeper socket
+        under the client (a server-side expiry's client-visible effect).
+        The watch loop's next poll errors out, re-establishes and resyncs;
+        this request serves from the (now stale-marked) cache. The prompt
+        flag covers the watchless case, where no poll exists to raise."""
+        self.state.mark_stale()
+        self._prompt_resync = True
+        zk = getattr(self.backend, "_zk", None)
+        sock = getattr(zk, "_sock", None)
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:  # kalint: disable=KA008 -- the socket may already be dead, which IS the state this seam wants
+                pass
+
+    def _plan_kwargs(self, params: dict) -> dict:
+        live = self.state.brokers()
+        broker_ids = resolve_broker_ids(
+            live,
+            params.get("integer_broker_ids"),
+            params.get("broker_hosts"),
+        )
+        excluded = resolve_excluded_broker_ids(
+            live, params.get("broker_hosts_to_remove")
+        )
+        rack = build_rack_assignment(
+            live, bool(params.get("disable_rack_awareness"))
+        )
+        topics = params.get("topics")
+        if topics is not None and not (
+            isinstance(topics, list)
+            and all(isinstance(t, str) for t in topics)
+        ):
+            raise ValueError("topics must be a list of topic names")
+        rf_raw = params.get("desired_replication_factor", -1)
+        if rf_raw is None:
+            rf_raw = -1  # an explicit JSON null means "infer", like the CLI default
+        try:
+            rf = int(rf_raw)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"desired_replication_factor must be an integer, got "
+                f"{rf_raw!r}"
+            ) from None
+        return {
+            "live": live,
+            "broker_ids": broker_ids,
+            "excluded": excluded,
+            "rack": rack,
+            "topics": topics,
+            "rf": rf,
+        }
+
+    def _run_plan(self, params: dict, out: io.StringIO) -> bool:
+        """The mode-3 pipeline against the cache (byte-identical stdout to
+        a fresh CLI run on the same metadata). Returns whether the request
+        degraded. A solver crash at the daemon seam re-runs on the greedy
+        solver — per-request isolation, never a dead request."""
+        solver = params.get("solver") or self.solver
+        policy = params.get("failure_policy") or self.failure_policy
+        pk = self._plan_kwargs(params)
+        effective = (
+            pk["broker_ids"] or {b.id for b in pk["live"]}
+        ) - pk["excluded"]
+
+        def run_once(chosen_solver: str) -> Degradation:
+            # The cached preencode bakes in the FULL broker set + rack map
+            # and only the tpu backend consumes it; any narrowing
+            # (exclusions, rack-blind request) — or the greedy fallback —
+            # skips the merge entirely: identical output, no wasted
+            # assembly under the cache lock.
+            want_encode = (
+                chosen_solver == "tpu"
+                and effective == self.state.broker_id_set()
+                and not params.get("disable_rack_awareness")
+            )
+            deg = Degradation()
+            print_least_disruptive_reassignment(
+                CacheBackend(self.state),
+                pk["topics"],
+                pk["broker_ids"],
+                pk["excluded"],
+                pk["rack"],
+                pk["rf"],
+                solver=chosen_solver,
+                out=out,
+                live_brokers=pk["live"],
+                failure_policy=policy,
+                degradation=deg,
+                ingest=lambda topic_list: self.state.plan_inputs(
+                    topic_list, want_encode
+                ),
+            )
+            return deg
+
+        try:
+            try:
+                fault_point("daemon", cluster=self.name)
+                deg = run_once(solver)
+            except IngestError:
+                # Churn race: the pipeline snapshotted the topic list, then
+                # a watch-thread delete removed one before plan_inputs read
+                # it. With an implicit (whole-cluster) topic list a single
+                # retry re-snapshots against the NEW truth — the answer a
+                # fresh CLI run would now give. A topic the CLIENT named
+                # re-raises instead: that is a 400, not a race.
+                if pk["topics"] is not None:
+                    raise
+                self._count("daemon.churn_retries")
+                out.seek(0)
+                out.truncate()
+                deg = run_once(solver)
+        except (InjectedSolverCrash, SolveError) as e:
+            self._count("daemon.solve_fallbacks")
+            self._log(
+                f"solve crashed in-request ({type(e).__name__}: {e}); "
+                "re-running this request on the greedy solver"
+            )
+            out.seek(0)
+            out.truncate()
+            run_once("greedy")
+            return True
+        return deg.any()
+
+    def _run_whatif(self, params: dict, out: io.StringIO) -> bool:
+        import tempfile
+
+        pk = self._plan_kwargs(params)
+        scenario_file = None
+        tmp = None
+        scenarios = params.get("scenarios")
+        if scenarios is not None:
+            tmp = tempfile.NamedTemporaryFile(
+                "w", suffix=".json", delete=False
+            )
+            # kalint: disable=KA005 -- request-scoped scenario handoff, not a plan payload
+            json.dump(scenarios, tmp)
+            tmp.close()
+            scenario_file = tmp.name
+        try:
+            live = [b for b in pk["live"] if b.id not in pk["excluded"]]
+
+            def rank_once() -> None:
+                print_decommission_ranking(
+                    CacheBackend(self.state),
+                    pk["topics"],
+                    (pk["broker_ids"] - pk["excluded"]) or None,
+                    {
+                        k: v for k, v in pk["rack"].items()
+                        if k not in pk["excluded"]
+                    },
+                    pk["rf"],
+                    out=out,
+                    live_brokers=live,
+                    scenario_file=scenario_file,
+                )
+
+            try:
+                rank_once()
+            except KeyError:
+                # Same churn race as /plan: the ranking snapshots the topic
+                # list and reads assignments as two cache reads; a
+                # watch-thread delete in between must retry against the
+                # fresh truth, not blame the client — unless the client
+                # NAMED the vanished topic.
+                if pk["topics"] is not None:
+                    raise
+                self._count("daemon.churn_retries")
+                out.seek(0)
+                out.truncate()
+                rank_once()
+        finally:
+            if tmp is not None:
+                os.unlink(tmp.name)
+        return False
+
+    # -- the supervised /execute half ---------------------------------------
+
+    def prepare_execute(self, params: dict):
+        """Validate one ``/execute`` request and claim the per-cluster
+        single-flight execution slot. Returns ``("error", code, body)`` for
+        a refusal (the handler replies JSON), or ``("run", ctx)`` — the
+        caller MUST then call :meth:`run_execute` with ``ctx`` (which
+        releases the slot)."""
+        from ..exec.engine import parse_plan_payload
+        from ..exec.journal import plan_fingerprint
+        from ..utils.env import env_str
+
+        if self.draining.is_set():
+            return ("error", 503, {"error": "draining"})
+        if not self._exec_lock.acquire(blocking=False):
+            self._count("daemon.execute_conflicts")
+            return ("error", 409, {
+                "error": "an execution is already in flight on this "
+                         "cluster (single-flight lock)",
+                "cluster": self.name,
+            })
+        try:
+            plan_text = params.get("plan_text")
+            plan_obj = params.get("plan")
+            if (plan_text is None) == (plan_obj is None):
+                raise ValueError(
+                    "pass exactly one of 'plan_text' (a saved mode-3 "
+                    "stdout or bare reassignment JSON string) or 'plan' "
+                    "(the reassignment JSON object)"
+                )
+            if plan_text is None:
+                if not isinstance(plan_obj, dict):
+                    raise ValueError("'plan' must be a JSON object")
+                # kalint: disable=KA005 -- request-scoped plan handoff into the byte-compat parser, not an emission
+                plan_text = json.dumps(plan_obj)
+            if not isinstance(plan_text, str):
+                raise ValueError("'plan_text' must be a string")
+            plan, topic_order = parse_plan_payload(plan_text)
+            plan_hash = plan_fingerprint(plan, topic_order)
+            journal = params.get("journal")
+            if journal is None:
+                jdir = env_str("KA_DAEMON_JOURNAL_DIR") or "."
+                journal = os.path.join(
+                    jdir,
+                    f"ka-execute-{self.name}-{plan_hash[:12]}.journal",
+                )
+            resume = bool(params.get("resume"))
+            wave_size = params.get("wave_size")
+            if wave_size is not None:
+                wave_size = int(wave_size)
+            throttle = params.get("throttle")
+            if throttle is not None:
+                throttle = float(throttle)
+            policy = params.get("failure_policy") or self.failure_policy
+            if policy not in ("strict", "best-effort"):
+                raise ValueError(f"unknown failure_policy {policy!r}")
+            ctx = {
+                "plan": plan,
+                "topic_order": topic_order,
+                "plan_hash": plan_hash,
+                "journal": journal,
+                "resume": resume,
+                "wave_size": wave_size,
+                "throttle": throttle,
+                "policy": policy,
+            }
+        except (TypeError, ValueError) as e:
+            self._exec_lock.release()
+            return ("error", 400, {"error": f"bad execute request: {e}"})
+        except Exception:
+            self._exec_lock.release()
+            raise
+        with self._active_lock:
+            self._active += 1  # the drain waits (bounded) for executions too
+        return ("run", ctx)
+
+    def abort_execute(self) -> None:
+        """Release a claimed execution slot WITHOUT running it: the handler
+        failed between :meth:`prepare_execute` and :meth:`run_execute`
+        (e.g. the client vanished before the response headers went out).
+        Without this the single-flight lock would leak and every later
+        /execute on this cluster would 409 forever."""
+        with self._active_lock:
+            self._active -= 1
+        self._exec_lock.release()
+
+    def run_execute(self, ctx: dict, emit: Callable[[dict], None]) -> None:
+        """Drive one prepared execution, streaming progress events through
+        ``emit`` (one dict per NDJSON line). Journals exactly like
+        ``ka-execute`` — journal identity is (cluster spec, plan sha), so a
+        daemon kill mid-execution resumes via ``/execute`` with
+        ``resume=1`` or offline ``ka-execute --resume`` to a byte-identical
+        final state. Runs on a FRESH backend session: the write path never
+        shares the watch session's socket (bulkhead isolation).
+
+        :class:`InjectedExecCrash` (the chaos kill stand-in) propagates
+        after cleanup — like a real kill, no terminal event is emitted."""
+        from ..exec.engine import PlanExecutor
+        from ..exec.journal import JournalError
+
+        self._count("daemon.executes")
+        safe_emit = _SafeEmitter(emit, self)
+        backend = None
+        try:
+            backend = open_backend(self.spec)
+            executor = PlanExecutor(
+                backend,
+                ctx["plan"],
+                ctx["topic_order"],
+                ctx["journal"],
+                failure_policy=ctx["policy"],
+                resume=ctx["resume"],
+                wave_size=ctx["wave_size"],
+                throttle=ctx["throttle"],
+                err=self.err,
+                cluster=self.spec,
+                on_event=safe_emit,
+            )
+            try:
+                outcome = executor.execute()
+            except ExecuteError as e:
+                self._count("daemon.execute_halts")
+                safe_emit({
+                    "event": "exec/error", "kind": "execute",
+                    "message": str(e), "resumable": True, "exit_code": 8,
+                })
+                return
+            except InjectedExecCrash:
+                # The chaos kill stand-in: a killed daemon emits nothing
+                # and releases nothing — the journal alone carries the run.
+                self._count("daemon.execute_interrupted")
+                raise
+            except (JournalError, ValueError, KeyError) as e:
+                safe_emit({
+                    "event": "exec/error", "kind": "validation",
+                    "message": str(e), "resumable": False, "exit_code": 5,
+                })
+                return
+            except Exception as e:
+                self._count("daemon.execute_errors")
+                safe_emit({
+                    "event": "exec/error", "kind": "internal",
+                    "message": f"{type(e).__name__}: {e}",
+                    "resumable": True,
+                })
+                return
+            if outcome.mismatches:
+                status, exit_code = "verify-mismatch", 7
+            elif outcome.skipped:
+                status, exit_code = "degraded", 6
+            else:
+                status, exit_code = "ok", 0
+            safe_emit({
+                "event": "exec/done",
+                "status": status,
+                "exit_code": exit_code,
+                "cluster": self.name,
+                "plan": {
+                    "waves": outcome.waves_total,
+                    "waves_run": outcome.waves_run,
+                    "moves_submitted": outcome.moves_submitted,
+                    "noops": outcome.noops,
+                    "resumed": outcome.resumed,
+                    "skipped_moves": [
+                        [t, p] for t, p in sorted(set(outcome.skipped))
+                    ],
+                    "verify_mismatches": outcome.mismatches,
+                },
+            })
+        finally:
+            if backend is not None:
+                backend.close()
+            with self._active_lock:
+                self._active -= 1
+            self._exec_lock.release()
+
+    # -- introspection ------------------------------------------------------
+
+    def healthz_view(self) -> dict:
+        return {
+            "status": self.lifecycle(),
+            "stale": self.state.stale,
+            "cluster": self.name,
+            "breaker": self.breaker.snapshot(),
+        }
+
+    def state_view(self) -> dict:
+        shape = self.state.encode_shape()
+        return {
+            "lifecycle": self.lifecycle(),
+            "stale": self.state.stale,
+            "cache_version": self.state.version,
+            "brokers": len(self.state.brokers()),
+            "topics": len(self.state.topic_names()),
+            "encode_shape": list(shape) if shape else None,
+            "watches": self._use_watches,
+            "solver": self.solver,
+            "failure_policy": self.failure_policy,
+            "cluster": self.name,
+            "breaker": self.breaker.snapshot(),
+            "execution_in_flight": self._exec_lock.locked(),
+            "counters": self.counters(),
+        }
+
+
+class _SafeEmitter:
+    """Wraps the stream-write callback: a client that disconnects
+    mid-stream must never abort the execution (the journal, not the
+    socket, is the source of truth) — the first write failure disables
+    further emission, loudly."""
+
+    def __init__(self, emit: Callable[[dict], None],
+                 sup: ClusterSupervisor) -> None:
+        self._emit = emit
+        self._sup = sup
+
+    def __call__(self, event: dict) -> None:
+        if self._emit is None:
+            return
+        try:
+            self._emit(event)
+        except Exception as e:
+            self._emit = None
+            self._sup._count("daemon.execute_stream_broken")
+            self._sup._log(
+                f"/execute progress stream broke ({type(e).__name__}: "
+                f"{e}); execution continues, resume state lives in the "
+                "journal"
+            )

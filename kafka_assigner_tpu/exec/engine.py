@@ -65,25 +65,24 @@ from ..obs.metrics import counter_add, hist_observe
 from .journal import ExecutionJournal, Move, plan_fingerprint
 
 
-def load_plan_file(
-    path: str, section: str = "new",
+def parse_plan_payload(
+    text: str, section: str = "new", origin: str = "plan payload",
 ) -> Tuple[Dict[str, Dict[int, List[int]]], List[str]]:
-    """Read a plan file into ``({topic: {partition: replicas}}, topic
-    order)``. Accepts the bare reassignment JSON object, or a saved mode-3
-    stdout: ``section="new"`` (default) takes the ``NEW ASSIGNMENT:``
-    payload, ``section="current"`` takes the ``CURRENT ASSIGNMENT:``
-    rollback snapshot above it — the target ``ka-execute --rollback``
-    drives the cluster BACK to. Topic order is the payload's own entry
-    order, which the verify pass reproduces byte-for-byte."""
-    with open(path, "r", encoding="utf-8") as f:
-        text = f.read()
+    """Parse a plan PAYLOAD (the text of a plan file, or the body of a
+    daemon ``/execute`` request) into ``({topic: {partition: replicas}},
+    topic order)``. Accepts the bare reassignment JSON object, or a saved
+    mode-3 stdout: ``section="new"`` (default) takes the ``NEW
+    ASSIGNMENT:`` payload, ``section="current"`` takes the ``CURRENT
+    ASSIGNMENT:`` rollback snapshot above it — the target ``ka-execute
+    --rollback`` drives the cluster BACK to. Topic order is the payload's
+    own entry order, which the verify pass reproduces byte-for-byte."""
     marker = (
         "NEW ASSIGNMENT:" if section == "new" else "CURRENT ASSIGNMENT:"
     )
     had_marker = marker in text
     if section != "new" and not had_marker:
         raise ValueError(
-            f"plan file {path!r} carries no {marker!r} snapshot to roll "
+            f"{origin} carries no {marker!r} snapshot to roll "
             "back to (a saved mode-3 stdout does; a bare plan JSON does "
             "not)"
         )
@@ -94,14 +93,26 @@ def load_plan_file(
         text = text.split(marker, 1)[1]
     start = text.find("{")
     if start < 0:
-        raise ValueError(f"plan file {path!r} contains no JSON object")
+        raise ValueError(f"{origin} contains no JSON object")
     text = text[start:]
     if had_marker:
         text = text.strip().splitlines()[0]
     plan = parse_reassignment_json(text)
     if not plan:
-        raise ValueError(f"plan file {path!r} describes no partitions")
+        raise ValueError(f"{origin} describes no partitions")
     return plan, list(plan)
+
+
+def load_plan_file(
+    path: str, section: str = "new",
+) -> Tuple[Dict[str, Dict[int, List[int]]], List[str]]:
+    """Read a plan file into ``({topic: {partition: replicas}}, topic
+    order)`` — :func:`parse_plan_payload` over the file's text."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    return parse_plan_payload(
+        text, section=section, origin=f"plan file {path!r}"
+    )
 
 
 @dataclasses.dataclass
@@ -141,6 +152,8 @@ class PlanExecutor:
         wave_size: Optional[int] = None,
         throttle: Optional[float] = None,
         err: Optional[TextIO] = None,
+        cluster: Optional[str] = None,
+        on_event=None,
     ) -> None:
         from ..utils.env import env_float, env_int
 
@@ -162,8 +175,31 @@ class PlanExecutor:
             else env_float("KA_EXEC_THROTTLE")
         )
         self.err = err if err is not None else sys.stderr
+        #: Executing-cluster identity (the backend connect spec): baked
+        #: into the journal so two clusters executing byte-identical plans
+        #: can never cross-resume (ISSUE 9 satellite). None = legacy
+        #: callers; their journals resume under any cluster.
+        self.cluster = cluster
+        #: Wave-by-wave progress callback (the daemon /execute stream):
+        #: called with one dict per event, named after the exec.* span
+        #: family. A failing callback disables itself — progress streaming
+        #: must never abort an execution.
+        self.on_event = on_event
         self.plan_hash = plan_fingerprint(self.plan, self.topic_order)
         self.outcome = ExecOutcome()
+
+    def _emit(self, event: dict) -> None:
+        if self.on_event is None:
+            return
+        try:
+            self.on_event(event)
+        except Exception as e:
+            self.on_event = None
+            print(
+                f"ka-execute: progress callback failed ({type(e).__name__}:"
+                f" {e}); events disabled, execution continues",
+                file=self.err,
+            )
 
     # -- setup -------------------------------------------------------------
 
@@ -207,6 +243,17 @@ class PlanExecutor:
                 moves.append((t, p, list(target)))
         return moves
 
+    def _same_cluster(self, journal_cluster: Optional[str]) -> bool:
+        """Journal identity is (cluster, plan sha): a journal stamped with
+        a DIFFERENT cluster never matches. A journal with no stamp (written
+        before the field existed) — or a caller with no identity — matches
+        any cluster (legacy tolerance)."""
+        return (
+            journal_cluster is None
+            or self.cluster is None
+            or journal_cluster == self.cluster
+        )
+
     def _open_journal(self) -> ExecutionJournal:
         if self.resume:
             journal = ExecutionJournal.load(self.journal_path)
@@ -218,6 +265,16 @@ class PlanExecutor:
                     f"plan (journal {journal.plan_hash[:12]}…, this plan "
                     f"{self.plan_hash[:12]}…); refusing to resume across "
                     "plans"
+                )
+            if not self._same_cluster(journal.cluster):
+                from .journal import JournalError
+
+                raise JournalError(
+                    f"journal {self.journal_path!r} belongs to a DIFFERENT "
+                    f"cluster ({journal.cluster!r}, this run "
+                    f"{self.cluster!r}); two clusters executing the same "
+                    "plan bytes must never cross-resume — point --journal "
+                    "at this cluster's own journal"
                 )
             self.outcome.resumed = True
             self.outcome.skipped.extend(journal.skipped)
@@ -233,25 +290,34 @@ class PlanExecutor:
             if prior.status != "complete":
                 from .journal import JournalError
 
-                if prior.plan_hash == self.plan_hash:
+                if prior.plan_hash == self.plan_hash \
+                        and self._same_cluster(prior.cluster):
                     raise JournalError(
                         f"journal {self.journal_path!r} records an "
                         "interrupted run of THIS plan — pass --resume to "
                         "continue it (or delete the journal to force a "
                         "fresh run)"
                     )
-                # An interrupted run of ANOTHER plan: overwriting would
-                # destroy its committed-wave record and make it
-                # unresumable. Never clobber silently.
+                # An interrupted run of ANOTHER plan (or of this plan on a
+                # DIFFERENT cluster): overwriting would destroy its
+                # committed-wave record and make it unresumable. Never
+                # clobber silently.
+                what = (
+                    f"a DIFFERENT plan ({prior.plan_hash[:12]}…)"
+                    if prior.plan_hash != self.plan_hash
+                    else f"this plan on a DIFFERENT cluster "
+                         f"({prior.cluster!r})"
+                )
                 raise JournalError(
                     f"journal {self.journal_path!r} records an interrupted "
-                    f"run of a DIFFERENT plan ({prior.plan_hash[:12]}…); "
-                    "finish that run with --resume against its plan file, "
-                    "or point --journal elsewhere"
+                    f"run of {what}; finish that run with --resume "
+                    "against its own plan/cluster, or point --journal "
+                    "elsewhere"
                 )
         moves = self._plan_moves()
         journal = ExecutionJournal.fresh(
-            self.journal_path, self.plan_hash, self.wave_size, moves
+            self.journal_path, self.plan_hash, self.wave_size, moves,
+            cluster=self.cluster,
         )
         if self.outcome.skipped:
             # Plan-time best-effort skips (unresolvable topics/partitions)
@@ -438,6 +504,16 @@ class PlanExecutor:
         out = self.outcome
         out.waves_total = journal.waves_total
         first = journal.waves_committed
+        self._emit({
+            "event": "exec/start",
+            "plan_sha": self.plan_hash,
+            "journal": self.journal_path,
+            "waves_total": journal.waves_total,
+            "waves_committed": first,
+            "moves": len(journal.moves),
+            "noops": out.noops,
+            "resumed": out.resumed,
+        })
         for i in range(first, journal.waves_total):
             # The kill-between-waves seam (`wave:i=crash`): fires BEFORE the
             # wave submits, exactly where a process kill leaves the journal.
@@ -445,6 +521,12 @@ class PlanExecutor:
             if i > first and self.throttle > 0:
                 time.sleep(self.throttle)
             wave = journal.wave(i)
+            self._emit({
+                "event": "exec/wave",
+                "wave": i + 1,
+                "of": journal.waves_total,
+                "moves": len(wave),
+            })
             t0 = time.perf_counter()
             with span("exec/wave"):
                 counter_add("exec.waves")
@@ -468,6 +550,13 @@ class PlanExecutor:
             journal.commit_wave(
                 i + 1, skipped=[(t, p) for t, p, _ in pending]
             )
+            self._emit({
+                "event": "exec/wave.committed",
+                "wave": i + 1,
+                "of": journal.waves_total,
+                "converged": len(wave) - len(pending),
+                "skipped": [[t, p] for t, p, _ in pending],
+            })
             print(
                 f"ka-execute: wave {i + 1}/{journal.waves_total} committed "
                 f"({len(wave) - len(pending)}/{len(wave)} move(s) "
@@ -476,6 +565,10 @@ class PlanExecutor:
             )
         with span("exec/verify"):
             out.mismatches = self._verify(journal)
+        self._emit({
+            "event": "exec/verify",
+            "mismatches": len(out.mismatches),
+        })
         journal.complete()
         if obs_active():
             gauge_set("plan.waves", journal.waves_total)

@@ -7,10 +7,15 @@ The journal is written once up front (the frozen wave partition) and then
 re-written after every converged wave, so at any kill point it answers the
 two questions resume needs:
 
-- *which plan?* — ``plan`` is the SHA-256 of the plan's canonical bytes
-  (``format_reassignment_json`` over the parsed plan); ``--resume`` against
-  a different plan file is refused loudly instead of silently executing the
-  wrong moves;
+- *which plan, on which cluster?* — ``plan`` is the SHA-256 of the plan's
+  canonical bytes (``format_reassignment_json`` over the parsed plan) and
+  ``cluster`` is the executing cluster's identity (the backend connect
+  spec). ``--resume`` against a different plan file — or the SAME plan on a
+  DIFFERENT cluster (two clusters executing byte-identical plans used to
+  collide on one journal and cross-resume; ISSUE 9 satellite, regression-
+  pinned) — is refused loudly instead of silently executing the wrong
+  moves. Journals written before the cluster field existed carry no
+  ``cluster`` and resume under any cluster (legacy tolerance);
 - *how far did it get?* — ``waves_committed`` counts fully CONVERGED waves.
   A crash between a wave's submit and its commit resumes by resubmitting
   that wave, which is safe because wave submission is idempotent
@@ -26,6 +31,7 @@ Schema (version 1)::
     {
       "version": 1,
       "plan": "<sha256 hex>",
+      "cluster": "<connect spec>" | null,        # executing cluster identity
       "wave_size": 8,
       "status": "in-progress" | "complete",
       "waves_committed": 2,
@@ -37,7 +43,7 @@ from __future__ import annotations
 
 import hashlib
 import json
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 JOURNAL_VERSION = 1
 
@@ -76,9 +82,11 @@ class ExecutionJournal:
         waves_committed: int = 0,
         skipped: List[Tuple[str, int]] | None = None,
         status: str = "in-progress",
+        cluster: Optional[str] = None,
     ) -> None:
         self.path = path
         self.plan_hash = plan_hash
+        self.cluster = cluster
         self.wave_size = max(1, int(wave_size))
         self.moves = [(t, int(p), [int(r) for r in reps])
                       for t, p, reps in moves]
@@ -102,12 +110,13 @@ class ExecutionJournal:
 
     @classmethod
     def fresh(
-        cls, path: str, plan_hash: str, wave_size: int, moves: List[Move]
+        cls, path: str, plan_hash: str, wave_size: int, moves: List[Move],
+        *, cluster: Optional[str] = None,
     ) -> "ExecutionJournal":
         """Start a new run: the journal is persisted BEFORE the first wave
         is submitted, so even a kill inside wave 0 leaves a resumable
         record."""
-        j = cls(path, plan_hash, wave_size, moves)
+        j = cls(path, plan_hash, wave_size, moves, cluster=cluster)
         j.save()
         return j
 
@@ -140,6 +149,10 @@ class ExecutionJournal:
                 waves_committed=int(data["waves_committed"]),
                 skipped=[(t, int(p)) for t, p in data.get("skipped", [])],
                 status=str(data.get("status", "in-progress")),
+                cluster=(
+                    str(data["cluster"])
+                    if data.get("cluster") is not None else None
+                ),
             )
         except (KeyError, TypeError, ValueError) as e:
             raise JournalError(
@@ -176,6 +189,7 @@ class ExecutionJournal:
         payload = {
             "version": JOURNAL_VERSION,
             "plan": self.plan_hash,
+            "cluster": self.cluster,
             "wave_size": self.wave_size,
             "status": self.status,
             "waves_committed": self.waves_committed,

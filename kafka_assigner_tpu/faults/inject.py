@@ -65,10 +65,17 @@ solver-crash daemon         the solve crashes inside a served request
 ========== ================ ==============================================
 
 Spec grammar (``KA_FAULTS_SPEC``): semicolon-separated events
-``scope:index=kind[:arg]`` — the fault fires the ``index``-th time that
-scope's hook runs (0-based, per-scope counters), e.g.::
+``scope[@cluster]:index=kind[:arg]`` — the fault fires the ``index``-th
+time that scope's hook runs (0-based, per-scope counters), e.g.::
 
     KA_FAULTS_SPEC='reply:3=drop;reply:6=nonode;connect:0=blackhole'
+
+``@cluster`` (ISSUE 9) addresses one cluster of the multi-cluster daemon:
+``session:expire@west`` is spelled ``session@west:1=expire`` and fires only
+when the ``west`` supervisor consults the hook, at ``west``'s OWN per-scope
+index — so a schedule can blackout cluster A while cluster B's hooks stay
+untouched (the bulkhead chaos rows). Clusterless events keep the legacy
+global per-scope counter, byte-identical to every historical schedule.
 
 or the single word ``random``: a schedule drawn from
 ``random.Random(KA_FAULTS_SEED)`` with per-hook probability
@@ -175,16 +182,21 @@ class InjectedExecCrash(RuntimeError):
 @dataclass(frozen=True)
 class FaultEvent:
     """One scheduled fault: fires the ``index``-th time ``scope``'s hook
-    runs. ``arg`` is kind-specific (trunc: bytes kept; slow: seconds)."""
+    runs. ``arg`` is kind-specific (trunc: bytes kept; slow: seconds).
+    ``cluster`` (None = any) addresses one cluster of the multi-cluster
+    daemon: the event fires at that cluster's own per-scope index, only
+    when the hook is consulted with a matching cluster."""
 
     scope: str
     index: int
     kind: str
     arg: Optional[float] = None
+    cluster: Optional[str] = None
 
     def __str__(self) -> str:
         suffix = "" if self.arg is None else f":{self.arg:g}"
-        return f"{self.scope}:{self.index}={self.kind}{suffix}"
+        at = "" if self.cluster is None else f"@{self.cluster}"
+        return f"{self.scope}{at}:{self.index}={self.kind}{suffix}"
 
 
 def parse_spec(
@@ -203,10 +215,24 @@ def parse_spec(
         head, eq, kind_arg = raw.partition("=")
         if not eq:
             raise FaultSpecError(
-                f"fault event {raw!r} is not of the form scope:index=kind"
+                f"fault event {raw!r} is not of the form "
+                "scope[@cluster]:index=kind"
             )
-        scope, _, idx_s = head.partition(":")
+        scope_part, _, idx_s = head.partition(":")
+        scope, at, cluster = scope_part.partition("@")
         scope = scope.strip()
+        cluster = cluster.strip() or None
+        if at and cluster is None:
+            raise FaultSpecError(
+                f"empty cluster name after '@' in {raw!r}"
+            )
+        if cluster is not None and not all(
+            c.isalnum() or c in "_.-" for c in cluster
+        ):
+            raise FaultSpecError(
+                f"invalid cluster name {cluster!r} in {raw!r} "
+                "(letters, digits, '_', '.', '-' only)"
+            )
         if scope not in FAULT_SCOPES:
             raise FaultSpecError(
                 f"unknown fault scope {scope!r} in {raw!r} "
@@ -235,7 +261,7 @@ def parse_spec(
                 raise FaultSpecError(
                     f"fault arg {arg_s!r} in {raw!r} is not a number"
                 ) from None
-        events.append(FaultEvent(scope, index, kind, arg))
+        events.append(FaultEvent(scope, index, kind, arg, cluster))
     return events
 
 
@@ -265,14 +291,35 @@ class FaultInjector:
 
     def __init__(self, events: List[FaultEvent]) -> None:
         self.schedule: Tuple[FaultEvent, ...] = tuple(events)
-        self._events = {(e.scope, e.index): e for e in events}
+        self._events = {
+            (e.scope, e.cluster, e.index): e for e in events
+        }
         self._counts: Dict[str, int] = {}
+        #: Per-(scope, cluster) counters for @cluster-addressed events —
+        #: a cluster-scoped event fires at that cluster's OWN index, so
+        #: schedules stay deterministic however the daemon interleaves its
+        #: supervisors' hooks.
+        self._cluster_counts: Dict[Tuple[str, str], int] = {}
         self.fired: List[FaultEvent] = []
 
-    def _next(self, scope: str) -> Optional[FaultEvent]:
+    def _next(
+        self, scope: str, cluster: Optional[str] = None
+    ) -> Optional[FaultEvent]:
         i = self._counts.get(scope, 0)
         self._counts[scope] = i + 1
-        return self._events.get((scope, i))
+        ev = self._events.get((scope, None, i))
+        if ev is not None:
+            # A clusterless (global-index) event claims this consult; the
+            # per-cluster index is deliberately NOT consumed — a @cluster
+            # event colliding with a global one fires at that cluster's
+            # next consult instead of being silently lost.
+            return ev
+        if cluster is not None:
+            key = (scope, cluster)
+            j = self._cluster_counts.get(key, 0)
+            self._cluster_counts[key] = j + 1
+            ev = self._events.get((scope, cluster, j))
+        return ev
 
     def _fire(self, ev: FaultEvent) -> None:
         self.fired.append(ev)
@@ -425,46 +472,48 @@ class FaultInjector:
 
     # -- daemon seams (ISSUE 8) --------------------------------------------
 
-    def watch_delivery(self) -> bool:
+    def watch_delivery(self, cluster: Optional[str] = None) -> bool:
         """Called by the daemon per received watch notification; a ``drop``
         event makes the daemon DISCARD it (a notification lost between the
         quorum and the client) — the periodic full-resync escape hatch, not
-        the watch, must then reconverge the cache."""
-        ev = self._next("watch")
+        the watch, must then reconverge the cache. ``cluster`` is the
+        consulting supervisor's cluster name (``@cluster`` addressing)."""
+        ev = self._next("watch", cluster)
         if ev is not None and ev.kind == "drop":
             self._fire(ev)
             return True
         return False
 
-    def session_check(self) -> bool:
+    def session_check(self, cluster: Optional[str] = None) -> bool:
         """Called by the daemon at the top of each served request; an
         ``expire`` event tells the daemon to kill its own ZooKeeper session
         NOW (the deterministic stand-in for a server-side session expiry
         landing mid-request) — re-establishment, watch re-arm and the
-        bounded resync are what's under test."""
-        ev = self._next("session")
+        bounded resync are what's under test. ``@cluster`` addressing
+        blackouts one supervisor while the others' requests stay clean."""
+        ev = self._next("session", cluster)
         if ev is not None and ev.kind == "expire":
             self._fire(ev)
             return True
         return False
 
-    def resync_attempt(self) -> None:
+    def resync_attempt(self, cluster: Optional[str] = None) -> None:
         """Called at the top of each daemon resync pass; ``stall`` raises
         :class:`InjectedResyncStall` — the daemon must retry with backoff
         and serve stale-marked responses meanwhile, never an error."""
-        ev = self._next("resync")
+        ev = self._next("resync", cluster)
         if ev is not None and ev.kind == "stall":
             self._fire(ev)
             raise InjectedResyncStall(
                 "injected fault: daemon resync attempt stalled"
             )
 
-    def daemon_solve(self) -> None:
+    def daemon_solve(self, cluster: Optional[str] = None) -> None:
         """Called at the daemon's per-request solve dispatch boundary;
         ``solver-crash`` raises :class:`InjectedSolverCrash` — the request
         must degrade to the greedy fallback in isolation (other requests,
-        and the daemon itself, unaffected)."""
-        ev = self._next("daemon")
+        other clusters, and the daemon itself, unaffected)."""
+        ev = self._next("daemon", cluster)
         if ev is not None and ev.kind == "solver-crash":
             self._fire(ev)
             raise InjectedSolverCrash(
@@ -525,10 +574,12 @@ def active_injector() -> Optional[FaultInjector]:
     return injector
 
 
-def fault_point(scope: str) -> None:
+def fault_point(scope: str, cluster: Optional[str] = None) -> None:
     """Generic crash-style fault point for non-wire call sites (``solve`` in
     the TPU solver, ``warmup`` in the ingest warm-up thread, ``wave`` at the
-    execution engine's wave boundaries). No-op without an active injector."""
+    execution engine's wave boundaries). ``cluster`` forwards the daemon
+    supervisor's cluster name for ``@cluster``-addressed schedules. No-op
+    without an active injector."""
     inj = active_injector()
     if inj is None:
         return
@@ -539,6 +590,6 @@ def fault_point(scope: str) -> None:
     elif scope == "wave":
         inj.wave_boundary()
     elif scope == "resync":
-        inj.resync_attempt()
+        inj.resync_attempt(cluster)
     elif scope == "daemon":
-        inj.daemon_solve()
+        inj.daemon_solve(cluster)

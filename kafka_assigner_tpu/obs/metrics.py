@@ -55,23 +55,23 @@ DEFAULT_HIST_EDGES: Tuple[float, ...] = (
 def obs_active() -> bool:
     """True when a run capture is recording — gate for metric computations
     that are themselves non-trivial (e.g. plan diff stats)."""
-    return trace._ACTIVE is not None
+    return trace._current() is not None
 
 
 def counter_add(name: str, n: int = 1) -> None:
-    run = trace._ACTIVE
+    run = trace._current()
     if run is not None:
         run.counter_add(name, n)
 
 
 def gauge_set(name: str, value) -> None:
-    run = trace._ACTIVE
+    run = trace._current()
     if run is not None:
         run.gauge_set(name, value)
 
 
 def hist_observe(name: str, value: float) -> None:
-    run = trace._ACTIVE
+    run = trace._current()
     if run is not None:
         run.hist_observe(name, value)
 
@@ -101,7 +101,7 @@ class _HistTimer:
 def hist_ms(name: str):
     """Context manager observing the block's wall ms into histogram
     ``name``; the shared no-op singleton when disabled."""
-    run = trace._ACTIVE
+    run = trace._current()
     if run is None:
         return trace.NULL_SPAN
     return _HistTimer(run, name)

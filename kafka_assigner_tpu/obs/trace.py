@@ -160,10 +160,25 @@ NULL_SPAN = _NullSpan()
 #: sites read one attribute and bail — the whole disabled-mode cost.
 _ACTIVE: Optional[RunCollector] = None
 
+#: Thread-LOCAL capture overlay (ISSUE 9): the multi-cluster daemon runs
+#: one capture per served request on the request's own thread, so two
+#: concurrent requests (different clusters, or a /plan racing an /execute's
+#: engine spans) can never tear each other's span stacks or steal each
+#: other's metrics. A thread-local capture shadows the global one FOR ITS
+#: THREAD ONLY; every other thread (the CLI orchestration thread, warm-up
+#: threads) keeps the global-fallback behavior unchanged.
+_TLS = threading.local()
+
+
+def _current() -> Optional[RunCollector]:
+    run = getattr(_TLS, "run", None)
+    return run if run is not None else _ACTIVE
+
 
 def active_run() -> Optional[RunCollector]:
-    """The collector of the current capture, or None when disabled."""
-    return _ACTIVE
+    """The collector of the current capture (this thread's local capture
+    when one is active, else the process-global one), or None."""
+    return _current()
 
 
 class _Span:
@@ -222,7 +237,7 @@ def record_span(name: str, ms: float, ok: bool = True) -> None:
     background-thread counterpart of :func:`span`, used by work that runs
     concurrently with the orchestration thread's span stack (the ingest
     warm-up, ``generator.py``)."""
-    run = _ACTIVE
+    run = _current()
     if run is not None:
         run.record_complete(name, ms, ok)
 
@@ -240,27 +255,39 @@ def span(name: str, *, sink=None, key=None, hist=None, log=None):
     - disabled and no sink/log: returns the shared no-op singleton (zero
       allocation).
     """
-    run = _ACTIVE
+    run = _current()
     if run is None and sink is None and log is None:
         return NULL_SPAN
     return _Span(run, name, sink, key, hist, log)
 
 
 @contextlib.contextmanager
-def run_capture(hist_edges=None) -> Iterator[RunCollector]:
+def run_capture(hist_edges=None, local: bool = False) -> Iterator[RunCollector]:
     """Activate a fresh :class:`RunCollector` for the duration of the block.
 
     Captures nest by save/restore (an inner capture shadows, then the outer
     resumes) so library callers and the CLI cannot corrupt each other.
     Histogram bucket edges default to the ``KA_OBS_HIST_EDGES`` knob.
+
+    ``local=True`` binds the capture to the CALLING THREAD only (the
+    daemon's per-request isolation): spans/metrics from this thread land
+    here, other threads are untouched and keep the global fallback.
     """
     global _ACTIVE
     if hist_edges is None:
         from .metrics import resolve_hist_edges
 
         hist_edges = resolve_hist_edges()
-    prev = _ACTIVE
     run = RunCollector(hist_edges=tuple(hist_edges))
+    if local:
+        prev = getattr(_TLS, "run", None)
+        _TLS.run = run
+        try:
+            yield run
+        finally:
+            _TLS.run = prev
+        return
+    prev = _ACTIVE
     _ACTIVE = run
     try:
         yield run

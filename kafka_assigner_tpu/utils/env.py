@@ -239,12 +239,16 @@ _knob(
 _knob(
     "KA_FAULTS_SPEC", "str", None, default_doc="unset (no injection)",
     doc="fault-injection schedule for the harness in `faults/inject.py`: "
-        "semicolon-separated `scope:index=kind[:arg]` events "
+        "semicolon-separated `scope[@cluster]:index=kind[:arg]` events "
         "(scopes connect/handshake/reply/solve/warmup plus the write seams "
-        "write/converge/wave; kinds blackhole, expire, drop, trunc, slow, "
-        "nonode, crash, lost, stall), or the word `random` for a "
+        "write/converge/wave and the daemon seams watch/session/resync/"
+        "daemon; kinds blackhole, expire, drop, trunc, slow, nonode, "
+        "crash, lost, stall, solver-crash), or the word `random` for a "
         "seed-deterministic schedule (`KA_FAULTS_SEED`/`KA_FAULTS_RATE`). "
-        "Malformed specs are ignored loudly and injection stays off",
+        "`@cluster` addresses one cluster of the multi-cluster daemon "
+        "(e.g. `session@west:1=expire`), firing at that cluster's own "
+        "per-scope index. Malformed specs are ignored loudly and "
+        "injection stays off",
 )
 _knob(
     "KA_FAULTS_SEED", "int", 0,
@@ -323,9 +327,12 @@ _knob(
 )
 _knob(
     "KA_DAEMON_MAX_INFLIGHT", "int", 8, floor=1,
-    doc="backpressure gate: concurrent requests the daemon admits; beyond "
-        "it requests are shed with 503 + `Retry-After` (counted as "
-        "`daemon.requests_shed`) instead of queueing unboundedly",
+    doc="backpressure gate: concurrent requests the daemon admits PER "
+        "CLUSTER; beyond it requests are shed with 503 + `Retry-After` "
+        "(counted as `daemon.requests_shed`) instead of queueing "
+        "unboundedly. LIVE: re-read per request (like the program store's "
+        "trace-time knobs), so an operator can loosen the gate on a "
+        "running fleet without a restart",
 )
 _knob(
     "KA_DAEMON_REQUEST_TIMEOUT", "float", 30.0, floor=0.1,
@@ -354,6 +361,31 @@ _knob(
     doc="seconds SIGTERM waits for in-flight requests to finish (new ones "
         "are refused on `/readyz` immediately) before the daemon exits 0 "
         "anyway",
+)
+_knob(
+    "KA_DAEMON_BREAKER_THRESHOLD", "int", 3, floor=1,
+    doc="per-cluster circuit breaker: consecutive session/resync failures "
+        "that OPEN the breaker (`daemon.breaker_opened`); while open, the "
+        "dead quorum is probed on the cooldown envelope instead of "
+        "hammered, and that cluster's responses stale-serve or shed — "
+        "other clusters' supervisors are untouched (bulkhead isolation)",
+)
+_knob(
+    "KA_DAEMON_BREAKER_COOLDOWN", "float", 1.0, floor=0.05,
+    doc="initial open-state cooldown before the breaker half-opens for one "
+        "probe; doubles with 0.5-1.5x jitter per failed probe "
+        "(`utils/backoff.py` envelope), capped at "
+        "`KA_DAEMON_RESYNC_INTERVAL`. A successful probe closes the "
+        "breaker and resets the progression",
+)
+_knob(
+    "KA_DAEMON_JOURNAL_DIR", "str", None,
+    default_doc="`.` (daemon working directory)",
+    doc="where the daemon's /execute endpoint writes its crash-safe "
+        "journals when the request names none: "
+        "`ka-execute-<cluster>-<plan sha12>.journal` per (cluster, plan) — "
+        "the journal identity that makes a daemon kill mid-execution "
+        "resumable via /execute resume=1 or offline `ka-execute --resume`",
 )
 _knob(
     "KA_DAEMON_WATCH", "bool", True,

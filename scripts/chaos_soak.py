@@ -535,14 +535,34 @@ DAEMON_MATRIX = [
 DAEMON_ENV = {"KA_ZK_CLIENT": "wire", "KA_DAEMON_RESYNC_INTERVAL": "0.5"}
 
 
-def _daemon_post(port, timeout_s):
+def _daemon_post(port, timeout_s, path="/plan", payload=None):
     import http.client
 
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout_s)
     try:
-        conn.request("POST", "/plan", body="{}")
+        # kalint: disable=KA005 -- request body handoff, not a plan payload
+        body = "{}" if payload is None else json.dumps(payload)
+        conn.request("POST", path, body=body)
         resp = conn.getresponse()
         return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _daemon_stream(port, timeout_s, path, payload):
+    """POST an /execute request and drain its NDJSON stream to EOF;
+    returns (status, events) — or (status, body) on a JSON refusal."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout_s)
+    try:
+        # kalint: disable=KA005 -- request body handoff, not a plan payload
+        conn.request("POST", path, body=json.dumps(payload))
+        resp = conn.getresponse()
+        raw = resp.read().decode("utf-8")
+        if resp.status != 200:
+            return resp.status, json.loads(raw)
+        return resp.status, [json.loads(ln) for ln in raw.splitlines()]
     finally:
         conn.close()
 
@@ -653,7 +673,7 @@ def soak_daemon_matrix(args, report_dir):
                             )
                 finally:
                     daemon.shutdown()
-                zk = getattr(daemon.backend, "_zk", None)
+                zk = getattr(daemon.supervisor().backend, "_zk", None)
                 if getattr(zk, "_sock", None) is not None:
                     row_fail = row_fail or "ZK socket stranded after shutdown"
                 if daemon.httpd is not None \
@@ -671,6 +691,305 @@ def soak_daemon_matrix(args, report_dir):
                     )
             finally:
                 server.shutdown()
+    return failures
+
+
+# ---------------------------------------------------------------------------
+# The multi-cluster matrix (ISSUE 9): per-cluster supervisors under
+# cluster-addressed faults. Three rows x both policies:
+#   bulkhead       session:expire@a + resync:stall@a while hammering
+#                  /clusters/b/plan — B's responses stay ok AND
+#                  byte-identical to a fresh-process CLI run THROUGHOUT,
+#                  A sheds/stale-serves alone; 0 hangs, 0 stranded sockets
+#   breaker        quorum blackout opens the per-cluster breaker
+#                  (stale-served degraded answers, byte-identical), the
+#                  quorum's return on the same port closes it via a
+#                  half-open probe, responses go ok again
+#   execute-kill   daemon "killed" at a wave boundary mid-/execute
+#                  (InjectedExecCrash, the in-process kill stand-in),
+#                  then /execute resume=1 converges the cluster
+#                  byte-identically to an uninterrupted offline ka-execute
+# ---------------------------------------------------------------------------
+
+
+def _await_pred(pred, deadline_s, every=0.2):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return False
+
+
+def _sockets_clean(daemon):
+    for name, sup in daemon.supervisors.items():
+        zk = getattr(sup.backend, "_zk", None)
+        if getattr(zk, "_sock", None) is not None:
+            return f"cluster {name!r}: ZK socket stranded after shutdown"
+    if daemon.httpd is not None and daemon.httpd.socket.fileno() != -1:
+        return "HTTP socket stranded after shutdown"
+    return None
+
+
+def _mc_bulkhead_row(args, report_dir, policy):
+    tag = f"multicluster[bulkhead/{policy}]"
+    sa, sb = JuteZkServer(cluster_tree()), JuteZkServer(cluster_tree())
+    sa.start(), sb.start()
+    box = {}
+    try:
+        fail = _mc_bulkhead_body(args, report_dir, policy, tag, sa, sb, box)
+        daemon = box.get("daemon")
+        if daemon is not None:
+            daemon.shutdown()
+            leak = _sockets_clean(daemon)
+            fail = fail or (leak and f"{tag}: {leak}")
+        return fail
+    finally:
+        sa.shutdown(), sb.shutdown()
+
+
+def _mc_bulkhead_body(args, report_dir, policy, tag, sa, sb, box):
+    from kafka_assigner_tpu.daemon import AssignerDaemon
+
+    base_a = baseline_bytes(sa.port, "greedy", report_dir, args.timeout)
+    base_b = baseline_bytes(sb.port, "greedy", report_dir, args.timeout)
+    set_schedule(dict(DAEMON_ENV),
+                 spec="session@a:1=expire;resync@a:1=stall")
+    daemon = box["daemon"] = AssignerDaemon(
+        clusters={"a": f"127.0.0.1:{sa.port}",
+                  "b": f"127.0.0.1:{sb.port}"},
+        solver="greedy", failure_policy=policy,
+    )
+    daemon.start()
+    port = daemon.http_port
+    s, body = _daemon_post(port, args.timeout, "/clusters/a/plan")
+    if s != 200 or body["status"] != "ok" \
+            or body["result"]["stdout"] != base_a:
+        return f"{tag}: pre-fault request on a broken (http {s})"
+    # request #1 on a: the expiry lands mid-request — stale-marked,
+    # byte-identical, never an error
+    s, body = _daemon_post(port, args.timeout, "/clusters/a/plan")
+    if s != 200 or body["result"]["stdout"] != base_a:
+        return f"{tag}: expiry request on a not stale-served (http {s})"
+    if body["status"] != "degraded":
+        return f"{tag}: expiry request status {body['status']!r}"
+    # hammer B concurrently while a's first resync attempt stalls
+    b_failures = []
+
+    def hammer_b():
+        for i in range(10):
+            try:
+                s2, b2 = _daemon_post(port, args.timeout,
+                                      "/clusters/b/plan")
+            except OSError as e:
+                b_failures.append(f"request {i} transport: {e}")
+                return
+            if s2 != 200 or b2["status"] != "ok" \
+                    or b2["result"]["stdout"] != base_b:
+                b_failures.append(
+                    f"request {i}: http={s2} "
+                    f"status={b2.get('status')!r} identical="
+                    f"{b2.get('result', {}).get('stdout') == base_b}"
+                )
+
+    hammer = threading.Thread(target=hammer_b)
+    hammer.start()
+    recovered = _await_pred(
+        lambda: _daemon_post(port, args.timeout,
+                             "/clusters/a/plan")[1]["status"] == "ok",
+        20.0,
+    )
+    hammer.join(timeout=args.timeout)
+    if hammer.is_alive():
+        return f"{tag}: B hammer thread HUNG"
+    if b_failures:
+        return f"{tag}: B was not isolated: {b_failures}"
+    if not recovered:
+        return f"{tag}: A never recovered to ok"
+    s, body = _daemon_post(port, args.timeout, "/clusters/a/plan")
+    if body["result"]["stdout"] != base_a:
+        return f"{tag}: post-recovery A bytes diverged"
+    if daemon.supervisors["b"].counters().get("daemon.session_lost"):
+        return f"{tag}: fault leaked into cluster b"
+    return None
+
+def _mc_breaker_row(args, report_dir, policy):
+    from kafka_assigner_tpu.daemon import AssignerDaemon
+
+    tag = f"multicluster[breaker/{policy}]"
+    server = JuteZkServer(cluster_tree())
+    server.start()
+    zk_port = server.port
+    daemon = None
+    revived = None
+    try:
+        base = baseline_bytes(zk_port, "greedy", report_dir, args.timeout)
+        set_schedule({
+            **DAEMON_ENV,
+            "KA_DAEMON_BREAKER_THRESHOLD": "2",
+            "KA_DAEMON_BREAKER_COOLDOWN": "0.2",
+            "KA_DAEMON_RESYNC_INTERVAL": "0.3",
+            "KA_DAEMON_RESYNC_RETRIES": "1",
+            "KA_ZK_CONNECT_RETRIES": "1",
+            "KA_ZK_SESSION_RETRIES": "1",
+        })
+        daemon = AssignerDaemon(
+            clusters={"west": f"127.0.0.1:{zk_port}"},
+            solver="greedy", failure_policy=policy,
+        )
+        daemon.start()
+        port = daemon.http_port
+        s, body = _daemon_post(port, args.timeout, "/clusters/west/plan")
+        if s != 200 or body["status"] != "ok":
+            return f"{tag}: pre-blackout request broken (http {s})"
+        server.shutdown()  # quorum blackout: established sessions die too
+        breaker = daemon.supervisors["west"].breaker
+        if not _await_pred(lambda: breaker.state == "open", 20.0):
+            return f"{tag}: breaker never opened (state {breaker.state!r})"
+        s, body = _daemon_post(port, args.timeout, "/clusters/west/plan")
+        if s != 200 or body["status"] != "degraded" \
+                or body["result"]["stdout"] != base:
+            return (f"{tag}: open-breaker request not stale-served "
+                    f"(http {s}, status {body.get('status')!r})")
+        # quorum returns on the SAME port (bind may race conn teardown)
+        deadline = time.monotonic() + 10
+        while revived is None:
+            try:
+                revived = JuteZkServer(cluster_tree(), port=zk_port)
+            except OSError:
+                if time.monotonic() > deadline:
+                    return f"{tag}: could not rebind the quorum port"
+                time.sleep(0.2)
+        revived.start()
+        if not _await_pred(lambda: breaker.state == "closed", 20.0):
+            return f"{tag}: breaker never closed after the quorum returned"
+        if not _await_pred(
+            lambda: _daemon_post(port, args.timeout,
+                                 "/clusters/west/plan")[1]["status"] == "ok",
+            20.0,
+        ):
+            return f"{tag}: responses never recovered to ok"
+        s, body = _daemon_post(port, args.timeout, "/clusters/west/plan")
+        if body["result"]["stdout"] != base:
+            return f"{tag}: post-recovery bytes diverged"
+        counters = daemon.supervisors["west"].counters()
+        if not counters.get("daemon.breaker_opened") \
+                or not counters.get("daemon.breaker_closed"):
+            return f"{tag}: breaker transitions not counted ({counters})"
+        return None
+    finally:
+        if daemon is not None:
+            daemon.shutdown()
+        server.shutdown()
+        if revived is not None:
+            revived.shutdown()
+
+
+def _mc_execute_kill_row(args, report_dir, policy):
+    import shutil
+
+    from kafka_assigner_tpu.daemon import AssignerDaemon
+    from tests.jute_server import exec_snapshot_cluster
+
+    tag = f"multicluster[execute-kill/{policy}]"
+    work = os.path.join(report_dir, f"mc_exec_{policy}")
+    os.makedirs(work, exist_ok=True)
+    snap = os.path.join(work, "cluster.json")
+    with open(snap, "w", encoding="utf-8") as f:
+        # kalint: disable=KA005 -- test-fixture snapshot, not a plan payload
+        json.dump(exec_snapshot_cluster(), f)
+    plan_path = os.path.join(work, "plan.txt")
+    set_schedule({})
+    fail = run_mode3_plan(snap, plan_path, args.timeout)
+    if fail is not None:
+        return f"{tag}: plan generation failed: {fail}"
+    with open(plan_path, "r", encoding="utf-8") as f:
+        plan_text = f.read()
+    # offline oracle: an uninterrupted ka-execute on a copy
+    offline = os.path.join(work, "offline.json")
+    shutil.copy(snap, offline)
+    set_schedule(dict(EXEC_ENV))
+    r = run_exec(["--zk_string", offline, "--plan", plan_path,
+                  "--journal", os.path.join(work, "offline.journal")],
+                 args.timeout)
+    if r.hung or r.killed or r.rc != EXIT_OK:
+        return f"{tag}: offline baseline broken (rc={r.rc})"
+    with open(offline, "r", encoding="utf-8") as f:
+        final_oracle = f.read()
+
+    set_schedule({**DAEMON_ENV, **EXEC_ENV,
+                  "KA_DAEMON_JOURNAL_DIR": work},
+                 spec="wave:1=crash")
+    daemon = AssignerDaemon(clusters={"x": snap}, solver="greedy",
+                            failure_policy=policy)
+    daemon.start()
+    try:
+        port = daemon.http_port
+        s, events = _daemon_stream(port, args.timeout,
+                                   "/clusters/x/execute",
+                                   {"plan_text": plan_text})
+        if s != 200:
+            return f"{tag}: /execute refused (http {s}: {events})"
+        kinds = [e["event"] for e in events]
+        if "exec/done" in kinds:
+            return f"{tag}: killed run still emitted exec/done"
+        if "exec/wave.committed" not in kinds:
+            return f"{tag}: no wave committed before the kill ({kinds})"
+        journals = [p for p in os.listdir(work)
+                    if p.startswith("ka-execute-x-")]
+        if len(journals) != 1:
+            return f"{tag}: expected one cluster-keyed journal, {journals}"
+        with open(os.path.join(work, journals[0]), encoding="utf-8") as f:
+            j = json.load(f)
+        if j["status"] != "in-progress" or j["waves_committed"] < 1:
+            return f"{tag}: journal after kill: {j['status']}/" \
+                   f"{j['waves_committed']}"
+        # "restart": clear the schedule, resume through the same endpoint
+        set_schedule({**DAEMON_ENV, **EXEC_ENV,
+                      "KA_DAEMON_JOURNAL_DIR": work})
+        s, events = _daemon_stream(port, args.timeout,
+                                   "/clusters/x/execute",
+                                   {"plan_text": plan_text, "resume": True})
+        if s != 200:
+            return f"{tag}: resume refused (http {s}: {events})"
+        done = events[-1] if events else {}
+        if done.get("event") != "exec/done" \
+                or done.get("status") != "ok" \
+                or done.get("exit_code") != 0:
+            return f"{tag}: resume did not complete ok ({done})"
+        if not done["plan"]["resumed"] or done["plan"]["skipped_moves"]:
+            return f"{tag}: resume accounting wrong ({done['plan']})"
+        with open(snap, "r", encoding="utf-8") as f:
+            if f.read() != final_oracle:
+                return (f"{tag}: resumed final state diverged from the "
+                        "uninterrupted offline execution")
+        with open(os.path.join(work, journals[0]), encoding="utf-8") as f:
+            if json.load(f)["status"] != "complete":
+                return f"{tag}: resumed journal not complete"
+        return None
+    finally:
+        daemon.shutdown()
+
+
+def soak_multicluster_matrix(args, report_dir):
+    failures = []
+    rows = [
+        ("bulkhead", _mc_bulkhead_row),
+        ("breaker", _mc_breaker_row),
+        ("execute-kill", _mc_execute_kill_row),
+    ]
+    for name, fn in rows:
+        for policy in ("strict", "best-effort"):
+            t0 = time.perf_counter()
+            fail = fn(args, report_dir, policy)
+            if fail:
+                failures.append(fail)
+            else:
+                print(
+                    f"chaos_soak: multicluster[{name}/{policy}]: ok "
+                    f"({time.perf_counter() - t0:.2f}s)",
+                    file=sys.stderr,
+                )
     return failures
 
 
@@ -778,6 +1097,7 @@ def main(argv=None):
                 failures = soak_matrix(args, report_dir)
                 failures += soak_exec_matrix(args, report_dir)
                 failures += soak_daemon_matrix(args, report_dir)
+                failures += soak_multicluster_matrix(args, report_dir)
             else:
                 failures = soak_random(args, report_dir)
     finally:

@@ -3,7 +3,14 @@
 assigner daemon end to end as a REAL process — real sockets, real SIGTERM —
 in a few seconds (ISSUE 8).
 
-Sequence, against the in-repo jute ZooKeeper server:
+``--multi`` (ISSUE 9) runs the TWO-CLUSTER variant instead: a real
+``ka-daemon --clusters`` subprocess fronting a jute-server cluster and a
+snapshot cluster, routed requests byte-identical per cluster, then the
+/execute crash-safety proof — a REAL SIGTERM mid-execution, restart, and
+``resume=1`` converging the cluster byte-identically to an uninterrupted
+offline ``ka-execute`` run.
+
+Default sequence, against the in-repo jute ZooKeeper server:
 
 1. baseline: a fresh-process CLI mode-3 run → stdout bytes A;
 2. start: ``ka-daemon`` as a subprocess (wire client, watches on,
@@ -42,12 +49,14 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 BANNER_RE = re.compile(r"listening on http://([\d.]+):(\d+)")
 
 
-def fresh_cli_plan(port: int) -> str:
-    """A FRESH-PROCESS mode-3 run — the byte-identity oracle."""
+def fresh_cli_plan(zk, *extra) -> str:
+    """A FRESH-PROCESS mode-3 run — the byte-identity oracle. ``zk`` is a
+    port (jute server) or a snapshot path."""
+    zk_string = f"127.0.0.1:{zk}" if isinstance(zk, int) else zk
     proc = subprocess.run(
         [sys.executable, "-m", "kafka_assigner_tpu.cli",
-         "--zk_string", f"127.0.0.1:{port}",
-         "--mode", "PRINT_REASSIGNMENT", "--solver", "greedy"],
+         "--zk_string", zk_string,
+         "--mode", "PRINT_REASSIGNMENT", "--solver", "greedy", *extra],
         cwd=REPO, capture_output=True, text=True, timeout=120,
         env={**os.environ, "KA_ZK_CLIENT": "wire"},
     )
@@ -188,5 +197,219 @@ def main() -> int:
         server.shutdown()
 
 
+def _start_daemon(args, env, stderr_lines):
+    """Spawn a real ka-daemon subprocess; returns (proc, http port) once
+    the startup banner lands (stderr drains on a thread)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "from kafka_assigner_tpu.cli import daemon_main; daemon_main()",
+         *args],
+        cwd=REPO, env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    banner = {}
+    ready = threading.Event()
+
+    def _drain():
+        for line in proc.stderr:
+            stderr_lines.append(line)
+            m = BANNER_RE.search(line)
+            if m:
+                banner["port"] = int(m.group(2))
+                ready.set()
+
+    threading.Thread(target=_drain, daemon=True).start()
+    if not ready.wait(60) or "port" not in banner:
+        proc.kill()
+        raise SystemExit("FAIL: daemon never announced its port\n"
+                         + "".join(stderr_lines))
+    return proc, banner["port"]
+
+
+def _post_json(port, path, payload):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        conn.request("POST", path, body=json.dumps(payload))
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def main_multi() -> int:
+    """The two-cluster variant: routed byte-identity per cluster, then the
+    /execute crash-safety acceptance — REAL SIGTERM at a wave boundary
+    mid-execution, restart, resume=1, final state byte-identical to an
+    uninterrupted offline ka-execute run."""
+    import shutil
+    import tempfile
+
+    from tests.jute_server import JuteZkServer, cluster_tree, \
+        exec_snapshot_cluster
+
+    server = JuteZkServer(cluster_tree())
+    server.start()
+    tmp = tempfile.mkdtemp(prefix="ka_daemon_smoke_")
+    daemon = None
+    stderr_lines = []
+    try:
+        snap = os.path.join(tmp, "b.json")
+        with open(snap, "w", encoding="utf-8") as f:
+            json.dump(exec_snapshot_cluster(), f)
+        base_a = fresh_cli_plan(server.port)
+        base_b = fresh_cli_plan(snap)
+        plan_text = fresh_cli_plan(snap, "--broker_hosts_to_remove", "h9")
+
+        # offline oracle: uninterrupted ka-execute on a copy
+        offline = os.path.join(tmp, "offline.json")
+        shutil.copy(snap, offline)
+        plan_file = os.path.join(tmp, "plan.txt")
+        with open(plan_file, "w", encoding="utf-8") as f:
+            f.write(plan_text)
+        exec_env = {
+            **os.environ, "KA_ZK_CLIENT": "wire",
+            "KA_EXEC_WAVE_SIZE": "3", "KA_EXEC_POLL_INTERVAL": "0.01",
+            "KA_EXEC_POLL_TIMEOUT": "10", "KA_EXEC_SIM_POLLS": "1",
+        }
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "from kafka_assigner_tpu.cli import execute_main; "
+             "execute_main()",
+             "--zk_string", offline, "--plan", plan_file,
+             "--journal", os.path.join(tmp, "offline.journal")],
+            cwd=REPO, env=exec_env, capture_output=True, text=True,
+            timeout=120,
+        )
+        if proc.returncode != 0:
+            print(f"FAIL: offline baseline execute rc={proc.returncode}\n"
+                  f"{proc.stderr}", file=sys.stderr)
+            return 1
+        with open(offline, "r", encoding="utf-8") as f:
+            final_oracle = f.read()
+
+        daemon_env = {
+            **exec_env,
+            "KA_EXEC_THROTTLE": "0.4",        # a wave boundary to kill at
+            "KA_DAEMON_DRAIN_TIMEOUT": "0.2",  # exit mid-execution
+            "KA_DAEMON_JOURNAL_DIR": tmp,
+            "KA_DAEMON_RESYNC_INTERVAL": "1.0",
+        }
+        clusters_arg = f"a=127.0.0.1:{server.port};b={snap}"
+        daemon, port = _start_daemon(
+            ["--clusters", clusters_arg, "--solver", "greedy"],
+            daemon_env, stderr_lines,
+        )
+
+        # routed byte-identity per cluster; bare data paths refuse
+        s, body = _post_json(port, "/clusters/a/plan", {})
+        if s != 200 or body["status"] != "ok" \
+                or body["result"]["stdout"] != base_a:
+            print(f"FAIL: /clusters/a/plan http={s} "
+                  f"status={body.get('status')!r}", file=sys.stderr)
+            return 1
+        s, body = _post_json(port, "/clusters/b/plan", {})
+        if s != 200 or body["result"]["stdout"] != base_b:
+            print(f"FAIL: /clusters/b/plan http={s}", file=sys.stderr)
+            return 1
+        s, body = _post_json(port, "/plan", {})
+        if s != 400 or body.get("clusters") != ["a", "b"]:
+            print(f"FAIL: bare /plan should 400 with the cluster list, "
+                  f"got http={s} {body}", file=sys.stderr)
+            return 1
+
+        # /execute on b, REAL SIGTERM after the first committed wave
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("POST", "/clusters/b/execute",
+                     body=json.dumps({"plan_text": plan_text}))
+        resp = conn.getresponse()
+        if resp.status != 200:
+            print(f"FAIL: /execute http={resp.status}", file=sys.stderr)
+            return 1
+        saw_commit = False
+        try:
+            while True:
+                line = resp.fp.readline()
+                if not line:
+                    break
+                event = json.loads(line)
+                if event["event"] == "exec/wave.committed":
+                    saw_commit = True
+                    daemon.send_signal(signal.SIGTERM)  # the real kill
+                if event["event"] == "exec/done":
+                    print("FAIL: execution completed before the kill "
+                          "landed (raise KA_EXEC_THROTTLE?)",
+                          file=sys.stderr)
+                    return 1
+        except (OSError, ValueError):
+            pass  # stream torn mid-line by the dying daemon: expected
+        finally:
+            conn.close()
+        if not saw_commit:
+            print("FAIL: no wave committed before the stream ended",
+                  file=sys.stderr)
+            return 1
+        rc = daemon.wait(timeout=60)
+        if rc != 0:
+            print(f"FAIL: daemon exit code {rc} after SIGTERM (want 0)\n"
+                  + "".join(stderr_lines), file=sys.stderr)
+            return 1
+        journals = [p for p in os.listdir(tmp)
+                    if p.startswith("ka-execute-b-")]
+        if len(journals) != 1:
+            print(f"FAIL: expected one cluster-keyed journal, {journals}",
+                  file=sys.stderr)
+            return 1
+        with open(os.path.join(tmp, journals[0]), encoding="utf-8") as f:
+            j = json.load(f)
+        if j["status"] != "in-progress" or j["waves_committed"] < 1:
+            print(f"FAIL: journal after kill: "
+                  f"{j['status']}/{j['waves_committed']}", file=sys.stderr)
+            return 1
+
+        # restart, resume=1: converge byte-identically to the oracle
+        daemon_env["KA_EXEC_THROTTLE"] = "0"
+        daemon, port = _start_daemon(
+            ["--clusters", clusters_arg, "--solver", "greedy"],
+            daemon_env, stderr_lines,
+        )
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request(
+            "POST", "/clusters/b/execute",
+            body=json.dumps({"plan_text": plan_text, "resume": True}),
+        )
+        resp = conn.getresponse()
+        events = [json.loads(ln)
+                  for ln in resp.read().decode("utf-8").splitlines()]
+        conn.close()
+        done = events[-1] if events else {}
+        if done.get("event") != "exec/done" or done.get("status") != "ok" \
+                or done.get("exit_code") != 0:
+            print(f"FAIL: resume did not complete ok ({done})",
+                  file=sys.stderr)
+            return 1
+        if not done["plan"]["resumed"] or done["plan"]["skipped_moves"]:
+            print(f"FAIL: resume accounting wrong ({done['plan']})",
+                  file=sys.stderr)
+            return 1
+        with open(snap, "r", encoding="utf-8") as f:
+            if f.read() != final_oracle:
+                print("FAIL: resumed final state diverged from the "
+                      "uninterrupted offline execution", file=sys.stderr)
+                return 1
+        daemon.send_signal(signal.SIGTERM)
+        rc = daemon.wait(timeout=60)
+        if rc != 0:
+            print(f"FAIL: final drain exit code {rc}", file=sys.stderr)
+            return 1
+        print("daemon_smoke --multi: PASS (routed byte-identity; SIGTERM "
+              "mid-/execute -> restart -> resume=1 byte-identical to the "
+              "offline run)", file=sys.stderr)
+        return 0
+    finally:
+        if daemon is not None and daemon.poll() is None:
+            daemon.kill()
+        server.shutdown()
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main_multi() if "--multi" in sys.argv[1:] else main())

@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # The project lint gate: kalint (knob-registry + jit-boundary + write-path
-# + deadline house rules, KA001-KA011), the README knob-table drift check,
+# + deadline + bulkhead house rules, KA001-KA012), the README knob-table
+# drift check,
 # the run-report fixture schema check, the fault-matrix smoke (one injected
 # fault per class — read, write AND daemon seams — strict + best-effort),
 # the exec crash→resume smoke, the daemon lifecycle smoke, and ruff
@@ -34,6 +35,11 @@ python scripts/exec_smoke.py
 # injected session expiry mid-request (stale-marked, byte-identical) →
 # /plan byte-identical after resync → SIGTERM → drained exit 0.
 python scripts/daemon_smoke.py
+# Multi-cluster daemon smoke (ISSUE 9): real --clusters subprocess —
+# routed per-cluster byte-identity, bare-path refusal, then /execute with
+# a REAL SIGTERM at a wave boundary → restart → resume=1 → final cluster
+# state byte-identical to an uninterrupted offline ka-execute run.
+python scripts/daemon_smoke.py --multi
 # Warm-start smoke (ISSUE 6): program store populate -> clear-memory -> hit
 # on the CPU backend, byte-identical output, compile.store.hits >= 1. The
 # fresh-process bench is the slow-marked tests/test_bench_warmstart.py.

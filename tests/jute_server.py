@@ -75,6 +75,11 @@ class JuteZkServer(threading.Thread):
         self._kids = {}
         for p in self.tree:
             self._index_path(p)
+        # Live accepted connections, closed by shutdown(): a quorum
+        # blackout (the breaker chaos rows, ISSUE 9) must kill ESTABLISHED
+        # sessions too, not just refuse new ones.
+        self._conns = set()
+        self._conns_lock = threading.Lock()
         # Watch registries (ISSUE 8): one-shot, like real ZooKeeper — a
         # getData/getChildren request with the watch flag set registers its
         # connection's send fn; a mutation (client write OR the simulated
@@ -213,6 +218,8 @@ class JuteZkServer(threading.Thread):
             # Mirror real ZooKeeper: replies must not sit in Nagle's buffer
             # waiting for a delayed ACK while the client pipelines.
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
@@ -390,6 +397,8 @@ class JuteZkServer(threading.Thread):
                 sender_q.put(None)
                 sender.join(timeout=10)
             conn.close()
+            with self._conns_lock:
+                self._conns.discard(conn)
 
     @staticmethod
     def _recv_frame(conn):
@@ -414,7 +423,26 @@ class JuteZkServer(threading.Thread):
 
     def shutdown(self):
         self._stop.set()
+        # Wake the accept loop: a thread blocked in accept() holds the
+        # kernel socket alive past close(), leaving a ghost LISTEN that
+        # blocks rebinding the pinned port (the breaker chaos rows restart
+        # a server on the SAME port).
+        try:
+            poke = socket.create_connection(("127.0.0.1", self.port),
+                                            timeout=1.0)
+            poke.close()
+        except OSError:  # accept loop already gone; nothing to wake
+            pass
         self.sock.close()
+        # Kill established sessions too: a stopped quorum is a BLACKOUT
+        # for its clients, not a server that answers forever.
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:  # already dying on its own thread
+                continue
 
 
 def cluster_tree():

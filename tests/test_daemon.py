@@ -318,27 +318,38 @@ def test_whatif_matches_cli_ranking(server):
         assert "DECOMMISSION RANKING:" in body["result"]["stdout"]
 
 
-def test_backpressure_sheds_with_retry_after(server):
+def test_backpressure_sheds_with_retry_after(server, monkeypatch):
+    """The inflight gate is per-cluster AND live: KA_DAEMON_MAX_INFLIGHT is
+    re-read per request, so an operator can loosen it on a running fleet
+    (ISSUE 9 satellite)."""
     with running_daemon(server) as d:
-        # Exhaust the inflight gate from outside: every admission slot
-        # taken, the next request must shed, not queue.
-        for _ in range(d.max_inflight):
-            assert d._inflight.acquire(blocking=False)
+        sup = d.supervisor()
+        monkeypatch.setenv("KA_DAEMON_MAX_INFLIGHT", "1")
+        # Occupy the single admission slot from outside: the next request
+        # must shed, not queue.
+        with sup._active_lock:
+            sup._active += 1
         try:
             s, body, headers = req(d.http_port, "POST", "/plan", {})
             assert s == 503
+            assert body["max_inflight"] == 1
             assert headers.get("Retry-After") == "1"
             assert d.counters().get("daemon.requests_shed") == 1
+            # Loosen the gate LIVE — no restart, the same daemon admits.
+            monkeypatch.setenv("KA_DAEMON_MAX_INFLIGHT", "2")
+            s, body, _ = req(d.http_port, "POST", "/plan", {})
+            assert s == 200
         finally:
-            for _ in range(d.max_inflight):
-                d._inflight.release()
+            with sup._active_lock:
+                sup._active -= 1
         s, body, _ = req(d.http_port, "POST", "/plan", {})
         assert s == 200
 
 
 def test_watchdog_flags_slow_requests(server):
     with running_daemon(server) as d:
-        d.request_timeout = 0.0  # every request overruns a zero budget
+        # every request overruns a zero budget (per-cluster override)
+        d.supervisor().request_timeout = 0.0
         s, body, _ = req(d.http_port, "POST", "/plan", {})
         assert s == 200  # flagged, not failed
         assert body["result"]["watchdog_exceeded"] is True
@@ -357,7 +368,7 @@ def test_drain_refuses_and_exits_clean(server):
     d.shutdown()
     assert d.lifecycle() == "stopped"
     # No stranded sockets: the ZK session and the HTTP listener are gone.
-    assert getattr(d.backend._zk, "_sock", None) is None
+    assert getattr(d.supervisor().backend._zk, "_sock", None) is None
     assert d.httpd.socket.fileno() == -1
 
 
@@ -374,6 +385,7 @@ def _await(predicate, timeout=10.0, every=0.05):
 
 def test_churn_updates_cache_and_stays_cli_identical(server):
     with running_daemon(server) as d:
+        sup = d.supervisor()
         w = MiniZkClient(f"127.0.0.1:{server.port}")
         w.start()
         try:
@@ -382,19 +394,22 @@ def test_churn_updates_cache_and_stays_cli_identical(server):
             # which is not what this test is about)
             w.create("/brokers/topics/fresh",
                      b'{"partitions": {"0": [1, 2, 3], "1": [2, 3, 4]}}')
-            assert _await(lambda: "fresh" in d.state.topic_names())
+            assert _await(lambda: "fresh" in sup.state.topic_names())
             # reassign (data change)
             w.set_data("/brokers/topics/logs",
                        b'{"partitions": {"0": [1, 2]}}')
             assert _await(
-                lambda: d.state.assignments(["logs"])["logs"] == {0: [1, 2]}
+                lambda: sup.state.assignments(["logs"])["logs"]
+                == {0: [1, 2]}
             )
             # delete
             w.delete("/brokers/topics/events")
-            assert _await(lambda: "events" not in d.state.topic_names())
+            assert _await(
+                lambda: "events" not in sup.state.topic_names()
+            )
             assert d.counters().get("daemon.reencode.topics", 0) >= 2
             # and the served plan equals a fresh CLI run on the NEW truth
-            assert _await(lambda: not d.state.stale)
+            assert _await(lambda: not sup.state.stale)
             base = fresh_cli(server.port, "--solver", "greedy")
             s, body, _ = req(d.http_port, "POST", "/plan", {})
             assert s == 200 and body["result"]["stdout"] == base
@@ -407,16 +422,17 @@ def test_churn_race_mid_request_retries_to_fresh_truth(server):
     snapshot and its cache read must not surface as an error: the implicit
     whole-cluster request retries once against the new truth."""
     with running_daemon(server) as d:
-        orig = d.state.plan_inputs
+        sup = d.supervisor()
+        orig = sup.state.plan_inputs
         fired = {"n": 0}
 
         def racy(topic_list, want_encode):
             if fired["n"] == 0:
                 fired["n"] += 1
-                d.state.apply_topic("logs", None)  # churn wins the race
+                sup.state.apply_topic("logs", None)  # churn wins the race
             return orig(topic_list, want_encode)
 
-        d.state.plan_inputs = racy
+        sup.state.plan_inputs = racy
         s, body, _ = req(d.http_port, "POST", "/plan", {})
         assert s == 200
         assert '"topic":"logs"' not in body["result"]["stdout"]
@@ -426,10 +442,12 @@ def test_churn_race_mid_request_retries_to_fresh_truth(server):
 
 def test_session_loss_recovers_via_resync(server):
     with running_daemon(server) as d:
-        assert _await(lambda: not d.state.stale)
-        d._expire_session()  # the session:expire seam's mechanics
-        assert d.state.stale  # stale-marked immediately
-        assert _await(lambda: not d.state.stale)  # re-established + resynced
+        sup = d.supervisor()
+        assert _await(lambda: not sup.state.stale)
+        sup._expire_session()  # the session:expire seam's mechanics
+        assert sup.state.stale  # stale-marked immediately
+        # re-established + resynced
+        assert _await(lambda: not sup.state.stale)
         assert d.counters().get("daemon.resyncs", 0) >= 2
         base = fresh_cli(server.port, "--solver", "greedy")
         s, body, _ = req(d.http_port, "POST", "/plan", {})
@@ -441,13 +459,14 @@ def test_watchless_interval_resync(server, monkeypatch):
     monkeypatch.setenv("KA_DAEMON_WATCH", "0")
     monkeypatch.setenv("KA_DAEMON_RESYNC_INTERVAL", "0.2")
     with running_daemon(server) as d:
-        assert not d._use_watches
+        sup = d.supervisor()
+        assert not sup._use_watches
         w = MiniZkClient(f"127.0.0.1:{server.port}")
         w.start()
         try:
             w.create("/brokers/topics/later",
                      b'{"partitions": {"0": [1, 2]}}')
-            assert _await(lambda: "later" in d.state.topic_names())
+            assert _await(lambda: "later" in sup.state.topic_names())
         finally:
             w.close()
 
@@ -463,9 +482,359 @@ def test_snapshot_backend_daemon(tmp_path):
     d = AssignerDaemon(str(snap), solver="greedy")
     d.start()
     try:
-        assert not d._use_watches
+        assert not d.supervisor()._use_watches
         s, body, _ = req(d.http_port, "POST", "/plan", {})
         assert s == 200 and body["status"] == "ok"
         assert body["result"]["stdout"] == base
+    finally:
+        d.shutdown()
+
+
+# --- ISSUE 9: multi-cluster supervisors, bulkheads, breakers, /execute ------
+
+import os
+import shutil
+import threading
+
+from kafka_assigner_tpu.cli import execute as cli_execute
+from kafka_assigner_tpu.faults.inject import FaultInjector, parse_spec
+
+from .jute_server import exec_snapshot_cluster
+
+
+@pytest.fixture()
+def server2():
+    s = JuteZkServer(cluster_tree())
+    s.start()
+    yield s
+    s.shutdown()
+
+
+@contextlib.contextmanager
+def running_multi(clusters, **kwargs):
+    kwargs.setdefault("solver", "greedy")
+    d = AssignerDaemon(clusters=clusters, **kwargs)
+    d.start()
+    try:
+        yield d
+    finally:
+        d.shutdown()
+
+
+def stream_execute(port, path, payload, timeout=120.0):
+    """POST an /execute request; returns (status, events-or-error-body)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(payload))
+        resp = conn.getresponse()
+        raw = resp.read().decode("utf-8")
+        if resp.status != 200:
+            return resp.status, json.loads(raw)
+        return resp.status, [json.loads(ln) for ln in raw.splitlines()]
+    finally:
+        conn.close()
+
+
+def test_wire_sessions_are_independent(server, server2):
+    """N independent sessions, per-session watch queues: a mutation on one
+    quorum fires only ITS client's watches, and session generations advance
+    independently — the zkwire property the multi-cluster daemon's
+    per-supervisor sessions are built on."""
+    c1 = MiniZkClient(f"127.0.0.1:{server.port}")
+    c2 = MiniZkClient(f"127.0.0.1:{server2.port}")
+    w = MiniZkClient(f"127.0.0.1:{server2.port}")
+    c1.start(); c2.start(); w.start()
+    try:
+        c1.get("/brokers/topics/logs", watch=True)
+        c2.get("/brokers/topics/logs", watch=True)
+        w.set_data("/brokers/topics/logs", b'{"partitions": {"0": [9]}}')
+        assert [e.path for e in c2.poll_watches(timeout=5.0)] == [
+            "/brokers/topics/logs"
+        ]
+        assert c1.poll_watches(timeout=0.3) == []  # other quorum: silent
+        g1, g2 = c1.session_generation, c2.session_generation
+        c1.stop(); c1.close(); c1.start()
+        assert c1.session_generation == g1 + 1
+        assert c2.session_generation == g2  # untouched
+    finally:
+        c1.close(); c2.close(); w.close()
+
+
+def test_multicluster_routing_and_aggregates(server, server2):
+    base_a = fresh_cli(server.port, "--solver", "greedy")
+    base_b = fresh_cli(server2.port, "--solver", "greedy")
+    clusters = {
+        "a": f"127.0.0.1:{server.port}",
+        "b": f"127.0.0.1:{server2.port}",
+    }
+    with running_multi(clusters) as d:
+        port = d.http_port
+        s, body, _ = req(port, "POST", "/clusters/a/plan", {})
+        assert s == 200 and body["status"] == "ok"
+        assert body["result"]["stdout"] == base_a
+        assert body["result"]["cluster"] == "a"
+        s, body, _ = req(port, "POST", "/clusters/b/plan", {})
+        assert s == 200 and body["result"]["stdout"] == base_b
+        # bare data paths refuse with the cluster list
+        s, body, _ = req(port, "POST", "/plan", {})
+        assert s == 400 and body["clusters"] == ["a", "b"]
+        # unknown cluster: 404 naming the known ones
+        s, body, _ = req(port, "POST", "/clusters/nope/plan", {})
+        assert s == 404 and body["clusters"] == ["a", "b"]
+        # aggregates
+        s, h, _ = req(port, "GET", "/healthz")
+        assert s == 200 and h["status"] == "ready"
+        assert set(h["clusters"]) == {"a", "b"}
+        assert h["clusters"]["a"]["breaker"]["state"] == "closed"
+        s, r, _ = req(port, "GET", "/readyz")
+        assert s == 200 and r["ready"]
+        s, r, _ = req(port, "GET", "/clusters/b/readyz")
+        assert s == 200 and r["ready"]
+        s, st, _ = req(port, "GET", "/state")
+        assert s == 200 and set(st["clusters"]) == {"a", "b"}
+        # per-request obs spans carry the cluster label in multi mode
+        s, body, _ = req(port, "POST", "/clusters/a/plan", {})
+        assert any(
+            sp["name"] == "daemon/request@a" for sp in body["spans"]
+        )
+
+
+def test_bulkhead_isolation_expiry_and_stall_on_a(server, server2):
+    """The acceptance bulkhead proof, in-process: session:expire@a +
+    resync:stall@a leave cluster B's concurrent /plan responses ok and
+    byte-identical THROUGHOUT — A sheds or stale-serves alone."""
+    base_a = fresh_cli(server.port, "--solver", "greedy")
+    base_b = fresh_cli(server2.port, "--solver", "greedy")
+    faults.install(FaultInjector(parse_spec(
+        "session@a:1=expire;resync@a:1=stall"
+    )))
+    clusters = {
+        "a": f"127.0.0.1:{server.port}",
+        "b": f"127.0.0.1:{server2.port}",
+    }
+    with running_multi(clusters) as d:
+        port = d.http_port
+        s, body, _ = req(port, "POST", "/clusters/a/plan", {})
+        assert s == 200 and body["status"] == "ok"
+        # request #1 on a: the injected expiry lands mid-request —
+        # stale-marked, still byte-identical
+        s, body, _ = req(port, "POST", "/clusters/a/plan", {})
+        assert s == 200 and body["result"]["stdout"] == base_a
+        assert body["status"] == "degraded"
+        # hammer B from a concurrent thread while A recovers (its first
+        # resync attempt stalls by schedule)
+        b_failures = []
+
+        def hammer_b():
+            for _ in range(8):
+                try:
+                    s2, b2, _ = req(port, "POST", "/clusters/b/plan", {})
+                except OSError as e:
+                    b_failures.append(f"transport: {e}")
+                    return
+                if s2 != 200 or b2["status"] != "ok" \
+                        or b2["result"]["stdout"] != base_b:
+                    b_failures.append(
+                        f"http={s2} status={b2.get('status')!r} "
+                        f"identical="
+                        f"{b2.get('result', {}).get('stdout') == base_b}"
+                    )
+
+        t = threading.Thread(target=hammer_b)
+        t.start()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            s, body, _ = req(port, "POST", "/clusters/a/plan", {})
+            assert s == 200 and body["result"]["stdout"] == base_a
+            if body["status"] == "ok":
+                break
+            time.sleep(0.2)
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert body["status"] == "ok", "cluster a never recovered"
+        assert b_failures == [], b_failures
+        assert d.supervisors["a"].counters().get(
+            "daemon.resync_failures", 0
+        ) >= 1
+        assert not d.supervisors["b"].counters().get("daemon.session_lost")
+
+
+def test_breaker_opens_probes_and_closes(monkeypatch):
+    """Quorum blackout: consecutive resync failures open the per-cluster
+    breaker (requests stale-serve), the cooldown half-opens it for probes,
+    and the quorum's return closes it — /healthz shows every state."""
+    monkeypatch.setenv("KA_DAEMON_BREAKER_THRESHOLD", "2")
+    monkeypatch.setenv("KA_DAEMON_BREAKER_COOLDOWN", "0.2")
+    monkeypatch.setenv("KA_DAEMON_RESYNC_INTERVAL", "0.3")
+    monkeypatch.setenv("KA_DAEMON_RESYNC_RETRIES", "1")
+    monkeypatch.setenv("KA_ZK_CONNECT_RETRIES", "1")
+    # 1, not 0: the wire client's transparent re-establishment IS how a
+    # breaker probe reaches the returned quorum; 0 would pin the socket
+    # dead forever.
+    monkeypatch.setenv("KA_ZK_SESSION_RETRIES", "1")
+    s1 = JuteZkServer(cluster_tree())
+    s1.start()
+    zk_port = s1.port
+    base = fresh_cli(zk_port, "--solver", "greedy")
+    with running_multi({"west": f"127.0.0.1:{zk_port}"}) as d:
+        port = d.http_port
+        s, body, _ = req(port, "POST", "/clusters/west/plan", {})
+        assert s == 200 and body["status"] == "ok"
+        s1.shutdown()  # blackout
+        assert _await(
+            lambda: req(port, "GET", "/clusters/west/healthz")[1]
+            ["breaker"]["state"] == "open",
+            timeout=20,
+        ), "breaker never opened"
+        # stale-served, never an error, bytes intact
+        s, body, _ = req(port, "POST", "/clusters/west/plan", {})
+        assert s == 200 and body["status"] == "degraded"
+        assert body["result"]["stdout"] == base
+        # quorum returns on the SAME port: a half-open probe closes it
+        # (bind may race the old connections' teardown — retry briefly)
+        s2 = None
+        bind_deadline = time.monotonic() + 10
+        while s2 is None:
+            try:
+                s2 = JuteZkServer(cluster_tree(), port=zk_port)
+            except OSError:
+                if time.monotonic() > bind_deadline:
+                    raise
+                time.sleep(0.2)
+        s2.start()
+        try:
+            assert _await(
+                lambda: req(port, "GET", "/clusters/west/healthz")[1]
+                ["breaker"]["state"] == "closed",
+                timeout=20,
+            ), "breaker never closed after the quorum returned"
+            assert _await(
+                lambda: req(port, "POST", "/clusters/west/plan", {})[1]
+                ["status"] == "ok",
+                timeout=20,
+            )
+            s, body, _ = req(port, "POST", "/clusters/west/plan", {})
+            assert body["result"]["stdout"] == base
+            counters = d.supervisors["west"].counters()
+            assert counters.get("daemon.breaker_opened", 0) >= 1
+            assert counters.get("daemon.breaker_closed", 0) >= 1
+        finally:
+            s2.shutdown()
+
+
+def test_double_session_expiry_during_resync(server, monkeypatch):
+    """ISSUE 9 satellite: expire -> re-arm -> expire AGAIN before the
+    resync completes must land in degraded-not-error, with watches
+    re-armed exactly once per session generation (pinned via
+    session_generation and the session:expire seam's mechanics)."""
+    monkeypatch.setenv("KA_DAEMON_RESYNC_INTERVAL", "30")  # no interval noise
+    with running_daemon(server) as d:
+        sup = d.supervisor()
+        assert _await(lambda: not sup.state.stale)
+        be = sup.backend
+        gen0 = be.session_generation()
+        arm_gens = []
+        orig_arm = be.watch_brokers
+
+        def recording_arm():
+            out = orig_arm()
+            arm_gens.append(be.session_generation())
+            return out
+
+        be.watch_brokers = recording_arm
+        kills = {"left": 1}
+        orig_list = be.watch_topic_list
+
+        def killing_list():
+            if kills["left"] > 0:
+                kills["left"] -= 1
+                sup._expire_session()  # the SECOND expiry, mid-resync
+            return orig_list()
+
+        be.watch_topic_list = killing_list
+        sup._expire_session()  # the first expiry
+        # degraded-not-error while the double-expired resync converges
+        s, body, _ = req(d.http_port, "POST", "/plan", {})
+        assert s == 200 and body["status"] in ("degraded", "ok")
+        assert _await(lambda: not sup.state.stale, timeout=30), \
+            "resync never completed after the double expiry"
+        # each completed arm belongs to a distinct generation: watches are
+        # re-armed exactly once per generation, never twice
+        assert len(arm_gens) == len(set(arm_gens)), arm_gens
+        assert sup._armed_generation == be.session_generation()
+        assert be.session_generation() > gen0 + 1  # both expiries landed
+        assert sup.counters().get("daemon.resync_failures", 0) >= 1
+        base = fresh_cli(server.port, "--solver", "greedy")
+        s, body, _ = req(d.http_port, "POST", "/plan", {})
+        assert s == 200 and body["status"] == "ok"
+        assert body["result"]["stdout"] == base
+
+
+def test_execute_endpoint_end_to_end(tmp_path, monkeypatch):
+    """Bare /execute on a single-cluster snapshot daemon: streams the
+    exec.* event family and converges the cluster byte-identically to an
+    offline ka-execute run of the same plan."""
+    for k, v in (("KA_EXEC_WAVE_SIZE", "3"),
+                 ("KA_EXEC_POLL_INTERVAL", "0.01"),
+                 ("KA_EXEC_POLL_TIMEOUT", "10"),
+                 ("KA_EXEC_SIM_POLLS", "1"),
+                 ("KA_DAEMON_JOURNAL_DIR", str(tmp_path))):
+        monkeypatch.setenv(k, v)
+    snap = tmp_path / "cluster.json"
+    snap.write_text(json.dumps(exec_snapshot_cluster()))
+    plan_text = fresh_cli(str(snap), "--solver", "greedy",
+                          "--broker_hosts_to_remove", "h9")
+    offline = tmp_path / "offline.json"
+    shutil.copy(snap, offline)
+    out, err = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+        plan_file = tmp_path / "plan.txt"
+        plan_file.write_text(plan_text)
+        rc = cli_execute([
+            "--zk_string", str(offline), "--plan", str(plan_file),
+            "--journal", str(tmp_path / "offline.journal"),
+        ])
+    assert rc == 0, err.getvalue()
+    final_offline = offline.read_text()
+
+    d = AssignerDaemon(str(snap), solver="greedy")
+    d.start()
+    try:
+        s, events = stream_execute(d.http_port, "/execute",
+                                   {"plan_text": plan_text})
+        assert s == 200
+        kinds = [e["event"] for e in events]
+        assert kinds[0] == "exec/start"
+        assert "exec/wave" in kinds and "exec/wave.committed" in kinds
+        assert "exec/verify" in kinds
+        done = events[-1]
+        assert done["event"] == "exec/done"
+        assert done["status"] == "ok" and done["exit_code"] == 0
+        assert done["plan"]["skipped_moves"] == []
+        assert snap.read_text() == final_offline
+        # journal identity: cluster spec stamped, default path per cluster
+        journals = [p for p in os.listdir(tmp_path)
+                    if p.startswith("ka-execute-default-")]
+        assert len(journals) == 1
+        j = json.loads((tmp_path / journals[0]).read_text())
+        assert j["cluster"] == str(snap) and j["status"] == "complete"
+        # single-flight: a held lock means 409 for the next attempt
+        sup = d.supervisor()
+        assert sup._exec_lock.acquire(blocking=False)
+        try:
+            s, body = stream_execute(d.http_port, "/execute",
+                                     {"plan_text": plan_text})
+            assert s == 409 and "single-flight" in body["error"]
+        finally:
+            sup._exec_lock.release()
+        # validation refusals are 400, lock released again afterwards
+        s, body = stream_execute(d.http_port, "/execute", {})
+        assert s == 400 and "plan" in body["error"]
+        assert not sup._exec_lock.locked()
+        s, body = stream_execute(
+            d.http_port, "/execute",
+            {"plan_text": plan_text, "failure_policy": "nope"},
+        )
+        assert s == 400
     finally:
         d.shutdown()

@@ -602,3 +602,81 @@ def test_rollback_env_journal_gets_own_identity(workdir, tmp_path,
         rb = json.load(f)
     assert fwd["plan"] != rb["plan"]  # two journal identities, both complete
     assert fwd["status"] == rb["status"] == "complete"
+
+
+# --- journal identity = (cluster, plan sha) — ISSUE 9 satellite --------------
+
+def test_journal_persists_cluster_identity(tmp_path):
+    path = str(tmp_path / "j")
+    j = ExecutionJournal.fresh(path, "hash", 3, [("t", 0, [1])],
+                               cluster="zk-a:2181")
+    loaded = ExecutionJournal.load(path)
+    assert loaded.cluster == "zk-a:2181"
+    # legacy journals (no cluster field) load as cluster=None
+    raw = json.loads((tmp_path / "j").read_text())
+    del raw["cluster"]
+    # kalint: disable=KA005 -- test fixture write, not a plan payload
+    (tmp_path / "legacy").write_text(json.dumps(raw))
+    assert ExecutionJournal.load(str(tmp_path / "legacy")).cluster is None
+
+
+def test_resume_refuses_same_plan_on_a_different_cluster(
+    workdir, tmp_path, monkeypatch
+):
+    """Two clusters executing BYTE-IDENTICAL plans must never cross-resume
+    through one journal file: the journal is keyed by (cluster, plan sha),
+    not the plan sha alone (the pre-ISSUE-9 collision)."""
+    # interrupt a run on cluster A after one committed wave
+    monkeypatch.setenv("KA_FAULTS_SPEC", "wave:1=crash")
+    faults.reset()
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err), pytest.raises(InjectedExecCrash):
+        execute(["--zk_string", workdir["cluster"], "--plan",
+                 workdir["plan"], "--journal", workdir["journal"]])
+    monkeypatch.delenv("KA_FAULTS_SPEC")
+    faults.reset()
+    # cluster B: same initial metadata, so the SAME plan bytes apply — but
+    # resuming through A's journal must be refused loudly
+    other = tmp_path / "other_cluster.json"
+    other.write_text(json.dumps(_cluster()))
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = execute(["--zk_string", str(other), "--plan", workdir["plan"],
+                      "--journal", workdir["journal"], "--resume"])
+    assert rc == EXIT_VALIDATION
+    assert "DIFFERENT cluster" in err.getvalue()
+    # a FRESH run on cluster B through the same journal path is refused
+    # too: the interrupted run's record must never be clobbered
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = execute(["--zk_string", str(other), "--plan", workdir["plan"],
+                      "--journal", workdir["journal"]])
+    assert rc == EXIT_VALIDATION
+    assert "DIFFERENT cluster" in err.getvalue()
+    # the rightful owner still resumes to completion
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = execute(["--zk_string", workdir["cluster"], "--plan",
+                      workdir["plan"], "--journal", workdir["journal"],
+                      "--resume"])
+    assert rc == EXIT_OK, err.getvalue()
+
+
+def test_legacy_clusterless_journal_still_resumes(workdir, monkeypatch):
+    """Back-compat: a journal written before the cluster field existed
+    (cluster=None) resumes under any cluster."""
+    monkeypatch.setenv("KA_FAULTS_SPEC", "wave:1=crash")
+    faults.reset()
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err), pytest.raises(InjectedExecCrash):
+        execute(["--zk_string", workdir["cluster"], "--plan",
+                 workdir["plan"], "--journal", workdir["journal"]])
+    monkeypatch.delenv("KA_FAULTS_SPEC")
+    faults.reset()
+    raw = json.loads(open(workdir["journal"]).read())
+    raw["cluster"] = None
+    with open(workdir["journal"], "w", encoding="utf-8") as f:
+        # kalint: disable=KA005 -- test fixture write, not a plan payload
+        json.dump(raw, f)
+    rc, err = _execute(workdir, "--resume")
+    assert rc == EXIT_OK, err

@@ -20,6 +20,7 @@ from kafka_assigner_tpu.faults.inject import (
     FaultEvent,
     FaultInjector,
     FaultSpecError,
+    InjectedResyncStall,
     parse_spec,
     random_schedule,
 )
@@ -660,3 +661,68 @@ def test_fake_admin_without_kip455_refuses_execution(monkeypatch):
     assert backend.supports_execution() is False
     with pytest.raises(ExecuteError, match="cannot execute"):
         backend.apply_assignment({"events": {0: [1]}})
+
+
+# --- @cluster-addressed events (ISSUE 9) -------------------------------------
+
+def test_parse_spec_cluster_addressing():
+    events = parse_spec(
+        "session@west:1=expire;resync@east-2:0=stall;watch@a.b:2=drop"
+    )
+    assert FaultEvent("session", 1, "expire", None, "west") in events
+    assert FaultEvent("resync", 0, "stall", None, "east-2") in events
+    assert FaultEvent("watch", 2, "drop", None, "a.b") in events
+    # str round-trips through the parser
+    for ev in events:
+        assert parse_spec(str(ev)) == [ev]
+
+
+@pytest.mark.parametrize("bad", [
+    "session@:0=expire",       # empty cluster name
+    "session@we st:0=expire",  # whitespace in cluster name
+    "session@w/e:0=expire",    # illegal character
+])
+def test_parse_spec_rejects_bad_cluster(bad):
+    with pytest.raises(FaultSpecError):
+        parse_spec(bad)
+
+
+def test_cluster_events_fire_at_per_cluster_indexes():
+    """A @cluster event fires at that cluster's OWN per-scope index —
+    other clusters' hook consults never consume it, however the daemon
+    interleaves its supervisors."""
+    inj = FaultInjector(parse_spec("session@west:1=expire"))
+    # east consults twice first: west's counter is untouched
+    assert not inj.session_check(cluster="east")
+    assert not inj.session_check(cluster="east")
+    assert not inj.session_check(cluster="west")   # west index 0
+    assert inj.session_check(cluster="west")       # west index 1 -> fires
+    assert not inj.session_check(cluster="west")   # one-shot
+
+
+def test_clusterless_events_keep_the_global_counter():
+    """Back-compat: a clusterless event fires at the GLOBAL per-scope
+    index regardless of which cluster consults — byte-identical to every
+    historical schedule."""
+    inj = FaultInjector(parse_spec("session:1=expire"))
+    assert not inj.session_check(cluster="a")  # global index 0
+    assert inj.session_check(cluster="b")      # global index 1 -> fires
+    inj2 = FaultInjector(parse_spec("watch:0=drop"))
+    assert inj2.watch_delivery()               # clusterless consult works too
+
+
+def test_cluster_scoped_resync_stall_raises_only_for_its_cluster():
+    inj = FaultInjector(parse_spec("resync@a:0=stall"))
+    inj.resync_attempt(cluster="b")  # b's index 0: no event
+    with pytest.raises(InjectedResyncStall):
+        inj.resync_attempt(cluster="a")
+
+
+def test_global_event_does_not_swallow_cluster_event():
+    """A clusterless event claiming a consult must not CONSUME the
+    cluster's own index: the @cluster event fires at that cluster's next
+    consult instead of vanishing silently."""
+    inj = FaultInjector(parse_spec("session:0=expire;session@west:0=expire"))
+    assert inj.session_check(cluster="west")  # the global event fires
+    assert inj.session_check(cluster="west")  # west's own event, not lost
+    assert len(inj.fired) == 2

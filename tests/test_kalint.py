@@ -711,3 +711,56 @@ def test_ka011_reasoned_suppression_holds():
         "        data = sock.recv(4)\n"
     )
     assert "KA011" not in rules_of(kalint.lint_source(src, "foo.py"))
+
+
+# --- KA012: cross-bulkhead access in daemon request handlers ------------------
+
+KA012_SNIPPET = (
+    "def do_plan(daemon, name, params):\n"
+    "    sup = daemon.supervisors[name]\n"
+    "    return sup.backend.brokers(), sup.state.topic_names()\n"
+)
+
+
+def test_ka012_trips_in_daemon_service_modules():
+    findings = kalint.lint_source(KA012_SNIPPET, "daemon/service.py")
+    ka012 = [f for f in findings if f.rule == "KA012"]
+    assert len(ka012) == 2  # one per attribute read (.backend, .state)
+    assert all("cross-bulkhead" in f.message for f in ka012)
+
+
+def test_ka012_silent_in_bulkhead_and_foreign_modules():
+    # the supervisor OWNS its backend/cache; state.py IS the cache
+    assert "KA012" not in rules_of(
+        kalint.lint_source(KA012_SNIPPET, "daemon/supervisor.py")
+    )
+    assert "KA012" not in rules_of(
+        kalint.lint_source(KA012_SNIPPET, "daemon/state.py")
+    )
+    # modules outside daemon/ are out of scope
+    assert "KA012" not in rules_of(
+        kalint.lint_source(KA012_SNIPPET, "cli.py")
+    )
+
+
+def test_ka012_ignores_stores_and_method_calls():
+    src = (
+        "def setup(self):\n"
+        "    self.state = object()\n"        # Store: building one's own
+        "    view = self.sup.state_view()\n"  # method named state_view: fine
+        "    self.sup.handle('/plan', {})\n"
+    )
+    assert "KA012" not in rules_of(
+        kalint.lint_source(src, "daemon/service.py")
+    )
+
+
+def test_ka012_suppressible_with_reason():
+    src = (
+        "def peek(sup):\n"
+        "    # kalint: disable=KA012 -- test-only introspection hook\n"
+        "    return sup.state\n"
+    )
+    assert "KA012" not in rules_of(
+        kalint.lint_source(src, "daemon/service.py")
+    )
