@@ -54,6 +54,16 @@ def pytest_runtest_teardown(item, nextitem):
 
     join_warmup_threads()
 
+    # A test that constructed an AssignerDaemon enabled the process-global
+    # telemetry plane (cumulative registry + flight recorder, ISSUE 10);
+    # the NEXT test must start from the CLI's zero-overhead disabled state
+    # (the obs contract tests pin it with identity checks).
+    from kafka_assigner_tpu.obs import flight
+    from kafka_assigner_tpu.obs.metrics import disable_cumulative
+
+    disable_cumulative()
+    flight.disable()
+
     global _tests_since_clear
     _tests_since_clear += 1
     if _tests_since_clear >= 40:

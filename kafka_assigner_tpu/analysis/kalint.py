@@ -63,6 +63,17 @@ KA012  daemon request-handling code (any module under ``daemon/`` except
        ``ClusterSupervisor``'s methods, or a handler can trivially couple
        two clusters' fates (the exact coupling the bulkheads exist to
        forbid)
+KA013  a metric/span name literal passed to the obs write API
+       (``counter_add``/``gauge_set``/``hist_observe``/``hist_ms``/
+       ``span``/``record_span``, plus the supervisor's ``_count``/
+       ``_metric`` wrappers and ``span``'s ``hist=`` keyword) that is not
+       declared in the name registry (``obs/names.py``) — a typo'd metric
+       name vanishes SILENTLY today (the registry creates entries on
+       first write, dashboards query the name that never arrives), so
+       names are declared once and machine-checked like knobs (KA003's
+       twin for the telemetry namespace); dynamic names (f-strings,
+       ``_metric(...)`` results) are the registered composition points
+       and pass through
 ====== =====================================================================
 
 Suppression: put ``# kalint: disable=KA002 -- <reason>`` on the offending
@@ -100,6 +111,8 @@ RULES = {
     "KA011": "unbounded blocking recv/poll loop (no deadline knob consulted)",
     "KA012": "cross-bulkhead access: daemon handler reaches into a "
              "supervisor's backend/cache",
+    "KA013": "metric/span name literal not declared in the obs name "
+             "registry (obs/names.py)",
 }
 
 #: Modules whose ENTIRE body is treated as traced kernel code (KA002): these
@@ -829,6 +842,79 @@ def _check_ka012(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
     return out
 
 
+#: The obs write API whose literal first argument is a METRIC name (KA013).
+METRIC_NAME_CALLS = frozenset({
+    "counter_add", "gauge_set", "hist_observe", "hist_ms", "counter_value",
+})
+#: Calls whose literal first argument is a SPAN name.
+SPAN_NAME_CALLS = frozenset({"span", "record_span"})
+#: The daemon supervisor's name-composing wrappers: their literal first
+#: argument may be either namespace (``_count`` feeds counters, ``_metric``
+#: labels both metric and span names with ``@cluster``).
+EITHER_NAME_CALLS = frozenset({"_count", "_metric"})
+
+
+def _call_terminal_name(node: ast.Call):
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _check_ka013(
+    tree: ast.AST, path: str, metric_names, span_names
+) -> List[Finding]:
+    """Literal metric/span names must resolve against the declared registry
+    (``obs/names.py``) — the KA003 posture for the telemetry namespace.
+    Dynamic first arguments (f-strings, variables, ``self._metric(...)``)
+    are skipped: they compose REGISTERED bases with runtime labels."""
+    every = metric_names | span_names
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _call_terminal_name(node)
+        if fname is None:
+            continue
+        table = table_desc = None
+        if fname in METRIC_NAME_CALLS:
+            table, table_desc = metric_names, "METRIC_NAMES"
+        elif fname in SPAN_NAME_CALLS:
+            table, table_desc = span_names, "SPAN_NAMES"
+        elif fname in EITHER_NAME_CALLS:
+            table, table_desc = every, "METRIC_NAMES/SPAN_NAMES"
+        if table is not None:
+            # The name may arrive positionally OR as name=... — both are
+            # the same write; a keyword spelling must not bypass the rule.
+            name_node = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "name"),
+                None,
+            )
+            lit = _const_str(name_node) if name_node is not None else None
+            if lit is not None and lit not in table:
+                out.append(Finding(
+                    "KA013", path, node.lineno, node.col_offset + 1,
+                    f"{fname}({lit!r}) uses an undeclared name: a typo'd "
+                    "metric/span vanishes silently — declare it in "
+                    f"obs/names.py ({table_desc}) or fix the spelling",
+                ))
+        if fname in SPAN_NAME_CALLS:
+            for kw in node.keywords:
+                if kw.arg == "hist":
+                    lit = _const_str(kw.value)
+                    if lit is not None and lit not in metric_names:
+                        out.append(Finding(
+                            "KA013", path, kw.value.lineno,
+                            kw.value.col_offset + 1,
+                            f"span(hist={lit!r}) uses an undeclared "
+                            "histogram name — declare it in obs/names.py "
+                            "(METRIC_NAMES) or fix the spelling",
+                        ))
+    return out
+
+
 def _check_ka008(tree: ast.AST, path: str) -> List[Finding]:
     """An ``except`` body that is exactly one ``pass`` or one bare
     ``continue`` handles nothing and records nothing — the exception
@@ -880,6 +966,8 @@ def lint_source(
     relpath: str,
     *,
     knobs: Set[str] | None = None,
+    metric_names: Set[str] | None = None,
+    span_names: Set[str] | None = None,
     path: str | None = None,
 ) -> List[Finding]:
     """Lint one module. ``relpath`` is the package-relative posix path (it
@@ -890,6 +978,13 @@ def lint_source(
         from ..utils.env import KNOBS
 
         knobs = set(KNOBS)
+    if metric_names is None or span_names is None:
+        from ..obs.names import METRIC_NAMES, SPAN_NAMES
+
+        if metric_names is None:
+            metric_names = METRIC_NAMES
+        if span_names is None:
+            span_names = SPAN_NAMES
     try:
         tree = ast.parse(src)
     except SyntaxError as e:
@@ -911,6 +1006,7 @@ def lint_source(
         + _check_ka010(tree, relpath, path)
         + _check_ka011(tree, path)
         + _check_ka012(tree, relpath, path)
+        + _check_ka013(tree, path, set(metric_names), set(span_names))
     )
     for f in raw:
         if f.rule in suppress.get(f.line, ()):  # reasoned suppression

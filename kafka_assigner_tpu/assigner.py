@@ -196,20 +196,15 @@ class TopicAssigner:
         the work was merely speculative — for backends that cannot consume
         it.
         """
-        import contextlib
+        # One device trace per batched solve when KA_OBS_PROFILE_DIR (or
+        # the legacy KA_PROFILE) is set (SURVEY.md §5: the reference has no
+        # profiling at all; solve latency is our headline metric). View
+        # with TensorBoard/XProf. Unset: zero profiler overhead; busy
+        # (a /debug/profile window in flight): this dispatch skips tracing
+        # instead of failing the solve.
+        from .obs.profile import dispatch_trace
 
-        from .utils.env import env_str
-
-        trace_ctx = contextlib.nullcontext()
-        profile_dir = env_str("KA_PROFILE")
-        if profile_dir:
-            # One device trace per batched solve (SURVEY.md §5: the
-            # reference has no profiling at all; solve latency is our
-            # headline metric). View with TensorBoard/XProf.
-            from .obs.profile import device_trace
-
-            trace_ctx = device_trace(profile_dir)
-        with trace_ctx:
+        with dispatch_trace():
             return self._generate_assignments(
                 topic_assignments, brokers, rack_assignment,
                 desired_replication_factor, preencoded,

@@ -514,6 +514,13 @@ def build_daemon_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=None,
                    help="listen port (default: the KA_DAEMON_PORT knob; "
                         "0 = ephemeral, announced on stderr)")
+    p.add_argument("--access-log", dest="access_log", default=None,
+                   metavar="PATH",
+                   help="structured NDJSON access log path — one JSON line "
+                        "per served request with its request id, endpoint, "
+                        "cluster, HTTP code, status, latency and "
+                        "stale/degraded markers (default: the "
+                        "KA_OBS_ACCESS_LOG knob, else stderr)")
     return p
 
 
@@ -594,6 +601,7 @@ def run_daemon(argv: Optional[List[str]] = None) -> int:
         failure_policy=args.failure_policy,
         bind=args.bind,
         port=args.port,
+        access_log=args.access_log,
     )
 
 

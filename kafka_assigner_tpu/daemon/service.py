@@ -44,7 +44,38 @@ endpoint                        method behavior
                                        the one cluster, byte-identical to
                                        PR 8); under ``--clusters`` they
                                        400 with the cluster list
+/metrics                        GET    Prometheus text exposition of the
+                                       process-lifetime cumulative
+                                       registry (``obs/promtext.py``):
+                                       every counter/gauge/histogram the
+                                       obs layer records, ``@cluster``
+                                       names as ``cluster`` labels, plus
+                                       per-endpoint-per-cluster request
+                                       latency histograms and
+                                       process/build-info gauges
+/debug/flight                   GET    the flight-recorder ring
+                                       (``obs/flight.py``): recent
+                                       lifecycle/breaker/session/resync/
+                                       watch/watchdog/request/fault
+                                       events; per-cluster filtered view
+                                       at /clusters/<name>/debug/flight
+/debug/profile?seconds=N        GET    one N-second ``jax.profiler``
+                                       device-trace window into
+                                       ``KA_OBS_PROFILE_DIR`` (400 when
+                                       unset, 409 while another capture
+                                       runs); returns the artifact dir
 =============================== ====== ==================================
+
+**Request correlation (ISSUE 10):** every request gets a request ID —
+accepted from an ``X-Request-Id`` header or generated — echoed in the
+``X-Request-Id`` response header and the response envelope
+(``result.request_id``), stamped into every span of that request's
+capture, and written to the structured NDJSON access log
+(``KA_OBS_ACCESS_LOG`` path, or stderr) as exactly ONE line per served
+request. The routing layer also feeds the cumulative registry
+(``daemon.http.request_ms``/``daemon.http.requests`` by endpoint ×
+cluster × code) and the flight recorder (request summaries for the data
+plane).
 
 Isolation is enforced as bulkheads (per-cluster inflight gates/watchdogs,
 per-cluster sessions — see ``supervisor.py``) with ONE shared solve lock
@@ -63,10 +94,14 @@ import json
 import sys
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
+from ..obs import flight
+from ..obs import metrics as obs_metrics
+from ..obs.report import REPORT_SCHEMA_VERSION, TOOL_NAME, AccessLog
 from .supervisor import POLL_S, ClusterSupervisor
 
 #: The implicit cluster name of a single-cluster (``--zk_string``) daemon.
@@ -80,6 +115,16 @@ def _valid_cluster_name(name: str) -> bool:
     return bool(name) and all(
         c.isalnum() or c in "_.-" for c in name
     )
+
+
+def _request_id(headers) -> str:
+    """The request's correlation id: a sane client-supplied
+    ``X-Request-Id`` wins (length-capped, control characters refused so a
+    header cannot forge log lines); otherwise a fresh 16-hex-char id."""
+    raw = (headers.get("X-Request-Id") or "").strip()
+    if raw and len(raw) <= 128 and raw.isprintable():
+        return raw
+    return uuid.uuid4().hex[:16]
 
 
 class AssignerDaemon:
@@ -97,6 +142,7 @@ class AssignerDaemon:
         failure_policy: Optional[str] = None,
         bind: Optional[str] = None,
         port: Optional[int] = None,
+        access_log: Optional[str] = None,
         err=None,
     ) -> None:
         from ..utils.env import env_float, env_int, env_str
@@ -122,6 +168,19 @@ class AssignerDaemon:
         self.port = port if port is not None else env_int("KA_DAEMON_PORT")
         self.drain_timeout = env_float("KA_DAEMON_DRAIN_TIMEOUT")
         self.err = err if err is not None else sys.stderr
+
+        # The live telemetry plane (ISSUE 10), one per daemon lifetime:
+        # cumulative process metrics (served at /metrics), the flight
+        # recorder (served at /debug/flight, flushed on exit), and the
+        # NDJSON access log. The one-shot CLI never enables any of these —
+        # its zero-overhead disabled mode is untouched.
+        obs_metrics.enable_cumulative()
+        flight.enable()
+        self.access_log = AccessLog(
+            access_log if access_log is not None
+            else env_str("KA_OBS_ACCESS_LOG"),
+            err=self.err,
+        )
 
         self.draining = threading.Event()
         self.stopped = threading.Event()
@@ -190,6 +249,9 @@ class AssignerDaemon:
         first sync must complete (bounded retries, then ``IngestError`` —
         PR 8 behavior). Multi-cluster: a cluster that cannot sync starts
         degraded behind its breaker and the daemon serves the rest."""
+        flight.record(
+            "daemon", event="start", clusters=sorted(self.supervisors),
+        )
         for sup in self.supervisors.values():
             sup.start(require_sync=self.single)
         self.httpd = _build_http_server(self, self.bind, self.port)
@@ -233,6 +295,7 @@ class AssignerDaemon:
         program store on disk are process-independent and stay intact (a
         mid-execution exit resumes from its journal)."""
         self.draining.set()
+        flight.record("daemon", event="draining")
         deadline = time.monotonic() + self.drain_timeout
         while time.monotonic() < deadline:
             if self._active_total() == 0:
@@ -252,6 +315,22 @@ class AssignerDaemon:
             sup.teardown()
         if self._serve_thread is not None:
             self._serve_thread.join(timeout=5.0)
+        flight.record("daemon", event="stopped", inflight_at_exit=left)
+        # The SIGTERM half of the crash-surviving contract: the ring
+        # reaches KA_OBS_FLIGHT_DUMP before the process exits (the crash
+        # half lives in run_daemon_process).
+        flight.flush_to_dump(err=self.err)
+        if left == 0:
+            # A drain-timeout straggler is the one request a post-mortem
+            # most wants in the access LOG FILE: leave the (line-buffered,
+            # per-write-flushed) handle open for it — the process exit
+            # reclaims the fd — and only close on a clean drain.
+            self.access_log.close()
+        # This daemon's lifetime is over: return the process to the CLI's
+        # zero-overhead disabled state so an in-process embedder's later
+        # runs stop accumulating into a dead daemon's registry and ring.
+        obs_metrics.disable_cumulative()
+        flight.disable()
         self._log("drained; exiting 0")
 
     def _active_total(self) -> int:
@@ -297,7 +376,39 @@ class AssignerDaemon:
 
 #: Per-cluster path suffixes the router accepts.
 _POST_SUFFIXES = ("/plan", "/whatif", "/execute")
-_GET_SUFFIXES = ("/healthz", "/readyz", "/state")
+_GET_SUFFIXES = ("/healthz", "/readyz", "/state", "/debug/flight")
+
+
+def _render_metrics(daemon: AssignerDaemon) -> str:
+    """The /metrics exposition body: the cumulative registry plus the
+    process/build-info gauges the scrape-side conventions expect."""
+    import platform
+
+    from ..obs import promtext
+
+    cum = obs_metrics.cumulative()
+    snapshot = cum.snapshot() if cum is not None else {
+        "counters": {}, "gauges": {}, "hists": {},
+    }
+    started = cum.started_at if cum is not None else time.time()
+    info = {
+        "tool": TOOL_NAME,
+        "report_schema": str(REPORT_SCHEMA_VERSION),
+        "python": platform.python_version(),
+        "mode": "single" if daemon.single else "multi",
+    }
+    extra = {
+        "process_start_time_seconds": started,
+        "process_uptime_seconds": round(time.time() - started, 3),
+        "daemon_clusters": len(daemon.supervisors),
+        "daemon_inflight_requests": daemon._active_total(),
+    }
+    rec = flight.recorder()
+    if rec is not None:
+        stats = rec.stats()
+        extra["flight_events_recorded"] = stats["recorded"]
+        extra["flight_events_dropped"] = stats["dropped"]
+    return promtext.render(snapshot, extra_gauges=extra, info=info)
 
 
 def _build_http_server(daemon: AssignerDaemon, bind: str,
@@ -308,15 +419,84 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
         def log_message(self, fmt, *args):  # stderr discipline: our lines only
             pass
 
+        def _begin(self) -> None:
+            """Per-request correlation state (one handler instance serves a
+            whole keep-alive connection; every request re-stamps)."""
+            self._t0 = time.perf_counter()
+            self._rid = _request_id(self.headers)
+            self._code: Optional[int] = None
+            self._sup = None
+            self._endpoint: Optional[str] = None
+            self._status: Optional[str] = None
+
+        def _access(self, method: str, path: str) -> None:
+            """Exactly ONE structured access-log line per served request
+            (ISSUE 10), plus the routing layer's cumulative telemetry:
+            per-endpoint-per-cluster latency histograms and request
+            counters, and a flight-recorder summary for data-plane
+            requests."""
+            ms = round((time.perf_counter() - self._t0) * 1000.0, 3)
+            sup = self._sup
+            daemon.access_log.log(
+                request_id=self._rid,
+                method=method,
+                path=path,
+                cluster=sup.name if sup is not None else None,
+                code=self._code,
+                status=self._status,
+                ms=ms,
+                inflight=sup.active_requests() if sup is not None else 0,
+                stale=sup.stale() if sup is not None else False,
+                degraded=self._status == "degraded",
+            )
+            cum = obs_metrics.cumulative()
+            if cum is not None and self._endpoint is not None:
+                labels = {"endpoint": self._endpoint}
+                if sup is not None:
+                    labels["cluster"] = sup.name
+                cum.hist_observe(
+                    "daemon.http.request_ms", ms, labels=labels
+                )
+                cum.counter_add(
+                    "daemon.http.requests", 1,
+                    labels={**labels, "code": str(self._code)},
+                )
+            if method == "POST":
+                flight.record(
+                    "request",
+                    sup.name if sup is not None else None,
+                    request_id=self._rid,
+                    path=path,
+                    code=self._code,
+                    status=self._status,
+                    ms=ms,
+                )
+
         def _reply(self, code: int, body: dict,
                    headers: Optional[dict] = None) -> None:
             # kalint: disable=KA005 -- HTTP response envelope, not a Kafka plan payload
             raw = json.dumps(body, sort_keys=True).encode("utf-8")
+            self._code = code
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(raw)))
+            self.send_header("X-Request-Id", self._rid)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
+            self.end_headers()
+            try:
+                self.wfile.write(raw)
+            except (BrokenPipeError, ConnectionResetError):  # kalint: disable=KA008 -- client went away mid-reply; nothing left to tell it
+                pass
+
+        def _reply_text(self, code: int, text: str,
+                        content_type: str) -> None:
+            raw = text.encode("utf-8")
+            self._code = code
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(raw)))
+            self.send_header("X-Request-Id", self._rid)
             self.end_headers()
             try:
                 self.wfile.write(raw)
@@ -351,11 +531,67 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
             return None, path  # bare GET aggregates
 
         def do_GET(self) -> None:
-            path = urlsplit(self.path).path
+            self._begin()
+            try:
+                self._do_get()
+            finally:
+                self._access("GET", urlsplit(self.path).path)
+
+        def _debug_profile(self, query: str) -> None:
+            from ..obs.profile import ProfilerBusy, capture_window
+
+            self._endpoint = "debug/profile"
+            raw = parse_qs(query).get("seconds", ["1"])[-1]
+            try:
+                seconds = float(raw)
+            except ValueError:
+                self._reply(
+                    400, {"error": f"bad seconds value {raw!r}"}
+                )
+                return
+            try:
+                artifact = capture_window(seconds)
+            except ProfilerBusy as e:
+                self._reply(409, {"error": str(e)})
+                return
+            except (RuntimeError, ValueError) as e:
+                self._reply(400, {"error": str(e)})
+                return
+            flight.record("profile", seconds=seconds, dir=artifact)
+            self._reply(200, {
+                "artifact_dir": artifact, "seconds": seconds,
+            })
+
+        def _do_get(self) -> None:
+            split = urlsplit(self.path)
+            path = split.path
+            if path == "/metrics":
+                self._endpoint = "metrics"
+                self._reply_text(
+                    200, _render_metrics(daemon),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                return
+            if path == "/debug/flight":
+                self._endpoint = "debug/flight"
+                rec = flight.recorder()
+                self._reply(
+                    200,
+                    rec.view() if rec is not None
+                    else {"error": "flight recorder disabled "
+                                   "(KA_OBS_FLIGHT_EVENTS=0)",
+                          "events": []},
+                )
+                return
+            if path == "/debug/profile":
+                self._debug_profile(split.query)
+                return
             routed = self._route(path)
             if routed is None:
                 return
             sup, suffix = routed
+            self._sup = sup
+            self._endpoint = suffix.lstrip("/") or None
             if sup is None:  # multi-cluster bare-path aggregates
                 if suffix == "/healthz":
                     self._reply(200, daemon.healthz_aggregate())
@@ -398,10 +634,26 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
                 )
             elif suffix == "/state":
                 self._reply(200, sup.state_view())
+            elif suffix == "/debug/flight":
+                rec = flight.recorder()
+                self._reply(
+                    200,
+                    rec.view(cluster=sup.name) if rec is not None
+                    else {"error": "flight recorder disabled "
+                                   "(KA_OBS_FLIGHT_EVENTS=0)",
+                          "events": []},
+                )
             else:
                 self._reply(404, {"error": f"unknown path {self.path!r}"})
 
         def do_POST(self) -> None:
+            self._begin()
+            try:
+                self._do_post()
+            finally:
+                self._access("POST", urlsplit(self.path).path)
+
+        def _do_post(self) -> None:
             split = urlsplit(self.path)
             path = split.path
             routed = self._route(path)
@@ -411,6 +663,8 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
             if sup is None or suffix not in _POST_SUFFIXES:
                 self._reply(404, {"error": f"unknown path {self.path!r}"})
                 return
+            self._sup = sup
+            self._endpoint = suffix.lstrip("/")
             try:
                 n = int(self.headers.get("Content-Length") or 0)
                 raw = self.rfile.read(n) if n else b"{}"
@@ -434,9 +688,13 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
                     value = raw_v
                 params.setdefault(key, value)
             if suffix == "/execute":
+                self._status = "stream"
                 self._execute(sup, params)
                 return
-            code, body, headers = sup.handle(suffix, params)
+            code, body, headers = sup.handle(
+                suffix, params, request_id=self._rid
+            )
+            self._status = body.get("status")
             self._reply(code, body, headers)
 
         def _execute(self, sup, params: dict) -> None:
@@ -451,8 +709,10 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
                 return
             _, ctx = prep
             try:
+                self._code = 200
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("X-Request-Id", self._rid)
                 self.send_header("Connection", "close")
                 self.end_headers()
                 self.close_connection = True
@@ -506,6 +766,7 @@ def run_daemon_process(
     failure_policy: Optional[str] = None,
     bind: Optional[str] = None,
     port: Optional[int] = None,
+    access_log: Optional[str] = None,
 ) -> int:
     """Start a daemon, install signal handlers, serve until SIGTERM/SIGINT,
     drain, exit 0. The console entry (``ka-daemon``) lands here."""
@@ -514,6 +775,7 @@ def run_daemon_process(
     daemon = AssignerDaemon(
         zk_string, clusters=clusters, solver=solver,
         failure_policy=failure_policy, bind=bind, port=port,
+        access_log=access_log,
     )
 
     def _sig(_signo, _frame):
@@ -521,5 +783,16 @@ def run_daemon_process(
 
     signal.signal(signal.SIGTERM, _sig)
     signal.signal(signal.SIGINT, _sig)
-    daemon.start()
-    return daemon.serve()
+    try:
+        daemon.start()
+        return daemon.serve()
+    except BaseException as e:
+        # The crash half of the flight recorder's survival contract: the
+        # ring reaches KA_OBS_FLIGHT_DUMP even when the daemon dies on an
+        # unhandled error (the SIGTERM half lives in shutdown()). The
+        # original exception always wins — flush never masks the crash.
+        flight.record(
+            "daemon", event="crash", error=f"{type(e).__name__}: {e}",
+        )
+        flight.flush_to_dump()
+        raise

@@ -70,6 +70,7 @@ from ..generator import (
 )
 from ..io.base import open_backend
 from ..io.zkwire import ZkConnectionError, ZkWireError
+from ..obs import flight
 from ..obs.metrics import counter_add
 from ..obs.trace import record_span
 from ..utils.backoff import JitteredBackoff
@@ -88,10 +89,14 @@ class CircuitBreaker:
     lockstep. Thread-safe; the watch loop is the only prober but request
     threads read :meth:`snapshot` concurrently."""
 
-    def __init__(self, threshold: int, cooldown: float, cap: float) -> None:
+    def __init__(self, threshold: int, cooldown: float, cap: float,
+                 cluster: Optional[str] = None) -> None:
         self.threshold = max(1, int(threshold))
         self._cooldown = max(0.05, float(cooldown))
         self._cap = max(self._cooldown, float(cap))
+        #: Flight-recorder correlation only; the breaker's behavior is
+        #: cluster-agnostic.
+        self.cluster = cluster
         self._lock = threading.Lock()
         self._backoff = self._fresh_backoff()
         self.state = "closed"
@@ -110,6 +115,7 @@ class CircuitBreaker:
                 return True
             if time.monotonic() >= self._open_until:
                 self.state = "half-open"
+                flight.record("breaker", self.cluster, state="half-open")
                 return True
             return False
 
@@ -128,6 +134,10 @@ class CircuitBreaker:
                 self._open_until = (
                     time.monotonic() + self._backoff.next_delay()
                 )
+                flight.record(
+                    "breaker", self.cluster, state="open",
+                    failures=self.consecutive_failures,
+                )
             return opening
 
     def record_success(self) -> bool:
@@ -140,6 +150,8 @@ class CircuitBreaker:
             self.consecutive_failures = 0
             self._open_until = 0.0
             self._backoff = self._fresh_backoff()
+            if was_tripped:
+                flight.record("breaker", self.cluster, state="closed")
             return was_tripped
 
     def snapshot(self) -> dict:
@@ -200,7 +212,11 @@ class ClusterSupervisor:
             env_int("KA_DAEMON_BREAKER_THRESHOLD"),
             env_float("KA_DAEMON_BREAKER_COOLDOWN"),
             cap=self.resync_interval,
+            cluster=name,
         )
+        #: Last lifecycle state the flight recorder saw (transitions only,
+        #: not a poll — the recorder's ring should hold signal, not ticks).
+        self._flight_lifecycle: Optional[str] = None
 
         self.state = DaemonState()
         self.backend = None
@@ -248,6 +264,15 @@ class ClusterSupervisor:
     def _log(self, msg: str) -> None:
         prefix = f"ka-daemon[{self.name}]" if self.label else "ka-daemon"
         print(f"{prefix}: {msg}", file=self.err)
+
+    def note_lifecycle(self) -> None:
+        """Record a flight-recorder ``lifecycle`` event when this cluster's
+        supervised state CHANGED since the last note — called at the seams
+        that can flip it (sync outcomes, session loss, drain)."""
+        state = self.lifecycle()
+        if state != self._flight_lifecycle:
+            self._flight_lifecycle = state
+            flight.record("lifecycle", self.name, state=state)
 
     # -- live knobs ---------------------------------------------------------
 
@@ -376,6 +401,7 @@ class ClusterSupervisor:
         failure — callers own the retry policy and the breaker."""
         t0 = time.perf_counter()
         ok = False
+        error: Optional[str] = None
         try:
             fault_point("resync", cluster=self.name)
             backend = self.backend
@@ -411,11 +437,17 @@ class ClusterSupervisor:
             self._count("daemon.resyncs")
             self._maybe_warm()
             ok = True
+        except BaseException as e:
+            error = f"{type(e).__name__}: {e}"
+            raise
         finally:
-            record_span(
-                self._metric("daemon/resync"),
-                (time.perf_counter() - t0) * 1e3, ok,
-            )
+            ms = (time.perf_counter() - t0) * 1e3
+            record_span(self._metric("daemon/resync"), ms, ok)
+            ev = {"outcome": "ok" if ok else "fail", "ms": round(ms, 3)}
+            if error is not None:
+                ev["error"] = error
+            flight.record("resync", self.name, **ev)
+            self.note_lifecycle()
 
     def _maybe_warm(self) -> None:
         """Post-resync program warm-up (``solvers/warmup.py``): the cache
@@ -573,7 +605,12 @@ class ClusterSupervisor:
                             )
                         ):
                             self._count("daemon.watch_dropped")
+                            flight.record(
+                                "watch", self.name, event=kind,
+                                dropped=True,
+                            )
                             continue
+                        flight.record("watch", self.name, event=kind)
                         if self._apply_event(kind, arg):
                             # The event handler ran a FULL resync (broker
                             # churn): restart the interval from it, or the
@@ -610,6 +647,11 @@ class ClusterSupervisor:
                     self.stopped.wait(POLL_S)
                     continue
                 self._count("daemon.session_lost")
+                flight.record(
+                    "session", self.name, event="lost",
+                    error=f"{type(e).__name__}: {e}",
+                )
+                self.note_lifecycle()
                 self._log(
                     f"ZooKeeper session lost ({type(e).__name__}: {e}); "
                     "re-establishing, re-arming watches and resyncing "
@@ -652,10 +694,13 @@ class ClusterSupervisor:
 
     # -- request surface ----------------------------------------------------
 
-    def handle(self, path: str, params: dict) -> Tuple[int, dict, dict]:
+    def handle(self, path: str, params: dict,
+               request_id: Optional[str] = None) -> Tuple[int, dict, dict]:
         """One POST request: per-cluster backpressure gate (the LIVE
         inflight knob) → shared-solve-lock dispatch. Returns
-        ``(http_code, body, extra_headers)``."""
+        ``(http_code, body, extra_headers)``. ``request_id`` (ISSUE 10) is
+        stamped into the request's capture so every span and the response
+        envelope correlate with the access-log line."""
         if self.draining.is_set():
             return 503, {"error": "draining"}, {"Retry-After": "5"}
         if not self.state.synced_once:
@@ -682,13 +727,14 @@ class ClusterSupervisor:
                 {"Retry-After": "1"},
             )
         try:
-            return self._handle_admitted(path, params)
+            return self._handle_admitted(path, params, request_id)
         finally:
             with self._active_lock:
                 self._active -= 1
 
     def _handle_admitted(
-        self, path: str, params: dict
+        self, path: str, params: dict,
+        request_id: Optional[str] = None,
     ) -> Tuple[int, dict, dict]:
         from .. import obs
 
@@ -714,6 +760,10 @@ class ClusterSupervisor:
         def _overrun() -> None:
             overran.set()
             self._count("daemon.watchdog_exceeded")
+            flight.record(
+                "watchdog", self.name, path=path, budget_s=budget,
+                request_id=request_id,
+            )
             self._log(
                 f"watchdog: {path} exceeded its "
                 f"{budget:.1f} s budget and is still running"
@@ -726,6 +776,10 @@ class ClusterSupervisor:
         # requests from other clusters can never tear each other's span
         # stacks or steal each other's metrics.
         with self._solve_lock, obs.run_capture(local=True) as run:
+            if request_id is not None:
+                # FIRST thing in the capture: every span this request
+                # records carries the correlation id (ISSUE 10).
+                run.annotate("request_id", request_id)
             try:
                 with obs.span(self._metric("daemon/request")) as sp:
                     if path == "/plan":
@@ -775,12 +829,23 @@ class ClusterSupervisor:
             "cache_version": self.state.version,
             "elapsed_ms": round(elapsed_ms, 3),
         }
+        if request_id is not None:
+            report["result"]["request_id"] = request_id
         if self.label:
             report["result"]["cluster"] = self.name
         if watchdog:
             report["result"]["watchdog_exceeded"] = True
         if degraded:
             self._count("daemon.requests_degraded")
+        from ..utils.env import env_str
+
+        if env_str("KA_OBS_REPORT"):
+            # The per-request stderr run summary is OPT-IN via KA_OBS_REPORT
+            # (ISSUE 10 satellite): by default a daemon request emits exactly
+            # ONE structured line — the access log's — never two. No file is
+            # written here (per-request writes to one path would clobber);
+            # the envelope already IS the report.
+            obs.emit_report(report, None, err=self.err)
         return code, report, {}
 
     def _expire_session(self) -> None:
@@ -790,6 +855,7 @@ class ClusterSupervisor:
         this request serves from the (now stale-marked) cache. The prompt
         flag covers the watchless case, where no poll exists to raise."""
         self.state.mark_stale()
+        self.note_lifecycle()
         self._prompt_resync = True
         zk = getattr(self.backend, "_zk", None)
         sock = getattr(zk, "_sock", None)
@@ -1061,6 +1127,10 @@ class ClusterSupervisor:
         from ..exec.journal import JournalError
 
         self._count("daemon.executes")
+        flight.record(
+            "execute", self.name, event="start",
+            plan_hash=ctx["plan_hash"][:12], resume=ctx["resume"],
+        )
         safe_emit = _SafeEmitter(emit, self)
         backend = None
         try:
@@ -1112,6 +1182,10 @@ class ClusterSupervisor:
                 status, exit_code = "degraded", 6
             else:
                 status, exit_code = "ok", 0
+            flight.record(
+                "execute", self.name, event="done", status=status,
+                plan_hash=ctx["plan_hash"][:12],
+            )
             safe_emit({
                 "event": "exec/done",
                 "status": status,

@@ -99,6 +99,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from ..obs import flight
 from ..obs.metrics import counter_add
 
 #: Scopes (hook sites) and the kinds each accepts.
@@ -325,6 +326,13 @@ class FaultInjector:
         self.fired.append(ev)
         counter_add("faults.injected")
         counter_add(f"faults.injected.{ev.kind}")
+        # Flight-recorder correlation (ISSUE 10): a chaos post-mortem diffs
+        # the recorder's `fault` events against the schedule it injected —
+        # no-op outside a daemon (the recorder is never enabled).
+        flight.record(
+            "fault", ev.cluster, spec=str(ev), scope=ev.scope,
+            fault_kind=ev.kind,
+        )
         print(f"kafka-assigner: fault injected: {ev}", file=sys.stderr)
 
     # -- hooks -------------------------------------------------------------

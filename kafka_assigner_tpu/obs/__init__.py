@@ -20,16 +20,21 @@ never code inside a jit trace (kalint KA002). Knobs: ``KA_OBS_ENABLE``,
 """
 from __future__ import annotations
 
+from . import flight
 from .metrics import (
     counter_add,
+    cumulative,
+    disable_cumulative,
+    enable_cumulative,
     gauge_set,
     hist_ms,
     hist_observe,
     obs_active,
 )
-from .profile import device_trace
+from .profile import device_trace, dispatch_trace
 from .report import (
     REPORT_SCHEMA_VERSION,
+    AccessLog,
     build_report,
     emit_report,
     validate_report,
@@ -38,12 +43,18 @@ from .trace import RunCollector, active_run, run_capture, span
 
 __all__ = [
     "REPORT_SCHEMA_VERSION",
+    "AccessLog",
     "RunCollector",
     "active_run",
     "build_report",
     "counter_add",
+    "cumulative",
     "device_trace",
+    "disable_cumulative",
+    "dispatch_trace",
     "emit_report",
+    "enable_cumulative",
+    "flight",
     "gauge_set",
     "hist_ms",
     "hist_observe",

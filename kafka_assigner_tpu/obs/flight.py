@@ -1,0 +1,182 @@
+"""The flight recorder: a bounded in-memory ring of daemon lifecycle events
+that survives the process into a crash dump (ISSUE 10 tentpole, piece 4).
+
+The run report answers "what did this REQUEST do"; the cumulative registry
+answers "how much has this PROCESS done"; neither answers the post-mortem
+question "what was the daemon DOING when it degraded". The flight recorder
+does: every consequential transition — lifecycle flips, breaker
+open/probe/close, session loss, resync outcomes, watch churn, watchdog
+firings, injected faults, request summaries — lands in one bounded ring
+buffer (``KA_OBS_FLIGHT_EVENTS`` entries; overflow drops the OLDEST and is
+counted, never silent), dumpable live via the daemon's ``/debug/flight``
+(and per-cluster ``/clusters/<name>/debug/flight``) and flushed to
+``KA_OBS_FLIGHT_DUMP`` as NDJSON on SIGTERM and on a crashing exit — so a
+chaos-soak post-mortem reads one artifact instead of scraping stderr.
+
+Event taxonomy (the ``kind`` field; every event also carries a monotonic
+``seq``, a wall-clock ``t``, and ``cluster`` when cluster-scoped):
+
+========== ===========================================================
+kind       fields / meaning
+========== ===========================================================
+daemon     ``event``: start / draining / stopped (process lifecycle)
+lifecycle  ``state``: a cluster's supervised lifecycle transition
+breaker    ``state``: open / half-open / closed (+ ``failures``)
+session    ``event``: lost — the cluster session died (re-establishment
+           shows up as the next ``resync`` with ``outcome: ok``)
+resync     ``outcome``: ok / fail (+ ``ms``, ``error`` on failure)
+watch      ``event``: the normalized watch event kind (topic / topics /
+           brokers), ``dropped``: true when fault injection discarded it
+watchdog   ``path``, ``budget_s``: a request overran its budget
+request    ``request_id``, ``path``, ``code``, ``status``, ``ms``: one
+           served data-plane request (the access log's in-memory twin)
+execute    ``event``: start / done / error (+ ``plan_hash``)
+fault      ``spec``: a fired fault-injection event (``faults/inject.py``)
+profile    ``seconds``, ``dir``: a /debug/profile window capture
+========== ===========================================================
+
+Activation model, same as the rest of ``obs/``: nothing records until
+:func:`enable` runs (the daemon enables at construction; the one-shot CLI
+never does), and :func:`record` without a live recorder is one global read
+and a ``None`` check — the disabled mode stays zero-overhead and
+byte-identical (test-pinned posture of the whole subsystem). Importing this
+module never touches jax (kalint KA006).
+"""
+from __future__ import annotations
+
+import collections
+import sys
+import threading
+import time
+from typing import List, Optional
+
+
+class FlightRecorder:
+    """One bounded event ring. Thread-safe: the watch loops, request
+    threads, and the breaker all record concurrently."""
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = max(1, int(capacity))
+        self._events: "collections.deque[dict]" = collections.deque(
+            maxlen=self.capacity
+        )
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.dropped = 0
+        self.started_at = time.time()
+
+    def record(self, kind: str, cluster: Optional[str] = None,
+               **fields) -> int:
+        """Append one event; returns its sequence number. Overflow evicts
+        the oldest event and bumps ``dropped`` (counted, never silent)."""
+        with self._lock:
+            self._seq += 1
+            if len(self._events) == self.capacity:
+                self.dropped += 1
+            ev = {"seq": self._seq, "t": round(time.time(), 3),
+                  "kind": kind}
+            if cluster is not None:
+                ev["cluster"] = cluster
+            ev.update(fields)
+            self._events.append(ev)
+            return self._seq
+
+    def snapshot(self, cluster: Optional[str] = None,
+                 since: int = 0) -> List[dict]:
+        """The retained events, oldest first; ``cluster`` filters to one
+        cluster's events (clusterless events are kept — they describe the
+        whole process), ``since`` to events after that sequence number."""
+        with self._lock:
+            events = [dict(e) for e in self._events]
+        return [
+            e for e in events
+            if e["seq"] > since
+            and (cluster is None or e.get("cluster", cluster) == cluster)
+        ]
+
+    def stats(self) -> dict:
+        """Ring accounting without copying the events (the /metrics
+        gauges): total recorded and overflow-dropped counts."""
+        with self._lock:
+            return {"recorded": self._seq, "dropped": self.dropped}
+
+    def view(self, cluster: Optional[str] = None) -> dict:
+        """The ``/debug/flight`` response body."""
+        events = self.snapshot(cluster)
+        stats = self.stats()
+        return {
+            "capacity": self.capacity,
+            "recorded": stats["recorded"],
+            "dropped": stats["dropped"],
+            "started_at": round(self.started_at, 3),
+            "events": events,
+        }
+
+    def flush(self, path: str, err=None) -> Optional[str]:
+        """Write the ring as NDJSON (one event per line, oldest first).
+        Returns the path written, or None. A failing write is reported on
+        stderr and swallowed — a flight dump must never mask the exit it
+        is documenting (same contract as the run-report emitter)."""
+        import json
+
+        err = err if err is not None else sys.stderr
+        try:
+            with open(path, "w", encoding="utf-8") as f:
+                for ev in self.snapshot():
+                    # kalint: disable=KA005 -- flight-recorder dump artifact, not a Kafka plan payload
+                    f.write(json.dumps(ev, sort_keys=True) + "\n")
+            return path
+        except OSError as e:
+            print(f"obs: could not write flight dump {path!r}: {e}",
+                  file=err)
+            return None
+
+
+#: The live recorder, or None (the CLI's state — zero overhead). One global
+#: read per record call, same activation model as trace._ACTIVE.
+_RECORDER: Optional[FlightRecorder] = None
+
+
+def enable(capacity: Optional[int] = None) -> Optional[FlightRecorder]:
+    """Install a FRESH recorder (the daemon calls this at construction —
+    one recorder per daemon lifetime). ``capacity`` defaults to the
+    ``KA_OBS_FLIGHT_EVENTS`` knob; 0 disables recording entirely."""
+    global _RECORDER
+    if capacity is None:
+        from ..utils.env import env_int
+
+        capacity = env_int("KA_OBS_FLIGHT_EVENTS")
+    _RECORDER = FlightRecorder(capacity) if capacity > 0 else None
+    return _RECORDER
+
+
+def disable() -> None:
+    global _RECORDER
+    _RECORDER = None
+
+
+def recorder() -> Optional[FlightRecorder]:
+    return _RECORDER
+
+
+def record(kind: str, cluster: Optional[str] = None, **fields) -> None:
+    """Record one event on the live recorder; a cheap no-op when none."""
+    rec = _RECORDER
+    if rec is not None:
+        rec.record(kind, cluster, **fields)
+
+
+def flush_to_dump(err=None) -> Optional[str]:
+    """Flush the live recorder to the ``KA_OBS_FLIGHT_DUMP`` path (no-op
+    when either is unset) — called on SIGTERM drain and on a crashing
+    daemon exit, so the last ``KA_OBS_FLIGHT_EVENTS`` transitions survive
+    the process."""
+    rec = _RECORDER
+    if rec is None:
+        return None
+    from ..utils.env import env_str
+
+    path = env_str("KA_OBS_FLIGHT_DUMP")
+    if not path:
+        return None
+    return rec.flush(path, err=err)

@@ -30,19 +30,35 @@ Namespace conventions (documented in the README "Observability" section):
   served/degraded/shed, ``daemon.reencode.topics`` delta re-encodes,
   resyncs and their failures, watch events/drops, sessions lost,
   in-request solver fallbacks, watchdog overruns. Daemon-LIFETIME totals
-  live on the daemon itself (``/state``); these obs mirrors land in
-  whichever request capture is active, so each response's report envelope
-  carries the per-request deltas.
+  live on the daemon itself (``/state``) and in the cumulative registry
+  (``/metrics``); the obs mirrors also land in whichever request capture
+  is active, so each response's report envelope carries the per-request
+  deltas. ``daemon.http.*`` (request latency/outcomes by endpoint ×
+  cluster × code) is cumulative-only — the routing layer writes it with
+  the explicit ``labels=`` API.
 
 Histogram bucket upper edges come from ``KA_OBS_HIST_EDGES`` (ms for timing
 histograms); one shared edge set keeps reports comparable across runs.
+
+**Cumulative daemon registry (ISSUE 10).** A run capture dies with its
+request; a resident daemon's health lives in process-lifetime totals. When
+:func:`enable_cumulative` has run (``ka-daemon`` does so at construction;
+the one-shot CLI never does), every write through this module ALSO lands in
+one process-wide :class:`CumulativeMetrics` — same names, same histogram
+edges — which the daemon's ``/metrics`` endpoint renders as Prometheus text
+(``obs/promtext.py``). The ``name@cluster`` suffix convention of the
+multi-cluster daemon becomes a ``cluster`` label; the routing layer's
+per-endpoint latency histograms use the explicit ``labels=`` API. Per-run
+captures are untouched — a ``/plan`` response envelope stays byte-identical
+whether the cumulative registry is on or off (test-pinned).
 """
 from __future__ import annotations
 
 import math
 import sys
+import threading
 import time
-from typing import Tuple
+from typing import Dict, Optional, Tuple
 
 from . import trace
 
@@ -50,6 +66,125 @@ from . import trace
 DEFAULT_HIST_EDGES: Tuple[float, ...] = (
     1.0, 5.0, 25.0, 100.0, 500.0, 2500.0, 10000.0
 )
+
+#: One label tuple: (("cluster", "west"),) — sorted (key, value) pairs.
+Labels = Tuple[Tuple[str, str], ...]
+
+
+def _split_label(name: str) -> Tuple[str, Labels]:
+    """``daemon.requests@west`` → (``daemon.requests``, cluster=west).
+    The ``@cluster`` suffix is the multi-cluster daemon's naming scheme
+    (``supervisor._metric``); plain names carry no labels."""
+    if "@" in name:
+        base, _, cluster = name.rpartition("@")
+        if base:
+            return base, (("cluster", cluster),)
+    return name, ()
+
+
+class CumulativeMetrics:
+    """Process-lifetime counters/gauges/histograms, keyed by (name, labels).
+    Thread-safe: request threads, watch loops, and the routing layer all
+    write concurrently; one lock is plenty at daemon request rates."""
+
+    def __init__(self, hist_edges: Tuple[float, ...] = ()) -> None:
+        self.hist_edges: Tuple[float, ...] = tuple(hist_edges)
+        self.started_at = time.time()
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Labels], int] = {}
+        self._gauges: Dict[Tuple[str, Labels], float] = {}
+        self._hists: Dict[Tuple[str, Labels], dict] = {}
+
+    @staticmethod
+    def _key(name: str, labels: Optional[Dict[str, str]]) -> Tuple[str, Labels]:
+        if labels:
+            return name, tuple(sorted(
+                (str(k), str(v)) for k, v in labels.items()
+            ))
+        return _split_label(name)
+
+    def counter_add(self, name: str, n: int = 1,
+                    labels: Optional[Dict[str, str]] = None) -> None:
+        key = self._key(name, labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + int(n)
+
+    def gauge_set(self, name: str, value,
+                  labels: Optional[Dict[str, str]] = None) -> None:
+        key = self._key(name, labels)
+        with self._lock:
+            self._gauges[key] = value
+
+    def hist_observe(self, name: str, value: float,
+                     labels: Optional[Dict[str, str]] = None) -> None:
+        key = self._key(name, labels)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                edges = list(self.hist_edges)
+                h = self._hists[key] = {
+                    "edges": edges,
+                    "counts": [0] * (len(edges) + 1),
+                    "count": 0,
+                    "sum": 0.0,
+                }
+            i = 0
+            edges = h["edges"]
+            while i < len(edges) and value > edges[i]:
+                i += 1
+            h["counts"][i] += 1
+            h["count"] += 1
+            h["sum"] = round(h["sum"] + value, 6)
+
+    def counter_value(self, name: str,
+                      labels: Optional[Dict[str, str]] = None) -> int:
+        with self._lock:
+            return self._counters.get(self._key(name, labels), 0)
+
+    def snapshot(self) -> dict:
+        """A structured copy for the exposition renderer: each section maps
+        ``name → {labels: value-or-hist}`` (labels as sorted tuples)."""
+        with self._lock:
+            out = {"counters": {}, "gauges": {}, "hists": {}}
+            for (name, labels), v in self._counters.items():
+                out["counters"].setdefault(name, {})[labels] = v
+            for (name, labels), v in self._gauges.items():
+                out["gauges"].setdefault(name, {})[labels] = v
+            for (name, labels), h in self._hists.items():
+                out["hists"].setdefault(name, {})[labels] = {
+                    "edges": list(h["edges"]),
+                    "counts": list(h["counts"]),
+                    "count": h["count"],
+                    "sum": h["sum"],
+                }
+            return out
+
+
+#: The process-lifetime registry, or None (the CLI's state). Same one-read
+#: activation model as trace._ACTIVE: the disabled mode costs each metric
+#: write one extra global read and None check.
+_CUMULATIVE: Optional[CumulativeMetrics] = None
+
+
+def enable_cumulative(hist_edges=None) -> CumulativeMetrics:
+    """Install a FRESH cumulative registry (the daemon calls this once at
+    construction — one registry per daemon lifetime; tests reset by calling
+    again or :func:`disable_cumulative`)."""
+    global _CUMULATIVE
+    if hist_edges is None:
+        hist_edges = resolve_hist_edges()
+    _CUMULATIVE = CumulativeMetrics(hist_edges=tuple(hist_edges))
+    return _CUMULATIVE
+
+
+def disable_cumulative() -> None:
+    global _CUMULATIVE
+    _CUMULATIVE = None
+
+
+def cumulative() -> Optional[CumulativeMetrics]:
+    """The live cumulative registry, or None outside a daemon."""
+    return _CUMULATIVE
 
 
 def obs_active() -> bool:
@@ -62,29 +197,39 @@ def counter_add(name: str, n: int = 1) -> None:
     run = trace._current()
     if run is not None:
         run.counter_add(name, n)
+    cum = _CUMULATIVE
+    if cum is not None:
+        cum.counter_add(name, n)
 
 
 def gauge_set(name: str, value) -> None:
     run = trace._current()
     if run is not None:
         run.gauge_set(name, value)
+    cum = _CUMULATIVE
+    if cum is not None:
+        cum.gauge_set(name, value)
 
 
 def hist_observe(name: str, value: float) -> None:
     run = trace._current()
     if run is not None:
         run.hist_observe(name, value)
+    cum = _CUMULATIVE
+    if cum is not None:
+        cum.hist_observe(name, value)
 
 
 class _HistTimer:
     """Metrics-only timer: observes elapsed ms into a histogram without
     creating a span record (for per-op sites too hot for the span log,
-    e.g. one ZooKeeper RPC per znode)."""
+    e.g. one ZooKeeper RPC per znode). Routes through :func:`hist_observe`
+    so the observation reaches the run capture AND the cumulative
+    registry."""
 
-    __slots__ = ("_run", "_name", "_t0")
+    __slots__ = ("_name", "_t0")
 
-    def __init__(self, run, name) -> None:
-        self._run = run
+    def __init__(self, name) -> None:
         self._name = name
 
     def __enter__(self) -> None:
@@ -92,7 +237,7 @@ class _HistTimer:
         return None
 
     def __exit__(self, *exc) -> bool:
-        self._run.hist_observe(
+        hist_observe(
             self._name, (time.perf_counter() - self._t0) * 1000.0
         )
         return False
@@ -100,11 +245,10 @@ class _HistTimer:
 
 def hist_ms(name: str):
     """Context manager observing the block's wall ms into histogram
-    ``name``; the shared no-op singleton when disabled."""
-    run = trace._current()
-    if run is None:
+    ``name``; the shared no-op singleton when nothing records."""
+    if trace._current() is None and _CUMULATIVE is None:
         return trace.NULL_SPAN
-    return _HistTimer(run, name)
+    return _HistTimer(name)
 
 
 def resolve_hist_edges() -> Tuple[float, ...]:

@@ -1,0 +1,101 @@
+"""The declared metric/span name registry — ``utils/env.py`` for telemetry.
+
+Every metric and span name the package emits with a LITERAL first argument
+is declared here exactly once; kalint rule KA013 sweeps the whole package
+and fails the lint gate on any literal write to an undeclared name. The
+failure mode this kills: a typo'd metric name today creates a fresh,
+never-queried registry entry while the dashboard watches the real name
+forever — silent on both ends, exactly the drift class KA003 closed for
+knobs.
+
+Dynamic names are the registered COMPOSITION points, not loopholes: the
+multi-cluster label suffix (``supervisor._metric`` → ``name@cluster``),
+per-kind fault counters (``faults.injected.<kind>``), and per-program
+warm-up outcomes (``warmup.<program>``) build on bases declared here and
+reach the registry through variables/f-strings, which KA013 deliberately
+skips. Prometheus exposition (``obs/promtext.py``) derives family names
+mechanically from these (dots → underscores, ``ka_`` prefix, counters get
+``_total``), so this table is ALSO the scrape's name contract — the README
+metric-name table is written from it.
+
+House rule for additions: declare the name here IN THE SAME CHANGE that
+introduces the write; group by namespace; never delete a name a dashboard
+may still query without saying so in the PR.
+"""
+from __future__ import annotations
+
+#: Counter / gauge / histogram names (the write API's first argument).
+METRIC_NAMES: frozenset = frozenset({
+    # zk.* — metadata-layer I/O (every backend counts here)
+    "zk.reads", "zk.writes", "zk.bytes", "zk.op_ms",
+    "zk.topics_missing", "zk.watch_events",
+    "zk.session.reestablished", "zk.write_readback_confirmed",
+    "zk.wire_frames_in", "zk.wire_frames_out",
+    "zk.wire_bytes_in", "zk.wire_bytes_out",
+    "zk.pipeline.batches", "zk.pipeline.rtts_saved",
+    "zk.pipeline.in_flight", "zk.pipeline.batch_ms",
+    # ingest.* — streamed ingest/encode overlap
+    "ingest.topics", "ingest.topics_skipped",
+    "ingest.encode_ms", "ingest.overlap_ms",
+    # encode.* — host→device canonicalization
+    "encode.topics", "encode.p_pad", "encode.pad_waste_frac",
+    # plan.* — lifted into the report's plan section
+    "plan.moves", "plan.leader_churn", "plan.topics", "plan.partitions",
+    "plan.waves", "plan.moves_submitted", "plan.noops",
+    "plan.skipped_moves", "plan.verify_mismatches", "plan.unplanned_topics",
+    # whatif.* — scenario-sweep fan-out
+    "whatif.scenarios", "whatif.fanout", "whatif.dispatch_ms",
+    "whatif.incremental_sweeps", "whatif.rescued",
+    # per-backend solve counters
+    "greedy.assigns", "greedy.partitions",
+    "native.assigns", "native.partitions",
+    "solver.assign_calls", "solver.fresh_calls", "solve.fallbacks",
+    # compile.store.* — persistent program store
+    "compile.store.hits", "compile.store.misses",
+    "compile.store.exec_fallbacks", "compile.store.unbucketed",
+    "compile.store.loads_ms", "compile.store.compiles_ms",
+    # warmup.* — ingest-overlapped warm-up ("warmup.<program>" composes
+    # dynamically on this base)
+    "warmup.failures",
+    # faults.* — injection accounting ("faults.injected.<kind>" composes)
+    "faults.injected",
+    # exec.* — plan execution engine
+    "exec.waves", "exec.moves", "exec.retries", "exec.write_retries",
+    "exec.skipped", "exec.verify", "exec.wave_ms",
+    # daemon.* — the resident daemon (cluster-lifetime counters; the
+    # multi-cluster "@cluster" label composes via supervisor._metric)
+    "daemon.requests", "daemon.requests_degraded", "daemon.requests_shed",
+    "daemon.requests_unsynced", "daemon.request_errors",
+    "daemon.churn_retries", "daemon.solve_fallbacks",
+    "daemon.watchdog_exceeded", "daemon.reencode.topics",
+    "daemon.resyncs", "daemon.resync_failures", "daemon.session_lost",
+    "daemon.watch_events", "daemon.watch_dropped", "daemon.watch_errors",
+    "daemon.warmups", "daemon.warmup_failures",
+    "daemon.breaker_opened", "daemon.breaker_probes",
+    "daemon.breaker_closed",
+    "daemon.executes", "daemon.execute_conflicts", "daemon.execute_halts",
+    "daemon.execute_errors", "daemon.execute_interrupted",
+    "daemon.execute_stream_broken",
+    # daemon.http.* — the routing layer's per-endpoint telemetry
+    # (ISSUE 10; labeled endpoint × cluster × code, cumulative-only)
+    "daemon.http.requests", "daemon.http.request_ms",
+})
+
+#: Span names (``span(...)`` / ``record_span(...)`` first argument).
+#: Hierarchical paths are derived from nesting at runtime; "mode/<MODE>"
+#: composes dynamically from the CLI mode.
+SPAN_NAMES: frozenset = frozenset({
+    "metadata/assignment", "ingest/stream", "feasibility",
+    "plan/solve", "plan/fresh", "plan/emit",
+    "encode", "solve", "decode",
+    "whatif/rank", "whatif/incremental", "whatif/dispatch",
+    "whatif/rescue",
+    "zk/brokers", "zk/partition_assignment",
+    "native/assign_many",
+    "warmup",
+    "exec/wave", "exec/submit", "exec/poll", "exec/verify",
+    "daemon/request", "daemon/resync",
+})
+
+#: Both namespaces — what the supervisor's ``_metric`` wrapper may label.
+ALL_NAMES: frozenset = METRIC_NAMES | SPAN_NAMES

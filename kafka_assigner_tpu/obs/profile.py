@@ -1,22 +1,120 @@
-"""Device-profiler hook: the op-level view the span log cannot give.
+"""Device-profiler hooks: the op-level view the span log cannot give.
 
-Spans record host wall-clock per phase; ``device_trace`` captures a full
-``jax.profiler`` trace (TensorBoard/XProf xplane) of everything inside the
-block — wired to each batched solve by ``KA_PROFILE=<dir>``
-(``assigner.py``). Lives in ``obs/`` (it IS observability) but imports jax
-strictly lazily: importing this package must never initialize a backend
-(kalint KA006 posture).
+Spans record host wall-clock per phase; a ``jax.profiler`` trace captures
+the full device timeline (TensorBoard/XProf xplane) — where an XLA solve's
+milliseconds actually go. Two entry points (ISSUE 10 satellite):
+
+- **per-dispatch tracing** (:func:`dispatch_trace`): gated on
+  ``KA_OBS_PROFILE_DIR`` (or the legacy ``KA_PROFILE``), wraps each batched
+  solve dispatch (``assigner.py``). Unset — the default — it costs two env
+  reads and yields immediately: zero profiler state, zero files.
+- **window capture** (:func:`capture_window`): the daemon's
+  ``/debug/profile?seconds=N`` endpoint captures one N-second trace of
+  whatever the device is doing RIGHT NOW (a wedged solve, a hot what-if
+  sweep) and returns the artifact directory — profiling a resident process
+  without restarting it.
+
+One process-wide profiler session: jax supports a single active trace, so
+both paths share a non-blocking lock — a dispatch trace overlapping a
+window capture SKIPS tracing (observability is best-effort; the solve must
+never fail because the profiler was busy).
+
+Lives in ``obs/`` (it IS observability) but imports jax strictly lazily:
+importing this package must never initialize a backend (kalint KA006).
 """
 from __future__ import annotations
 
 import contextlib
-from typing import Iterator
+import threading
+from typing import Iterator, Optional
+
+#: One active jax profiler session per process (jax's own constraint).
+_PROFILER_LOCK = threading.Lock()
+
+#: /debug/profile window bounds: long enough to catch a solve, short
+#: enough that the handler thread (which sleeps through the window) can
+#: never wedge the daemon for minutes.
+MAX_WINDOW_S = 30.0
+MIN_WINDOW_S = 0.05
+
+
+class ProfilerBusy(RuntimeError):
+    """A trace is already being captured (window vs. window, or a dispatch
+    trace holds the profiler) — the caller should retry later."""
+
+
+def profile_dir() -> Optional[str]:
+    """The configured trace directory: ``KA_OBS_PROFILE_DIR``, falling back
+    to the legacy ``KA_PROFILE`` knob; None when profiling is off."""
+    from ..utils.env import env_str
+
+    return env_str("KA_OBS_PROFILE_DIR") or env_str("KA_PROFILE")
 
 
 @contextlib.contextmanager
 def device_trace(log_dir: str) -> Iterator[None]:
-    """Capture a device profile (TPU trace) for everything in the block."""
+    """Capture a device profile (TPU trace) for everything in the block.
+    The raw primitive — no gating, no lock arbitration; callers that may
+    race a window capture use :func:`dispatch_trace` instead."""
     import jax
 
     with jax.profiler.trace(log_dir):
         yield
+
+
+@contextlib.contextmanager
+def dispatch_trace() -> Iterator[None]:
+    """The per-solve-dispatch hook: trace the block into the configured
+    profile directory when one is set; otherwise (or when the profiler is
+    busy with a window capture) yield untraced. Zero overhead when unset —
+    two env reads, no jax import."""
+    log_dir = profile_dir()
+    if not log_dir:
+        yield
+        return
+    if not _PROFILER_LOCK.acquire(blocking=False):
+        # A /debug/profile window owns the profiler: skip this dispatch's
+        # trace rather than fail the solve (best-effort observability).
+        yield
+        return
+    try:
+        with device_trace(log_dir):
+            yield
+    finally:
+        _PROFILER_LOCK.release()
+
+
+def capture_window(seconds: float,
+                   out_dir: Optional[str] = None) -> str:
+    """Capture one bounded trace window of live device activity into the
+    profile directory and return it (the ``/debug/profile`` body). Raises
+    ``RuntimeError`` when profiling is disabled (no directory configured),
+    :class:`ProfilerBusy` when another capture holds the profiler, and
+    ``ValueError`` on a nonsensical window."""
+    import time
+
+    log_dir = out_dir or profile_dir()
+    if not log_dir:
+        raise RuntimeError(
+            "device profiling is disabled: set KA_OBS_PROFILE_DIR to a "
+            "trace output directory"
+        )
+    seconds = float(seconds)
+    if not (seconds == seconds and seconds > 0):  # NaN-safe positivity
+        raise ValueError(f"seconds must be positive, got {seconds!r}")
+    seconds = min(max(seconds, MIN_WINDOW_S), MAX_WINDOW_S)
+    if not _PROFILER_LOCK.acquire(blocking=False):
+        raise ProfilerBusy(
+            "a profiler capture is already in progress; retry when it ends"
+        )
+    try:
+        import jax
+
+        jax.profiler.start_trace(log_dir)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+    finally:
+        _PROFILER_LOCK.release()
+    return log_dir

@@ -36,6 +36,8 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import threading
+import time
 from typing import List, Optional, Sequence
 
 from .trace import RunCollector
@@ -150,6 +152,75 @@ def emit_report(
     if written:
         print(f"obs: report written: {written}", file=err)
     return written
+
+
+class AccessLog:
+    """The daemon's structured NDJSON access log (ISSUE 10): exactly one
+    JSON line per served request, to the ``KA_OBS_ACCESS_LOG`` path (append
+    mode — restarts extend, never clobber) or stderr when unset.
+
+    Line schema (sorted keys; consumers should tolerate additions)::
+
+        {"ts": epoch_s, "request_id": "...", "method": "POST",
+         "path": "/plan", "cluster": "west" | null, "code": 200,
+         "status": "ok" | "degraded" | "error" | null,
+         "ms": 12.3, "inflight": 1, "stale": false, "degraded": false}
+
+    ``status`` is the request's run-report status (null for GET probes),
+    ``inflight`` the owning cluster's admitted-request depth at completion,
+    ``stale``/``degraded`` the staleness/degradation markers a dashboards
+    alert on without parsing the envelope. Thread-safe (one lock, one
+    line-buffered stream); a failing write is reported once on stderr and
+    the log disables itself — telemetry must never take down the serving
+    path it is describing.
+    """
+
+    def __init__(self, path: Optional[str] = None, err=None) -> None:
+        self._err = err if err is not None else sys.stderr
+        self._lock = threading.Lock()
+        self._path = path
+        self._fh = None
+        self._dead = False
+        if path:
+            try:
+                self._fh = open(path, "a", encoding="utf-8")
+            except OSError as e:
+                print(
+                    f"obs: could not open access log {path!r}: {e}; "
+                    "falling back to stderr",
+                    file=self._err,
+                )
+
+    def log(self, **fields) -> None:
+        if self._dead:
+            return
+        fields.setdefault("ts", round(time.time(), 3))
+        # kalint: disable=KA005 -- access-log line, not a Kafka plan payload
+        line = json.dumps(fields, sort_keys=True, default=str)
+        with self._lock:
+            try:
+                stream = self._fh if self._fh is not None else self._err
+                stream.write(line + "\n")
+                stream.flush()
+            except (OSError, ValueError) as e:
+                self._dead = True
+                print(
+                    f"obs: access log write failed ({e}); access logging "
+                    "disabled for this process",
+                    file=self._err,
+                )
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError as e:
+                    print(
+                        f"obs: access log close failed ({e})",
+                        file=self._err,
+                    )
+                self._fh = None
 
 
 def validate_report(obj) -> List[str]:

@@ -45,8 +45,21 @@ class RunCollector:
         self.gauges: Dict[str, float] = {}
         self.hists: Dict[str, dict] = {}
         self.hist_edges: Tuple[float, ...] = tuple(hist_edges)
+        #: Correlation keys stamped into every span recorded AFTER
+        #: :meth:`annotate` (ISSUE 10: the daemon stamps ``request_id``
+        #: first thing in each request capture, so every span of that
+        #: request carries it). Empty for CLI runs — their span records
+        #: stay byte-identical.
+        self.annotations: Dict[str, str] = {}
         self._stack: List[tuple] = []  # (span index | None, leaf name)
         self._lock = threading.Lock()
+
+    def annotate(self, key: str, value: str) -> None:
+        """Stamp a correlation field (e.g. ``request_id``) into every span
+        this run records from now on. Core span keys are protected — an
+        annotation can never overwrite name/path/ms/status."""
+        with self._lock:
+            self.annotations[str(key)] = str(value)
 
     # -- spans (single-threaded: the CLI orchestration thread) -------------
 
@@ -65,14 +78,17 @@ class RunCollector:
                 if idx is not None:
                     parent = idx
                     break
-            self.spans.append({
+            rec = {
                 "name": name,
                 "path": path,
                 "parent": parent,
                 "depth": depth,
                 "ms": 0.0,
                 "status": "open",
-            })
+            }
+            for k, v in self.annotations.items():
+                rec.setdefault(k, v)
+            self.spans.append(rec)
             self._stack.append((len(self.spans) - 1, name))
             return len(self.spans) - 1
 
@@ -93,14 +109,17 @@ class RunCollector:
             if len(self.spans) >= MAX_SPANS:
                 self.spans_dropped += 1
                 return
-            self.spans.append({
+            rec = {
                 "name": name,
                 "path": name,
                 "parent": -1,
                 "depth": 0,
                 "ms": round(ms, 3),
                 "status": "ok" if ok else "error",
-            })
+            }
+            for k, v in self.annotations.items():
+                rec.setdefault(k, v)
+            self.spans.append(rec)
 
     # -- metrics (written through obs/metrics.py) ---------------------------
 

@@ -476,6 +476,39 @@ _knob(
         "used",
 )
 _knob(
+    "KA_OBS_ACCESS_LOG", "str", None, default_doc="unset (stderr)",
+    doc="path of the daemon's structured NDJSON access log (one JSON line "
+        "per served request: request id, method, path, cluster, HTTP code, "
+        "report status, duration ms, inflight depth, stale/degraded "
+        "markers; appended across restarts). Unset: the lines go to "
+        "stderr. `ka-daemon --access-log PATH` overrides",
+)
+_knob(
+    "KA_OBS_FLIGHT_EVENTS", "int", 512, floor=0,
+    doc="flight-recorder ring capacity: the daemon retains this many "
+        "recent lifecycle/breaker/session/resync/watch/watchdog/request/"
+        "fault events in memory (`obs/flight.py`), dumpable via "
+        "`/debug/flight` and flushed to `KA_OBS_FLIGHT_DUMP` on SIGTERM "
+        "or crash; overflow evicts oldest and is counted (`dropped`). "
+        "0 disables the recorder",
+)
+_knob(
+    "KA_OBS_FLIGHT_DUMP", "str", None,
+    default_doc="unset (live /debug/flight only)",
+    doc="when set, the daemon flushes its flight-recorder ring to this "
+        "path as NDJSON on SIGTERM drain AND on a crashing exit — the "
+        "post-mortem artifact that replaces scraping stderr after a "
+        "chaos-soak failure",
+)
+_knob(
+    "KA_OBS_PROFILE_DIR", "str", None, default_doc="unset (no profiling)",
+    doc="device-profiler output directory: gates the `jax.profiler` trace "
+        "around each batched solve dispatch (`obs/profile.py`; supersedes "
+        "the legacy `KA_PROFILE`, which still works) and enables the "
+        "daemon's `/debug/profile?seconds=N` window capture. Unset "
+        "(default): zero profiler overhead, /debug/profile refuses",
+)
+_knob(
     "KA_DEVICE_WATCHDOG_S", "float", 0.0, floor=0.0,
     doc="console entry point probes accelerator init in a subprocess for "
         "this many seconds and falls back to the CPU backend (with a stderr "

@@ -876,6 +876,23 @@ def _mc_breaker_row(args, report_dir, policy):
         if not counters.get("daemon.breaker_opened") \
                 or not counters.get("daemon.breaker_closed"):
             return f"{tag}: breaker transitions not counted ({counters})"
+        # ISSUE 10 acceptance: after a breaker cycle the flight recorder
+        # holds the open -> half-open -> closed transitions IN ORDER (the
+        # post-mortem trail a dead-quorum incident is reconstructed from).
+        from kafka_assigner_tpu.obs import flight
+
+        rec = flight.recorder()
+        states = [
+            e["state"] for e in (rec.snapshot() if rec else [])
+            if e["kind"] == "breaker" and e.get("cluster") == "west"
+        ]
+        try:
+            i = states.index("open")
+            j = states.index("half-open", i + 1)
+            states.index("closed", j + 1)
+        except ValueError:
+            return (f"{tag}: flight recorder missing the ordered "
+                    f"open -> half-open -> closed breaker trail ({states})")
         return None
     finally:
         if daemon is not None:

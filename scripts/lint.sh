@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # The project lint gate: kalint (knob-registry + jit-boundary + write-path
-# + deadline + bulkhead house rules, KA001-KA012), the README knob-table
-# drift check,
+# + deadline + bulkhead + telemetry-name house rules, KA001-KA013), the
+# README knob-table drift check,
 # the run-report fixture schema check, the fault-matrix smoke (one injected
 # fault per class — read, write AND daemon seams — strict + best-effort),
 # the exec crash→resume smoke, the daemon lifecycle smoke, and ruff
@@ -40,6 +40,12 @@ python scripts/daemon_smoke.py
 # a REAL SIGTERM at a wave boundary → restart → resume=1 → final cluster
 # state byte-identical to an uninterrupted offline ka-execute run.
 python scripts/daemon_smoke.py --multi
+# Telemetry-plane smoke (ISSUE 10): real ka-daemon subprocess — /metrics
+# parses as Prometheus exposition (histograms consistent, counters monotone
+# across two scrapes), request ids correlate header/envelope/spans/access
+# log, /debug/flight matches the injected fault schedule, SIGTERM flushes
+# the flight dump.
+python scripts/metrics_smoke.py
 # Warm-start smoke (ISSUE 6): program store populate -> clear-memory -> hit
 # on the CPU backend, byte-identical output, compile.store.hits >= 1. The
 # fresh-process bench is the slow-marked tests/test_bench_warmstart.py.

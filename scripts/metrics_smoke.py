@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Telemetry-plane smoke (tier-1, via scripts/lint.sh): the daemon's live
+telemetry end to end against a REAL ``ka-daemon`` subprocess (ISSUE 10).
+
+What it proves, in a few seconds:
+
+1.  ``/metrics`` serves valid Prometheus text exposition: the scrape
+    round-trips through the in-tree parser (``obs/promtext.py``), the
+    required process/build-info families are present, and EVERY histogram
+    family is internally consistent (buckets cumulative-monotone, ``+Inf``
+    == ``_count``, finite ``_sum``);
+2.  counters are monotone across two scrapes separated by real traffic
+    (``ka_daemon_requests_total`` strictly increases);
+3.  request correlation: a client-supplied ``X-Request-Id`` is echoed in
+    the response header AND the envelope AND that request's spans, a
+    daemon-generated id appears when none is supplied, and the NDJSON
+    access log carries exactly ONE line per served request with the
+    matching ids;
+4.  the flight recorder (``/debug/flight``) contains the injected fault
+    schedule (diffed event-for-event against ``KA_FAULTS_SPEC``), the
+    session-loss/resync trail behind it, and per-request summaries;
+5.  SIGTERM flushes the ring to ``KA_OBS_FLIGHT_DUMP`` (the
+    crash-surviving post-mortem artifact) and the daemon still exits 0.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from scripts.daemon_smoke import BANNER_RE  # noqa: E402  (same banner contract)
+
+FAULT_SPEC = "session:1=expire"
+
+
+def _req(port, method, path, payload=None, headers=None, timeout=60.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        body = None if payload is None else json.dumps(payload)
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, resp.read(), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def _counter_samples(families):
+    """{(name, labels-tuple): value} over every counter family."""
+    out = {}
+    for fam, data in families.items():
+        if data["type"] != "counter":
+            continue
+        for name, labels, value in data["samples"]:
+            out[(name, tuple(sorted(labels.items())))] = value
+    return out
+
+
+def _scrape(port):
+    from kafka_assigner_tpu.obs import promtext
+
+    s, raw, _ = _req(port, "GET", "/metrics")
+    if s != 200:
+        raise SystemExit(f"FAIL: /metrics http={s}")
+    text = raw.decode("utf-8")
+    families = promtext.parse(text)  # raises PromParseError on bad format
+    for fam, data in families.items():
+        if data["type"] == "histogram":
+            problems = promtext.check_histogram(data)
+            if problems:
+                raise SystemExit(
+                    f"FAIL: histogram {fam} inconsistent: {problems}"
+                )
+    return families
+
+
+def main() -> int:
+    from tests.jute_server import JuteZkServer, cluster_tree
+
+    server = JuteZkServer(cluster_tree())
+    server.start()
+    tmp = tempfile.mkdtemp(prefix="ka_metrics_smoke_")
+    access_path = os.path.join(tmp, "access.ndjson")
+    dump_path = os.path.join(tmp, "flight.ndjson")
+    daemon = None
+    stderr_lines = []
+    requests_made = 0
+    try:
+        env = {
+            **os.environ,
+            "KA_ZK_CLIENT": "wire",
+            "KA_FAULTS_SPEC": FAULT_SPEC,
+            "KA_DAEMON_RESYNC_INTERVAL": "1.0",
+            "KA_OBS_ACCESS_LOG": access_path,
+            "KA_OBS_FLIGHT_DUMP": dump_path,
+        }
+        daemon = subprocess.Popen(
+            [sys.executable, "-c",
+             "from kafka_assigner_tpu.cli import daemon_main; daemon_main()",
+             "--zk_string", f"127.0.0.1:{server.port}",
+             "--solver", "greedy"],
+            cwd=REPO, env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        import threading
+
+        banner = {}
+        ready = threading.Event()
+
+        def _drain():
+            for line in daemon.stderr:
+                stderr_lines.append(line)
+                m = BANNER_RE.search(line)
+                if m:
+                    banner["port"] = int(m.group(2))
+                    ready.set()
+
+        threading.Thread(target=_drain, daemon=True).start()
+        if not ready.wait(60) or "port" not in banner:
+            print("FAIL: daemon never announced its port\n"
+                  + "".join(stderr_lines), file=sys.stderr)
+            return 1
+        port = banner["port"]
+
+        # 1+3. correlated /plan: client-supplied id echoes everywhere
+        rid = "metrics-smoke-rid-0"
+        s, raw, h = _req(port, "POST", "/plan", {},
+                         {"X-Request-Id": rid})
+        requests_made += 1
+        body = json.loads(raw)
+        if s != 200 or body["status"] != "ok":
+            print(f"FAIL: first /plan http={s} "
+                  f"status={body.get('status')!r}", file=sys.stderr)
+            return 1
+        if h.get("X-Request-Id") != rid:
+            print(f"FAIL: X-Request-Id header not echoed ({h})",
+                  file=sys.stderr)
+            return 1
+        if body["result"].get("request_id") != rid:
+            print("FAIL: request_id missing from the response envelope",
+                  file=sys.stderr)
+            return 1
+        span_rids = {sp.get("request_id") for sp in body["spans"]}
+        if span_rids != {rid}:
+            print(f"FAIL: spans not stamped with the request id "
+                  f"({span_rids})", file=sys.stderr)
+            return 1
+
+        # 2. scrape #1: valid exposition, required families, consistency
+        fams1 = _scrape(port)
+        requests_made += 1
+        for needed in ("ka_build_info", "ka_process_start_time_seconds",
+                       "ka_daemon_requests_total",
+                       "ka_daemon_http_request_ms"):
+            if needed not in fams1:
+                print(f"FAIL: scrape missing family {needed} "
+                      f"(have {sorted(fams1)})", file=sys.stderr)
+                return 1
+
+        # the expiry request: fault fires mid-request (daemon-generated id)
+        s, raw, h = _req(port, "POST", "/plan", {})
+        requests_made += 1
+        body = json.loads(raw)
+        gen_rid = body["result"].get("request_id")
+        if s != 200 or body["status"] != "degraded" or not gen_rid:
+            print(f"FAIL: expiry /plan http={s} "
+                  f"status={body.get('status')!r} rid={gen_rid!r}",
+                  file=sys.stderr)
+            return 1
+        if h.get("X-Request-Id") != gen_rid:
+            print("FAIL: generated request id not echoed in the header",
+                  file=sys.stderr)
+            return 1
+
+        # 2. scrape #2: counters monotone, traffic visible
+        fams2 = _scrape(port)
+        requests_made += 1
+        c1, c2 = _counter_samples(fams1), _counter_samples(fams2)
+        for key, v1 in c1.items():
+            if key in c2 and c2[key] < v1:
+                print(f"FAIL: counter {key} went backwards "
+                      f"({v1} -> {c2[key]})", file=sys.stderr)
+                return 1
+        req1 = [v for (n, _), v in c1.items()
+                if n == "ka_daemon_requests_total"]
+        req2 = [v for (n, _), v in c2.items()
+                if n == "ka_daemon_requests_total"]
+        if not req1 or not req2 or sum(req2) <= sum(req1):
+            print(f"FAIL: ka_daemon_requests_total not strictly "
+                  f"increasing ({req1} -> {req2})", file=sys.stderr)
+            return 1
+
+        # 4. flight recorder vs the injected schedule
+        s, raw, _ = _req(port, "GET", "/debug/flight")
+        requests_made += 1
+        if s != 200:
+            print(f"FAIL: /debug/flight http={s}", file=sys.stderr)
+            return 1
+        view = json.loads(raw)
+        events = view["events"]
+        fired = [e["spec"] for e in events if e["kind"] == "fault"]
+        if fired != [FAULT_SPEC]:
+            print(f"FAIL: flight fault events {fired} != injected "
+                  f"schedule [{FAULT_SPEC!r}]", file=sys.stderr)
+            return 1
+        kinds = {e["kind"] for e in events}
+        for needed in ("daemon", "resync", "session", "request"):
+            if needed not in kinds:
+                print(f"FAIL: flight recorder missing {needed!r} events "
+                      f"(have {sorted(kinds)})", file=sys.stderr)
+                return 1
+        flight_rids = {e.get("request_id")
+                       for e in events if e["kind"] == "request"}
+        if not {rid, gen_rid} <= flight_rids:
+            print(f"FAIL: request ids {rid!r}/{gen_rid!r} not in flight "
+                  f"request summaries ({flight_rids})", file=sys.stderr)
+            return 1
+
+        # 5. SIGTERM: drain, exit 0, ring flushed to the dump file
+        daemon.send_signal(signal.SIGTERM)
+        rc = daemon.wait(timeout=60)
+        if rc != 0:
+            print(f"FAIL: daemon exit code {rc} after SIGTERM\n"
+                  + "".join(stderr_lines), file=sys.stderr)
+            return 1
+        if not os.path.exists(dump_path):
+            print("FAIL: KA_OBS_FLIGHT_DUMP never written", file=sys.stderr)
+            return 1
+        with open(dump_path, "r", encoding="utf-8") as f:
+            dumped = [json.loads(line) for line in f if line.strip()]
+        dump_kinds = {e["kind"] for e in dumped}
+        if "fault" not in dump_kinds or "daemon" not in dump_kinds:
+            print(f"FAIL: flight dump incomplete (kinds {dump_kinds})",
+                  file=sys.stderr)
+            return 1
+        if not any(e["kind"] == "daemon" and e.get("event") == "stopped"
+                   for e in dumped):
+            print("FAIL: flight dump missing the stopped event",
+                  file=sys.stderr)
+            return 1
+
+        # 3. access log: exactly one line per served request, ids present
+        with open(access_path, "r", encoding="utf-8") as f:
+            lines = [json.loads(line) for line in f if line.strip()]
+        if len(lines) != requests_made:
+            print(f"FAIL: access log has {len(lines)} lines for "
+                  f"{requests_made} requests", file=sys.stderr)
+            return 1
+        logged_rids = {ln["request_id"] for ln in lines}
+        if not {rid, gen_rid} <= logged_rids:
+            print(f"FAIL: access log missing request ids ({logged_rids})",
+                  file=sys.stderr)
+            return 1
+        for ln in lines:
+            for key in ("ts", "request_id", "method", "path", "code",
+                        "ms", "inflight", "stale", "degraded"):
+                if key not in ln:
+                    print(f"FAIL: access-log line missing {key!r}: {ln}",
+                          file=sys.stderr)
+                    return 1
+
+        print("metrics_smoke: PASS (exposition parses + histograms "
+              "consistent; counters monotone across scrapes; request ids "
+              "in header/envelope/spans/access-log; flight == fault "
+              "schedule; SIGTERM flushed the dump)", file=sys.stderr)
+        return 0
+    finally:
+        if daemon is not None and daemon.poll() is None:
+            daemon.kill()
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

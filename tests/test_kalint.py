@@ -764,3 +764,106 @@ def test_ka012_suppressible_with_reason():
     assert "KA012" not in rules_of(
         kalint.lint_source(src, "daemon/service.py")
     )
+
+
+# --- KA013: metric/span names must come from the declared registry ------------
+
+def test_ka013_trips_on_typod_metric_name():
+    src = (
+        "from ..obs.metrics import counter_add\n"
+        "def f():\n"
+        '    counter_add("daemon.requestz")\n'  # typo: would vanish silently
+    )
+    findings = kalint.lint_source(src, "daemon/foo.py")
+    ka013 = [f for f in findings if f.rule == "KA013"]
+    assert len(ka013) == 1 and "daemon.requestz" in ka013[0].message
+
+
+@pytest.mark.parametrize("line", [
+    'obs.counter_add("daemon.requests")',       # attribute-call form
+    'gauge_set("plan.moves", 3)',
+    'hist_observe("zk.op_ms", 1.0)',
+    'with hist_ms("zk.pipeline.batch_ms"): pass',
+    'with span("encode"): pass',
+    'record_span("daemon/resync", 1.0)',        # _metric composes on this
+    'self._count("daemon.breaker_opened")',
+    'self._metric("daemon/request")',
+    'with span("solve", hist="exec.wave_ms"): pass',
+])
+def test_ka013_declared_names_are_clean(line):
+    src = f"def f(self):\n    {line}\n"
+    assert "KA013" not in rules_of(kalint.lint_source(src, "foo.py"))
+
+
+@pytest.mark.parametrize("line,needle", [
+    ('span("enc0de")', "enc0de"),                       # span typo
+    ('record_span("daemon/resink", 1.0)', "daemon/resink"),
+    ('self._count("daemon.breaker_openend")', "breaker_openend"),
+    ('self._metric("daemon/requets")', "daemon/requets"),
+    ('hist_ms("zk.op_mss")', "zk.op_mss"),
+    ('span("solve", hist="exec.wave_mss")', "exec.wave_mss"),
+])
+def test_ka013_trips_on_each_namespace(line, needle):
+    src = f"def f(self):\n    {line}\n"
+    findings = kalint.lint_source(src, "foo.py")
+    ka013 = [f for f in findings if f.rule == "KA013"]
+    assert len(ka013) == 1 and needle in ka013[0].message
+
+
+def test_ka013_keyword_spelling_cannot_bypass():
+    findings = kalint.lint_source(
+        'def f():\n    counter_add(name="daemon.requestz")\n', "foo.py"
+    )
+    assert any(
+        f.rule == "KA013" and "daemon.requestz" in f.message
+        for f in findings
+    )
+    assert "KA013" not in rules_of(kalint.lint_source(
+        'def f():\n    span(name="encode")\n', "foo.py"
+    ))
+
+
+def test_ka013_skips_dynamic_names():
+    # Dynamic names are the REGISTERED composition points (cluster labels,
+    # per-kind fault counters) — never findings.
+    src = (
+        "def f(self, ev, name):\n"
+        '    counter_add(f"faults.injected.{ev.kind}")\n'
+        "    counter_add(name)\n"
+        "    span(self._metric('daemon/request'))\n"
+    )
+    assert "KA013" not in rules_of(kalint.lint_source(src, "foo.py"))
+
+
+def test_ka013_spans_and_metrics_are_separate_namespaces():
+    # A metric name handed to span() (or vice versa) is as wrong as a typo:
+    # the registry split is the contract.
+    findings = kalint.lint_source(
+        'def f():\n    span("daemon.requests")\n', "foo.py"
+    )
+    assert any(f.rule == "KA013" for f in findings)
+    findings = kalint.lint_source(
+        'def f():\n    counter_add("daemon/request")\n', "foo.py"
+    )
+    assert any(f.rule == "KA013" for f in findings)
+
+
+def test_ka013_suppressible_with_reason():
+    src = (
+        "def f():\n"
+        "    # kalint: disable=KA013 -- third-party sink, not our registry\n"
+        '    counter_add("vendor.custom.metric")\n'
+    )
+    assert "KA013" not in rules_of(kalint.lint_source(src, "foo.py"))
+
+
+def test_ka013_registry_tables_are_disjoint_and_nonempty():
+    from kafka_assigner_tpu.obs.names import (
+        ALL_NAMES,
+        METRIC_NAMES,
+        SPAN_NAMES,
+    )
+
+    assert METRIC_NAMES and SPAN_NAMES
+    assert not (METRIC_NAMES & SPAN_NAMES)
+    assert ALL_NAMES == METRIC_NAMES | SPAN_NAMES

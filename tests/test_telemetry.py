@@ -1,0 +1,593 @@
+"""The live telemetry plane (ISSUE 10): cumulative registry exactness under
+concurrency, Prometheus exposition round-trips, request-ID correlation,
+the flight recorder, the access log, and the profile hooks — unit layers
+plus in-process daemon integration against the jute server."""
+from __future__ import annotations
+
+import contextlib
+import http.client
+import io
+import json
+import math
+import threading
+import time
+
+import pytest
+
+from kafka_assigner_tpu import faults, obs
+from kafka_assigner_tpu.daemon import AssignerDaemon
+from kafka_assigner_tpu.obs import flight, promtext
+from kafka_assigner_tpu.obs import metrics as metrics_mod
+from kafka_assigner_tpu.obs.report import AccessLog
+
+from .jute_server import JuteZkServer, cluster_tree
+from .test_daemon import fresh_cli, req, running_daemon
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Every test starts (and leaves) the CLI's disabled state; daemons
+    constructed inside re-enable their own fresh plane."""
+    faults.reset()
+    metrics_mod.disable_cumulative()
+    flight.disable()
+    yield
+    faults.reset()
+    metrics_mod.disable_cumulative()
+    flight.disable()
+
+
+@pytest.fixture(autouse=True)
+def _daemon_env(monkeypatch):
+    monkeypatch.setenv("KA_ZK_CLIENT", "wire")
+    monkeypatch.setenv("KA_DAEMON_RESYNC_INTERVAL", "0.5")
+
+
+@pytest.fixture()
+def server():
+    s = JuteZkServer(cluster_tree())
+    s.start()
+    yield s
+    s.shutdown()
+
+
+# --- CumulativeMetrics -------------------------------------------------------
+
+def test_cumulative_splits_cluster_label_and_sums():
+    cum = metrics_mod.CumulativeMetrics(hist_edges=(1.0, 10.0))
+    cum.counter_add("daemon.requests@west", 2)
+    cum.counter_add("daemon.requests@west")
+    cum.counter_add("daemon.requests@east")
+    cum.counter_add("daemon.requests")  # single-cluster: no label
+    snap = cum.snapshot()
+    by_label = snap["counters"]["daemon.requests"]
+    assert by_label[(("cluster", "west"),)] == 3
+    assert by_label[(("cluster", "east"),)] == 1
+    assert by_label[()] == 1
+    assert cum.counter_value("daemon.requests@west") == 3
+    assert cum.counter_value(
+        "daemon.requests", labels={"cluster": "east"}
+    ) == 1
+
+
+def test_cumulative_labeled_hist_bucketing():
+    cum = metrics_mod.CumulativeMetrics(hist_edges=(1.0, 10.0))
+    for v in (0.5, 5.0, 50.0):
+        cum.hist_observe("daemon.http.request_ms", v,
+                         labels={"endpoint": "plan", "cluster": "a"})
+    snap = cum.snapshot()
+    key = (("cluster", "a"), ("endpoint", "plan"))
+    h = snap["hists"]["daemon.http.request_ms"][key]
+    assert h["counts"] == [1, 1, 1]
+    assert h["count"] == 3 and h["sum"] == 55.5
+
+
+def test_module_writes_feed_both_run_and_cumulative():
+    cum = metrics_mod.enable_cumulative(hist_edges=(1.0,))
+    with obs.run_capture() as run:
+        obs.counter_add("zk.reads", 3)
+        obs.gauge_set("plan.moves", 7)
+        obs.hist_observe("zk.op_ms", 0.5)
+        with obs.hist_ms("zk.op_ms"):
+            pass
+    # The per-run capture is untouched by the cumulative plane...
+    assert run.counters["zk.reads"] == 3
+    assert run.gauges["plan.moves"] == 7
+    assert run.hists["zk.op_ms"]["count"] == 2
+    # ...and the cumulative registry saw the same writes.
+    snap = cum.snapshot()
+    assert snap["counters"]["zk.reads"][()] == 3
+    assert snap["gauges"]["plan.moves"][()] == 7
+    assert snap["hists"]["zk.op_ms"][()]["count"] == 2
+    # Writes OUTSIDE any capture still accumulate (the daemon watch loop).
+    obs.counter_add("zk.reads", 2)
+    assert cum.counter_value("zk.reads") == 5
+    assert "zk.reads" not in run.counters or run.counters["zk.reads"] == 3
+
+
+def test_disabled_state_keeps_noop_singleton():
+    assert metrics_mod.cumulative() is None
+    from kafka_assigner_tpu.obs import trace as trace_mod
+
+    assert obs.hist_ms("zk.op_ms") is trace_mod.NULL_SPAN
+    # hist_ms with cumulative-only (no run capture) records there.
+    cum = metrics_mod.enable_cumulative(hist_edges=(1.0,))
+    with obs.hist_ms("zk.op_ms"):
+        pass
+    assert cum.snapshot()["hists"]["zk.op_ms"][()]["count"] == 1
+
+
+# --- promtext ----------------------------------------------------------------
+
+def _sample_snapshot():
+    cum = metrics_mod.CumulativeMetrics(hist_edges=(1.0, 10.0))
+    cum.counter_add("daemon.requests@west", 4)
+    cum.counter_add("daemon.requests")
+    cum.gauge_set("plan.moves", 12)
+    for v in (0.5, 5.0, 50.0):
+        cum.hist_observe("daemon.http.request_ms", v,
+                         labels={"endpoint": "plan", "cluster": "west"})
+    return cum.snapshot()
+
+
+def test_render_parse_round_trip():
+    text = promtext.render(
+        _sample_snapshot(),
+        extra_gauges={"process_uptime_seconds": 1.5},
+        info={"tool": "kafka-assignment-generator", "report_schema": "1"},
+    )
+    fams = promtext.parse(text)
+    assert fams["ka_build_info"]["type"] == "gauge"
+    [(name, labels, value)] = fams["ka_build_info"]["samples"]
+    assert value == 1 and labels["tool"] == "kafka-assignment-generator"
+    counters = {
+        tuple(sorted(lb.items())): v
+        for _, lb, v in fams["ka_daemon_requests_total"]["samples"]
+    }
+    assert counters[(("cluster", "west"),)] == 4
+    assert counters[()] == 1
+    assert fams["ka_process_uptime_seconds"]["samples"][0][2] == 1.5
+    hist = fams["ka_daemon_http_request_ms"]
+    assert hist["type"] == "histogram"
+    assert promtext.check_histogram(hist) == []
+    # Cumulative bucket semantics: le=1 has 1, le=10 has 2, +Inf has 3.
+    buckets = {
+        lb["le"]: v for name, lb, v in hist["samples"]
+        if name.endswith("_bucket")
+    }
+    assert buckets == {"1": 1, "10": 2, "+Inf": 3}
+
+
+def test_parse_rejects_malformed_exposition():
+    with pytest.raises(promtext.PromParseError):
+        promtext.parse("ka_undeclared_total 3\n")  # no TYPE line
+    with pytest.raises(promtext.PromParseError):
+        promtext.parse("# TYPE ka_x counter\nka_x not-a-number\n")
+    with pytest.raises(promtext.PromParseError):
+        promtext.parse("# TYPE ka_x wat\n")
+    # label bodies are validated sequentially: a dropped comma or junk
+    # BETWEEN labels fails (Prometheus rejects both), not just trailing
+    with pytest.raises(promtext.PromParseError):
+        promtext.parse('# TYPE ka_x counter\nka_x{a="1"b="2"} 1\n')
+    with pytest.raises(promtext.PromParseError):
+        promtext.parse('# TYPE ka_x counter\nka_x{a="1" !! b="2"} 1\n')
+    # a trailing comma is legal exposition
+    fams = promtext.parse('# TYPE ka_x counter\nka_x{a="1",} 1\n')
+    assert fams["ka_x"]["samples"][0][1] == {"a": "1"}
+
+
+def test_check_histogram_flags_missing_le_instead_of_crashing():
+    text = (
+        "# TYPE ka_h histogram\n"
+        'ka_h_bucket{cluster="a"} 5\n'   # no le label at all
+        'ka_h_bucket{cluster="a",le="+Inf"} 5\n'
+        'ka_h_sum{cluster="a"} 1.0\nka_h_count{cluster="a"} 5\n'
+    )
+    problems = promtext.check_histogram(promtext.parse(text)["ka_h"])
+    assert any("le label" in p for p in problems)
+
+
+def test_check_histogram_flags_inconsistency():
+    text = (
+        "# TYPE ka_h histogram\n"
+        'ka_h_bucket{le="1"} 5\n'
+        'ka_h_bucket{le="10"} 3\n'   # not monotone
+        'ka_h_bucket{le="+Inf"} 9\n'
+        "ka_h_sum 1.0\nka_h_count 8\n"  # +Inf != count
+    )
+    problems = promtext.check_histogram(promtext.parse(text)["ka_h"])
+    assert any("monotone" in p for p in problems)
+    assert any("_count" in p for p in problems)
+
+
+def test_label_escaping_round_trips():
+    cum = metrics_mod.CumulativeMetrics()
+    cum.counter_add("daemon.requests", 1,
+                    labels={"cluster": 'we"st\\x\nq'})
+    text = promtext.render(cum.snapshot())
+    fams = promtext.parse(text)
+    [(_, labels, value)] = fams["ka_daemon_requests_total"]["samples"]
+    assert labels["cluster"] == 'we"st\\x\nq' and value == 1
+
+
+# --- flight recorder ---------------------------------------------------------
+
+def test_flight_ring_bounds_and_filters(tmp_path):
+    rec = flight.FlightRecorder(capacity=3)
+    for i in range(5):
+        rec.record("watch", "a" if i % 2 else "b", event=f"e{i}")
+    assert rec.dropped == 2
+    events = rec.snapshot()
+    assert [e["event"] for e in events] == ["e2", "e3", "e4"]
+    assert all(e["seq"] > 2 for e in events)
+    # cluster filter keeps that cluster's (and clusterless) events
+    rec.record("daemon", event="draining")
+    a_events = rec.snapshot(cluster="a")
+    assert {e.get("cluster", "a") for e in a_events} == {"a"}
+    assert any(e["kind"] == "daemon" for e in a_events)
+    # NDJSON flush
+    path = tmp_path / "flight.ndjson"
+    assert rec.flush(str(path)) == str(path)
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert [e["seq"] for e in lines] == [e["seq"] for e in rec.snapshot()]
+    # unwritable path: loud, swallowed
+    err = io.StringIO()
+    assert rec.flush(str(tmp_path / "no" / "dir.ndjson"), err=err) is None
+    assert "flight dump" in err.getvalue()
+
+
+def test_flight_module_activation(monkeypatch):
+    assert flight.recorder() is None
+    flight.record("daemon", event="ignored")  # disabled: no-op
+    monkeypatch.setenv("KA_OBS_FLIGHT_EVENTS", "2")
+    rec = flight.enable()
+    assert rec is flight.recorder() and rec.capacity == 2
+    monkeypatch.setenv("KA_OBS_FLIGHT_EVENTS", "0")
+    assert flight.enable() is None  # 0 disables
+    monkeypatch.setenv("KA_OBS_FLIGHT_DUMP", "")
+    flight.enable(capacity=4)
+    flight.record("daemon", event="x")
+    assert flight.flush_to_dump() is None  # no dump path: no-op
+
+
+# --- access log --------------------------------------------------------------
+
+def test_access_log_file_and_stderr(tmp_path):
+    path = tmp_path / "access.ndjson"
+    log = AccessLog(str(path))
+    log.log(request_id="r1", method="POST", path="/plan", code=200)
+    log.log(request_id="r2", method="GET", path="/healthz", code=200)
+    log.close()
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert [ln["request_id"] for ln in lines] == ["r1", "r2"]
+    assert all("ts" in ln for ln in lines)
+    # append across "restarts", never clobber
+    log2 = AccessLog(str(path))
+    log2.log(request_id="r3", method="POST", path="/plan", code=200)
+    log2.close()
+    assert len(path.read_text().splitlines()) == 3
+    # unset path: stderr stream
+    err = io.StringIO()
+    AccessLog(None, err=err).log(request_id="r4", code=503)
+    assert json.loads(err.getvalue())["request_id"] == "r4"
+    # unopenable path: loud fallback to stderr, not a crash
+    err = io.StringIO()
+    bad = AccessLog(str(tmp_path / "no" / "log.ndjson"), err=err)
+    assert "access log" in err.getvalue()
+    bad.log(request_id="r5", code=200)
+    assert '"request_id": "r5"' in err.getvalue()
+
+
+# --- span annotations --------------------------------------------------------
+
+def test_annotations_stamp_spans_recorded_after():
+    with obs.run_capture() as run:
+        with obs.span("before"):
+            pass
+        run.annotate("request_id", "rid-1")
+        with obs.span("encode"):
+            pass
+        from kafka_assigner_tpu.obs.trace import record_span
+
+        record_span("warmup", 1.0)
+    by_name = {s["name"]: s for s in run.spans}
+    assert "request_id" not in by_name["before"]
+    assert by_name["encode"]["request_id"] == "rid-1"
+    assert by_name["warmup"]["request_id"] == "rid-1"
+
+
+def test_cli_report_has_no_annotation_keys(tmp_path, capsys):
+    """CLI runs never annotate: the schema-v1 report's span records stay
+    byte-identical to PR 9 (no request_id key anywhere)."""
+    from kafka_assigner_tpu.cli import run_tool
+
+    cluster = {
+        "brokers": [
+            {"id": 100 + i, "host": f"h{i}", "port": 9092,
+             "rack": f"r{i % 3}"} for i in range(6)
+        ],
+        "topics": {"events": {
+            str(p): [100 + (p + i) % 5 for i in range(3)] for p in range(4)
+        }},
+    }
+    snap = tmp_path / "cluster.json"
+    snap.write_text(json.dumps(cluster))
+    report_path = tmp_path / "report.json"
+    rc = run_tool([
+        "--zk_string", f"file://{snap}", "--mode", "PRINT_REASSIGNMENT",
+        "--solver", "greedy", "--report-json", str(report_path),
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    report = json.loads(report_path.read_text())
+    assert all("request_id" not in s for s in report["spans"])
+
+
+# --- profile hooks -----------------------------------------------------------
+
+def test_profile_disabled_is_refusal_not_crash(monkeypatch):
+    from kafka_assigner_tpu.obs import profile
+
+    monkeypatch.delenv("KA_OBS_PROFILE_DIR", raising=False)
+    monkeypatch.delenv("KA_PROFILE", raising=False)
+    assert profile.profile_dir() is None
+    with pytest.raises(RuntimeError, match="KA_OBS_PROFILE_DIR"):
+        profile.capture_window(0.1)
+    with profile.dispatch_trace():  # zero-overhead no-op
+        pass
+
+
+def test_profile_window_capture_and_busy(monkeypatch, tmp_path):
+    from kafka_assigner_tpu.obs import profile
+
+    monkeypatch.setenv("KA_OBS_PROFILE_DIR", str(tmp_path))
+    with pytest.raises(ValueError):
+        profile.capture_window(float("nan"))
+    assert profile.capture_window(0.05) == str(tmp_path)
+    assert list(tmp_path.iterdir()), "no trace artifact written"
+    # busy: a held profiler lock refuses a second capture AND downgrades
+    # the dispatch hook to untraced instead of crashing the solve
+    assert profile._PROFILER_LOCK.acquire(blocking=False)
+    try:
+        with pytest.raises(profile.ProfilerBusy):
+            profile.capture_window(0.05)
+        with profile.dispatch_trace():
+            pass
+    finally:
+        profile._PROFILER_LOCK.release()
+
+
+# --- daemon integration ------------------------------------------------------
+
+def test_request_id_correlation_end_to_end(server):
+    with running_daemon(server) as d:
+        port = d.http_port
+        s, body, headers = req(port, "POST", "/plan", {})
+        assert s == 200
+        rid = body["result"]["request_id"]
+        assert rid and headers.get("X-Request-Id") == rid
+        assert {sp["request_id"] for sp in body["spans"]} == {rid}
+        # client-supplied id wins, echoed everywhere
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("POST", "/plan", body="{}",
+                     headers={"X-Request-Id": "client-rid-7"})
+        resp = conn.getresponse()
+        body2 = json.loads(resp.read())
+        assert resp.getheader("X-Request-Id") == "client-rid-7"
+        conn.close()
+        assert body2["result"]["request_id"] == "client-rid-7"
+        assert all(
+            sp["request_id"] == "client-rid-7" for sp in body2["spans"]
+        )
+        # hostile header (control chars) is replaced, not propagated
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("POST", "/plan", body="{}",
+                     headers={"X-Request-Id": "evil\tid"})
+        resp = conn.getresponse()
+        body3 = json.loads(resp.read())
+        conn.close()
+        assert body3["result"]["request_id"] != "evil\tid"
+        # GET probes carry the header too
+        s, _, h = req(port, "GET", "/healthz")
+        assert h.get("X-Request-Id")
+
+
+def test_metrics_endpoint_serves_valid_exposition(server):
+    with running_daemon(server) as d:
+        port = d.http_port
+        s, _, _ = req(port, "POST", "/plan", {})
+        assert s == 200
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type").startswith("text/plain")
+        text = resp.read().decode("utf-8")
+        conn.close()
+        fams = promtext.parse(text)
+        assert "ka_build_info" in fams
+        assert "ka_process_start_time_seconds" in fams
+        assert "ka_daemon_requests_total" in fams
+        for fam, data in fams.items():
+            if data["type"] == "histogram":
+                assert promtext.check_histogram(data) == [], fam
+        # the routing layer's per-endpoint-per-cluster latency histogram
+        hist = fams["ka_daemon_http_request_ms"]
+        assert any(
+            lb.get("endpoint") == "plan" and lb.get("cluster") == "default"
+            for _, lb, _ in hist["samples"]
+        )
+
+
+def test_debug_flight_global_and_per_cluster(server):
+    with running_daemon(server) as d:
+        port = d.http_port
+        s, _, _ = req(port, "POST", "/plan", {})
+        s, view, _ = req(port, "GET", "/debug/flight")
+        assert s == 200
+        kinds = {e["kind"] for e in view["events"]}
+        assert {"daemon", "lifecycle", "resync", "request"} <= kinds
+        assert view["dropped"] == 0
+        s, per, _ = req(port, "GET", "/clusters/default/debug/flight")
+        assert s == 200
+        assert all(
+            e.get("cluster", "default") == "default" for e in per["events"]
+        )
+        # request summaries carry the envelope's request id
+        s, body, _ = req(port, "POST", "/plan", {})
+        rid = body["result"]["request_id"]
+        s, view, _ = req(port, "GET", "/debug/flight")
+        assert any(
+            e["kind"] == "request" and e.get("request_id") == rid
+            for e in view["events"]
+        )
+
+
+def test_stderr_summary_gated_on_ka_obs_report(server, monkeypatch):
+    """ISSUE 10 satellite: by default a daemon request emits NO obs stderr
+    summary (the access log line is the one structured line); setting
+    KA_OBS_REPORT opts the per-request summary back in."""
+    err = io.StringIO()
+    d = AssignerDaemon(f"127.0.0.1:{server.port}", solver="greedy",
+                       err=err)
+    d.start()
+    try:
+        s, _, _ = req(d.http_port, "POST", "/plan", {})
+        assert s == 200
+        assert "obs: run" not in err.getvalue()
+        # exactly one access-log line for the one POST (GET probes aside)
+        plan_lines = [
+            ln for ln in err.getvalue().splitlines()
+            if ln.startswith("{") and '"path": "/plan"' in ln
+        ]
+        assert len(plan_lines) == 1
+        monkeypatch.setenv("KA_OBS_REPORT", "/dev/null")
+        s, _, _ = req(d.http_port, "POST", "/plan", {})
+        assert s == 200
+        assert "obs: run" in err.getvalue()
+    finally:
+        monkeypatch.delenv("KA_OBS_REPORT", raising=False)
+        d.shutdown()
+
+
+def test_debug_profile_endpoint(server, monkeypatch, tmp_path):
+    with running_daemon(server) as d:
+        port = d.http_port
+        s, body, _ = req(port, "GET", "/debug/profile?seconds=0.05")
+        assert s == 400 and "KA_OBS_PROFILE_DIR" in body["error"]
+        monkeypatch.setenv("KA_OBS_PROFILE_DIR", str(tmp_path))
+        s, body, _ = req(port, "GET", "/debug/profile?seconds=0.05")
+        assert s == 200 and body["artifact_dir"] == str(tmp_path)
+        assert list(tmp_path.iterdir())
+        s, body, _ = req(port, "GET", "/debug/profile?seconds=wat")
+        assert s == 400
+
+
+# --- the concurrency acceptance: exact sums, no cross-talk -------------------
+
+def test_concurrent_hammer_cumulative_sums_exact():
+    """ISSUE 10 satellite: N parallel /plan + /whatif requests across TWO
+    clusters — the cumulative registry sums exactly (no lost updates), the
+    @cluster labels never cross-talk, and every per-run envelope stays
+    byte-identical to a fresh CLI run with per-request (not cumulative)
+    counters."""
+    sa, sb = JuteZkServer(cluster_tree()), JuteZkServer(cluster_tree())
+    sa.start(), sb.start()
+    d = None
+    try:
+        base_a = fresh_cli(sa.port, "--solver", "greedy")
+        base_b = fresh_cli(sb.port, "--solver", "greedy")
+        d = AssignerDaemon(
+            clusters={"a": f"127.0.0.1:{sa.port}",
+                      "b": f"127.0.0.1:{sb.port}"},
+            solver="greedy", err=io.StringIO(),
+        )
+        d.start()
+        port = d.http_port
+        n_threads, per_thread = 4, 3
+        failures = []
+
+        def hammer(cluster, base):
+            for _ in range(per_thread):
+                try:
+                    s, body, _ = req(
+                        port, "POST", f"/clusters/{cluster}/plan", {}
+                    )
+                    if s != 200 or body["result"]["stdout"] != base:
+                        failures.append(f"{cluster}: http={s}")
+                        continue
+                    # per-run envelope: THIS request's capture only —
+                    # never another cluster's metrics (label cross-talk)
+                    # and never cumulative-scale totals
+                    c = body["metrics"]["counters"]
+                    other = "b" if cluster == "a" else "a"
+                    if any(k.endswith(f"@{other}") for k in c):
+                        failures.append(f"{cluster}: cross-talk in {c}")
+                    if any(v > per_thread for k, v in c.items()
+                           if k.startswith("daemon.")):
+                        failures.append(
+                            f"{cluster}: cumulative totals leaked into "
+                            f"the envelope {c}"
+                        )
+                    s, body, _ = req(
+                        port, "POST", f"/clusters/{cluster}/whatif", {}
+                    )
+                    if s != 200:
+                        failures.append(f"{cluster}: whatif http={s}")
+                except Exception as e:  # noqa: BLE001 -- collected, asserted below
+                    failures.append(f"{cluster}: {type(e).__name__}: {e}")
+
+        threads = [
+            threading.Thread(target=hammer,
+                             args=(("a", base_a) if i % 2 == 0
+                                   else ("b", base_b)))
+            for i in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "hammer thread hung"
+        assert failures == [], failures
+        cum = metrics_mod.cumulative()
+        assert cum is not None
+        sent_per_cluster = (n_threads // 2) * per_thread * 2  # plan+whatif
+        assert cum.counter_value(
+            "daemon.requests", labels={"cluster": "a"}
+        ) == sent_per_cluster
+        assert cum.counter_value(
+            "daemon.requests", labels={"cluster": "b"}
+        ) == sent_per_cluster
+        # the routing layer's labeled http counters agree exactly
+        assert cum.counter_value(
+            "daemon.http.requests",
+            labels={"endpoint": "plan", "cluster": "a", "code": "200"},
+        ) == (n_threads // 2) * per_thread
+        assert cum.counter_value(
+            "daemon.http.requests",
+            labels={"endpoint": "whatif", "cluster": "b", "code": "200"},
+        ) == (n_threads // 2) * per_thread
+    finally:
+        if d is not None:
+            d.shutdown()
+        sa.shutdown(), sb.shutdown()
+
+
+def test_daemon_lifetime_metrics_survive_requests(server):
+    """Cumulative totals keep growing across requests while each envelope
+    stays per-request — the 'process-lifetime vs run capture' split."""
+    with running_daemon(server) as d:
+        port = d.http_port
+        for i in range(3):
+            s, body, _ = req(port, "POST", "/plan", {})
+            assert s == 200
+            # The envelope is the per-REQUEST capture: lifetime totals
+            # (admission counters, resyncs) live in the cumulative
+            # registry and /state, never in a response's own report.
+            assert "daemon.requests" not in body["metrics"]["counters"]
+        cum = metrics_mod.cumulative()
+        assert cum.counter_value("daemon.requests") == 3
+        # time flows only forward in the http latency histogram
+        snap = cum.snapshot()
+        key = (("cluster", "default"), ("endpoint", "plan"))
+        assert snap["hists"]["daemon.http.request_ms"][key]["count"] == 3
