@@ -74,6 +74,16 @@ KA013  a metric/span name literal passed to the obs write API
        twin for the telemetry namespace); dynamic names (f-strings,
        ``_metric(...)`` results) are the registered composition points
        and pass through
+KA014  a metric registered in ``obs/names.py:METRIC_NAMES`` that neither
+       carries a recognized unit suffix on its last dotted segment
+       (``_ms``/``_bytes``/``_frac``/``_total``/``_seconds``, or the bare
+       token as the whole segment, e.g. ``zk.bytes``) nor sits in the
+       declared ``UNITLESS_METRICS`` allowlist — a dashboard reading
+       ``foo.latency`` cannot know ms from seconds, so every name states
+       its unit in the name or is consciously declared unitless; stale
+       allowlist entries (names no longer registered) and entries that
+       ALSO carry a unit suffix are findings too (the allowlist must stay
+       an exact complement, not a dumping ground)
 ====== =====================================================================
 
 Suppression: put ``# kalint: disable=KA002 -- <reason>`` on the offending
@@ -113,6 +123,8 @@ RULES = {
              "supervisor's backend/cache",
     "KA013": "metric/span name literal not declared in the obs name "
              "registry (obs/names.py)",
+    "KA014": "registered metric carries no unit suffix and is not in the "
+             "unitless allowlist (obs/names.py)",
 }
 
 #: Modules whose ENTIRE body is treated as traced kernel code (KA002): these
@@ -937,6 +949,66 @@ def _check_ka008(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+#: Unit tokens KA014 recognizes on a metric name's LAST dotted segment —
+#: either the whole segment (``zk.bytes``) or a ``_token`` suffix
+#: (``exec.wave_ms``). ``_total`` is listed for completeness although the
+#: Prometheus renderer also appends it to counters mechanically.
+METRIC_UNIT_TOKENS = ("ms", "bytes", "frac", "total", "seconds")
+
+
+def _has_unit_suffix(name: str) -> bool:
+    seg = name.rsplit(".", 1)[-1]
+    return seg in METRIC_UNIT_TOKENS or any(
+        seg.endswith("_" + tok) for tok in METRIC_UNIT_TOKENS
+    )
+
+
+def check_metric_units(
+    metric_names=None, unitless=None,
+    path: str = "kafka_assigner_tpu/obs/names.py",
+) -> List[Finding]:
+    """KA014: every registered metric either states its unit in its name or
+    is consciously declared unitless (``obs/names.py:UNITLESS_METRICS``) —
+    so a dashboard never guesses whether ``foo.latency`` is ms or seconds.
+    Registry-level (one pass per lint run), not per-module: the names ARE
+    the data, there is no AST to walk."""
+    if metric_names is None or unitless is None:
+        from ..obs.names import METRIC_NAMES, UNITLESS_METRICS
+
+        if metric_names is None:
+            metric_names = METRIC_NAMES
+        if unitless is None:
+            unitless = UNITLESS_METRICS
+    out: List[Finding] = []
+    for name in sorted(metric_names):
+        if _has_unit_suffix(name):
+            if name in unitless:
+                out.append(Finding(
+                    "KA014", path, 1, 1,
+                    f"metric {name!r} carries a unit suffix AND sits in "
+                    "UNITLESS_METRICS — pick one (the allowlist is for "
+                    "names with genuinely no unit)",
+                ))
+            continue
+        if name not in unitless:
+            out.append(Finding(
+                "KA014", path, 1, 1,
+                f"metric {name!r} carries no unit suffix "
+                f"({'/'.join('_' + t for t in METRIC_UNIT_TOKENS)} on its "
+                "last segment) and is not declared in UNITLESS_METRICS — "
+                "dashboards must never guess units: rename it or declare "
+                "it unitless",
+            ))
+    for name in sorted(unitless):
+        if name not in metric_names:
+            out.append(Finding(
+                "KA014", path, 1, 1,
+                f"UNITLESS_METRICS entry {name!r} is not a registered "
+                "metric (stale allowlist entry — remove it)",
+            ))
+    return out
+
+
 def check_readme(readme_text: str, knobs=None, path: str = "README.md"):
     """KA004: every registered knob must appear in the README (the generated
     knob table keeps this true; drift means the table is stale)."""
@@ -1033,6 +1105,7 @@ def lint_package(root: Path | None = None) -> List[Finding]:
     readme = repo / "README.md"
     if readme.is_file():
         findings.extend(check_readme(readme.read_text(encoding="utf-8")))
+    findings.extend(check_metric_units())
     return findings
 
 

@@ -27,6 +27,18 @@ endpoint                        method behavior
                                        resumes via ``resume`` (body or
                                        ``?resume=1``) or offline
                                        ``ka-execute --resume``
+/clusters/<name>/recommendations GET   observe-mode rebalance advice
+                                       (ISSUE 11): scores the live cached
+                                       assignment (obs/health.py), runs
+                                       the plan machinery under the shared
+                                       solve lock, and returns a schema-
+                                       versioned byte-stable envelope —
+                                       current scores, the candidate
+                                       plan's projected scores, movement
+                                       debt, and a recommend/hold verdict
+                                       against KA_HEALTH_MOVE_COST
+                                       (?move_cost= overrides). Computed,
+                                       flight-recorded, NEVER executed
 /clusters/<name>/healthz        GET    that cluster's lifecycle + breaker
 /clusters/<name>/readyz         GET    that cluster's readiness
 /clusters/<name>/state          GET    that cluster's cache introspection
@@ -376,7 +388,9 @@ class AssignerDaemon:
 
 #: Per-cluster path suffixes the router accepts.
 _POST_SUFFIXES = ("/plan", "/whatif", "/execute")
-_GET_SUFFIXES = ("/healthz", "/readyz", "/state", "/debug/flight")
+_GET_SUFFIXES = (
+    "/healthz", "/readyz", "/state", "/debug/flight", "/recommendations",
+)
 
 
 def _render_metrics(daemon: AssignerDaemon) -> str:
@@ -521,7 +535,7 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
                 return sup, suffix
             if daemon.single:
                 return daemon.supervisor(), path
-            if path in _POST_SUFFIXES:
+            if path in _POST_SUFFIXES or path == "/recommendations":
                 self._reply(400, {
                     "error": "this daemon serves multiple clusters; use "
                              f"/clusters/<name>{path}",
@@ -634,6 +648,20 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
                 )
             elif suffix == "/state":
                 self._reply(200, sup.state_view())
+            elif suffix == "/recommendations":
+                # Observe-mode endpoint (ISSUE 11): GET because it is
+                # read-only by contract — computed, flight-recorded, never
+                # executed. Query params (?move_cost=0.5) override the
+                # cost-of-change knob per request.
+                params = {
+                    k: vals[-1]
+                    for k, vals in parse_qs(split.query).items()
+                }
+                code, body, headers = sup.recommendations(
+                    params, request_id=self._rid
+                )
+                self._status = body.get("verdict") or body.get("error")
+                self._reply(code, body, headers)
             elif suffix == "/debug/flight":
                 rec = flight.recorder()
                 self._reply(

@@ -70,8 +70,9 @@ from ..generator import (
 )
 from ..io.base import open_backend
 from ..io.zkwire import ZkConnectionError, ZkWireError
-from ..obs import flight
-from ..obs.metrics import counter_add
+from ..obs import flight, health
+from ..obs import metrics as obs_metrics
+from ..obs.metrics import counter_add, gauge_set, hist_ms, hist_observe
 from ..obs.trace import record_span
 from ..utils.backoff import JitteredBackoff
 from .state import CacheBackend, DaemonState
@@ -246,6 +247,8 @@ class ClusterSupervisor:
         #: Prompt-resync request from the request path (session seam) for
         #: the watchless case, where no poll exists to raise.
         self._prompt_resync = False
+        #: Last computed health scores (ISSUE 11), surfaced in /state.
+        self._last_health: Optional[health.HealthScores] = None
 
     # -- counters (cluster-lifetime; mirrored into any active obs capture) --
 
@@ -436,6 +439,8 @@ class ClusterSupervisor:
                 self._armed_generation = gen_before
             self._count("daemon.resyncs")
             self._maybe_warm()
+            self._publish_health()
+            self._publish_traffic()
             ok = True
         except BaseException as e:
             error = f"{type(e).__name__}: {e}"
@@ -490,6 +495,209 @@ class ClusterSupervisor:
             w for w in self._warm_threads if w.is_alive()
         ] + [t]
         t.start()
+
+    # -- cluster-health plane (ISSUE 11) -----------------------------------
+
+    def _publish_health(self) -> None:
+        """Re-score the cached assignment (``obs/health.py``) and publish
+        the ``health.*`` gauges — called on every completed resync and on
+        every watch-driven delta re-encode, so the scrape tracks the
+        cluster as it churns, not as it was at startup. Gated on the
+        cumulative registry: outside a daemon there is nowhere for a
+        continuous gauge to live, and the scoring pass (O(replicas) host
+        arithmetic) must not tax an embedder that never enabled the
+        plane."""
+        if obs_metrics.cumulative() is None:
+            return
+        with hist_ms(self._metric("health.score_ms")):
+            scores = health.score_assignment(
+                self.state.broker_id_set(),
+                self.state.all_assignments(),
+                self.state.rack_map(),
+            )
+        self._last_health = scores
+        gauge_set(self._metric("health.replica_spread"),
+                  scores.replica_spread)
+        gauge_set(self._metric("health.replica_stddev"),
+                  scores.replica_stddev)
+        gauge_set(self._metric("health.leader_spread"),
+                  scores.leader_spread)
+        gauge_set(self._metric("health.leader_stddev"),
+                  scores.leader_stddev)
+        gauge_set(self._metric("health.rack_violations"),
+                  scores.rack_violations)
+        gauge_set(self._metric("health.score"), scores.score)
+
+    def _publish_traffic(self) -> None:
+        """Ingest per-partition traffic/lag through the backend hook
+        (``io/base.py:fetch_partition_traffic``; deterministic synthetic
+        fallback for meter-less backends) and publish them as
+        cumulative-only gauge series labeled ``{topic, partition}`` (plus
+        ``cluster`` in multi mode). ``replace_gauges`` swaps each family
+        atomically so deleted topics drop their series instead of
+        flat-lining forever. ``KA_OBS_TRAFFIC_SERIES_MAX`` caps the series
+        count per cluster (top partitions by produce rate — a
+        million-partition cluster must not mint a million label sets);
+        anything over the cap is COUNTED in ``traffic.series_dropped``,
+        never silently truncated. A failing fetch degrades loudly
+        (``traffic.fetch_failures``) — telemetry must never fail the
+        resync that feeds it."""
+        from ..utils.env import env_int
+
+        cum = obs_metrics.cumulative()
+        if cum is None:
+            return
+        partitions = {
+            t: sorted(parts)
+            for t, parts in self.state.all_assignments().items()
+        }
+        try:
+            fetch = getattr(self.backend, "fetch_partition_traffic", None)
+            if fetch is not None:
+                stats = fetch(partitions)
+            else:  # pure duck-typed backend without the hook
+                stats = health.synthetic_partition_traffic(partitions)
+        except Exception as e:
+            self._count("traffic.fetch_failures")
+            self._log(
+                f"traffic/lag fetch failed ({type(e).__name__}: {e}); "
+                "scrape series keep their last values"
+            )
+            return
+        flat = [
+            (t, p, tr)
+            for t in sorted(stats)
+            for p, tr in sorted(stats[t].items())
+        ]
+        cap = env_int("KA_OBS_TRAFFIC_SERIES_MAX")
+        dropped = 0
+        if cap and len(flat) > cap:
+            flat.sort(key=lambda row: (-row[2].in_bytes, row[0], row[1]))
+            dropped = len(flat) - cap
+            flat = sorted(flat[:cap], key=lambda row: (row[0], row[1]))
+        base = {"cluster": self.name} if self.label else {}
+
+        def series(field):
+            return {
+                (("partition", str(p)), ("topic", t)):
+                    getattr(tr, field)
+                for t, p, tr in flat
+            }
+
+        cum.replace_gauges("traffic.in_bytes", series("in_bytes"), base)
+        cum.replace_gauges("traffic.out_bytes", series("out_bytes"), base)
+        cum.replace_gauges("traffic.lag", series("lag"), base)
+        gauge_set(self._metric("traffic.series_dropped"), dropped)
+
+    def recommendations(
+        self, params: dict, request_id: Optional[str] = None,
+    ) -> Tuple[int, dict, dict]:
+        """The observe-mode ``/recommendations`` endpoint (ISSUE 11): runs
+        the existing plan machinery against the live cache under the
+        shared solve lock, scores current vs projected assignment, and
+        returns a schema-versioned, byte-stable envelope with a
+        recommend/hold verdict against the cost-of-change knob
+        (``KA_HEALTH_MOVE_COST``; the ``move_cost`` query param overrides
+        per request). READ-ONLY by construction — nothing here can reach a
+        write opcode; the recommendation is computed, recorded in the
+        flight ring, and never executed (the auto-execute rung of the
+        observe → recommend → auto-execute ladder is deliberately NOT
+        this endpoint's job)."""
+        from ..exec.engine import parse_plan_payload
+        from ..utils.env import env_float
+
+        raw_cost = params.get("move_cost")
+        if raw_cost is None:
+            move_cost = env_float("KA_HEALTH_MOVE_COST")
+        else:
+            try:
+                move_cost = max(0.0, float(raw_cost))
+            except (TypeError, ValueError):
+                return 400, {
+                    "error": f"move_cost must be a number, got {raw_cost!r}"
+                }, {}
+        refusal = self._gate()
+        if refusal is not None:
+            return refusal
+        t0 = time.perf_counter()
+        ok = False
+        # Same live watchdog every other solve-bearing request gets: a
+        # recommendation wedged in (or behind) the shared solve lock must
+        # be visible to the overrun telemetry, not invisible to it.
+        watchdog_timer = self._watchdog(
+            "/recommendations", self._request_budget(), request_id
+        )
+        try:
+            solver = params.get("solver") or self.solver
+            out = io.StringIO()
+            with self._solve_lock:
+                topics = self.state.all_assignments()
+                broker_ids = self.state.broker_id_set()
+                rack = self.state.rack_map()
+                current = health.score_assignment(broker_ids, topics, rack)
+                degraded = self._run_plan({"solver": solver}, out)
+            proposed, _order = parse_plan_payload(
+                out.getvalue(), origin="recommendation plan",
+            )
+            projected_topics = dict(topics)
+            projected_topics.update(proposed)
+            projected = health.score_assignment(
+                broker_ids, projected_topics, rack
+            )
+            moves, leader_moves = health.movement_debt(topics, proposed)
+            improvement = round(current.score - projected.score, 6)
+            cost = round(moves * move_cost, 6)
+            verdict = (
+                "recommend" if moves > 0 and improvement > cost else "hold"
+            )
+            gauge_set(self._metric("health.movement_debt"), moves)
+            self._count("daemon.recommendations")
+            flight.record(
+                "recommendation", self.name,
+                verdict=verdict, moves=moves, improvement=improvement,
+                request_id=request_id,
+            )
+            ok = True
+            # Byte-stable by design: no timestamps, elapsed times, request
+            # ids, or cache versions — two identical calls over unchanged
+            # metadata return identical bytes (test- and smoke-pinned).
+            # The request id travels in the X-Request-Id header only.
+            body = {
+                "schema_version": health.RECOMMENDATION_SCHEMA_VERSION,
+                "kind": "recommendations",
+                "policy": "observe",
+                "cluster": self.name,
+                "solver": solver,
+                "stale": self.state.stale,
+                "degraded": degraded,
+                "current": current.as_dict(),
+                "candidate": {
+                    "projected": projected.as_dict(),
+                    "moves_required": moves,
+                    "leader_moves": leader_moves,
+                },
+                "cost_model": {
+                    "move_cost": move_cost,
+                    "cost": cost,
+                    "improvement": improvement,
+                },
+                "verdict": verdict,
+            }
+            return 200, body, {}
+        except (ValueError, KeyError, IngestError) as e:
+            return 400, {"error": f"bad recommendation request: {e}"}, {}
+        except SolveError as e:
+            return 500, {"error": f"{type(e).__name__}: {e}"}, {}
+        except Exception as e:
+            self._count("daemon.request_errors")
+            return 500, {"error": f"{type(e).__name__}: {e}"}, {}
+        finally:
+            watchdog_timer.cancel()
+            record_span(
+                self._metric("daemon/recommend"),
+                (time.perf_counter() - t0) * 1e3, ok,
+            )
+            self._release()
 
     def _resync_with_retries(self) -> bool:
         """The bounded resync: ``KA_DAEMON_RESYNC_RETRIES`` prompt attempts
@@ -596,6 +804,7 @@ class ClusterSupervisor:
                             "session re-established underneath; watches "
                             "lost"
                         )
+                    cache_v0 = self.state.version
                     for kind, arg in events:
                         self._count("daemon.watch_events")
                         if (
@@ -617,6 +826,16 @@ class ClusterSupervisor:
                             # periodic check below immediately doubles the
                             # whole-cluster re-read.
                             last_sync = time.monotonic()
+                    if self.state.version != cache_v0:
+                        # ONE re-score per drained event batch that
+                        # actually changed the cache — the scoring pass is
+                        # O(cluster replicas), so per-event publishing
+                        # would undo the delta store's
+                        # work-proportional-to-touched-topics design
+                        # under a churn storm. (A batch whose resync
+                        # already published re-scores once more — cheap,
+                        # and always post-churn-correct.)
+                        self._publish_health()
                 else:
                     self.stopped.wait(POLL_S)
                 if time.monotonic() - last_sync >= self.resync_interval \
@@ -694,13 +913,15 @@ class ClusterSupervisor:
 
     # -- request surface ----------------------------------------------------
 
-    def handle(self, path: str, params: dict,
-               request_id: Optional[str] = None) -> Tuple[int, dict, dict]:
-        """One POST request: per-cluster backpressure gate (the LIVE
-        inflight knob) → shared-solve-lock dispatch. Returns
-        ``(http_code, body, extra_headers)``. ``request_id`` (ISSUE 10) is
-        stamped into the request's capture so every span and the response
-        envelope correlate with the access-log line."""
+    def _gate(self) -> Optional[Tuple[int, dict, dict]]:
+        """Shared request admission — drain check, synced check, then the
+        per-cluster backpressure gate against the LIVE inflight knob.
+        Returns the refusal ``(code, body, headers)``, or None when the
+        request is ADMITTED: the caller then owns one inflight slot and
+        MUST call :meth:`_release`. One implementation for every
+        solve-bearing endpoint (``/plan``/``/whatif`` via :meth:`handle`,
+        ``/recommendations``) so the admission accounting can never
+        diverge between them."""
         if self.draining.is_set():
             return 503, {"error": "draining"}, {"Retry-After": "5"}
         if not self.state.synced_once:
@@ -726,11 +947,55 @@ class ClusterSupervisor:
                 {"error": "overloaded", "max_inflight": limit},
                 {"Retry-After": "1"},
             )
+        return None
+
+    def _release(self) -> None:
+        with self._active_lock:
+            self._active -= 1
+
+    def _watchdog(self, path: str, budget: float,
+                  request_id: Optional[str],
+                  overran: Optional[threading.Event] = None,
+                  ) -> threading.Timer:
+        """Arm the live request watchdog: a started daemon Timer that, at
+        budget expiry, counts/flags the STILL-RUNNING request (a post-hoc
+        elapsed check can never see a solve that never returns); it also
+        sets ``overran`` when given, for callers that stamp the outcome
+        into their response. The caller cancels the timer on
+        completion."""
+
+        def _overrun() -> None:
+            if overran is not None:
+                overran.set()
+            self._count("daemon.watchdog_exceeded")
+            flight.record(
+                "watchdog", self.name, path=path, budget_s=budget,
+                request_id=request_id,
+            )
+            self._log(
+                f"watchdog: {path} exceeded its "
+                f"{budget:.1f} s budget and is still running"
+            )
+
+        timer = threading.Timer(budget, _overrun)
+        timer.daemon = True
+        timer.start()
+        return timer
+
+    def handle(self, path: str, params: dict,
+               request_id: Optional[str] = None) -> Tuple[int, dict, dict]:
+        """One POST request: per-cluster backpressure gate (the LIVE
+        inflight knob) → shared-solve-lock dispatch. Returns
+        ``(http_code, body, extra_headers)``. ``request_id`` (ISSUE 10) is
+        stamped into the request's capture so every span and the response
+        envelope correlate with the access-log line."""
+        refusal = self._gate()
+        if refusal is not None:
+            return refusal
         try:
             return self._handle_admitted(path, params, request_id)
         finally:
-            with self._active_lock:
-                self._active -= 1
+            self._release()
 
     def _handle_admitted(
         self, path: str, params: dict,
@@ -750,28 +1015,12 @@ class ClusterSupervisor:
         budget = self._request_budget()
         # The watchdog must fire WHILE a wedged request is still running —
         # a post-hoc elapsed check can never see a solve that never
-        # returns — so a timer thread flags the overrun live (counter +
-        # stderr); the post-completion check below only stamps the result
+        # returns; the post-completion check below only stamps the result
         # field. Armed BEFORE the shared solve lock: a request wedged
         # BEHIND another cluster's solve is flagged too (the bulkhead's
         # visibility guarantee).
         overran = threading.Event()
-
-        def _overrun() -> None:
-            overran.set()
-            self._count("daemon.watchdog_exceeded")
-            flight.record(
-                "watchdog", self.name, path=path, budget_s=budget,
-                request_id=request_id,
-            )
-            self._log(
-                f"watchdog: {path} exceeded its "
-                f"{budget:.1f} s budget and is still running"
-            )
-
-        watchdog_timer = threading.Timer(budget, _overrun)
-        watchdog_timer.daemon = True
-        watchdog_timer.start()
+        watchdog_timer = self._watchdog(path, budget, request_id, overran)
         # Per-request capture is THREAD-LOCAL (obs/trace.py): concurrent
         # requests from other clusters can never tear each other's span
         # stacks or steal each other's metrics.
@@ -977,6 +1226,7 @@ class ClusterSupervisor:
     def _run_whatif(self, params: dict, out: io.StringIO) -> bool:
         import tempfile
 
+        t0 = time.perf_counter()
         pk = self._plan_kwargs(params)
         scenario_file = None
         tmp = None
@@ -1021,6 +1271,19 @@ class ClusterSupervisor:
                 out.seek(0)
                 out.truncate()
                 rank_once()
+            # Per-scenario solve latency (the ISSUE 10 capacity-planning
+            # follow-up): request wall ms over the scenarios this sweep
+            # evaluated — candidates when none were named — into a
+            # per-cluster histogram the scrape exposes.
+            cand = pk["broker_ids"] - pk["excluded"]
+            n_scenarios = (
+                len(scenarios) if scenarios is not None
+                else len(cand) if cand else len(live)
+            )
+            hist_observe(
+                self._metric("whatif.scenario_ms"),
+                (time.perf_counter() - t0) * 1e3 / max(1, n_scenarios),
+            )
         finally:
             if tmp is not None:
                 os.unlink(tmp.name)
@@ -1235,6 +1498,13 @@ class ClusterSupervisor:
             "cluster": self.name,
             "breaker": self.breaker.snapshot(),
             "execution_in_flight": self._exec_lock.locked(),
+            "health": (
+                self._last_health.as_dict()
+                if self._last_health is not None else None
+            ),
+            "traffic_real": bool(
+                getattr(self.backend, "supports_traffic", lambda: False)()
+            ),
             "counters": self.counters(),
         }
 

@@ -19,7 +19,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from typing import (
-    Dict, Iterator, List, NamedTuple, Optional, Protocol, Sequence, Tuple,
+    Dict, Iterator, List, Mapping, NamedTuple, Optional, Protocol,
+    Sequence, Tuple,
 )
 
 
@@ -32,6 +33,21 @@ class BrokerInfo:
     host: str
     port: int
     rack: Optional[str] = None
+
+
+class PartitionTraffic(NamedTuple):
+    """One partition's traffic/lag observation, as the cluster-health
+    plane ingests it (ISSUE 11): produce/consume byte rates and the worst
+    consumer-group lag. Backends without real meters serve the
+    deterministic synthetic series (``obs/health.py:
+    synthetic_partition_traffic``) so the scrape surface, the
+    traffic-weighted objective work, and the ``/recommendations`` envelope
+    have stable inputs everywhere — ``supports_traffic()`` tells consumers
+    which kind they are looking at."""
+
+    in_bytes: float   # produced bytes/s into this partition
+    out_bytes: float  # consumed bytes/s out of this partition
+    lag: int          # worst consumer-group lag, in messages
 
 
 class PartitionState(NamedTuple):
@@ -134,6 +150,36 @@ class MetadataBackend(Protocol):
         metadata I/O. The live ZooKeeper backend overrides this when the
         in-tree wire client is underneath (``io/zk.py``)."""
         return False
+
+    # -- traffic/lag surface (ISSUE 11) -----------------------------------
+
+    def supports_traffic(self) -> bool:
+        """True when this backend reports REAL per-partition traffic/lag
+        observations from :meth:`fetch_partition_traffic`. Default False:
+        the deterministic synthetic fallback is in use — still a valid
+        scrape series (stable, skew-shaped), but a dashboard must not
+        mistake it for cluster truth, so the daemon surfaces this flag in
+        ``/state``."""
+        return False
+
+    def fetch_partition_traffic(
+        self, partitions: Mapping[str, Sequence[int]]
+    ) -> Dict[str, Dict[int, PartitionTraffic]]:
+        """Per-partition traffic/lag observations for the given
+        ``{topic: [partition ids]}`` map (the caller — the daemon
+        supervisor — already holds the partition list in its cache, so
+        this hook never re-reads metadata). Real default, not a stub: the
+        deterministic synthetic series (``obs/health.py``), which any
+        backend without meters inherits. Implementations with real
+        sources (JMX bridges, AdminClient consumer-group offsets)
+        override this AND :meth:`supports_traffic`. Partial-map contract:
+        the CALLER does no synthetic fill — a topic/partition absent from
+        the returned map simply gets no scrape series — so a backend that
+        wants synthetic values for its unmetered partitions must merge
+        them itself (``io/snapshot.py`` does exactly that)."""
+        from ..obs.health import synthetic_partition_traffic
+
+        return synthetic_partition_traffic(partitions)
 
     # -- plan execution surface (ISSUE 7) ---------------------------------
 

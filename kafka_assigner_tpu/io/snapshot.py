@@ -32,7 +32,7 @@ from typing import Dict, Iterator, List, Sequence, Tuple
 
 from ..faults.inject import active_injector
 from ..obs.metrics import counter_add
-from .base import BrokerInfo, PartitionState
+from .base import BrokerInfo, PartitionState, PartitionTraffic
 
 
 class SnapshotBackend:
@@ -58,6 +58,24 @@ class SnapshotBackend:
         self._topics: Dict[str, Dict[int, List[int]]] = {
             topic: {int(p): [int(x) for x in replicas] for p, replicas in parts.items()}
             for topic, parts in data.get("topics", {}).items()
+        }
+        # Optional per-partition traffic/lag observations (ISSUE 11):
+        #   "traffic": {"events": {"0": {"in_bytes": 1e6,
+        #                                "out_bytes": 2e6, "lag": 40}}}
+        # Topics/partitions absent from the section fall back to the
+        # deterministic synthetic series, so a partially-metered snapshot
+        # still yields a complete scrape surface.
+        self._traffic_raw: Dict = dict(data.get("traffic", {}) or {})
+        self._traffic: Dict[str, Dict[int, PartitionTraffic]] = {
+            t: {
+                int(p): PartitionTraffic(
+                    in_bytes=float(v.get("in_bytes", 0.0)),
+                    out_bytes=float(v.get("out_bytes", 0.0)),
+                    lag=int(v.get("lag", 0)),
+                )
+                for p, v in per.items()
+            }
+            for t, per in self._traffic_raw.items()
         }
         # Simulated-convergence execution state (module docstring): pending
         # moves and their remaining poll countdowns. Resolved once per
@@ -99,6 +117,30 @@ class SnapshotBackend:
         if missing:
             raise KeyError(f"topics not in snapshot: {missing}")
         return {t: {p: list(r) for p, r in self._topics[t].items()} for t in topics}
+
+    # -- traffic/lag surface (ISSUE 11) ------------------------------------
+
+    def supports_traffic(self) -> bool:
+        """True only when the snapshot file carried a ``traffic`` section
+        — a bare metadata snapshot serves the synthetic series and says
+        so."""
+        return bool(self._traffic)
+
+    def fetch_partition_traffic(self, partitions):
+        """Snapshot-recorded observations where present, synthetic
+        fallback per absent topic/partition (the backend-hook contract,
+        ``io/base.py``)."""
+        from ..obs.health import synthetic_partition_traffic
+
+        synth = synthetic_partition_traffic(partitions)
+        out = {}
+        for topic, parts in partitions.items():
+            recorded = self._traffic.get(topic, {})
+            out[topic] = {
+                int(p): recorded.get(int(p), synth[topic][int(p)])
+                for p in parts
+            }
+        return out
 
     # -- plan execution surface (simulated convergence; module docstring) --
 
@@ -167,7 +209,8 @@ class SnapshotBackend:
         import sys
 
         try:
-            write_snapshot(self.path, self._brokers, self._topics)
+            write_snapshot(self.path, self._brokers, self._topics,
+                           traffic=self._traffic_raw)
         except OSError as e:
             print(
                 f"kafka-assigner: snapshot persist failed for "
@@ -183,6 +226,7 @@ def write_snapshot(
     path: str,
     brokers: Sequence[BrokerInfo],
     topics: Dict[str, Dict[int, List[int]]],
+    traffic: Dict | None = None,
 ) -> None:
     """Serialize cluster metadata to a snapshot file (inverse of the
     loader). Atomic + fsync'd (``utils/atomicwrite.py``): the execution
@@ -205,6 +249,10 @@ def write_snapshot(
             for t, parts in topics.items()
         },
     }
+    if traffic:
+        # Round-trip the optional traffic section (ISSUE 11): a converged
+        # wave's persist must not silently strip the cluster's meters.
+        data["traffic"] = traffic
     # kalint: disable=KA005 -- snapshot capture file, not a byte-compat plan payload
     atomic_write_text(path, json.dumps(data, indent=1),
                       prefix=".ka_snapshot_")

@@ -264,6 +264,24 @@ class ZkBackend:
                 out[topic] = parts
         return out
 
+    # -- traffic/lag surface (ISSUE 11) ------------------------------------
+
+    def supports_traffic(self) -> bool:
+        """ZooKeeper stores topology, not meters: byte rates live in the
+        brokers' JMX surface and lag in the consumer coordinators, neither
+        reachable over a quorum connection. Always False — the health
+        plane serves the deterministic synthetic series for ZK-backed
+        clusters and says so, rather than inventing a half-real source."""
+        return False
+
+    def fetch_partition_traffic(self, partitions):
+        """The synthetic fallback, explicitly: the contract lives on every
+        backend even where the real source is structurally absent (module
+        rationale in :meth:`supports_traffic`)."""
+        from ..obs.health import synthetic_partition_traffic
+
+        return synthetic_partition_traffic(partitions)
+
     # -- watch surface (ISSUE 8: the daemon's churn feed) ------------------
 
     TOPICS_PATH = "/brokers/topics"

@@ -33,6 +33,9 @@ request    ``request_id``, ``path``, ``code``, ``status``, ``ms``: one
 execute    ``event``: start / done / error (+ ``plan_hash``)
 fault      ``spec``: a fired fault-injection event (``faults/inject.py``)
 profile    ``seconds``, ``dir``: a /debug/profile window capture
+recommendation ``verdict``, ``moves``, ``improvement``, ``request_id``:
+           one observe-mode /recommendations evaluation (ISSUE 11) —
+           the audit trail proving advice was computed, never executed
 ========== ===========================================================
 
 Activation model, same as the rest of ``obs/``: nothing records until

@@ -19,8 +19,10 @@ mechanically from these (dots → underscores, ``ka_`` prefix, counters get
 metric-name table is written from it.
 
 House rule for additions: declare the name here IN THE SAME CHANGE that
-introduces the write; group by namespace; never delete a name a dashboard
-may still query without saying so in the PR.
+introduces the write; group by namespace; give it a unit suffix or add it
+to :data:`UNITLESS_METRICS` (kalint KA014 — dashboards must never guess
+units); never delete a name a dashboard may still query without saying so
+in the PR.
 """
 from __future__ import annotations
 
@@ -79,6 +81,23 @@ METRIC_NAMES: frozenset = frozenset({
     # daemon.http.* — the routing layer's per-endpoint telemetry
     # (ISSUE 10; labeled endpoint × cluster × code, cumulative-only)
     "daemon.http.requests", "daemon.http.request_ms",
+    # health.* — continuous assignment-quality scoring (ISSUE 11): the
+    # supervisor re-scores the cached assignment on every resync/delta
+    # re-encode (obs/health.py) and publishes these per cluster
+    "health.replica_spread", "health.replica_stddev",
+    "health.leader_spread", "health.leader_stddev",
+    "health.rack_violations", "health.score", "health.score_ms",
+    "health.movement_debt",
+    # traffic.* — per-partition traffic/lag scrape series (ISSUE 11):
+    # cumulative-only gauges labeled {cluster, topic, partition} via the
+    # backend hook io/base.py:fetch_partition_traffic (synthetic fallback)
+    "traffic.in_bytes", "traffic.out_bytes", "traffic.lag",
+    "traffic.series_dropped", "traffic.fetch_failures",
+    # the observe-mode /recommendations endpoint (ISSUE 11)
+    "daemon.recommendations",
+    # per-scenario what-if solve latency (ISSUE 10 follow-up, landed in
+    # ISSUE 11): request wall ms / scenario count, per cluster
+    "whatif.scenario_ms",
 })
 
 #: Span names (``span(...)`` / ``record_span(...)`` first argument).
@@ -94,8 +113,68 @@ SPAN_NAMES: frozenset = frozenset({
     "native/assign_many",
     "warmup",
     "exec/wave", "exec/submit", "exec/poll", "exec/verify",
-    "daemon/request", "daemon/resync",
+    "daemon/request", "daemon/resync", "daemon/recommend",
 })
 
 #: Both namespaces — what the supervisor's ``_metric`` wrapper may label.
 ALL_NAMES: frozenset = METRIC_NAMES | SPAN_NAMES
+
+#: Unit-suffix convention (kalint KA014): every name in
+#: :data:`METRIC_NAMES` must either end in a recognized unit token on its
+#: last dotted segment (``_ms``/``_bytes``/``_frac``/``_total``/
+#: ``_seconds``, or the bare token as the whole segment, e.g.
+#: ``zk.bytes``) or be declared HERE — the explicit allowlist of unitless
+#: counts/gauges (events, topics, partitions, state flags: quantities with
+#: no physical unit a dashboard could mis-guess). The two grandfathered
+#: ``zk.wire_bytes_in``/``zk.wire_bytes_out`` names predate the rule and
+#: carry their unit mid-name; they stay (a scrape family rename breaks
+#: every dashboard querying it) and are listed with that reason. House
+#: rule: a NEW metric either carries a unit suffix or is added here in the
+#: same change — ``scripts/lint.sh`` fails otherwise.
+UNITLESS_METRICS: frozenset = frozenset({
+    # event / item counts (dimensionless by construction)
+    "zk.reads", "zk.writes", "zk.topics_missing", "zk.watch_events",
+    "zk.session.reestablished", "zk.write_readback_confirmed",
+    "zk.wire_frames_in", "zk.wire_frames_out",
+    "zk.pipeline.batches", "zk.pipeline.rtts_saved",
+    "zk.pipeline.in_flight",
+    "ingest.topics", "ingest.topics_skipped",
+    "encode.topics", "encode.p_pad",
+    "plan.moves", "plan.leader_churn", "plan.topics", "plan.partitions",
+    "plan.waves", "plan.moves_submitted", "plan.noops",
+    "plan.skipped_moves", "plan.verify_mismatches",
+    "plan.unplanned_topics",
+    "whatif.scenarios", "whatif.fanout", "whatif.incremental_sweeps",
+    "whatif.rescued",
+    "greedy.assigns", "greedy.partitions",
+    "native.assigns", "native.partitions",
+    "solver.assign_calls", "solver.fresh_calls", "solve.fallbacks",
+    "compile.store.hits", "compile.store.misses",
+    "compile.store.exec_fallbacks", "compile.store.unbucketed",
+    "warmup.failures", "faults.injected",
+    "exec.waves", "exec.moves", "exec.retries", "exec.write_retries",
+    "exec.skipped", "exec.verify",
+    "daemon.requests", "daemon.requests_degraded", "daemon.requests_shed",
+    "daemon.requests_unsynced", "daemon.request_errors",
+    "daemon.churn_retries", "daemon.solve_fallbacks",
+    "daemon.watchdog_exceeded", "daemon.reencode.topics",
+    "daemon.resyncs", "daemon.resync_failures", "daemon.session_lost",
+    "daemon.watch_events", "daemon.watch_dropped", "daemon.watch_errors",
+    "daemon.warmups", "daemon.warmup_failures",
+    "daemon.breaker_opened", "daemon.breaker_probes",
+    "daemon.breaker_closed",
+    "daemon.executes", "daemon.execute_conflicts", "daemon.execute_halts",
+    "daemon.execute_errors", "daemon.execute_interrupted",
+    "daemon.execute_stream_broken",
+    "daemon.http.requests", "daemon.recommendations",
+    # health.* unitless scores (spreads/stddevs are replica counts,
+    # violations/debt are partition/replica counts)
+    "health.replica_spread", "health.replica_stddev",
+    "health.leader_spread", "health.leader_stddev",
+    "health.rack_violations", "health.score", "health.movement_debt",
+    # traffic.lag is messages; the series accounting gauges are counts
+    "traffic.lag", "traffic.series_dropped", "traffic.fetch_failures",
+    # grandfathered: unit (bytes) lives mid-name, predates KA014; renaming
+    # the scrape family would orphan existing dashboards
+    "zk.wire_bytes_in", "zk.wire_bytes_out",
+})

@@ -137,9 +137,32 @@ class PromParseError(ValueError):
 
 
 def _unescape(value: str) -> str:
-    return (
-        value.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
-    )
+    """Left-to-right escape decoding. A chained ``str.replace`` is WRONG
+    here: for a literal backslash followed by ``n`` the renderer emits
+    ``\\\\n`` (escaped backslash, then a real ``n``), and replacing
+    ``\\n`` first would eat the second backslash and fabricate a newline
+    — caught by the ISSUE 11 round-trip edge tests. Unknown escapes pass
+    through verbatim, matching Prometheus's reader."""
+    if "\\" not in value:
+        return value
+    out: List[str] = []
+    i = 0
+    n = len(value)
+    while i < n:
+        c = value[i]
+        if c == "\\" and i + 1 < n:
+            nxt = value[i + 1]
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if nxt in ("\\", '"'):
+                out.append(nxt)
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
 
 
 def parse(text: str) -> Dict[str, dict]:

@@ -180,10 +180,13 @@ class AccessLog:
         self._lock = threading.Lock()
         self._path = path
         self._fh = None
+        self._size = 0
         self._dead = False
+        self._rollover_dead = False
         if path:
             try:
                 self._fh = open(path, "a", encoding="utf-8")
+                self._size = self._fh.tell()  # restart: resume the cap count
             except OSError as e:
                 print(
                     f"obs: could not open access log {path!r}: {e}; "
@@ -209,6 +212,62 @@ class AccessLog:
                     "disabled for this process",
                     file=self._err,
                 )
+                return
+            if self._fh is not None:
+                self._size += len(line.encode("utf-8")) + 1
+                self._maybe_rollover()
+
+    def _maybe_rollover(self) -> None:
+        """Size-capped rollover (ISSUE 11 satellite), under the log lock:
+        once the file reaches ``KA_OBS_ACCESS_LOG_MAX_MB`` (live-read per
+        write; 0 = unbounded, the historical behavior) the current file is
+        renamed to ``<path>.1`` — atomically replacing any previous ``.1``,
+        so disk stays bounded at ~2x the cap — and a fresh file reopened.
+        The rename happens FIRST, with the handle still open (the open fd
+        follows the inode), so a failing rename leaves appending fully
+        intact with no close/reopen churn; that failure is reported ONCE
+        and disables further rollover attempts for this process — a
+        persistently unwritable ``.1`` must not cost a stderr line and two
+        syscalls per served request forever."""
+        import os
+
+        from ..utils.env import env_int
+
+        if self._rollover_dead:
+            return
+        cap_mb = env_int("KA_OBS_ACCESS_LOG_MAX_MB")
+        if not cap_mb or self._size < cap_mb * 1024 * 1024:
+            return
+        try:
+            os.replace(self._path, self._path + ".1")
+        except OSError as e:
+            self._rollover_dead = True
+            print(
+                f"obs: access log rollover failed for {self._path!r} "
+                f"({e}); rollover disabled for this process, continuing "
+                "to append",
+                file=self._err,
+            )
+            return
+        try:
+            fresh = open(self._path, "a", encoding="utf-8")
+        except OSError as e:
+            # The old handle still points at the renamed .1 file: keep
+            # appending there (no line is ever lost), loudly, once.
+            self._rollover_dead = True
+            print(
+                f"obs: could not reopen access log {self._path!r} after "
+                f"rollover ({e}); rollover disabled, appending to the "
+                "rolled file",
+                file=self._err,
+            )
+            return
+        try:
+            self._fh.close()
+        except OSError as e:
+            print(f"obs: access log close failed ({e})", file=self._err)
+        self._fh = fresh
+        self._size = fresh.tell()
 
     def close(self) -> None:
         with self._lock:

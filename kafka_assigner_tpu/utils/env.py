@@ -388,6 +388,15 @@ _knob(
         "resumable via /execute resume=1 or offline `ka-execute --resume`",
 )
 _knob(
+    "KA_HEALTH_MOVE_COST", "float", 1.0, floor=0.0,
+    doc="cost-of-change threshold for the daemon's observe-mode "
+        "`/recommendations` endpoint (`obs/health.py`): a candidate plan is "
+        "`recommend`ed only when its composite-score improvement exceeds "
+        "`moves_required x this` — lower it and cheap rebalances flip from "
+        "`hold` to `recommend`; the `?move_cost=` query param overrides per "
+        "request. Read live per request, no restart needed",
+)
+_knob(
     "KA_DAEMON_WATCH", "bool", True,
     doc="watch-driven incremental re-encode (`daemon/`): ZooKeeper watches "
         "feed topic churn into the group-encode delta store so only "
@@ -482,6 +491,25 @@ _knob(
         "report status, duration ms, inflight depth, stale/degraded "
         "markers; appended across restarts). Unset: the lines go to "
         "stderr. `ka-daemon --access-log PATH` overrides",
+)
+_knob(
+    "KA_OBS_ACCESS_LOG_MAX_MB", "int", 0, floor=0,
+    doc="size-capped rollover for the daemon's NDJSON access log: once the "
+        "file reaches this many MB it is renamed to `<path>.1` (replacing "
+        "any previous `.1`) and a fresh file reopened atomically under the "
+        "log lock — at most ~2x this bound on disk. 0 (default) keeps the "
+        "historical unbounded append behavior. Read live per write, so an "
+        "operator can cap a runaway log without a restart",
+)
+_knob(
+    "KA_OBS_TRAFFIC_SERIES_MAX", "int", 512, floor=0,
+    doc="per-cluster cap on the `/metrics` per-partition traffic/lag gauge "
+        "series (`traffic.in_bytes`/`traffic.out_bytes`/`traffic.lag`, "
+        "labeled topic x partition): the top partitions by produce rate "
+        "are exported, the suppressed remainder is COUNTED in "
+        "`traffic.series_dropped` (never silently truncated). 0 disables "
+        "the cap — a million-partition cluster will mint a million label "
+        "sets, so leave it bounded on giants",
 )
 _knob(
     "KA_OBS_FLIGHT_EVENTS", "int", 512, floor=0,

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # The project lint gate: kalint (knob-registry + jit-boundary + write-path
-# + deadline + bulkhead + telemetry-name house rules, KA001-KA013), the
-# README knob-table drift check,
+# + deadline + bulkhead + telemetry-name + metric-unit house rules,
+# KA001-KA014), the README knob-table drift check,
 # the run-report fixture schema check, the fault-matrix smoke (one injected
 # fault per class — read, write AND daemon seams — strict + best-effort),
 # the exec crash→resume smoke, the daemon lifecycle smoke, and ruff
@@ -46,6 +46,12 @@ python scripts/daemon_smoke.py --multi
 # log, /debug/flight matches the injected fault schedule, SIGTERM flushes
 # the flight dump.
 python scripts/metrics_smoke.py
+# Cluster-health smoke (ISSUE 11): real two-cluster ka-daemon — per-cluster
+# health gauges + traffic/lag series on /metrics, whatif scenario
+# histogram, schema-valid byte-stable /recommendations whose verdict flips
+# on the cost-of-change knob, churn updating the scrape, and ZERO writes
+# (assignment bytes untouched through a SIGTERM-raced recommendation).
+python scripts/health_smoke.py
 # Warm-start smoke (ISSUE 6): program store populate -> clear-memory -> hit
 # on the CPU backend, byte-identical output, compile.store.hits >= 1. The
 # fresh-process bench is the slow-marked tests/test_bench_warmstart.py.

@@ -365,3 +365,112 @@ def test_cli_rack_blind_inspection_modes_still_warn(monkeypatch, capsys):
     assert rc == 0
     assert "CURRENT ASSIGNMENT:" in captured.out
     assert "WARNING" in captured.err and "rack" in captured.err
+
+
+def test_kafka_admin_traffic_lag_gating_and_batching(monkeypatch):
+    """ISSUE 11 traffic hook on the AdminClient: supports_traffic() is
+    True only when the WHOLE lag chain exists (groups + offsets + an
+    end-offset source) — a bare AdminClient must report synthetic
+    honestly — and the end-offset fetch is ONE batched call, never a
+    per-(group, partition) round trip."""
+    import collections
+    import sys
+    import types
+
+    from kafka_assigner_tpu.io.kafka_admin import KafkaAdminBackend
+    from kafka_assigner_tpu.obs.health import synthetic_partition_traffic
+
+    TopicPartition = collections.namedtuple(
+        "TopicPartition", ("topic", "partition")
+    )
+    Meta = collections.namedtuple("Meta", ("offset",))
+    end_calls = []
+
+    class BareAdmin:
+        def __init__(self, bootstrap_servers):
+            pass
+
+        def close(self):
+            pass
+
+    class LagAdmin(BareAdmin):
+        def list_consumer_groups(self):
+            return [("g1", "consumer"), ("g2", "consumer")]
+
+        def list_consumer_group_offsets(self, group):
+            committed = {"g1": 90, "g2": 40}[group]
+            return {TopicPartition("events", 0): Meta(committed),
+                    TopicPartition("events", 9): Meta(5),   # not wanted
+                    TopicPartition("events", 1): Meta(-1)}  # never committed
+
+        def end_offsets(self, tps):
+            end_calls.append(list(tps))
+            return {tp: 100 for tp in tps}
+
+    pkg = types.ModuleType("kafka")
+    pkg.KafkaAdminClient = BareAdmin
+    pkg.TopicPartition = TopicPartition
+    monkeypatch.setitem(sys.modules, "kafka", pkg)
+
+    bare = KafkaAdminBackend("b1:9092")
+    assert not bare.supports_traffic()
+    wanted = {"events": [0, 1]}
+    assert bare.fetch_partition_traffic(wanted) \
+        == synthetic_partition_traffic(wanted)
+
+    pkg.KafkaAdminClient = LagAdmin
+    lagged = KafkaAdminBackend("b1:9092")
+    assert lagged.supports_traffic()
+    out = lagged.fetch_partition_traffic(wanted)
+    # worst lag across groups: end 100 - min committed 40 = 60
+    assert out["events"][0].lag == 60
+    # byte rates stay synthetic even when lag is real
+    synth = synthetic_partition_traffic(wanted)
+    assert out["events"][0].in_bytes == synth["events"][0].in_bytes
+    # uncommitted partition keeps its synthetic lag
+    assert out["events"][1].lag == synth["events"][1].lag
+    # ONE batched end-offset call over the wanted set, not per group/part
+    assert len(end_calls) == 1
+    assert sorted(end_calls[0]) == [TopicPartition("events", 0),
+                                    TopicPartition("events", 1)]
+
+
+def test_kafka_admin_lag_sweep_failure_degrades_to_synthetic(
+    monkeypatch, capsys
+):
+    import collections
+    import sys
+    import types
+
+    from kafka_assigner_tpu.io.kafka_admin import KafkaAdminBackend
+    from kafka_assigner_tpu.obs.health import synthetic_partition_traffic
+
+    class BrokenLagAdmin:
+        def __init__(self, bootstrap_servers):
+            pass
+
+        def list_consumer_groups(self):
+            raise ConnectionError("coordinator flapping")
+
+        def list_consumer_group_offsets(self, group):
+            return {}
+
+        def end_offsets(self, tps):
+            return {}
+
+        def close(self):
+            pass
+
+    pkg = types.ModuleType("kafka")
+    pkg.KafkaAdminClient = BrokenLagAdmin
+    pkg.TopicPartition = collections.namedtuple(
+        "TopicPartition", ("topic", "partition")
+    )
+    monkeypatch.setitem(sys.modules, "kafka", pkg)
+
+    backend = KafkaAdminBackend("b1:9092")
+    assert backend.supports_traffic()
+    wanted = {"t": [0]}
+    assert backend.fetch_partition_traffic(wanted) \
+        == synthetic_partition_traffic(wanted)
+    assert "lag sweep failed" in capsys.readouterr().err

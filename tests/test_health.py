@@ -1,0 +1,409 @@
+"""The cluster-health observability plane (ISSUE 11): assignment scoring,
+movement debt, the traffic/lag backend hook, supervisor gauge publishing,
+and the observe-mode /recommendations endpoint — unit layers plus
+in-process daemon integration against the jute server."""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from kafka_assigner_tpu import faults
+from kafka_assigner_tpu.daemon import AssignerDaemon
+from kafka_assigner_tpu.io.base import PartitionTraffic
+from kafka_assigner_tpu.io.snapshot import SnapshotBackend
+from kafka_assigner_tpu.obs import flight, health
+from kafka_assigner_tpu.obs import metrics as metrics_mod
+
+from .jute_server import JuteZkServer
+from .test_daemon import req
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    faults.reset()
+    metrics_mod.disable_cumulative()
+    flight.disable()
+    yield
+    faults.reset()
+    metrics_mod.disable_cumulative()
+    flight.disable()
+
+
+@pytest.fixture(autouse=True)
+def _daemon_env(monkeypatch):
+    monkeypatch.setenv("KA_ZK_CLIENT", "wire")
+    monkeypatch.setenv("KA_DAEMON_RESYNC_INTERVAL", "0.5")
+
+
+def imbalanced_tree():
+    """Everything on brokers 1-2 of a four-broker/four-rack cluster:
+    predictable skew, zero rack violations, and a provably-improving
+    rebalance plan."""
+    tree = {}
+    for i in range(1, 5):
+        tree[f"/brokers/ids/{i}"] = json.dumps(
+            {"host": f"h{i}", "port": 9092, "rack": f"r{i}"}
+        ).encode()
+    tree["/brokers/topics/hot"] = json.dumps(
+        {"partitions": {str(p): [1, 2] for p in range(4)}}
+    ).encode()
+    return tree
+
+
+# --- score_assignment --------------------------------------------------------
+
+def test_balanced_cluster_scores_zero():
+    topics = {"t": {0: [1, 2], 1: [3, 4], 2: [2, 1], 3: [4, 3]}}
+    s = health.score_assignment(
+        {1, 2, 3, 4}, topics, {1: "ra", 2: "rb", 3: "ra", 4: "rb"}
+    )
+    assert s.replica_spread == 0
+    assert s.replica_stddev == 0.0
+    assert s.leader_spread == 0
+    assert s.rack_violations == 0
+    assert s.score == 0.0
+    assert (s.brokers, s.topics, s.partitions, s.replicas) == (4, 1, 4, 8)
+
+
+def test_skew_scores_spread_and_stddev():
+    topics = {"hot": {p: [1, 2] for p in range(4)}}
+    s = health.score_assignment(
+        {1, 2, 3, 4}, topics, {i: f"r{i}" for i in range(1, 5)}
+    )
+    # counts 4,4,0,0 -> spread 4, stddev 2; leaders all on 1 -> spread 4
+    assert s.replica_spread == 4
+    assert s.replica_stddev == 2.0
+    assert s.leader_spread == 4
+    assert s.score == 4 + 0.5 * 4  # no violations
+
+
+def test_empty_brokers_count_toward_imbalance():
+    s = health.score_assignment({1, 2, 3}, {"t": {0: [1]}}, {})
+    assert s.replica_spread == 1
+    assert s.brokers == 3
+
+
+def test_rack_violations_counted_per_partition():
+    topics = {"t": {0: [1, 2], 1: [1, 3], 2: [2, 3]}}
+    rack = {1: "ra", 2: "ra", 3: "rb"}
+    s = health.score_assignment({1, 2, 3}, topics, rack)
+    assert s.rack_violations == 1  # only partition 0 doubles rack ra
+    # unknown racks never violate (a rackless cluster scores clean)
+    s2 = health.score_assignment({1, 2, 3}, topics, {})
+    assert s2.rack_violations == 0
+
+
+def test_stray_replicas_outside_live_set_still_count():
+    s = health.score_assignment({1, 2}, {"t": {0: [1, 9]}}, {})
+    assert s.brokers == 3  # the stray broker 9 appears in the stats
+    assert s.replicas == 2
+
+
+def test_score_composite_weights_violations_heaviest():
+    clean = health.score_assignment(
+        {1, 2}, {"t": {0: [1, 2]}}, {1: "ra", 2: "rb"}
+    )
+    dirty = health.score_assignment(
+        {1, 2}, {"t": {0: [1, 2]}}, {1: "ra", 2: "ra"}
+    )
+    assert dirty.score == clean.score + 10.0
+
+
+# --- movement_debt -----------------------------------------------------------
+
+def test_movement_debt_identity_is_zero():
+    cur = {"t": {0: [1, 2], 1: [2, 3]}}
+    assert health.movement_debt(cur, cur) == (0, 0)
+
+
+def test_movement_debt_reorder_moves_leader_only():
+    # Same replica set, different preferred leader: zero data movement,
+    # one leadership move.
+    assert health.movement_debt(
+        {"t": {0: [1, 2]}}, {"t": {0: [2, 1]}}
+    ) == (0, 1)
+
+
+def test_movement_debt_counts_new_placements_and_one_sided_partitions():
+    cur = {"t": {0: [1, 2]}, "gone": {0: [5, 6]}}
+    new = {"t": {0: [2, 3]}, "fresh": {0: [7]}}
+    moves, leaders = health.movement_debt(cur, new)
+    # t/0: +3 (1 move); gone/0 vanishes (2); fresh/0 appears (1)
+    assert moves == 4
+    assert leaders == 3  # t leader 1->2, gone 5->None, fresh None->7
+
+
+# --- traffic hook ------------------------------------------------------------
+
+def test_synthetic_traffic_deterministic_and_skewed():
+    a = health.synthetic_partition_traffic({"events": [0, 1, 2, 3]})
+    b = health.synthetic_partition_traffic({"events": [3, 2, 1, 0]})
+    assert a == b
+    rates = {tr.in_bytes for tr in a["events"].values()}
+    assert len(rates) > 1  # skew-shaped, not a constant
+    for tr in a["events"].values():
+        assert isinstance(tr, PartitionTraffic)
+        assert tr.in_bytes > 0 and tr.lag >= 0
+
+
+def test_snapshot_traffic_section_overrides_synthetic(tmp_path):
+    snap = tmp_path / "c.json"
+    snap.write_text(json.dumps({
+        "brokers": [{"id": 1, "host": "h1", "port": 9092}],
+        "topics": {"t": {"0": [1], "1": [1]}},
+        "traffic": {"t": {"0": {"in_bytes": 1.5, "out_bytes": 2.5,
+                                "lag": 7}}},
+    }))
+    be = SnapshotBackend(str(snap))
+    assert be.supports_traffic()
+    tr = be.fetch_partition_traffic({"t": [0, 1]})
+    assert tr["t"][0] == PartitionTraffic(1.5, 2.5, 7)
+    # partition 1 has no recorded meter: synthetic fallback fills it
+    synth = health.synthetic_partition_traffic({"t": [1]})["t"][1]
+    assert tr["t"][1] == synth
+
+
+def test_snapshot_without_traffic_reports_synthetic(tmp_path):
+    snap = tmp_path / "c.json"
+    snap.write_text(json.dumps({
+        "brokers": [{"id": 1, "host": "h1", "port": 9092}],
+        "topics": {"t": {"0": [1]}},
+    }))
+    be = SnapshotBackend(str(snap))
+    assert not be.supports_traffic()
+    assert be.fetch_partition_traffic({"t": [0]}) \
+        == health.synthetic_partition_traffic({"t": [0]})
+
+
+def test_replace_gauges_swaps_series_atomically():
+    cum = metrics_mod.CumulativeMetrics()
+    base = {"cluster": "a"}
+    cum.replace_gauges(
+        "traffic.lag",
+        {(("partition", "0"), ("topic", "old")): 5.0}, base,
+    )
+    # another cluster's series must survive the swap
+    cum.replace_gauges(
+        "traffic.lag",
+        {(("partition", "0"), ("topic", "keep")): 9.0}, {"cluster": "b"},
+    )
+    cum.replace_gauges(
+        "traffic.lag",
+        {(("partition", "0"), ("topic", "new")): 6.0}, base,
+    )
+    series = cum.snapshot()["gauges"]["traffic.lag"]
+    labels = {dict(k)["topic"]: v for k, v in series.items()}
+    assert labels == {"new": 6.0, "keep": 9.0}
+
+
+# --- recommendation envelope validator ---------------------------------------
+
+def test_validate_recommendation_flags_missing_and_wrong():
+    assert health.validate_recommendation([]) \
+        == ["recommendation envelope is not a JSON object"]
+    problems = health.validate_recommendation({"schema_version": 99})
+    assert any("missing required key" in p for p in problems)
+    assert any("schema_version" in p for p in problems)
+    assert any("policy" in p for p in problems)
+
+
+# --- daemon integration ------------------------------------------------------
+
+def test_daemon_health_gauges_and_recommendations(monkeypatch):
+    from kafka_assigner_tpu.obs import promtext
+
+    monkeypatch.setenv("KA_HEALTH_MOVE_COST", "1000000")
+    server = JuteZkServer(imbalanced_tree())
+    server.start()
+    d = AssignerDaemon(clusters={"a": f"127.0.0.1:{server.port}"},
+                       solver="greedy")
+    try:
+        d.start()
+        port = d.http_port
+
+        # health gauges land per cluster in the scrape
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        fams = promtext.parse(text)
+        spread = fams["ka_health_replica_spread"]["samples"]
+        assert [(labels, v) for _n, labels, v in spread] \
+            == [({"cluster": "a"}, 4.0)]
+        lag_labels = {
+            (labels["topic"], labels["partition"])
+            for _n, labels, _v in fams["ka_traffic_lag"]["samples"]
+        }
+        assert ("hot", "0") in lag_labels
+
+        # observe-mode endpoint: valid, byte-stable, flips on move_cost
+        s, body, _h = req(port, "GET", "/clusters/a/recommendations")
+        assert s == 200
+        assert health.validate_recommendation(body) == []
+        assert body["verdict"] == "hold"  # knob is sky-high
+        assert body["candidate"]["moves_required"] > 0
+        assert body["cost_model"]["improvement"] > 0
+        s, body2, _h = req(port, "GET", "/clusters/a/recommendations")
+        assert body2 == body
+        s, low, _h = req(
+            port, "GET", "/clusters/a/recommendations?move_cost=0"
+        )
+        assert low["verdict"] == "recommend"
+        assert low["candidate"]["projected"]["replica_spread"] \
+            < body["current"]["replica_spread"]
+
+        # bad move_cost is a 400, not a crash
+        s, err, _h = req(
+            port, "GET", "/clusters/a/recommendations?move_cost=cheap"
+        )
+        assert s == 400 and "move_cost" in err["error"]
+
+        # multi-cluster bare path: helpful 400 naming the clusters
+        s, err, _h = req(port, "GET", "/recommendations")
+        assert s == 400 and err["clusters"] == ["a"]
+
+        # flight ring carries the audit trail; no writes ever happened
+        s, view, _h = req(port, "GET", "/clusters/a/debug/flight")
+        verdicts = [e["verdict"] for e in view["events"]
+                    if e["kind"] == "recommendation"]
+        assert verdicts == ["hold", "hold", "recommend"]
+        assert server.write_ops == {"create": 0, "setData": 0, "delete": 0}
+
+        # movement debt published as a gauge after the evaluations
+        cum = metrics_mod.cumulative()
+        assert cum is not None
+        snap = cum.snapshot()
+        assert snap["gauges"]["health.movement_debt"][
+            (("cluster", "a"),)
+        ] > 0
+    finally:
+        d.shutdown()
+        server.shutdown()
+
+
+def test_single_cluster_recommendations_and_unsynced_503(tmp_path):
+    snap = tmp_path / "c.json"
+    snap.write_text(json.dumps({
+        "brokers": [
+            {"id": i, "host": f"h{i}", "port": 9092, "rack": f"r{i}"}
+            for i in range(1, 5)
+        ],
+        "topics": {"hot": {str(p): [1, 2] for p in range(4)}},
+    }))
+    d = AssignerDaemon(str(snap), solver="greedy")
+    try:
+        d.start()
+        port = d.http_port
+        s, body, _h = req(port, "GET", "/recommendations?move_cost=0")
+        assert s == 200
+        assert health.validate_recommendation(body) == []
+        assert body["cluster"] == "default"
+        assert body["verdict"] == "recommend"
+        # single-cluster health gauges carry NO cluster label
+        cum = metrics_mod.cumulative()
+        assert () in cum.snapshot()["gauges"]["health.replica_spread"]
+    finally:
+        d.shutdown()
+    # the snapshot file itself is untouched (observe-only, no persists)
+    assert json.loads(snap.read_text())["topics"]["hot"]["0"] == [1, 2]
+
+
+def test_watch_churn_republishes_health_gauges():
+    from kafka_assigner_tpu.io.zkwire import MiniZkClient
+
+    server = JuteZkServer(imbalanced_tree())
+    server.start()
+    d = AssignerDaemon(clusters={"a": f"127.0.0.1:{server.port}"},
+                       solver="greedy")
+    try:
+        d.start()
+        cum = metrics_mod.cumulative()
+
+        def spread():
+            return cum.snapshot()["gauges"]["health.replica_spread"][
+                (("cluster", "a"),)
+            ]
+
+        assert spread() == 4
+        w = MiniZkClient(f"127.0.0.1:{server.port}")
+        w.start()
+        try:
+            # counter-skew topic: pile replicas on the empty brokers
+            w.create("/brokers/topics/counter",
+                     b'{"partitions": {"0": [3, 4], "1": [3, 4], '
+                     b'"2": [3, 4], "3": [3, 4]}}')
+        finally:
+            w.close()
+        import time
+
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and spread() != 0:
+            time.sleep(0.05)
+        assert spread() == 0  # 4,4,0,0 + 0,0,4,4 -> balanced
+    finally:
+        d.shutdown()
+        server.shutdown()
+
+
+def test_recommendations_watchdog_flags_overrun(tmp_path):
+    """A recommendation solve that overruns its budget must be visible to
+    the same overrun telemetry as every other solve-bearing request."""
+    import time
+
+    snap = tmp_path / "c.json"
+    snap.write_text(json.dumps({
+        "brokers": [
+            {"id": i, "host": f"h{i}", "port": 9092, "rack": f"r{i}"}
+            for i in range(1, 5)
+        ],
+        "topics": {"hot": {str(p): [1, 2] for p in range(4)}},
+    }))
+    d = AssignerDaemon(str(snap), solver="greedy")
+    try:
+        d.start()
+        sup = d.supervisor()
+        sup.request_timeout = 0.0  # the live-budget override tests use
+        code, body, _h = sup.recommendations({"move_cost": "0"})
+        assert code == 200 and body["verdict"] == "recommend"
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline \
+                and sup.counters().get("daemon.watchdog_exceeded", 0) < 1:
+            time.sleep(0.01)
+        assert sup.counters()["daemon.watchdog_exceeded"] >= 1
+        rec = flight.recorder()
+        assert any(
+            e["kind"] == "watchdog" and e["path"] == "/recommendations"
+            for e in rec.snapshot()
+        )
+    finally:
+        d.shutdown()
+
+
+def test_recommendations_shed_when_inflight_full(tmp_path, monkeypatch):
+    """The shared admission gate covers /recommendations: with the live
+    inflight knob at 1 and the slot held, the endpoint sheds 503."""
+    snap = tmp_path / "c.json"
+    snap.write_text(json.dumps({
+        "brokers": [{"id": 1, "host": "h1", "port": 9092}],
+        "topics": {"t": {"0": [1]}},
+    }))
+    monkeypatch.setenv("KA_DAEMON_MAX_INFLIGHT", "1")
+    d = AssignerDaemon(str(snap), solver="greedy")
+    try:
+        d.start()
+        sup = d.supervisor()
+        assert sup._gate() is None  # hold the one slot
+        try:
+            code, body, headers = sup.recommendations({})
+            assert code == 503 and body["error"] == "overloaded"
+            assert headers["Retry-After"] == "1"
+            assert sup.counters()["daemon.requests_shed"] == 1
+        finally:
+            sup._release()
+        code, _body, _h = sup.recommendations({})
+        assert code == 200
+    finally:
+        d.shutdown()

@@ -867,3 +867,64 @@ def test_ka013_registry_tables_are_disjoint_and_nonempty():
     assert METRIC_NAMES and SPAN_NAMES
     assert not (METRIC_NAMES & SPAN_NAMES)
     assert ALL_NAMES == METRIC_NAMES | SPAN_NAMES
+
+
+# --- KA014: every metric states its unit or is declared unitless -------------
+
+def test_ka014_trips_on_suffixless_undeclared_metric():
+    findings = kalint.check_metric_units(
+        metric_names={"foo.latency"}, unitless=set(),
+    )
+    assert [f.rule for f in findings] == ["KA014"]
+    assert "foo.latency" in findings[0].message
+    assert "unit suffix" in findings[0].message
+
+
+@pytest.mark.parametrize("name", [
+    "foo.wait_ms", "zk.bytes", "io.read_bytes", "used.heap_frac",
+    "foo.requests_total", "uptime.seconds",
+])
+def test_ka014_unit_suffixed_names_pass(name):
+    assert kalint.check_metric_units(
+        metric_names={name}, unitless=set(),
+    ) == []
+
+
+def test_ka014_unit_token_must_be_a_suffix_not_a_substring():
+    # "bytes" mid-segment is NOT a unit statement (the grandfathered
+    # zk.wire_bytes_in names are allowlisted precisely because of this)
+    findings = kalint.check_metric_units(
+        metric_names={"zk.wire_bytes_in"}, unitless=set(),
+    )
+    assert [f.rule for f in findings] == ["KA014"]
+    assert kalint.check_metric_units(
+        metric_names={"zk.wire_bytes_in"}, unitless={"zk.wire_bytes_in"},
+    ) == []
+
+
+def test_ka014_allowlisted_unitless_passes():
+    assert kalint.check_metric_units(
+        metric_names={"daemon.requests"}, unitless={"daemon.requests"},
+    ) == []
+
+
+def test_ka014_stale_allowlist_entry_is_a_finding():
+    findings = kalint.check_metric_units(
+        metric_names=set(), unitless={"ghost.metric"},
+    )
+    assert [f.rule for f in findings] == ["KA014"]
+    assert "stale" in findings[0].message
+
+
+def test_ka014_double_declared_name_is_a_finding():
+    findings = kalint.check_metric_units(
+        metric_names={"exec.wave_ms"}, unitless={"exec.wave_ms"},
+    )
+    assert [f.rule for f in findings] == ["KA014"]
+    assert "pick one" in findings[0].message
+
+
+def test_ka014_repo_registry_is_clean():
+    """The live registry (obs/names.py METRIC_NAMES vs UNITLESS_METRICS)
+    passes its own rule — the repo-wide sweep the lint gate runs."""
+    assert kalint.check_metric_units() == []

@@ -587,7 +587,198 @@ def test_daemon_lifetime_metrics_survive_requests(server):
             assert "daemon.requests" not in body["metrics"]["counters"]
         cum = metrics_mod.cumulative()
         assert cum.counter_value("daemon.requests") == 3
-        # time flows only forward in the http latency histogram
-        snap = cum.snapshot()
+        # time flows only forward in the http latency histogram. The
+        # routing layer observes it AFTER the response bytes go out (the
+        # latency covers the whole request), so wait out that last write
+        # instead of racing it.
         key = (("cluster", "default"), ("endpoint", "plan"))
-        assert snap["hists"]["daemon.http.request_ms"][key]["count"] == 3
+
+        def hist_count():
+            snap = cum.snapshot()
+            return snap["hists"]["daemon.http.request_ms"][key]["count"]
+
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and hist_count() < 3:
+            time.sleep(0.01)
+        assert hist_count() == 3
+
+
+# --- promtext edge cases (ISSUE 11 satellite) --------------------------------
+
+def test_empty_registry_scrape_round_trips():
+    """A daemon scraped before any traffic: the exposition of an empty
+    registry must still be valid (and parse to no families), not a
+    zero-length body some scrapers treat as an outage."""
+    empty = {"counters": {}, "gauges": {}, "hists": {}}
+    text = promtext.render(empty)
+    assert text == "\n"
+    assert promtext.parse(text) == {}
+    # with only the process gauges (what a freshly-started daemon serves)
+    text = promtext.render(empty, extra_gauges={"daemon_clusters": 2},
+                           info={"tool": "x"})
+    fams = promtext.parse(text)
+    assert set(fams) == {"ka_build_info", "ka_daemon_clusters"}
+
+
+def test_histogram_with_zero_observations_is_consistent():
+    """A histogram family whose series never observed anything: all-zero
+    cumulative buckets, +Inf == _count == 0, _sum == 0 — consistent, not a
+    divide-by-zero or a missing-bucket finding."""
+    cum = metrics_mod.CumulativeMetrics(hist_edges=(1.0, 10.0))
+    cum.hist_observe("exec.wave_ms", 5.0)  # force the dict entry...
+    snap = cum.snapshot()
+    h = snap["hists"]["exec.wave_ms"][()]
+    h["counts"] = [0] * len(h["counts"])  # ...then zero it out
+    h["count"] = 0
+    h["sum"] = 0.0
+    text = promtext.render(snap)
+    fam = promtext.parse(text)["ka_exec_wave_ms"]
+    assert promtext.check_histogram(fam) == []
+    buckets = {lb["le"]: v for n, lb, v in fam["samples"]
+               if n.endswith("_bucket")}
+    assert buckets == {"1": 0, "10": 0, "+Inf": 0}
+
+
+def test_escaped_label_values_round_trip_hard_cases():
+    """Label values that LOOK like escape sequences must survive the
+    render->parse round trip byte-exactly: literal backslash-n (not a
+    newline), quote-backslash runs, and a real newline next to them."""
+    cases = ["a\\nb", 'q"\\"w', "line1\nline2\\", "\\\\", "plain"]
+    cum = metrics_mod.CumulativeMetrics()
+    for i, v in enumerate(cases):
+        cum.counter_add("daemon.requests", i + 1, labels={"cluster": v})
+    fams = promtext.parse(promtext.render(cum.snapshot()))
+    got = {lb["cluster"]: v
+           for _n, lb, v in fams["ka_daemon_requests_total"]["samples"]}
+    assert got == {v: i + 1.0 for i, v in enumerate(cases)}
+
+
+def test_scrape_raced_against_sigterm_drain(server):
+    """/metrics hammered while another thread drains the daemon: every
+    response that arrives must be a complete, parseable exposition with
+    consistent histograms — never a torn half-render — and refused
+    connections after the drain are the only acceptable failure."""
+    d = AssignerDaemon(f"127.0.0.1:{server.port}", solver="greedy")
+    d.start()
+    port = d.http_port
+    s, _body, _h = req(port, "POST", "/plan", {})
+    assert s == 200
+    results = {"scrapes": 0, "torn": []}
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=5
+                )
+                conn.request("GET", "/metrics")
+                resp = conn.getresponse()
+                raw = resp.read()
+                conn.close()
+            except OSError:
+                break  # the listener is gone: the race is over
+            if resp.status != 200:
+                continue
+            try:
+                fams = promtext.parse(raw.decode("utf-8"))
+                for fam, data in fams.items():
+                    if data["type"] == "histogram":
+                        assert promtext.check_histogram(data) == [], fam
+            except (promtext.PromParseError, AssertionError) as e:
+                results["torn"].append(str(e))
+                break
+            results["scrapes"] += 1
+
+    threads = [threading.Thread(target=scraper) for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)  # let the scrapers land a few pre-drain rounds
+    d.shutdown()
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert results["torn"] == []
+    assert results["scrapes"] > 0
+
+
+# --- access-log rollover (ISSUE 11 satellite) --------------------------------
+
+def test_access_log_rollover_caps_size(tmp_path, monkeypatch):
+    monkeypatch.setenv("KA_OBS_ACCESS_LOG_MAX_MB", "1")
+    path = tmp_path / "access.ndjson"
+    log = AccessLog(str(path))
+    filler = "x" * 4096
+    lines_to_fill = (1024 * 1024) // 4096 + 2
+    for i in range(lines_to_fill):
+        log.log(request_id=f"r{i}", pad=filler)
+    # the cap tripped: current file restarted, .1 holds the old bytes
+    rolled = tmp_path / "access.ndjson.1"
+    assert rolled.exists()
+    assert rolled.stat().st_size >= 1024 * 1024
+    assert path.stat().st_size < 1024 * 1024
+    # every line is intact on one side of the boundary or the other
+    all_lines = (rolled.read_text() + path.read_text()).splitlines()
+    ids = [json.loads(ln)["request_id"] for ln in all_lines]
+    assert ids == [f"r{i}" for i in range(lines_to_fill)]
+    # a second rollover REPLACES .1 (bounded at ~2x the cap, never 3x)
+    first_rolled_head = rolled.read_text().splitlines()[0]
+    for i in range(lines_to_fill):
+        log.log(request_id=f"s{i}", pad=filler)
+    log.close()
+    assert rolled.read_text().splitlines()[0] != first_rolled_head
+    assert not (tmp_path / "access.ndjson.2").exists()
+
+
+def test_access_log_unbounded_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("KA_OBS_ACCESS_LOG_MAX_MB", raising=False)
+    path = tmp_path / "access.ndjson"
+    log = AccessLog(str(path))
+    for i in range(50):
+        log.log(request_id=f"r{i}", pad="y" * 1000)
+    log.close()
+    assert not (tmp_path / "access.ndjson.1").exists()
+    assert len(path.read_text().splitlines()) == 50
+
+
+def test_access_log_rollover_resumes_count_across_restart(
+    tmp_path, monkeypatch
+):
+    """A restarted daemon opens the log in append mode: the cap must count
+    the EXISTING bytes, not restart from zero and overshoot 2x."""
+    monkeypatch.setenv("KA_OBS_ACCESS_LOG_MAX_MB", "1")
+    path = tmp_path / "access.ndjson"
+    filler = "z" * 4096
+    log = AccessLog(str(path))
+    for i in range(100):  # ~400 KB, under the cap
+        log.log(request_id=f"a{i}", pad=filler)
+    log.close()
+    log2 = AccessLog(str(path))  # restart
+    n = 0
+    while not (tmp_path / "access.ndjson.1").exists():
+        log2.log(request_id=f"b{n}", pad=filler)
+        n += 1
+        assert n < 400, "rollover never tripped after restart"
+    log2.close()
+    # tripped well before a full fresh 1 MB of post-restart lines
+    assert n < 200
+
+
+def test_access_log_rollover_failure_reported_once(tmp_path, monkeypatch):
+    """A persistently failing rollover (unrenameable .1 target) must warn
+    ONCE and disable further attempts — never a stderr line plus a
+    close/reopen per served request — while appending keeps working."""
+    monkeypatch.setenv("KA_OBS_ACCESS_LOG_MAX_MB", "1")
+    path = tmp_path / "access.ndjson"
+    (tmp_path / "access.ndjson.1").mkdir()  # os.replace onto a dir fails
+    err = io.StringIO()
+    log = AccessLog(str(path), err=err)
+    filler = "x" * 4096
+    n = (1024 * 1024) // 4096 + 10
+    for i in range(n):
+        log.log(request_id=f"r{i}", pad=filler)
+    log.close()
+    assert err.getvalue().count("rollover failed") == 1
+    assert err.getvalue().count("rollover disabled") == 1
+    # every line still landed in the (now over-cap) primary file
+    assert len(path.read_text().splitlines()) == n
