@@ -1,11 +1,19 @@
 """Project-native static analysis.
 
-- :mod:`.kalint` — the AST linter enforcing the knob-registry and
-  jit-boundary house rules (rules KA001-KA005; ``python -m
-  kafka_assigner_tpu.analysis.kalint``).
+- :mod:`.kalint` — the interprocedural static analyzer (rules
+  KA000-KA017): per-module AST checks plus a project-wide resolution
+  layer (import graph, symbol tables, call graph) feeding a taint engine
+  (traced set across module boundaries, solve-lock-held set) and a
+  content-hash analysis cache. ``python -m
+  kafka_assigner_tpu.analysis.kalint`` (``--explain KA0NN`` for call
+  chains, ``--format json`` for CI).
 - :mod:`.knobdoc` — generates the README "Tuning knobs" table from the
-  declarative registry in ``utils/env.py`` (``--check`` catches docs drift).
+  declarative registry in ``utils/env.py`` (``--check`` catches docs
+  drift).
+- :mod:`.ruledoc` — generates the README kalint rule table from the
+  ``RULE_DOCS`` catalog (``--check`` catches rule-doc drift the same
+  way).
 
-No eager re-exports: both submodules double as ``python -m`` entry points,
+No eager re-exports: the submodules double as ``python -m`` entry points,
 and importing them here would shadow that (runpy's double-import warning).
 """

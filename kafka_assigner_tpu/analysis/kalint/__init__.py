@@ -1,0 +1,92 @@
+"""``kalint`` — the project-native static analyzer, now interprocedural.
+
+The system's value proposition is byte-compatibility with the reference
+assigner under a large surface of tuning knobs; the correctness risks that
+grow with the codebase are silent config drift, host-sync leaking into
+jitted solver paths, and — since the daemon became a concurrent,
+lock-mediated service — blocking work hiding behind the shared solve lock
+and handlers reaching across cluster bulkheads. ``kalint`` machine-checks
+all of it, and since ISSUE 12 it does so PROJECT-WIDE: an import graph,
+per-module symbol tables and a call graph over the whole package
+(:mod:`.resolve`) feed a taint engine (:mod:`.taint`) that computes the
+transitive *traced set* (everything reachable from a ``jax.jit``/``pjit``/
+``shard_map`` entry, across modules) and the *lock-held set* (everything
+reachable from a ``with <solve-lock>`` region in ``daemon/``), so KA002/
+KA007 fire anywhere in the traced set, KA012 is transitive, and the graph
+powers three rules a single-file pass cannot see (KA015–KA017).
+
+The rule catalog (KA000–KA017) lives in :data:`RULES` with one-line
+meanings and example chains in :data:`RULE_DOCS`; the README rule table is
+generated from it (``python -m kafka_assigner_tpu.analysis.ruledoc
+--write``).
+
+Suppression: put ``# kalint: disable=KA002 -- <reason>`` on the offending
+line, on its own line directly above, or on ANY physical line the wrapped
+statement spans. The reason is mandatory — a reasonless suppression is
+itself a finding (KA000) and does not suppress.
+
+Run ``python -m kafka_assigner_tpu.analysis.kalint`` (no args: lint the
+whole package interprocedurally through the content-hash cache, plus the
+README check; exit non-zero on findings), pass explicit file paths for
+single-file mode, ``--explain KA0NN`` for offending call chains, or
+``--format json --out f.json`` for CI. ``scripts/lint.sh`` wires all of it
+into the tier-1 gate.
+"""
+from __future__ import annotations
+
+from .findings import (  # noqa: F401
+    Finding,
+    SuppressionIndex,
+    dedupe_findings,
+    finalize,
+    sort_findings,
+)
+from .resolve import (  # noqa: F401
+    FUNC_SEP,
+    FunctionInfo,
+    ModuleInfo,
+    Project,
+    build_project,
+    func_key,
+    split_key,
+)
+from .taint import (  # noqa: F401
+    JIT_WRAPPER_NAMES,
+    LockRegion,
+    TaintResult,
+    jit_roots,
+    lock_held_set,
+    lock_regions,
+    traced_set,
+)
+from .rules import (  # noqa: F401
+    BUCKET_BOUNDARY_MODULES,
+    BULKHEAD_ATTRS,
+    DAEMON_BULKHEAD_MODULES,
+    DAEMON_PKG_PREFIX,
+    EITHER_NAME_CALLS,
+    ENV_ACCESSOR_NAMES,
+    JSON_BOUNDARY_MODULE,
+    KERNEL_MODULES,
+    METRIC_NAME_CALLS,
+    METRIC_UNIT_TOKENS,
+    OBS_WRITE_NAMES,
+    REGISTRY_MODULE,
+    RULE_DOCS,
+    RULES,
+    SERIAL_WRITE_FUNCS,
+    SPAN_NAME_CALLS,
+    SUPERVISOR_CLASS,
+    WIRE_MODULE,
+    WRITE_OPCODES,
+    ZK_WRITE_FUNC_NAMES,
+    check_metric_units,
+    check_readme,
+    project_findings,
+)
+from .driver import (  # noqa: F401
+    lint_package,
+    lint_source,
+    lint_tree,
+)
+from .cli import main  # noqa: F401

@@ -1,0 +1,6 @@
+"""``python -m kafka_assigner_tpu.analysis.kalint`` dispatch."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

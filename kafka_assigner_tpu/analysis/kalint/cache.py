@@ -1,0 +1,149 @@
+"""Content-hash-keyed analysis cache.
+
+The interprocedural pass re-reads and re-resolves the whole package; the
+cache keeps ``scripts/lint.sh`` inside its wall-clock budget by keying the
+COMPLETE finding set on a fingerprint of everything that can change it:
+
+- every ``*.py`` under the analyzed root (path + content sha),
+- the kalint implementation itself (rule changes invalidate),
+- the live registries the rules consult (knobs, metric/span names,
+  unitless allowlist),
+- the README text (KA004 reads it),
+- the analysis schema version (bumped on format changes).
+
+A hit returns the stored findings verbatim (chains included); any edit
+anywhere misses and re-analyzes. Entries are whole-tree — correct by
+construction, no per-file invalidation logic to get wrong — and pruned to
+the newest few so the directory stays small. Writes are atomic
+(tmp+rename) and corruption-tolerant on read (drop + re-analyze).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .findings import Finding
+
+#: Bump on any change to the finding schema or rule semantics that a file
+#: hash would not capture (kalint's own sources are hashed too, so this is
+#: belt-and-braces for out-of-tree callers).
+ANALYSIS_SCHEMA = 1
+
+#: Cache entries kept (newest by mtime); the rest are pruned on store.
+KEEP_ENTRIES = 8
+
+
+def default_cache_dir(repo_root: Path) -> Path:
+    from ...utils.env import env_str
+
+    configured = env_str("KA_LINT_CACHE_DIR")
+    if configured:
+        return Path(configured)
+    return repo_root / ".kalint-cache"
+
+
+def cache_enabled() -> bool:
+    from ...utils.env import env_bool
+
+    return env_bool("KA_LINT_CACHE")
+
+
+def _file_sha(path: Path) -> Optional[str]:
+    try:
+        return hashlib.sha256(path.read_bytes()).hexdigest()
+    except OSError:
+        return None
+
+
+def tree_fingerprint(root: Path, extra_files: Sequence[Path] = (),
+                     registry_blob: str = "") -> str:
+    """One sha over every analysis input under ``root`` plus the kalint
+    implementation, the extra files (README) and the registry snapshot."""
+    h = hashlib.sha256()
+    h.update(f"schema={ANALYSIS_SCHEMA}\n".encode())
+    kalint_dir = Path(__file__).resolve().parent
+    seen = set()
+    for base in (root, kalint_dir):
+        for p in sorted(base.rglob("*.py")):
+            if "__pycache__" in p.parts or p in seen:
+                continue
+            seen.add(p)
+            sha = _file_sha(p)
+            if sha is None:
+                continue
+            h.update(f"{p.as_posix()}={sha}\n".encode())
+    for p in extra_files:
+        sha = _file_sha(Path(p))
+        h.update(f"{Path(p).as_posix()}={sha}\n".encode())
+    h.update(registry_blob.encode())
+    return h.hexdigest()
+
+
+def registry_blob(knobs, metric_names, span_names, unitless) -> str:
+    # kalint: disable=KA005 -- cache-key fingerprint input, not a Kafka plan payload
+    return json.dumps({
+        "knobs": sorted(knobs),
+        "metric_names": sorted(metric_names),
+        "span_names": sorted(span_names),
+        "unitless": sorted(unitless),
+    }, sort_keys=True)
+
+
+def load(cache_dir: Path, key: str) -> Optional[List[Finding]]:
+    entry = cache_dir / f"{key}.json"
+    try:
+        payload = json.loads(entry.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return None
+    if payload.get("schema") != ANALYSIS_SCHEMA or payload.get("key") != key:
+        return None
+    try:
+        findings = [Finding.from_dict(d) for d in payload["findings"]]
+    except (KeyError, TypeError, ValueError):
+        return None
+    try:
+        os.utime(entry)  # LRU recency for the prune below
+    except OSError:  # kalint: disable=KA008 -- recency refresh is advisory
+        pass
+    return findings
+
+
+def store(cache_dir: Path, key: str, findings: Sequence[Finding]) -> None:
+    try:
+        cache_dir.mkdir(parents=True, exist_ok=True)
+        payload = {
+            "schema": ANALYSIS_SCHEMA,
+            "key": key,
+            "findings": [f.to_dict() for f in findings],
+        }
+        fd, tmp = tempfile.mkstemp(
+            dir=str(cache_dir), prefix=".tmp-", suffix=".json"
+        )
+        with os.fdopen(fd, "w", encoding="utf-8") as fh:
+            # kalint: disable=KA005 -- analysis cache entry, not a Kafka plan payload
+            json.dump(payload, fh)
+        os.replace(tmp, cache_dir / f"{key}.json")
+        _prune(cache_dir)
+    except OSError:
+        # A read-only or full cache dir must never fail the lint run; the
+        # next run simply re-analyzes.
+        return
+
+
+def _prune(cache_dir: Path) -> None:
+    entries: List[Tuple[float, Path]] = []
+    for p in cache_dir.glob("*.json"):
+        try:
+            entries.append((p.stat().st_mtime, p))
+        except OSError:  # kalint: disable=KA008 -- entry raced away; nothing to prune
+            pass
+    entries.sort(reverse=True)
+    for _, p in entries[KEEP_ENTRIES:]:
+        try:
+            p.unlink()
+        except OSError:  # kalint: disable=KA008 -- concurrent prune won; goal state reached
+            pass

@@ -1,0 +1,151 @@
+"""The ``python -m kafka_assigner_tpu.analysis.kalint`` entry point.
+
+Modes:
+
+- no paths — interprocedural package lint (import graph + call graph +
+  taint sets) served through the content-hash cache; ``--root`` points it
+  at another package tree (fixtures, tests).
+- explicit paths — single-file mode: per-module rules only, no graph, no
+  cache (the pre-ISSUE-12 behavior; fast editor integration).
+
+Output:
+
+- text (default) — one ``path:line:col: RULE message`` per finding.
+- ``--format json [--out FILE]`` — machine-readable, deterministic:
+  findings sorted by (path, line, rule), duplicate reports of one
+  violation (same rule/path/line/col — e.g. a graph finding's per-module
+  twin) merged chain-preferentially, chains included. Cache status goes
+  to stderr only, so two identical runs produce byte-identical payloads.
+- ``--explain KA0NN`` (repeatable) — after the findings, print every
+  offending call chain (entry → … → sink) for that rule's graph-backed
+  findings.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from .driver import lint_package, lint_source
+from .findings import Finding, finalize
+from .rules import RULES
+
+
+def _print_explanations(findings: Sequence[Finding], rule: str) -> None:
+    picked = [f for f in findings if f.rule == rule]
+    if not picked:
+        print(f"--explain {rule}: no findings for this rule")
+        return
+    for f in picked:
+        print(f"{rule} at {f.path}:{f.line}: {f.message}")
+        if f.chain:
+            print("  chain:")
+            for i, hop in enumerate(f.chain):
+                arrow = "  " if i == 0 else "→ "
+                print(f"    {arrow}{hop}")
+        else:
+            print("  (per-module rule: no call chain — the finding site "
+                  "is the whole story)")
+
+
+def _json_payload(findings: Sequence[Finding], root: str) -> dict:
+    return {
+        "schema_version": 1,
+        "tool": "kalint",
+        "root": root,
+        "count": len(findings),
+        "findings": [f.to_dict() for f in findings],
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="kalint", description="project-native static analysis "
+        "(knob registry + jit-boundary + interprocedural taint/lock/"
+        "bulkhead house rules)",
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="files to lint in single-file mode (default: "
+                             "the whole package, interprocedurally, plus "
+                             "the README knob check)")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--root", metavar="DIR",
+                        help="package tree to lint instead of the installed "
+                             "kafka_assigner_tpu (fixture trees, tests)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text", dest="fmt")
+    parser.add_argument("--out", metavar="FILE",
+                        help="write the report there instead of stdout")
+    parser.add_argument("--explain", action="append", default=[],
+                        metavar="KA0NN",
+                        help="print the offending call chain for every "
+                             "graph-backed finding of this rule "
+                             "(repeatable)")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="bypass the content-hash analysis cache")
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for rule, desc in RULES.items():
+            print(f"{rule}  {desc}")
+        return 0
+    for rule in args.explain:
+        if rule not in RULES:
+            parser.error(f"--explain {rule}: unknown rule "
+                         f"(see --list-rules)")
+    status: dict = {}
+    if args.paths:
+        pkg = Path(__file__).resolve().parents[2]
+        findings: List[Finding] = []
+        for raw in args.paths:
+            p = Path(raw).resolve()
+            try:
+                rel = p.relative_to(pkg).as_posix()
+            except ValueError:
+                rel = p.name
+            findings.extend(
+                lint_source(p.read_text(encoding="utf-8"), rel, path=raw)
+            )
+        root_desc = "<files>"
+    else:
+        findings = lint_package(
+            root=args.root,
+            use_cache=False if args.no_cache else None,
+            _status=status,
+        )
+        root_desc = args.root or "kafka_assigner_tpu"
+    findings = finalize(findings)
+    if args.fmt == "json":
+        import json as _json
+
+        # kalint: disable=KA005 -- lint report for CI, not a Kafka plan payload
+        text = _json.dumps(_json_payload(findings, root_desc), indent=1,
+                           sort_keys=True)
+        if args.out:
+            Path(args.out).write_text(text + "\n", encoding="utf-8")
+        else:
+            print(text)
+    else:
+        out_lines = [str(f) for f in findings]
+        if args.out:
+            Path(args.out).write_text(
+                "".join(line + "\n" for line in out_lines),
+                encoding="utf-8",
+            )
+        else:
+            for line in out_lines:
+                print(line)
+    for rule in args.explain:
+        _print_explanations(findings, rule)
+    n = len(findings)
+    if status.get("cache"):
+        print(
+            f"kalint: analysis cache {status['cache']}"
+            + (f" ({status['key'][:12]})" if status.get("key") else ""),
+            file=sys.stderr,
+        )
+    print(
+        f"kalint: {n} finding(s)" if n else "kalint: clean",
+        file=sys.stderr,
+    )
+    return 1 if findings else 0

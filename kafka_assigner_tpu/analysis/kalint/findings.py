@@ -1,0 +1,179 @@
+"""Finding model, suppression handling, and deterministic output shaping.
+
+A :class:`Finding` is one rule violation at one source location; graph-backed
+rules attach the offending call ``chain`` (entry → … → sink) so ``--explain``
+and the JSON output can show WHY a cross-module fact fired, not just where.
+
+Suppression contract (unchanged since ISSUE 1, extended for wrapped
+statements in ISSUE 12): ``# kalint: disable=KA0NN -- <reason>`` on the
+offending line, on the line directly above, or — for a statement wrapped
+over several physical lines — on ANY physical line the statement spans
+(the reported line is always the statement's first line, but a trailing
+comment naturally lands on the last). A reasonless suppression is itself a
+finding (KA000) and suppresses nothing.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*kalint:\s*disable=([A-Z0-9, ]+?)\s*(?:--\s*(\S.*))?$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    #: Offending call chain for graph-backed findings (entry → … → sink),
+    #: each hop ``"<relpath>::<qualname>@<line>"``; empty for single-file
+    #: rules. Compared/hased like any other field, but excluded from the
+    #: identity dedupe key (two chains to one sink are still one finding).
+    chain: Tuple[str, ...] = field(default=())
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict:
+        d = {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+        if self.chain:
+            d["chain"] = list(self.chain)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        return cls(
+            rule=d["rule"], path=d["path"], line=int(d["line"]),
+            col=int(d["col"]), message=d["message"],
+            chain=tuple(d.get("chain") or ()),
+        )
+
+
+def sort_findings(findings: Iterable[Finding]) -> List[Finding]:
+    """Deterministic order: (path, line, rule) first — the stable-diff
+    contract for ``--format json`` — with col/message as tiebreakers so the
+    order is total regardless of dict/set iteration order or Python
+    version."""
+    return sorted(
+        findings, key=lambda f: (f.path, f.line, f.rule, f.col, f.message)
+    )
+
+
+def dedupe_findings(findings: Sequence[Finding]) -> List[Finding]:
+    """Drop duplicate reports of one violation — identical
+    (rule, path, line, col) — keeping, per group, a chain-bearing finding
+    when one exists (the chain is the explanation; the per-module twin of
+    a graph finding anchors to the SAME call node and adds nothing). The
+    col in the key is what keeps two DISTINCT sinks sharing a source line
+    both reported. Input order is preserved for the survivors; callers
+    sort first."""
+    best: Dict[Tuple[str, str, int, int], Finding] = {}
+    order: List[Tuple[str, str, int, int]] = []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.col)
+        if key not in best:
+            best[key] = f
+            order.append(key)
+        elif f.chain and not best[key].chain:
+            best[key] = f
+    return [best[k] for k in order]
+
+
+def finalize(findings: Iterable[Finding]) -> List[Finding]:
+    """sort + dedupe: the printed/serialized form."""
+    return dedupe_findings(sort_findings(findings))
+
+
+def _effective_span(stmt: ast.stmt) -> Tuple[int, int]:
+    """The physical lines a suppression comment may ride on for ``stmt``:
+    the full span for simple statements (a wrapped call's trailing comment
+    sits on its last line), the HEADER only for compound statements (a
+    comment inside a ``while``/``with`` body must not suppress a finding
+    anchored on the header — the body's own statements carry their own
+    spans)."""
+    body = getattr(stmt, "body", None)
+    if isinstance(body, list) and body and isinstance(body[0], ast.stmt):
+        end = max(stmt.lineno, body[0].lineno - 1)
+    else:
+        end = getattr(stmt, "end_lineno", None) or stmt.lineno
+    return stmt.lineno, end
+
+
+class SuppressionIndex:
+    """Per-module suppression state: the comment-line table (a comment
+    covers its own line and the one below), the statement spans that widen
+    coverage to every physical line a wrapped statement occupies, and the
+    KA000 metas for reasonless suppressions."""
+
+    def __init__(self, src: str, path: str, tree: ast.AST | None = None):
+        self.path = path
+        self.table: Dict[int, Set[str]] = {}
+        self.metas: List[Finding] = []
+        self._spans: List[Tuple[int, int]] = []
+        self._scan_comments(src, path)
+        if tree is not None:
+            for node in ast.walk(tree):
+                if isinstance(node, ast.stmt):
+                    self._spans.append(_effective_span(node))
+
+    def _scan_comments(self, src: str, path: str) -> None:
+        try:
+            comments = [
+                t for t in tokenize.generate_tokens(io.StringIO(src).readline)
+                if t.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            comments = []  # unparsable source is KA000 via ast.parse already
+        for tok in comments:
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            lineno = tok.start[0]
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = (m.group(2) or "").strip()
+            if not reason:
+                self.metas.append(Finding(
+                    "KA000", path, lineno, tok.start[1] + m.start() + 1,
+                    "suppression requires a reason: "
+                    "'# kalint: disable=KAnnn -- <why>'",
+                ))
+                continue
+            self.table.setdefault(lineno, set()).update(rules)
+            self.table.setdefault(lineno + 1, set()).update(rules)
+
+    def _enclosing_span(self, line: int) -> Tuple[int, int]:
+        """The innermost statement span containing ``line`` (smallest, then
+        latest-starting), or the line itself when no statement matches."""
+        best: Tuple[int, int] | None = None
+        for start, end in self._spans:
+            if start <= line <= end:
+                if best is None or (end - start, -start) < (
+                    best[1] - best[0], -best[0]
+                ):
+                    best = (start, end)
+        return best or (line, line)
+
+    def covers(self, rule: str, line: int) -> bool:
+        span = self._enclosing_span(line)
+        return any(
+            rule in self.table.get(ln, ())
+            for ln in range(span[0], span[1] + 1)
+        )
+
+    def apply(self, findings: Iterable[Finding]) -> List[Finding]:
+        """Findings that survive suppression (metas NOT included)."""
+        return [f for f in findings if not self.covers(f.rule, f.line)]

@@ -1,0 +1,612 @@
+"""Project-wide resolution layer: import graph, symbol tables, call graph.
+
+This is the substrate the interprocedural rules stand on. It parses every
+module under one package root ONCE, then resolves, statically and
+conservatively:
+
+- **import graph** — which project modules a module imports (cycles are
+  fine: resolution is a lookup over the fully-parsed set, never a load);
+- **symbol tables** — what each top-level name in a module refers to:
+  an in-project module, function, or class, through ``import``/
+  ``from x import y as z`` aliasing and re-export chains;
+- **call graph** — for every top-level function and method, the set of
+  in-project callees it can statically reach, with the first call-site
+  line per edge (chains for ``--explain``). Resolution covers direct
+  names, module-attribute calls (``mod.f()``), ``self.method()`` through
+  in-project base classes, constructor calls (edge to ``__init__``), and
+  one level of instance typing: parameter annotations, ``x = Class(...)``
+  locals, and ``self.attr = Class(...)`` instance attributes.
+
+Everything unresolvable (duck-typed attribute calls on unknown objects,
+dynamic dispatch tables, ``getattr``) contributes NO edge — the analysis
+under-approximates reachability rather than drowning the rules in false
+positives. The rules that consume it (KA002/KA007 taint, KA012 transitive,
+KA015-017) are tripwires over the statically-knowable graph, not a sound
+whole-program analysis; the suppression mechanism covers the gap the other
+way.
+
+Function identity is ``"<relpath>::<qualname>"`` (e.g.
+``daemon/supervisor.py::ClusterSupervisor._run_plan``) — stable across
+runs, JSON-friendly, human-readable in chains. Nested functions are folded
+into their enclosing definition (their bodies are walked as part of it):
+what a closure does, its owner is accountable for.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+FUNC_SEP = "::"
+
+#: Symbol-table target kinds (first tuple element).
+MOD, FUNC, CLS = "mod", "func", "class"
+
+Target = Tuple  # (MOD, relpath) | (FUNC, funckey) | (CLS, relpath, name)
+
+
+def func_key(relpath: str, qualname: str) -> str:
+    return f"{relpath}{FUNC_SEP}{qualname}"
+
+
+def split_key(key: str) -> Tuple[str, str]:
+    relpath, _, qual = key.partition(FUNC_SEP)
+    return relpath, qual
+
+
+@dataclass
+class FunctionInfo:
+    key: str
+    relpath: str
+    qualname: str          # "f" or "Class.m"
+    name: str              # terminal name
+    node: ast.AST          # FunctionDef | AsyncFunctionDef
+    cls: Optional[str] = None  # owning class name, None for module functions
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    relpath: str
+    node: ast.ClassDef
+    methods: Dict[str, FunctionInfo] = field(default_factory=dict)
+    base_exprs: List[ast.expr] = field(default_factory=list)
+    resolved_bases: List[Tuple[str, str]] = field(default_factory=list)
+    #: instance-attribute types gathered from ``self.x = Class(...)``,
+    #: ``self.x: Class`` and annotated-parameter assignment in any method.
+    attr_types: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+
+
+@dataclass
+class ModuleInfo:
+    relpath: str           # package-root-relative posix path
+    dotted: str            # package-relative dotted name ("" = root __init__)
+    src: str
+    sha: str
+    tree: ast.Module
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)
+    func_by_name: Dict[str, FunctionInfo] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    #: unresolved import records: (bound_name, kind, payload)
+    raw_imports: List[Tuple] = field(default_factory=list)
+    bindings: Dict[str, Target] = field(default_factory=dict)
+
+
+class _LocalEnv:
+    """Per-function resolution context: function-local imports and the
+    one-level instance types of parameters and locals."""
+
+    __slots__ = ("bindings", "types")
+
+    def __init__(self) -> None:
+        self.bindings: Dict[str, Target] = {}
+        self.types: Dict[str, Tuple[str, str]] = {}
+
+
+def _module_dotted(relpath: str) -> str:
+    parts = relpath[:-3].split("/")  # strip .py
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _pkg_of(dotted: str, relpath: str) -> str:
+    """The package a module's relative imports resolve against."""
+    if relpath.endswith("/__init__.py") or relpath == "__init__.py":
+        return dotted
+    return dotted.rpartition(".")[0]
+
+
+class Project:
+    """The parsed-and-resolved package tree. Build with
+    :func:`build_project`; the taint sets (traced / lock-held) are computed
+    lazily by :mod:`.taint` and memoized here."""
+
+    def __init__(self, root: Path, pkg_name: str):
+        self.root = root
+        self.pkg_name = pkg_name
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_dotted: Dict[str, str] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        #: caller key -> {callee key: first call-site line}
+        self.call_graph: Dict[str, Dict[str, int]] = {}
+        #: module relpath -> imported project-module relpaths
+        self.import_graph: Dict[str, Set[str]] = {}
+        # taint memos (filled by .taint)
+        self._traced = None
+        self._lock_held = None
+        #: post-resolution _LocalEnv memo (see :meth:`function_env`)
+        self._env_cache: Dict[str, _LocalEnv] = {}
+
+    # -- queries -----------------------------------------------------------
+
+    def module(self, relpath: str) -> Optional[ModuleInfo]:
+        return self.modules.get(relpath)
+
+    def function(self, key: str) -> Optional[FunctionInfo]:
+        return self.functions.get(key)
+
+    def callees(self, key: str) -> Dict[str, int]:
+        return self.call_graph.get(key, {})
+
+    def resolve_module(self, dotted: str) -> Optional[str]:
+        return self.by_dotted.get(dotted)
+
+    def lookup(self, relpath: str, name: str) -> Optional[Target]:
+        """``name`` in module ``relpath``'s namespace: own def, own class,
+        submodule, or binding (re-export chains are already flattened into
+        ``bindings`` by the ``_resolve_bindings`` fixpoint — no recursion
+        here)."""
+        mod = self.modules.get(relpath)
+        if mod is None:
+            return None
+        if name in mod.func_by_name:
+            return (FUNC, mod.func_by_name[name].key)
+        if name in mod.classes:
+            return (CLS, relpath, name)
+        sub = self.by_dotted.get(
+            (mod.dotted + "." + name) if mod.dotted else name
+        )
+        if sub is not None:
+            return (MOD, sub)
+        t = mod.bindings.get(name)
+        return t
+
+    def class_info(self, relpath: str, name: str) -> Optional[ClassInfo]:
+        mod = self.modules.get(relpath)
+        return mod.classes.get(name) if mod else None
+
+    def find_method(self, relpath: str, clsname: str, method: str,
+                    _seen: Optional[Set[Tuple[str, str]]] = None
+                    ) -> Optional[FunctionInfo]:
+        """Method lookup through in-project base classes (BFS)."""
+        _seen = _seen or set()
+        if (relpath, clsname) in _seen:
+            return None
+        _seen.add((relpath, clsname))
+        ci = self.class_info(relpath, clsname)
+        if ci is None:
+            return None
+        if method in ci.methods:
+            return ci.methods[method]
+        for brp, bname in ci.resolved_bases:
+            hit = self.find_method(brp, bname, method, _seen)
+            if hit is not None:
+                return hit
+        return None
+
+    # -- construction ------------------------------------------------------
+
+    def _add_module(self, relpath: str, src: str) -> None:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            return  # KA000 is the per-module pass's job; no graph facts
+        dotted = _module_dotted(relpath)
+        mod = ModuleInfo(
+            relpath=relpath, dotted=dotted, src=src,
+            sha=hashlib.sha256(src.encode("utf-8")).hexdigest(), tree=tree,
+        )
+        self._collect_defs(mod, tree.body)
+        self._collect_imports(mod, tree)
+        self.modules[relpath] = mod
+        self.by_dotted[dotted] = relpath
+
+    def _collect_defs(self, mod: ModuleInfo, stmts: Sequence[ast.stmt],
+                      ) -> None:
+        """Top-level functions and classes, looking through module-level
+        ``if``/``try`` wrappers (version-compat defs are still defs)."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = FunctionInfo(
+                    key=func_key(mod.relpath, stmt.name),
+                    relpath=mod.relpath, qualname=stmt.name,
+                    name=stmt.name, node=stmt,
+                )
+                mod.functions[stmt.name] = info
+                mod.func_by_name[stmt.name] = info
+            elif isinstance(stmt, ast.ClassDef):
+                ci = ClassInfo(
+                    name=stmt.name, relpath=mod.relpath, node=stmt,
+                    base_exprs=list(stmt.bases),
+                )
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        qual = f"{stmt.name}.{sub.name}"
+                        info = FunctionInfo(
+                            key=func_key(mod.relpath, qual),
+                            relpath=mod.relpath, qualname=qual,
+                            name=sub.name, node=sub, cls=stmt.name,
+                        )
+                        ci.methods[sub.name] = info
+                        mod.functions[qual] = info
+                mod.classes[stmt.name] = ci
+            else:
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, attr, None)
+                    if isinstance(sub, list):
+                        self._collect_defs(mod, sub)
+                for handler in getattr(stmt, "handlers", []) or []:
+                    self._collect_defs(mod, handler.body)
+
+    def _collect_imports(self, mod: ModuleInfo, scope: ast.AST) -> None:
+        """Module-level import records (function-local imports are gathered
+        per function at call-graph time with the same resolver)."""
+        mod.raw_imports = self._import_records(mod, scope, module_level=True)
+
+    def _import_records(self, mod: ModuleInfo, scope: ast.AST,
+                        module_level: bool) -> List[Tuple]:
+        deferred: Set[int] = set()
+        if module_level:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                    for sub in ast.walk(node):
+                        if sub is not node:
+                            deferred.add(id(sub))
+        records: List[Tuple] = []
+        for node in ast.walk(scope):
+            if module_level and id(node) in deferred:
+                continue
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    records.append(
+                        (alias.asname or alias.name.split(".")[0],
+                         "import", alias.name, alias.asname)
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                base = self._from_base(mod, node)
+                if base is None:
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    records.append(
+                        (alias.asname or alias.name,
+                         "from", base, alias.name)
+                    )
+        return records
+
+    def _from_base(self, mod: ModuleInfo,
+                   node: ast.ImportFrom) -> Optional[str]:
+        """The project-relative dotted base an ImportFrom resolves against,
+        or None for out-of-project imports."""
+        if node.level:
+            pkg = _pkg_of(mod.dotted, mod.relpath)
+            parts = pkg.split(".") if pkg else []
+            up = node.level - 1
+            if up > len(parts):
+                return None
+            parts = parts[:len(parts) - up] if up else parts
+            if node.module:
+                parts = parts + node.module.split(".")
+            return ".".join(parts)
+        if node.module is None:
+            return None
+        if node.module == self.pkg_name:
+            return ""
+        if node.module.startswith(self.pkg_name + "."):
+            return node.module[len(self.pkg_name) + 1:]
+        return None
+
+    def _resolve_record(self, record: Tuple) -> Optional[Target]:
+        _bound, kind, a, b = record
+        if kind == "import":
+            dotted_abs = a
+            if dotted_abs == self.pkg_name:
+                rel = ""
+            elif dotted_abs.startswith(self.pkg_name + "."):
+                rel = dotted_abs[len(self.pkg_name) + 1:]
+            else:
+                return None
+            if b is None and "." in dotted_abs:
+                # plain `import pkg.sub.mod` binds the ROOT name only
+                rel = ""
+            rp = self.by_dotted.get(rel)
+            return (MOD, rp) if rp else None
+        # kind == "from": base dotted `a`, symbol `b`
+        sub_rp = self.by_dotted.get((a + "." + b) if a else b)
+        if sub_rp is not None:
+            return (MOD, sub_rp)
+        base_rp = self.by_dotted.get(a)
+        if base_rp is None:
+            return None
+        return self.lookup(base_rp, b)
+
+    def _resolve_bindings(self) -> None:
+        """Module-level symbol tables, iterated to a fixpoint so re-export
+        chains (``from .x import y`` where x's y is itself imported)
+        resolve. Termination is guaranteed without a pass cap: bindings
+        only ever GROW, and a pass that adds none breaks — cycles just
+        stop making progress."""
+        while True:
+            changed = False
+            for mod in self.modules.values():
+                for record in mod.raw_imports:
+                    bound = record[0]
+                    if bound in mod.bindings:
+                        continue
+                    t = self._resolve_record(record)
+                    if t is not None:
+                        mod.bindings[bound] = t
+                        changed = True
+            if not changed:
+                break
+
+    def _resolve_classes(self) -> None:
+        for mod in self.modules.values():
+            for ci in mod.classes.values():
+                for base in ci.base_exprs:
+                    t = self._resolve_expr_target(mod, base, _LocalEnv())
+                    if t and t[0] == CLS:
+                        ci.resolved_bases.append((t[1], t[2]))
+
+    def _annotation_class(self, mod: ModuleInfo, ann: Optional[ast.expr],
+                          env: _LocalEnv) -> Optional[Tuple[str, str]]:
+        """A parameter/attribute annotation resolved to an in-project
+        class, looking through Optional[...]/``X | None`` wrappers."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Subscript):
+            return self._annotation_class(mod, ann.slice, env)
+        if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+            return (self._annotation_class(mod, ann.left, env)
+                    or self._annotation_class(mod, ann.right, env))
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+            return self._annotation_class(mod, ann, env)
+        t = self._resolve_expr_target(mod, ann, env)
+        if t and t[0] == CLS:
+            return (t[1], t[2])
+        return None
+
+    def _resolve_expr_target(self, mod: ModuleInfo, expr: ast.expr,
+                             env: _LocalEnv) -> Optional[Target]:
+        """A Name/Attribute expression resolved to a project target."""
+        if isinstance(expr, ast.Name):
+            n = expr.id
+            if n in env.bindings:
+                return env.bindings[n]
+            if n in mod.func_by_name:
+                return (FUNC, mod.func_by_name[n].key)
+            if n in mod.classes:
+                return (CLS, mod.relpath, n)
+            return mod.bindings.get(n)
+        if isinstance(expr, ast.Attribute):
+            base = self._resolve_expr_target(mod, expr.value, env)
+            if base and base[0] == MOD:
+                return self.lookup(base[1], expr.attr)
+            if base and base[0] == CLS:
+                # ClassName.method reference
+                hit = self.find_method(base[1], base[2], expr.attr)
+                return (FUNC, hit.key) if hit else None
+            return None
+        return None
+
+    def _collect_attr_types(self) -> None:
+        """``self.x = Class(...)`` / annotated-parameter assignment /
+        ``self.x: Class`` across every method of every class."""
+        for mod in self.modules.values():
+            for ci in mod.classes.values():
+                for stmt in ci.node.body:
+                    if isinstance(stmt, ast.AnnAssign) \
+                            and isinstance(stmt.target, ast.Name):
+                        t = self._annotation_class(
+                            mod, stmt.annotation, _LocalEnv())
+                        if t:
+                            ci.attr_types.setdefault(stmt.target.id, t)
+                for m in ci.methods.values():
+                    env = self._function_env(mod, m)
+                    for node in ast.walk(m.node):
+                        target = None
+                        value = None
+                        if isinstance(node, ast.Assign) \
+                                and len(node.targets) == 1:
+                            target, value = node.targets[0], node.value
+                        elif isinstance(node, ast.AnnAssign):
+                            target, value = node.target, node.value
+                            if isinstance(target, ast.Attribute) \
+                                    and isinstance(target.value, ast.Name) \
+                                    and target.value.id == "self":
+                                t = self._annotation_class(
+                                    mod, node.annotation, env)
+                                if t:
+                                    ci.attr_types.setdefault(target.attr, t)
+                        if not (isinstance(target, ast.Attribute)
+                                and isinstance(target.value, ast.Name)
+                                and target.value.id == "self"):
+                            continue
+                        t = self._value_type(mod, value, env)
+                        if t:
+                            ci.attr_types.setdefault(target.attr, t)
+
+    def _value_type(self, mod: ModuleInfo, value: Optional[ast.expr],
+                    env: _LocalEnv) -> Optional[Tuple[str, str]]:
+        """The in-project class an assigned value is an instance of, when
+        statically evident: ``Class(...)`` constructor calls and names the
+        env already typed."""
+        if value is None:
+            return None
+        if isinstance(value, ast.Call):
+            t = self._resolve_expr_target(mod, value.func, env)
+            if t and t[0] == CLS:
+                return (t[1], t[2])
+            return None
+        if isinstance(value, ast.Name):
+            return env.types.get(value.id)
+        return None
+
+    def function_env(self, mod: ModuleInfo, fn: FunctionInfo) -> _LocalEnv:
+        """Memoized :meth:`_function_env` for AFTER construction finishes:
+        the env is a pure function of the frozen module state once
+        ``_collect_attr_types`` has run (which itself must keep calling
+        the uncached builder — attr types are still being filled then)."""
+        env = self._env_cache.get(fn.key)
+        if env is None:
+            env = self._env_cache[fn.key] = self._function_env(mod, fn)
+        return env
+
+    def _function_env(self, mod: ModuleInfo, fn: FunctionInfo) -> _LocalEnv:
+        """Local imports + one-level instance types for one function."""
+        env = _LocalEnv()
+        for record in self._import_records(mod, fn.node, module_level=False):
+            if record[0] in env.bindings:
+                continue
+            t = self._resolve_record(record)
+            if t is not None:
+                env.bindings[record[0]] = t
+        args = fn.node.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            t = self._annotation_class(mod, a.annotation, env)
+            if t:
+                env.types[a.arg] = t
+        # two passes so `x = Backend(...)` typed above its uses regardless
+        # of walk order, and chained `y = x` picks up x's type
+        for _ in range(2):
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    name = node.targets[0].id
+                    t = self._value_type(mod, node.value, env)
+                    if t is None and fn.cls is not None \
+                            and isinstance(node.value, ast.Attribute) \
+                            and isinstance(node.value.value, ast.Name) \
+                            and node.value.value.id == "self":
+                        ci = mod.classes.get(fn.cls)
+                        if ci:
+                            t = ci.attr_types.get(node.value.attr)
+                    if t:
+                        env.types.setdefault(name, t)
+        return env
+
+    def resolve_call(self, mod: ModuleInfo, fn: FunctionInfo,
+                     call: ast.Call, env: _LocalEnv) -> Optional[str]:
+        """The in-project FuncKey a call dispatches to, or None."""
+        f = call.func
+        target: Optional[Target] = None
+        if isinstance(f, ast.Name):
+            target = self._resolve_expr_target(mod, f, env)
+        elif isinstance(f, ast.Attribute):
+            v = f.value
+            if isinstance(v, ast.Name):
+                if v.id in ("self", "cls") and fn.cls is not None:
+                    hit = self.find_method(mod.relpath, fn.cls, f.attr)
+                    if hit is not None:
+                        return hit.key
+                    return None
+                if v.id in env.types:
+                    rp, cn = env.types[v.id]
+                    hit = self.find_method(rp, cn, f.attr)
+                    return hit.key if hit else None
+                target = self._resolve_expr_target(mod, f, env)
+            elif isinstance(v, ast.Attribute) and \
+                    isinstance(v.value, ast.Name):
+                if v.value.id == "self" and fn.cls is not None:
+                    ci = mod.classes.get(fn.cls)
+                    t = ci.attr_types.get(v.attr) if ci else None
+                    if t is None:
+                        # inherited instance attribute: search bases
+                        seen: Set[Tuple[str, str]] = set()
+                        stack = list(ci.resolved_bases) if ci else []
+                        while stack:
+                            brp, bcn = stack.pop()
+                            if (brp, bcn) in seen:
+                                continue
+                            seen.add((brp, bcn))
+                            bci = self.class_info(brp, bcn)
+                            if bci is None:
+                                continue
+                            if v.attr in bci.attr_types:
+                                t = bci.attr_types[v.attr]
+                                break
+                            stack.extend(bci.resolved_bases)
+                    if t is not None:
+                        hit = self.find_method(t[0], t[1], f.attr)
+                        return hit.key if hit else None
+                    return None
+                target = self._resolve_expr_target(mod, f, env)
+            else:
+                return None
+        if target is None:
+            return None
+        if target[0] == FUNC:
+            return target[1]
+        if target[0] == CLS:
+            hit = self.find_method(target[1], target[2], "__init__")
+            return hit.key if hit else None
+        return None
+
+    def _build_call_graph(self) -> None:
+        for mod in self.modules.values():
+            for fn in mod.functions.values():
+                self.functions[fn.key] = fn
+        for mod in self.modules.values():
+            for fn in mod.functions.values():
+                env = self.function_env(mod, fn)
+                edges: Dict[str, int] = {}
+                for node in ast.walk(fn.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = self.resolve_call(mod, fn, node, env)
+                    if callee is not None and callee != fn.key:
+                        edges.setdefault(callee, node.lineno)
+                self.call_graph[fn.key] = edges
+
+    def _build_import_graph(self) -> None:
+        for mod in self.modules.values():
+            deps: Set[str] = set()
+            for t in mod.bindings.values():
+                deps.add(t[1] if t[0] != FUNC else split_key(t[1])[0])
+            deps.discard(mod.relpath)
+            self.import_graph[mod.relpath] = deps
+
+
+def build_project(root: Path | str,
+                  pkg_name: Optional[str] = None) -> Project:
+    """Parse and resolve every ``*.py`` under ``root`` (one package tree).
+    ``pkg_name`` defaults to the root directory's name — what absolute
+    imports of the package are matched against."""
+    root = Path(root).resolve()
+    project = Project(root, pkg_name or root.name)
+    for p in sorted(root.rglob("*.py")):
+        if "__pycache__" in p.parts:
+            continue
+        rel = p.relative_to(root).as_posix()
+        try:
+            src = p.read_text(encoding="utf-8")
+        except OSError:  # kalint: disable=KA008 -- file raced away mid-walk; no module to add
+            continue
+        project._add_module(rel, src)
+    project._resolve_bindings()
+    project._resolve_classes()
+    project._collect_attr_types()
+    project._build_call_graph()
+    project._build_import_graph()
+    return project

@@ -1,110 +1,26 @@
-"""``kalint`` — the project-native AST linter.
+"""All kalint rule passes: the per-module AST checks (KA000–KA014) and the
+project-wide graph rules (interprocedural KA002/KA007/KA012, plus
+KA015–KA017) that run over the :mod:`.resolve` call graph and the
+:mod:`.taint` traced / lock-held sets.
 
-The system's value proposition is byte-compatibility with the reference
-assigner under a large surface of tuning knobs; the two correctness risks
-that grow with the codebase are silent config drift (a knob read raw,
-bypassing the loud-ignore house rule in ``utils/env.py``) and host-sync
-leaking into jitted solver paths. ``kalint`` machine-checks both:
-
-====== =====================================================================
-rule   meaning
-====== =====================================================================
-KA000  meta: unparsable file, or a suppression comment without a reason
-KA001  raw ``os.environ``/``os.getenv`` access to a ``KA_*`` knob outside
-       the registry module (``utils/env.py``) — use the typed accessors
-KA002  host-sync / nondeterminism call (``jax.device_get``, ``.item()``,
-       ``np.asarray``, ``time.*`` clocks, ``random.*``) inside kernel
-       modules (``ops/``) or inside any function traced by ``jax.jit``
-KA003  a ``KA_*`` string literal that does not resolve to a registered
-       knob (catches typos at lint time instead of silently-unset knobs)
-KA004  a registered knob missing from the README knob table (docs drift;
-       the table is generated — ``python -m ...analysis.knobdoc --write``)
-KA005  plan/golden JSON emission (``json.dumps``/``json.dump``) outside
-       ``io/json_io.py``'s byte-compat helpers
-KA006  a ``jnp.`` / ``jax.numpy`` call at module import time (module scope,
-       class bodies, decorators, default arguments) — imports must stay
-       cheap and backend-agnostic; build arrays lazily inside functions
-KA007  a jit-traced function closes over a mutable module-level global
-       (reads a module-scope list/dict/set binding, or rebinds any global
-       via ``global``) — trace-time capture freezes the value at first
-       compile, so later mutations are silently ignored by every cached
-       executable; pass the value as an argument or bind it immutably
-KA008  an ``except`` clause that swallows its exception silently (a body
-       that is nothing but ``pass`` or a bare ``continue``) — a robustness
-       layer lives or dies on failures staying visible: log it, count it,
-       re-raise it, or suppress with a written reason
-KA009  a jitted ``ops/`` entry point (a ``*_jit`` name from
-       ``ops.assignment``) dispatched outside a registered bucket-boundary
-       module — every array crossing into ``ops/`` must be padded to a
-       registered bucket size (``models/problem.py``: partition/node axes
-       multiples of 8, batch axis powers of two), and only the boundary
-       modules build their arrays through that encode layer (the program
-       store contract-checks their shapes at runtime,
-       ``utils/programstore.py:BucketContract``). An ad-hoc dispatch site
-       would silently explode the per-signature compile/program caches
-KA010  a ZooKeeper WRITE opcode (``OP_CREATE``/``OP_SET_DATA``/
-       ``OP_DELETE``) referenced outside the wire client's serial write
-       methods (``io/zkwire.py``: ``create``/``set_data``/``delete``) —
-       the write-safety rule (ISSUE 7): writes are never pipelined through
-       the xid window and never blindly replayed after session
-       re-establishment, so no other code may build a write frame
-KA011  a ``while True`` loop containing a blocking socket/poll call
-       (``recv*``, ``accept``, ``poll``, ``select``, ``sleep``) whose
-       enclosing function consults NO deadline: neither a registered
-       ``KA_*`` knob whose name carries TIMEOUT/INTERVAL/RETRIES/DEADLINE
-       nor a ``.settimeout(...)`` call — a resident daemon must not be
-       able to regress into an unbounded wait (ISSUE 8); loops genuinely
-       bounded elsewhere carry a reasoned suppression naming the bound
-KA012  daemon request-handling code (any module under ``daemon/`` except
-       ``supervisor.py``/``state.py``) reading a ``.backend`` or ``.state``
-       attribute — reaching into a supervisor's session or cache from the
-       routing/service layer is CROSS-BULKHEAD access (ISSUE 9): one
-       cluster's failure domain must stay behind its owning
-       ``ClusterSupervisor``'s methods, or a handler can trivially couple
-       two clusters' fates (the exact coupling the bulkheads exist to
-       forbid)
-KA013  a metric/span name literal passed to the obs write API
-       (``counter_add``/``gauge_set``/``hist_observe``/``hist_ms``/
-       ``span``/``record_span``, plus the supervisor's ``_count``/
-       ``_metric`` wrappers and ``span``'s ``hist=`` keyword) that is not
-       declared in the name registry (``obs/names.py``) — a typo'd metric
-       name vanishes SILENTLY today (the registry creates entries on
-       first write, dashboards query the name that never arrives), so
-       names are declared once and machine-checked like knobs (KA003's
-       twin for the telemetry namespace); dynamic names (f-strings,
-       ``_metric(...)`` results) are the registered composition points
-       and pass through
-KA014  a metric registered in ``obs/names.py:METRIC_NAMES`` that neither
-       carries a recognized unit suffix on its last dotted segment
-       (``_ms``/``_bytes``/``_frac``/``_total``/``_seconds``, or the bare
-       token as the whole segment, e.g. ``zk.bytes``) nor sits in the
-       declared ``UNITLESS_METRICS`` allowlist — a dashboard reading
-       ``foo.latency`` cannot know ms from seconds, so every name states
-       its unit in the name or is consciously declared unitless; stale
-       allowlist entries (names no longer registered) and entries that
-       ALSO carry a unit suffix are findings too (the allowlist must stay
-       an exact complement, not a dumping ground)
-====== =====================================================================
-
-Suppression: put ``# kalint: disable=KA002 -- <reason>`` on the offending
-line or on its own line directly above. The reason is mandatory — a
-reasonless suppression is itself a finding (KA000) and does not suppress.
-
-Run ``python -m kafka_assigner_tpu.analysis.kalint`` (no args: lint the whole
-package plus the README check; exit non-zero on findings), or pass explicit
-file paths. ``scripts/lint.sh`` wires this into the tier-1 gate.
+Per-module checks are pure functions of one module's AST (plus the live
+knob/name registries); the graph passes are functions of the whole
+:class:`~.resolve.Project` and attach the offending call chain
+(entry → … → sink) to every finding they emit.
 """
 from __future__ import annotations
 
-import argparse
 import ast
-import io
 import re
-import sys
-import tokenize
-from dataclasses import dataclass
-from pathlib import Path
-from typing import Iterable, List, Sequence, Set
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding
+from .resolve import Project, split_key
+from .taint import (
+    is_jit_expr,
+    lock_held_set,
+    traced_set,
+)
 
 RULES = {
     "KA000": "meta finding (syntax error / reasonless suppression)",
@@ -125,6 +41,139 @@ RULES = {
              "registry (obs/names.py)",
     "KA014": "registered metric carries no unit suffix and is not in the "
              "unitless allowlist (obs/names.py)",
+    "KA015": "blocking call reachable while the shared solve lock is held",
+    "KA016": "KA_* knob accessor called inside jit-traced code "
+             "(trace-time freeze)",
+    "KA017": "obs write API called inside jit-traced code "
+             "(host-sync hazard)",
+}
+
+#: One-line meaning + example offending chain per rule — the source of the
+#: generated README rule table (``python -m
+#: kafka_assigner_tpu.analysis.ruledoc --write``).
+RULE_DOCS: Dict[str, Tuple[str, str]] = {
+    "KA000": (
+        "meta: unparsable file, or a suppression comment without a reason "
+        "(the reason IS the audit trail)",
+        "`# kalint: disable=KA005` with no `-- why`",
+    ),
+    "KA001": (
+        "no raw `os.environ`/`os.getenv` access to a `KA_*` knob outside "
+        "the registry module (`utils/env.py`) — raw reads bypass the "
+        "loud-ignore house rule",
+        "`os.environ.get(\"KA_WAVE_MODE\")` in `solvers/tpu.py`",
+    ),
+    "KA002": (
+        "no host-sync or nondeterminism call (`jax.device_get`, `.item()`, "
+        "`np.asarray`, `time.*` clocks, `random.*`) anywhere in the traced "
+        "set — any function reachable, across modules, from a "
+        "`jax.jit`/`pjit`/`shard_map` entry — nor anywhere in the kernel "
+        "modules (`ops/`)",
+        "`solve_batched_jit` (ops/assignment.py) → `helper()` "
+        "(models/problem.py) → `time.time()`",
+    ),
+    "KA003": (
+        "every `KA_*` string literal resolves to a registered knob (a "
+        "typo'd knob name is a lint error, not a silently-unset knob)",
+        "`env_int(\"KA_PLACE_CHUNKK\")`",
+    ),
+    "KA004": (
+        "every registered knob appears in the README knob table "
+        "(generated — `knobdoc --write`)",
+        "`KA_NEW_KNOB` registered but table stale",
+    ),
+    "KA005": (
+        "no plan/golden JSON emission (`json.dumps`/`json.dump`) outside "
+        "`io/json_io.py`'s byte-compat helpers",
+        "`json.dumps(plan)` in `generator.py`",
+    ),
+    "KA006": (
+        "no `jnp.`/`jax.numpy` calls at module import time (module scope, "
+        "class bodies, decorators, default arguments) — imports stay cheap "
+        "and backend-agnostic",
+        "`ZEROS = jnp.zeros((8,))` at module scope",
+    ),
+    "KA007": (
+        "no function in the traced set may close over a mutable "
+        "module-level global (list/dict/set reads, or any `global` "
+        "rebinding) — trace-time capture freezes the value into every "
+        "cached executable; pass it as an argument or bind it immutably",
+        "`kernel_jit` → `resolve()` → reads module dict `MODES`",
+    ),
+    "KA008": (
+        "no `except` clause may swallow its exception silently (a body "
+        "that is nothing but `pass` or a bare `continue`) — log it, count "
+        "it, re-raise, or suppress with a written reason",
+        "`except OSError: pass`",
+    ),
+    "KA009": (
+        "no jitted `ops/` entry point (a `*_jit` name from "
+        "`ops.assignment`) dispatched outside the registered "
+        "bucket-boundary modules (`solvers/tpu.py`, `solvers/warmup.py`, "
+        "`parallel/whatif.py`) whose shapes the program store "
+        "contract-checks at runtime",
+        "`solve_batched_jit(...)` called from `generator.py`",
+    ),
+    "KA010": (
+        "no ZooKeeper WRITE opcode (`OP_CREATE`/`OP_SET_DATA`/`OP_DELETE`) "
+        "referenced outside the wire client's serial write methods "
+        "(`io/zkwire.py` `create`/`set_data`/`delete`) — writes are never "
+        "pipelined and never blindly replayed",
+        "`zkwire.OP_CREATE` referenced in `io/zk.py`",
+    ),
+    "KA011": (
+        "no `while True` loop with a blocking socket/poll call whose "
+        "enclosing function consults no deadline — no TIMEOUT/INTERVAL/"
+        "RETRIES/DEADLINE knob, no `.settimeout(...)`, and (one hop "
+        "through the call graph) no helper that does",
+        "`while True: sock.recv(4)` with no deadline in scope",
+    ),
+    "KA012": (
+        "no daemon request-handling code (modules under `daemon/` except "
+        "`supervisor.py`/`state.py`) may read a supervisor's `.backend`/"
+        "`.state` — directly OR through any helper chain that does it on "
+        "its behalf (cross-bulkhead access)",
+        "`service.do_plan()` → `helper(sup)` → `sup.backend`",
+    ),
+    "KA013": (
+        "every metric/span name passed as a LITERAL to the obs write API "
+        "must be declared in the name registry (`obs/names.py`) — a typo'd "
+        "name vanishes silently; dynamic names are the registered "
+        "composition points",
+        "`counter_add(\"daemon.requestz\")`",
+    ),
+    "KA014": (
+        "every registered metric states its unit (`_ms`/`_bytes`/`_frac`/"
+        "`_total`/`_seconds` suffix on its last dotted segment) or sits in "
+        "the `UNITLESS_METRICS` allowlist; stale and double-declared "
+        "allowlist entries are findings too",
+        "`foo.latency` registered with no unit and no allowlist entry",
+    ),
+    "KA015": (
+        "no blocking call — socket read/accept/poll/select, `sleep`, "
+        "`subprocess`, or a ZooKeeper write — reachable while the shared "
+        "solve lock is held: the lock serializes every solve-bearing "
+        "request across all clusters, so one blocked holder stalls the "
+        "whole daemon",
+        "`_handle_admitted` [with solve-lock] → `fault_point()` → "
+        "`time.sleep()`",
+    ),
+    "KA016": (
+        "no `KA_*` knob accessor (`env_int`/`env_float`/`env_bool`/"
+        "`env_choice`/`env_str`) called inside the traced set — trace-time "
+        "freeze means the cached executable silently ignores later env "
+        "changes (KA007's twin for knobs); hoist the read outside the "
+        "trace or suppress citing the program-store re-key",
+        "`solve_batched_jit` → `dense_mask_budget()` → "
+        "`env_int(\"KA_DENSE_MASK_BUDGET\")`",
+    ),
+    "KA017": (
+        "no `obs/` write API call (`counter_add`/`gauge_set`/"
+        "`hist_observe`/`hist_ms`/`span`/`record_span`) inside the traced "
+        "set — metrics emission from traced code is a host-sync hazard "
+        "KA013 cannot see (it fires at trace time only, then never again)",
+        "`kernel_jit` → `helper()` → `counter_add(\"solve.steps\")`",
+    ),
 }
 
 #: Modules whose ENTIRE body is treated as traced kernel code (KA002): these
@@ -136,52 +185,46 @@ KERNEL_MODULES = frozenset({"ops/assignment.py", "ops/pallas_leadership.py"})
 REGISTRY_MODULE = "utils/env.py"
 #: The one module allowed to emit plan JSON (KA005).
 JSON_BOUNDARY_MODULE = "io/json_io.py"
-#: Modules allowed to dispatch the jitted ops/ entry points (KA009): each
-#: builds its arrays through models/problem.py's bucketing layer and its
-#: dispatches are shape-contract-checked at runtime by the program store
-#: (utils/programstore.py:BucketContract).
+#: Modules allowed to dispatch the jitted ops/ entry points (KA009).
 BUCKET_BOUNDARY_MODULES = frozenset({
     "solvers/tpu.py", "solvers/warmup.py", "parallel/whatif.py",
 })
 #: The wire-client module and the only functions in it allowed to reference
-#: the ZooKeeper WRITE opcodes (KA010): the serial, read-back-then-decide
-#: write methods. The pipelined window helpers and every other module must
-#: never see a write opcode.
+#: the ZooKeeper WRITE opcodes (KA010).
 WIRE_MODULE = "io/zkwire.py"
 WRITE_OPCODES = frozenset({"OP_CREATE", "OP_SET_DATA", "OP_DELETE"})
 SERIAL_WRITE_FUNCS = frozenset({"create", "set_data", "delete"})
-#: KA012: the daemon package's bulkhead boundary. ``supervisor.py`` OWNS a
-#: cluster's backend/cache; ``state.py`` IS the cache. Everything else
-#: under ``daemon/`` (the routing/service layer, future middleware) must go
-#: through supervisor methods — a ``.backend``/``.state`` attribute read
-#: there is cross-bulkhead access.
+#: KA012: the daemon package's bulkhead boundary.
 DAEMON_PKG_PREFIX = "daemon/"
 DAEMON_BULKHEAD_MODULES = frozenset({
     "daemon/supervisor.py", "daemon/state.py",
 })
 BULKHEAD_ATTRS = frozenset({"backend", "state"})
+#: The supervisor class whose internals the bulkhead protects: attribute
+#: reads on values of this type are cross-bulkhead wherever they happen.
+SUPERVISOR_CLASS = ("daemon/supervisor.py", "ClusterSupervisor")
+
+#: KA016: the typed accessors whose call inside traced code freezes a knob.
+ENV_ACCESSOR_NAMES = frozenset({
+    "env_int", "env_float", "env_bool", "env_choice", "env_str",
+})
+#: KA017: the obs WRITE api (counter_value is a read and exempt).
+OBS_WRITE_NAMES = frozenset({
+    "counter_add", "gauge_set", "hist_observe", "hist_ms", "span",
+    "record_span",
+})
+#: KA015: functions in the wire module whose reachability under the solve
+#: lock IS a finding (a ZK write on the request path).
+ZK_WRITE_FUNC_NAMES = frozenset({
+    "create", "set_data", "delete", "_write_call",
+})
 
 _KNOB_RE = re.compile(r"KA_[A-Z][A-Z0-9_]*")
-_SUPPRESS_RE = re.compile(
-    r"#\s*kalint:\s*disable=([A-Z0-9, ]+?)\s*(?:--\s*(\S.*))?$"
-)
 _TIME_CALLS = frozenset({
     "time", "time_ns", "perf_counter", "perf_counter_ns",
     "monotonic", "monotonic_ns", "sleep",
 })
 _NUMPY_ALIASES = frozenset({"np", "numpy", "onp"})
-
-
-@dataclass(frozen=True)
-class Finding:
-    rule: str
-    path: str
-    line: int
-    col: int
-    message: str
-
-    def __str__(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
 
 
 def _is_name(node: ast.AST, name: str) -> bool:
@@ -199,41 +242,13 @@ def _knob_literal(node: ast.AST):
     return v if v is not None and _KNOB_RE.fullmatch(v) else None
 
 
-def _suppressions(src: str, path: str):
-    """Per-line ``# kalint: disable=...`` map. A suppression covers its own
-    line and the line below (so it can sit above a long statement). A
-    suppression without a reason is a KA000 finding and suppresses nothing
-    (the reason IS the audit trail).
-
-    Only real COMMENT tokens count — suppression syntax quoted inside a
-    string literal or docstring (e.g. this module's own docs) is neither a
-    suppression nor a finding."""
-    table: dict = {}
-    metas: List[Finding] = []
-    try:
-        comments = [
-            t for t in tokenize.generate_tokens(io.StringIO(src).readline)
-            if t.type == tokenize.COMMENT
-        ]
-    except (tokenize.TokenError, IndentationError, SyntaxError):
-        comments = []  # unparsable source is KA000 via ast.parse already
-    for tok in comments:
-        m = _SUPPRESS_RE.search(tok.string)
-        if not m:
-            continue
-        lineno = tok.start[0]
-        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
-        reason = (m.group(2) or "").strip()
-        if not reason:
-            metas.append(Finding(
-                "KA000", path, lineno, tok.start[1] + m.start() + 1,
-                "suppression requires a reason: "
-                "'# kalint: disable=KAnnn -- <why>'",
-            ))
-            continue
-        table.setdefault(lineno, set()).update(rules)
-        table.setdefault(lineno + 1, set()).update(rules)
-    return table, metas
+def _call_terminal_name(node: ast.Call):
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
 
 
 # --- KA002 machinery --------------------------------------------------------
@@ -265,45 +280,36 @@ def _banned_call(node: ast.Call):
     return None
 
 
-def _is_jit_expr(node: ast.AST) -> bool:
-    """``jax.jit`` or a bare ``jit`` name (``from jax import jit``)."""
-    return _is_name(node, "jit") or (
-        isinstance(node, ast.Attribute)
-        and node.attr == "jit"
-        and _is_name(node.value, "jax")
-    )
-
-
 def _jit_roots(tree: ast.AST) -> Set[str]:
-    """Function names handed to ``jax.jit`` in this module — as call
+    """Function names handed to a tracing wrapper in this module — as call
     arguments (``f_jit = jax.jit(f, ...)``) or decorators (``@jax.jit``,
     ``@jax.jit(...)``, ``@partial(jax.jit, ...)``)."""
     roots: Set[str] = set()
     for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and _is_jit_expr(node.func):
+        if isinstance(node, ast.Call) and is_jit_expr(node.func):
             if node.args and isinstance(node.args[0], ast.Name):
                 roots.add(node.args[0].id)
         elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for dec in node.decorator_list:
-                if _is_jit_expr(dec):
+                if is_jit_expr(dec):
                     roots.add(node.name)
                 elif isinstance(dec, ast.Call):
-                    if _is_jit_expr(dec.func):
+                    if is_jit_expr(dec.func):
                         roots.add(node.name)
                     elif (
                         (_is_name(dec.func, "partial")
                          or (isinstance(dec.func, ast.Attribute)
                              and dec.func.attr == "partial"))
-                        and dec.args and _is_jit_expr(dec.args[0])
+                        and dec.args and is_jit_expr(dec.args[0])
                     ):
                         roots.add(node.name)
     return roots
 
 
 def _traced_functions(tree: ast.AST):
-    """Transitive closure of jit roots over same-module calls-by-name:
-    the statically knowable approximation of 'code that runs under
-    trace'. Cross-module callees are covered by KERNEL_MODULES."""
+    """Transitive closure of jit roots over same-module calls-by-name: the
+    single-file approximation used when no project graph is available (the
+    project-wide traced set supersedes this in package mode)."""
     funcs = {
         n.name: n
         for n in ast.walk(tree)
@@ -322,13 +328,9 @@ def _traced_functions(tree: ast.AST):
     return [funcs[name] for name in sorted(traced)]
 
 
-# --- rule passes ------------------------------------------------------------
+# --- rule passes (per-module) -----------------------------------------------
 
 def _os_bindings(tree: ast.AST):
-    """Names the module binds to the ``os`` module, ``os.environ``, and
-    ``os.getenv`` — ``import os as o`` / ``from os import environ as env`` /
-    ``from os import getenv`` all count, so the import form cannot be used
-    to slip a raw knob read past KA001."""
     os_mods = {"os"}
     environs: Set[str] = set()
     getenvs: Set[str] = set()
@@ -347,7 +349,7 @@ def _os_bindings(tree: ast.AST):
     return os_mods, environs, getenvs
 
 
-def _check_ka001(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
+def check_ka001(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
     if relpath == REGISTRY_MODULE:
         return []
     os_mods, environs, getenvs = _os_bindings(tree)
@@ -412,13 +414,18 @@ def _check_ka001(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
     return out
 
 
-def _check_ka002(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
+def check_ka002(tree: ast.AST, relpath: str, path: str,
+                interprocedural: bool = False) -> List[Finding]:
+    """Kernel modules are checked wholesale always; the same-module traced
+    closure runs only when NO project graph exists (package mode replaces
+    it with the real cross-module traced set in :func:`project_findings`)."""
+    scopes: List = []
+    where = "jit-traced function"
     if relpath in KERNEL_MODULES:
-        scopes: Iterable[ast.AST] = [tree]
+        scopes = [tree]
         where = "kernel module"
-    else:
+    elif not interprocedural:
         scopes = _traced_functions(tree)
-        where = "jit-traced function"
     out: List[Finding] = []
     seen: Set[int] = set()
     for scope in scopes:
@@ -435,7 +442,7 @@ def _check_ka002(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
     return out
 
 
-def _check_ka003(tree: ast.AST, knobs: Set[str], path: str) -> List[Finding]:
+def check_ka003(tree: ast.AST, knobs: Set[str], path: str) -> List[Finding]:
     out: List[Finding] = []
     for node in ast.walk(tree):
         v = _knob_literal(node)
@@ -448,7 +455,7 @@ def _check_ka003(tree: ast.AST, knobs: Set[str], path: str) -> List[Finding]:
     return out
 
 
-def _check_ka005(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
+def check_ka005(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
     if relpath == JSON_BOUNDARY_MODULE:
         return []
     out: List[Finding] = []
@@ -469,12 +476,6 @@ def _check_ka005(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
 
 
 def _jnp_module_aliases(tree: ast.AST) -> Set[str]:
-    """Names this module binds to ``jax.numpy``: ``import jax.numpy as X``
-    and ``from jax import numpy as X``. The conventional ``jnp`` is always
-    included — most modules import it lazily inside functions, and a stray
-    module-level ``jnp.zeros(...)`` pasted above such an import is exactly
-    the bug class KA006 exists for (NameError today, silent backend init
-    after the next refactor)."""
     aliases = {"jnp"}
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
@@ -489,9 +490,6 @@ def _jnp_module_aliases(tree: ast.AST) -> Set[str]:
 
 
 def _deferred_nodes(tree: ast.AST) -> Set[int]:
-    """ids of AST nodes that do NOT execute at import time: function and
-    lambda bodies. Decorators, default arguments, and class bodies all run
-    at import and are deliberately left in."""
     deferred: Set[int] = set()
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -504,7 +502,7 @@ def _deferred_nodes(tree: ast.AST) -> Set[int]:
     return deferred
 
 
-def _check_ka006(tree: ast.AST, path: str) -> List[Finding]:
+def check_ka006(tree: ast.AST, path: str) -> List[Finding]:
     aliases = _jnp_module_aliases(tree)
     deferred = _deferred_nodes(tree)
     out: List[Finding] = []
@@ -519,8 +517,6 @@ def _check_ka006(tree: ast.AST, path: str) -> List[Finding]:
         if not isinstance(f, ast.Name) or not parts:
             continue
         root = f.id
-        # `jnp.zeros(...)` (any registered alias) or the spelled-out
-        # `jax.numpy.zeros(...)` chain; `jax.jit(...)` etc. stay legal.
         if root in aliases or (root == "jax" and parts[-1] == "numpy"):
             dotted = ".".join([root] + list(reversed(parts)))
             out.append(Finding(
@@ -540,11 +536,6 @@ _MUTABLE_CTORS = frozenset({
 
 
 def _module_mutable_globals(tree: ast.AST) -> Set[str]:
-    """Names bound at module scope to obviously-mutable containers: literal
-    list/dict/set displays, comprehensions, or calls to the stdlib mutable
-    constructors. Module-scope statements only (incl. inside module-level
-    ``if``/``try`` blocks) — function and class bodies bind elsewhere."""
-
     def value_is_mutable(node: ast.AST) -> bool:
         if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
                              ast.DictComp, ast.SetComp)):
@@ -573,7 +564,6 @@ def _module_mutable_globals(tree: ast.AST) -> Set[str]:
                     and value_is_mutable(stmt.value) \
                     and isinstance(stmt.target, ast.Name):
                 out.add(stmt.target.id)
-            # recurse into compound module-scope statements
             for attr in ("body", "orelse", "finalbody"):
                 scan(getattr(stmt, attr, []) or [])
             for handler in getattr(stmt, "handlers", []) or []:
@@ -584,11 +574,6 @@ def _module_mutable_globals(tree: ast.AST) -> Set[str]:
 
 
 def _local_bindings(fn: ast.AST) -> Set[str]:
-    """Names the function binds locally (parameters, assignments, loop and
-    with targets, comprehension targets, inner defs): a Load of such a name
-    is not a global read. Over-approximates (any binding anywhere in the
-    function shadows for the whole check) — that only suppresses findings,
-    never fabricates them."""
     bound: Set[str] = set()
     args = fn.args
     for a in (
@@ -611,51 +596,78 @@ def _local_bindings(fn: ast.AST) -> Set[str]:
     return bound
 
 
-def _check_ka007(tree: ast.AST, path: str) -> List[Finding]:
+def _ka007_fn_findings(fn, fn_label: str, mutable: Set[str], path: str,
+                       chain: Tuple[str, ...] = ()) -> List[Finding]:
+    """KA007 findings for ONE function body against its module's mutable
+    global set — shared by the single-file closure and the project-wide
+    traced pass (which adds the reaching chain)."""
+    out: List[Finding] = []
+    globals_declared: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            globals_declared.update(node.names)
+            out.append(Finding(
+                "KA007", path, node.lineno, node.col_offset + 1,
+                f"jit-traced function {fn_label!r} rebinds module "
+                f"global(s) {', '.join(node.names)} via 'global' (the "
+                "rebinding runs at trace time only; cached executables "
+                "never see it — return the value instead)",
+                chain=chain,
+            ))
+    if not mutable:
+        return out
+    local = _local_bindings(fn) - globals_declared
+    seen_names: Set[str] = set()
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Name)
+            and isinstance(node.ctx, ast.Load)
+            and node.id in mutable
+            and node.id not in local
+            and node.id not in seen_names  # one finding per name per fn
+        ):
+            seen_names.add(node.id)
+            out.append(Finding(
+                "KA007", path, node.lineno, node.col_offset + 1,
+                f"jit-traced function {fn_label!r} closes over mutable "
+                f"module global {node.id!r} (its value is frozen into "
+                "the compiled executable at trace time; later mutations "
+                "are silently ignored — pass it as an argument or bind "
+                "it immutably, e.g. tuple/frozenset/MappingProxyType)",
+                chain=chain,
+            ))
+    return out
+
+
+def check_ka007(tree: ast.AST, path: str,
+                interprocedural: bool = False) -> List[Finding]:
+    if interprocedural:
+        return []  # the project-wide traced pass owns KA007 in package mode
     mutable = _module_mutable_globals(tree)
     out: List[Finding] = []
     for fn in _traced_functions(tree):
-        globals_declared: Set[str] = set()
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Global):
-                globals_declared.update(node.names)
-                out.append(Finding(
-                    "KA007", path, node.lineno, node.col_offset + 1,
-                    f"jit-traced function {fn.name!r} rebinds module "
-                    f"global(s) {', '.join(node.names)} via 'global' (the "
-                    "rebinding runs at trace time only; cached executables "
-                    "never see it — return the value instead)",
-                ))
-        if not mutable:
+        out.extend(_ka007_fn_findings(fn, fn.name, mutable, path))
+    return out
+
+
+def check_ka008(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
             continue
-        local = _local_bindings(fn) - globals_declared
-        seen_names: Set[str] = set()
-        for node in ast.walk(fn):
-            if (
-                isinstance(node, ast.Name)
-                and isinstance(node.ctx, ast.Load)
-                and node.id in mutable
-                and node.id not in local
-                and node.id not in seen_names  # one finding per name per fn
-            ):
-                seen_names.add(node.id)
-                out.append(Finding(
-                    "KA007", path, node.lineno, node.col_offset + 1,
-                    f"jit-traced function {fn.name!r} closes over mutable "
-                    f"module global {node.id!r} (its value is frozen into "
-                    "the compiled executable at trace time; later mutations "
-                    "are silently ignored — pass it as an argument or bind "
-                    "it immutably, e.g. tuple/frozenset/MappingProxyType)",
-                ))
+        body = node.body
+        if len(body) == 1 and isinstance(body[0], (ast.Pass, ast.Continue)):
+            what = "pass" if isinstance(body[0], ast.Pass) else "continue"
+            out.append(Finding(
+                "KA008", path, body[0].lineno, body[0].col_offset + 1,
+                f"except clause swallows the exception silently (bare "
+                f"{what}): log it, count it, re-raise, or suppress with a "
+                "reason",
+            ))
     return out
 
 
 def _ops_jit_bindings(tree: ast.AST):
-    """Names this module binds to ``ops.assignment`` ``*_jit`` entry points
-    (``from ..ops.assignment import solve_batched_jit [as x]``) and names
-    bound to the ``ops.assignment`` module itself (``from ..ops import
-    assignment [as x]``, ``import ...ops.assignment as x``) — both forms can
-    dispatch a kernel program."""
     entries: Set[str] = set()
     modules: Set[str] = set()
     for node in ast.walk(tree):
@@ -675,7 +687,7 @@ def _ops_jit_bindings(tree: ast.AST):
     return entries, modules
 
 
-def _check_ka009(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
+def check_ka009(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
     if relpath in BUCKET_BOUNDARY_MODULES or relpath in KERNEL_MODULES:
         return []
     entries, modules = _ops_jit_bindings(tree)
@@ -709,16 +721,10 @@ def _check_ka009(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
     return out
 
 
-def _check_ka010(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
-    """A WRITE opcode reference (``OP_CREATE``/``OP_SET_DATA``/
-    ``OP_DELETE``, as a bare name or an attribute like
-    ``zkwire.OP_CREATE``) is legal only inside the wire client's serial
-    write methods. The module-level constant DEFINITIONS (Store context)
-    are exempt; every Load anywhere else — including zkwire's own pipelined
-    helpers — is a finding."""
+def check_ka010(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
     out: List[Finding] = []
 
-    def visit(node: ast.AST, func: str | None) -> None:
+    def visit(node: ast.AST, func: Optional[str]) -> None:
         for child in ast.iter_child_nodes(node):
             child_func = func
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -748,10 +754,7 @@ def _check_ka010(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
     return out
 
 
-#: Call names that block on external progress (KA011): any ``recv*``
-#: variant plus the accept/poll/select family and bare sleeps. Deliberately
-#: name-based — the rule is a tripwire for new unbounded wait loops, not a
-#: full escape analysis.
+#: Call names that block on external progress (KA011/KA015 loop bodies).
 _BLOCKING_NAMES = frozenset({"accept", "poll", "select", "sleep"})
 #: Substrings of knob names that count as a deadline consult (KA011).
 _DEADLINE_TOKENS = ("TIMEOUT", "INTERVAL", "RETRIES", "DEADLINE")
@@ -770,10 +773,6 @@ def _is_blocking_call(node: ast.Call) -> bool:
 
 
 def _scope_consults_deadline(scope: ast.AST) -> bool:
-    """True when ``scope`` (function or module) reads a deadline-shaped
-    registered knob (a ``KA_*`` literal carrying TIMEOUT/INTERVAL/RETRIES/
-    DEADLINE) or sets a socket timeout — the evidence KA011 accepts that a
-    blocking loop is bounded."""
     for node in ast.walk(scope):
         v = _knob_literal(node)
         if v is not None and any(tok in v for tok in _DEADLINE_TOKENS):
@@ -787,15 +786,55 @@ def _scope_consults_deadline(scope: ast.AST) -> bool:
     return False
 
 
-def _check_ka011(tree: ast.AST, path: str) -> List[Finding]:
+def check_ka011(tree: ast.AST, path: str) -> List[Finding]:
+    """A ``while True`` blocking loop must see a deadline consult in its
+    enclosing function — directly, or (ISSUE 12) one hop away in a helper
+    the function calls: a same-class method (``self._deadline_remaining()``)
+    or a same-module function. One hop is deliberate: the bound must stay
+    NEAR the loop to be auditable; deeper indirection carries a reasoned
+    suppression naming where the bound lives."""
     out: List[Finding] = []
     consult_cache: dict = {}
+    module_funcs = {
+        n.name: n for n in tree.body  # type: ignore[attr-defined]
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    # function node id -> {method name: node} of its enclosing class
+    class_methods: Dict[int, Dict[str, ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            methods = {
+                m.name: m for m in node.body
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            for m in methods.values():
+                class_methods[id(m)] = methods
 
-    def consults(scope: ast.AST) -> bool:
+    def consults_direct(scope: ast.AST) -> bool:
         key = id(scope)
         if key not in consult_cache:
             consult_cache[key] = _scope_consults_deadline(scope)
         return consult_cache[key]
+
+    def consults(scope: ast.AST) -> bool:
+        if consults_direct(scope):
+            return True
+        if scope is tree:
+            return False
+        siblings = class_methods.get(id(scope), {})
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            helper = None
+            if isinstance(f, ast.Attribute) and _is_name(f.value, "self"):
+                helper = siblings.get(f.attr)
+            elif isinstance(f, ast.Name):
+                helper = module_funcs.get(f.id)
+            if helper is not None and helper is not scope \
+                    and consults_direct(helper):
+                return True
+        return False
 
     def visit(node: ast.AST, scope: ast.AST) -> None:
         for child in ast.iter_child_nodes(node):
@@ -816,9 +855,10 @@ def _check_ka011(tree: ast.AST, path: str) -> List[Finding]:
                     "KA011", path, child.lineno, child.col_offset + 1,
                     "blocking recv/poll loop with no deadline: the "
                     "enclosing function consults no registered KA_* "
-                    "timeout/interval/retries knob and sets no socket "
-                    "timeout — bound the wait, or suppress with a reason "
-                    "naming where the bound lives",
+                    "timeout/interval/retries knob, sets no socket "
+                    "timeout, and calls no helper that does — bound the "
+                    "wait, or suppress with a reason naming where the "
+                    "bound lives",
                 ))
             visit(child, child_scope)
 
@@ -826,13 +866,7 @@ def _check_ka011(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
-def _check_ka012(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
-    """Daemon modules outside the bulkhead boundary must not read a
-    ``.backend`` or ``.state`` attribute: the supervisor's session and
-    cache are its failure domain, and the service/routing layer touching
-    them directly couples clusters the bulkheads exist to isolate. Store
-    contexts (assignments) are not reads and stay legal; genuinely-needed
-    exceptions carry a reasoned suppression."""
+def check_ka012(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
     if not relpath.startswith(DAEMON_PKG_PREFIX) \
             or relpath in DAEMON_BULKHEAD_MODULES:
         return []
@@ -860,28 +894,13 @@ METRIC_NAME_CALLS = frozenset({
 })
 #: Calls whose literal first argument is a SPAN name.
 SPAN_NAME_CALLS = frozenset({"span", "record_span"})
-#: The daemon supervisor's name-composing wrappers: their literal first
-#: argument may be either namespace (``_count`` feeds counters, ``_metric``
-#: labels both metric and span names with ``@cluster``).
+#: The daemon supervisor's name-composing wrappers.
 EITHER_NAME_CALLS = frozenset({"_count", "_metric"})
 
 
-def _call_terminal_name(node: ast.Call):
-    f = node.func
-    if isinstance(f, ast.Name):
-        return f.id
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    return None
-
-
-def _check_ka013(
+def check_ka013(
     tree: ast.AST, path: str, metric_names, span_names
 ) -> List[Finding]:
-    """Literal metric/span names must resolve against the declared registry
-    (``obs/names.py``) — the KA003 posture for the telemetry namespace.
-    Dynamic first arguments (f-strings, variables, ``self._metric(...)``)
-    are skipped: they compose REGISTERED bases with runtime labels."""
     every = metric_names | span_names
     out: List[Finding] = []
     for node in ast.walk(tree):
@@ -898,8 +917,6 @@ def _check_ka013(
         elif fname in EITHER_NAME_CALLS:
             table, table_desc = every, "METRIC_NAMES/SPAN_NAMES"
         if table is not None:
-            # The name may arrive positionally OR as name=... — both are
-            # the same write; a keyword spelling must not bypass the rule.
             name_node = node.args[0] if node.args else next(
                 (kw.value for kw in node.keywords if kw.arg == "name"),
                 None,
@@ -927,32 +944,7 @@ def _check_ka013(
     return out
 
 
-def _check_ka008(tree: ast.AST, path: str) -> List[Finding]:
-    """An ``except`` body that is exactly one ``pass`` or one bare
-    ``continue`` handles nothing and records nothing — the exception
-    vanishes. Any other body (a log call, a metric bump, a re-raise, even an
-    assignment) is taken as deliberate handling; truly-intentional swallows
-    carry a reasoned suppression, which IS the audit trail."""
-    out: List[Finding] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        body = node.body
-        if len(body) == 1 and isinstance(body[0], (ast.Pass, ast.Continue)):
-            what = "pass" if isinstance(body[0], ast.Pass) else "continue"
-            out.append(Finding(
-                "KA008", path, body[0].lineno, body[0].col_offset + 1,
-                f"except clause swallows the exception silently (bare "
-                f"{what}): log it, count it, re-raise, or suppress with a "
-                "reason",
-            ))
-    return out
-
-
-#: Unit tokens KA014 recognizes on a metric name's LAST dotted segment —
-#: either the whole segment (``zk.bytes``) or a ``_token`` suffix
-#: (``exec.wave_ms``). ``_total`` is listed for completeness although the
-#: Prometheus renderer also appends it to counters mechanically.
+#: Unit tokens KA014 recognizes on a metric name's LAST dotted segment.
 METRIC_UNIT_TOKENS = ("ms", "bytes", "frac", "total", "seconds")
 
 
@@ -967,13 +959,9 @@ def check_metric_units(
     metric_names=None, unitless=None,
     path: str = "kafka_assigner_tpu/obs/names.py",
 ) -> List[Finding]:
-    """KA014: every registered metric either states its unit in its name or
-    is consciously declared unitless (``obs/names.py:UNITLESS_METRICS``) —
-    so a dashboard never guesses whether ``foo.latency`` is ms or seconds.
-    Registry-level (one pass per lint run), not per-module: the names ARE
-    the data, there is no AST to walk."""
+    """KA014 (registry-level, one pass per lint run)."""
     if metric_names is None or unitless is None:
-        from ..obs.names import METRIC_NAMES, UNITLESS_METRICS
+        from ...obs.names import METRIC_NAMES, UNITLESS_METRICS
 
         if metric_names is None:
             metric_names = METRIC_NAMES
@@ -1010,16 +998,14 @@ def check_metric_units(
 
 
 def check_readme(readme_text: str, knobs=None, path: str = "README.md"):
-    """KA004: every registered knob must appear in the README (the generated
-    knob table keeps this true; drift means the table is stale)."""
+    """KA004: every registered knob must appear in the README."""
     if knobs is None:
-        from ..utils.env import KNOBS
+        from ...utils.env import KNOBS
 
         knobs = KNOBS
     names = knobs if not hasattr(knobs, "keys") else list(knobs)
     out: List[Finding] = []
     for name in names:
-        # whole-name match: KA_FOO must not be satisfied by KA_FOO_BAR
         pat = r"(?<![A-Z0-9_])" + re.escape(name) + r"(?![A-Z0-9_])"
         if not re.search(pat, readme_text):
             out.append(Finding(
@@ -1031,121 +1017,198 @@ def check_readme(readme_text: str, knobs=None, path: str = "README.md"):
     return out
 
 
-# --- drivers ----------------------------------------------------------------
+# --- project-wide graph passes ----------------------------------------------
 
-def lint_source(
-    src: str,
-    relpath: str,
-    *,
-    knobs: Set[str] | None = None,
-    metric_names: Set[str] | None = None,
-    span_names: Set[str] | None = None,
-    path: str | None = None,
-) -> List[Finding]:
-    """Lint one module. ``relpath`` is the package-relative posix path (it
-    selects the module class: registry / kernel / json boundary); ``path`` is
-    the display path for findings (defaults to ``relpath``)."""
-    path = path or relpath
-    if knobs is None:
-        from ..utils.env import KNOBS
+def _blocking_sink_desc(node: ast.Call) -> Optional[str]:
+    """KA015 sink classification for one call node."""
+    f = node.func
+    name = _call_terminal_name(node)
+    if name is None:
+        return None
+    if "recv" in name:
+        return f"{name}() socket read"
+    if name in ("accept", "poll", "select"):
+        return f"{name}() blocking wait"
+    if name == "sleep":
+        return "sleep() stall"
+    if name in ("run", "Popen", "call", "check_call", "check_output") \
+            and isinstance(f, ast.Attribute) \
+            and _is_name(f.value, "subprocess"):
+        return f"subprocess.{name}() child process"
+    return None
 
-        knobs = set(KNOBS)
-    if metric_names is None or span_names is None:
-        from ..obs.names import METRIC_NAMES, SPAN_NAMES
 
-        if metric_names is None:
-            metric_names = METRIC_NAMES
-        if span_names is None:
-            span_names = SPAN_NAMES
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as e:
-        return [Finding(
-            "KA000", path, e.lineno or 1, (e.offset or 0) + 1,
-            f"syntax error: {e.msg}",
-        )]
-    suppress, findings = _suppressions(src, path)
-    findings = list(findings)
-    raw = (
-        _check_ka001(tree, relpath, path)
-        + _check_ka002(tree, relpath, path)
-        + _check_ka003(tree, set(knobs), path)
-        + _check_ka005(tree, relpath, path)
-        + _check_ka006(tree, path)
-        + _check_ka007(tree, path)
-        + _check_ka008(tree, path)
-        + _check_ka009(tree, relpath, path)
-        + _check_ka010(tree, relpath, path)
-        + _check_ka011(tree, path)
-        + _check_ka012(tree, relpath, path)
-        + _check_ka013(tree, path, set(metric_names), set(span_names))
-    )
-    for f in raw:
-        if f.rule in suppress.get(f.line, ()):  # reasoned suppression
+def project_findings(project: Project,
+                     display: Dict[str, str]) -> List[Finding]:
+    """Every graph-backed finding over one resolved project: the traced-set
+    rules (KA002/KA007/KA016/KA017), the lock-held rule (KA015), and
+    transitive bulkhead reachability (KA012). ``display`` maps module
+    relpaths to the path findings should print (suppressions are applied by
+    the caller, which owns the per-module suppression indexes)."""
+    out: List[Finding] = []
+    traced = traced_set(project)
+    mutable_cache: Dict[str, Set[str]] = {}
+
+    def disp(relpath: str) -> str:
+        return display.get(relpath, relpath)
+
+    def entry_label(taint, key: str) -> str:
+        entry = taint.entry_of.get(key, key)
+        return taint.root_labels.get(entry, entry)
+
+    # -- traced-set rules: KA002, KA007, KA016, KA017 ------------------------
+    for key in sorted(traced.members):
+        fn = project.functions.get(key)
+        if fn is None:
             continue
-        findings.append(f)
-    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+        relpath = fn.relpath
+        path = disp(relpath)
+        chain = traced.chain_strs(key)
+        label = entry_label(traced, key)
+        mod = project.modules[relpath]
+        if relpath not in mutable_cache:
+            mutable_cache[relpath] = _module_mutable_globals(mod.tree)
+        out.extend(_ka007_fn_findings(
+            fn.node, fn.qualname, mutable_cache[relpath], path, chain=chain,
+        ))
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = _banned_call(node)
+            if msg:
+                out.append(Finding(
+                    "KA002", path, node.lineno, node.col_offset + 1,
+                    f"{msg} in jit-traced code reachable from {label} "
+                    "(host work must stay outside the traced solve)",
+                    chain=chain,
+                ))
+            name = _call_terminal_name(node)
+            if name in ENV_ACCESSOR_NAMES:
+                knob = _knob_literal(node.args[0]) if node.args else None
+                what = f"{name}({knob!r})" if knob else f"{name}(...)"
+                out.append(Finding(
+                    "KA016", path, node.lineno, node.col_offset + 1,
+                    f"trace-time knob read {what} inside jit-traced code "
+                    f"reachable from {label}: the value is frozen into the "
+                    "cached executable and later env changes are silently "
+                    "ignored — hoist the read outside the trace and pass "
+                    "it as a static argument, or suppress with a reason "
+                    "citing what re-keys the compiled program",
+                    chain=chain,
+                ))
+            if name in OBS_WRITE_NAMES:
+                out.append(Finding(
+                    "KA017", path, node.lineno, node.col_offset + 1,
+                    f"obs write {name}(...) inside jit-traced code "
+                    f"reachable from {label}: metrics emission from traced "
+                    "code fires at trace time only (then never again per "
+                    "cached executable) and forces host sync — emit from "
+                    "the dispatching host code instead",
+                    chain=chain,
+                ))
 
+    # -- KA015: blocking work under the shared solve lock --------------------
+    held, regions = lock_held_set(project)
 
-def lint_package(root: Path | None = None) -> List[Finding]:
-    """Lint every module of the installed package tree plus the README knob
-    check; the empty list is the green state ``scripts/lint.sh`` gates on."""
-    pkg = Path(root) if root else Path(__file__).resolve().parent.parent
-    repo = pkg.parent
-    findings: List[Finding] = []
-    for p in sorted(pkg.rglob("*.py")):
-        rel = p.relative_to(pkg).as_posix()
-        try:
-            display = p.relative_to(repo).as_posix()
-        except ValueError:
-            display = str(p)
-        findings.extend(
-            lint_source(p.read_text(encoding="utf-8"), rel, path=display)
+    def ka015(path: str, node: ast.Call, desc: str,
+              chain: Tuple[str, ...], label: str) -> Finding:
+        return Finding(
+            "KA015", path, node.lineno, node.col_offset + 1,
+            f"{desc} reachable while the shared solve lock is held "
+            f"(from {label}): the lock serializes every solve-bearing "
+            "request across all clusters, so a blocked holder stalls the "
+            "whole daemon — move the blocking work outside the lock, or "
+            "suppress with a reason citing the chain",
+            chain=chain,
         )
-    readme = repo / "README.md"
-    if readme.is_file():
-        findings.extend(check_readme(readme.read_text(encoding="utf-8")))
-    findings.extend(check_metric_units())
-    return findings
 
+    for region in regions:
+        path = disp(region.relpath)
+        label = held.root_labels.get(region.funckey, region.funckey)
+        for stmt in region.held_nodes:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    desc = _blocking_sink_desc(node)
+                    if desc:
+                        out.append(ka015(
+                            path, node, desc,
+                            (f"{region.funckey}@{region.line}",), label,
+                        ))
+    region_keys = {r.funckey for r in regions}
+    for key in sorted(held.members):
+        if key in region_keys:
+            continue  # only the with-body of a holder runs under the lock
+        fn = project.functions.get(key)
+        if fn is None:
+            continue
+        path = disp(fn.relpath)
+        chain = held.chain_strs(key)
+        label = entry_label(held, key)
+        if fn.relpath == WIRE_MODULE and fn.name in ZK_WRITE_FUNC_NAMES:
+            parent, line = held.parents.get(key, (None, fn.node.lineno))
+            anchor_rel, _ = split_key(parent) if parent else (fn.relpath, "")
+            out.append(Finding(
+                "KA015", disp(anchor_rel), line, 1,
+                f"ZooKeeper write {fn.qualname}(...) reachable while the "
+                f"shared solve lock is held (from {label}): a quorum "
+                "round-trip under the lock stalls every cluster's "
+                "solve-bearing requests — writes belong on the execute "
+                "path, never under the solve lock",
+                chain=chain,
+            ))
+            continue
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                desc = _blocking_sink_desc(node)
+                if desc:
+                    out.append(ka015(path, node, desc, chain, label))
 
-def main(argv: Sequence[str] | None = None) -> int:
-    parser = argparse.ArgumentParser(
-        prog="kalint", description="project-native static analysis "
-        "(knob registry + jit-boundary house rules)",
+    # -- KA012 transitive: bulkhead reachability ------------------------------
+    # Roots: every function in a daemon non-bulkhead module. Traversal never
+    # passes THROUGH the bulkhead modules (supervisor methods ARE the
+    # sanctioned interface). Sinks: a `.backend`/`.state` read on a value
+    # statically typed as the supervisor class, in any non-bulkhead module
+    # (direct reads inside daemon/ are the per-module rule's job).
+    from .taint import _closure
+
+    roots = {
+        key: (fn.node.lineno, f"daemon handler {fn.qualname} ({fn.relpath})")
+        for key, fn in project.functions.items()
+        if fn.relpath.startswith(DAEMON_PKG_PREFIX)
+        and fn.relpath not in DAEMON_BULKHEAD_MODULES
+    }
+    reach = _closure(
+        project, roots,
+        stop=lambda k: split_key(k)[0] in DAEMON_BULKHEAD_MODULES,
     )
-    parser.add_argument("paths", nargs="*",
-                        help="files to lint (default: the whole package + "
-                             "README knob check)")
-    parser.add_argument("--list-rules", action="store_true")
-    args = parser.parse_args(argv)
-    if args.list_rules:
-        for rule, desc in RULES.items():
-            print(f"{rule}  {desc}")
-        return 0
-    if args.paths:
-        pkg = Path(__file__).resolve().parent.parent
-        findings: List[Finding] = []
-        for raw in args.paths:
-            p = Path(raw).resolve()
-            try:
-                rel = p.relative_to(pkg).as_posix()
-            except ValueError:
-                rel = p.name
-            findings.extend(
-                lint_source(p.read_text(encoding="utf-8"), rel, path=raw)
-            )
-    else:
-        findings = lint_package()
-    for f in findings:
-        print(f)
-    n = len(findings)
-    print(
-        f"kalint: {n} finding(s)" if n else "kalint: clean",
-        file=sys.stderr,
-    )
-    return 1 if findings else 0
-
-
-if __name__ == "__main__":
-    sys.exit(main())
+    for key in sorted(reach.members):
+        fn = project.functions.get(key)
+        if fn is None or fn.relpath.startswith(DAEMON_PKG_PREFIX):
+            continue  # daemon-module reads are the per-module rule's job
+        mod = project.modules[fn.relpath]
+        env = project.function_env(mod, fn)
+        sup_names = {
+            n for n, t in env.types.items() if t == SUPERVISOR_CLASS
+        }
+        if not sup_names:
+            continue
+        chain = reach.chain_strs(key)
+        label = entry_label(reach, key)
+        for node in ast.walk(fn.node):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and node.attr in BULKHEAD_ATTRS
+                and isinstance(node.value, ast.Name)
+                and node.value.id in sup_names
+            ):
+                out.append(Finding(
+                    "KA012", disp(fn.relpath), node.lineno,
+                    node.col_offset + 1,
+                    f".{node.attr} read on a ClusterSupervisor outside the "
+                    f"bulkhead boundary, reachable from {label} "
+                    "(cross-bulkhead access through a helper chain): route "
+                    "through the owning supervisor's methods",
+                    chain=chain,
+                ))
+    return out

@@ -1,0 +1,289 @@
+"""Taint engine over the project call graph: the two transitive fact sets
+the graph rules consume.
+
+- **traced set** — every function reachable from a jit entry: a function
+  decorated with (or handed to) ``jax.jit``/``pjit``/``shard_map`` —
+  including ``@partial(jax.jit, ...)`` and the ``*_jit = jax.jit(f, ...)``
+  binding idiom — closed transitively over the call graph ACROSS module
+  boundaries. Code in this set runs under trace: host-sync (KA002),
+  mutable-global capture (KA007), trace-time knob reads (KA016) and
+  metric emission (KA017) all freeze or leak there.
+
+- **lock-held set** — every function reachable from a ``with <solve-lock>``
+  region in ``daemon/``: the shared solve lock serializes every solve-
+  bearing request across all clusters, so anything blocking in this set
+  (KA015) multiplies into every client's tail latency — the invariant the
+  request-coalescing refactor depends on staying machine-checked.
+
+Both sets carry parent pointers so every membership has a demonstrable
+chain (entry → … → function) for ``--explain`` and the finding payload.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .resolve import FUNC, Project, _LocalEnv
+
+#: Callable names that wrap a function for tracing.
+JIT_WRAPPER_NAMES = frozenset({"jit", "pjit", "shard_map"})
+
+#: Lock-name fragment that marks a ``with`` region as solve-lock-held.
+SOLVE_LOCK_FRAGMENT = "solve_lock"
+
+#: Daemon package prefix the lock scan is confined to.
+DAEMON_PREFIX = "daemon/"
+
+#: Host-only boundaries the TRACED closure does not descend into: calling
+#: into the knob registry or the obs plane from traced code is itself the
+#: finding (KA016/KA017 fire at the call site); their internals are host
+#: implementation by construction (obs/ never touches jax — KA006/KA013
+#: docs) and re-reporting them adds noise, not signal.
+TRACED_STOP_PREFIXES = ("obs/",)
+TRACED_STOP_MODULES = frozenset({"utils/env.py"})
+
+
+def _traced_stops_at(callee_key: str) -> bool:
+    relpath = callee_key.partition("::")[0]
+    return relpath in TRACED_STOP_MODULES or any(
+        relpath.startswith(p) for p in TRACED_STOP_PREFIXES
+    )
+
+
+def is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` / ``pjit`` / ``shard_map`` in any spelling: a bare name
+    (``from jax import jit``) or the terminal attribute of any dotted path
+    (``jax.jit``, ``jax.experimental.shard_map.shard_map``)."""
+    if isinstance(node, ast.Name):
+        return node.id in JIT_WRAPPER_NAMES
+    return isinstance(node, ast.Attribute) and node.attr in JIT_WRAPPER_NAMES
+
+
+@dataclass
+class TaintResult:
+    """A reachability closure with provenance. ``parents`` maps each member
+    to its (caller key, call-site line); roots map to (None, root line).
+    ``entry_of`` names the root that first reached each member."""
+    members: Set[str] = field(default_factory=set)
+    parents: Dict[str, Tuple[Optional[str], int]] = field(
+        default_factory=dict)
+    entry_of: Dict[str, str] = field(default_factory=dict)
+    #: root key -> human label ("jit entry solve_batched_jit", ...)
+    root_labels: Dict[str, str] = field(default_factory=dict)
+
+    def chain(self, key: str) -> List[Tuple[str, int]]:
+        """(func key, call-site line) hops from the entry to ``key``
+        inclusive; the entry's line is its root line."""
+        hops: List[Tuple[str, int]] = []
+        cur: Optional[str] = key
+        seen: Set[str] = set()
+        while cur is not None and cur not in seen:
+            seen.add(cur)
+            parent, line = self.parents.get(cur, (None, 0))
+            hops.append((cur, line))
+            cur = parent
+        hops.reverse()
+        return hops
+
+    def chain_strs(self, key: str) -> Tuple[str, ...]:
+        return tuple(f"{k}@{line}" for k, line in self.chain(key))
+
+
+def _expand(project: Project, result: TaintResult,
+            frontier: List[str], stop=None) -> None:
+    """The shared closure loop: grow ``result`` over the call graph from
+    ``frontier`` (whose members/parents/entries are already seeded);
+    ``stop(callee_key)`` prunes traversal INTO a callee (boundary rules
+    fire at the call site instead). One implementation so the traced and
+    lock-held sets can never diverge on traversal semantics."""
+    while frontier:
+        cur = frontier.pop()
+        for callee, line in project.callees(cur).items():
+            if callee in result.members:
+                continue
+            if stop is not None and stop(callee):
+                continue
+            result.members.add(callee)
+            result.parents[callee] = (cur, line)
+            result.entry_of[callee] = result.entry_of[cur]
+            frontier.append(callee)
+
+
+def _closure(project: Project,
+             roots: Dict[str, Tuple[int, str]],
+             stop=None) -> TaintResult:
+    """BFS over the call graph from ``roots`` ({key: (line, label)})."""
+    result = TaintResult()
+    frontier: List[str] = []
+    for key, (line, label) in roots.items():
+        if key not in project.functions:
+            continue
+        result.members.add(key)
+        result.parents[key] = (None, line)
+        result.entry_of[key] = key
+        result.root_labels[key] = label
+        frontier.append(key)
+    _expand(project, result, frontier, stop=stop)
+    return result
+
+
+# -- jit entries -------------------------------------------------------------
+
+def jit_roots(project: Project) -> Dict[str, Tuple[int, str]]:
+    """Every function the project hands to a tracing wrapper, resolved
+    ACROSS modules: decorators (``@jax.jit``, ``@jax.jit(...)``,
+    ``@partial(jax.jit, ...)``) and call-argument form
+    (``f_jit = jax.jit(f, ...)`` — ``f`` may be imported)."""
+    roots: Dict[str, Tuple[int, str]] = {}
+
+    def add(key: Optional[str], line: int, label: str) -> None:
+        if key is not None and key not in roots:
+            roots[key] = (line, label)
+
+    for mod in project.modules.values():
+        for fn in list(mod.functions.values()):
+            for dec in fn.node.decorator_list:
+                wrapped = None
+                if is_jit_expr(dec):
+                    wrapped = dec
+                elif isinstance(dec, ast.Call):
+                    if is_jit_expr(dec.func):
+                        wrapped = dec.func
+                    elif (
+                        (isinstance(dec.func, ast.Name)
+                         and dec.func.id == "partial")
+                        or (isinstance(dec.func, ast.Attribute)
+                            and dec.func.attr == "partial")
+                    ) and dec.args and is_jit_expr(dec.args[0]):
+                        wrapped = dec.args[0]
+                if wrapped is not None:
+                    add(fn.key, fn.node.lineno,
+                        f"jit entry {fn.qualname} ({mod.relpath})")
+        # call-argument form anywhere in the module (module scope AND
+        # inside functions — a local `fn = jax.jit(_fresh_solve, ...)`
+        # still traces _fresh_solve)
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and is_jit_expr(node.func)
+                    and node.args):
+                continue
+            arg = node.args[0]
+            target = None
+            if isinstance(arg, (ast.Name, ast.Attribute)):
+                target = project._resolve_expr_target(
+                    mod, arg, _LocalEnv())
+            if target is not None and target[0] == FUNC:
+                fi = project.function(target[1])
+                if fi is not None:
+                    add(fi.key, node.lineno,
+                        f"jit entry {fi.qualname} "
+                        f"(wrapped at {mod.relpath}:{node.lineno})")
+    # NOTE: the `*_jit` ENTRY idiom (`solve_batched_jit = jax.jit(
+    # solve_batched, ...)`) is covered above by resolving the wrapper's
+    # call argument — a mere `*_jit`-NAMED def is a host-side dispatch
+    # wrapper (solvers/tpu.py `_fresh_solve_jit`, programstore `wrap_jit`)
+    # and must NOT seed the traced set.
+    return roots
+
+
+def traced_set(project: Project) -> TaintResult:
+    if project._traced is None:
+        project._traced = _closure(
+            project, jit_roots(project), stop=_traced_stops_at
+        )
+    return project._traced
+
+
+# -- solve-lock regions ------------------------------------------------------
+
+@dataclass
+class LockRegion:
+    """One ``with <solve-lock>`` block: the function holding it, the with
+    statement, and every node that executes UNDER the lock — the body
+    statements plus the context expressions of with-items listed AFTER
+    the lock item (``with self._solve_lock, obs.run_capture(...)``: the
+    second manager enters while the lock is already held)."""
+    funckey: str
+    relpath: str
+    line: int
+    held_nodes: List[ast.AST]
+
+
+def _mentions_solve_lock(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) \
+                and SOLVE_LOCK_FRAGMENT in node.attr:
+            return True
+        if isinstance(node, ast.Name) and SOLVE_LOCK_FRAGMENT in node.id:
+            return True
+    return False
+
+
+def lock_regions(project: Project) -> List[LockRegion]:
+    regions: List[LockRegion] = []
+    for relpath, mod in sorted(project.modules.items()):
+        if not relpath.startswith(DAEMON_PREFIX):
+            continue
+        for fn in mod.functions.values():
+            for node in ast.walk(fn.node):
+                if not isinstance(node, (ast.With, ast.AsyncWith)):
+                    continue
+                lock_idx = next(
+                    (i for i, item in enumerate(node.items)
+                     if _mentions_solve_lock(item.context_expr)),
+                    None,
+                )
+                if lock_idx is None:
+                    continue
+                held: List[ast.AST] = [
+                    item.context_expr
+                    for item in node.items[lock_idx + 1:]
+                ]
+                held.extend(node.body)
+                regions.append(LockRegion(
+                    funckey=fn.key, relpath=relpath,
+                    line=node.lineno, held_nodes=held,
+                ))
+    return regions
+
+
+def lock_held_set(project: Project) -> Tuple[TaintResult, List[LockRegion]]:
+    """The closure of functions reachable from inside any solve-lock
+    region. The REGION-HOLDING functions themselves are roots (labelled
+    with the with-statement line); direct in-region sinks are the rule
+    pass's job since only part of the holder's body is under the lock."""
+    if project._lock_held is None:
+        regions = lock_regions(project)
+        roots: Dict[str, Tuple[int, str]] = {}
+        seeds: List[Tuple[str, str, int]] = []  # (callee, region key, line)
+        for region in regions:
+            mod = project.modules[region.relpath]
+            fn = project.functions[region.funckey]
+            env = project.function_env(mod, fn)
+            for stmt in region.held_nodes:
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Call):
+                        callee = project.resolve_call(mod, fn, node, env)
+                        if callee is not None:
+                            seeds.append(
+                                (callee, region.funckey, node.lineno))
+        result = TaintResult()
+        for region in regions:
+            label = (f"solve-lock region {region.funckey} "
+                     f"(with at line {region.line})")
+            result.members.add(region.funckey)
+            result.parents.setdefault(
+                region.funckey, (None, region.line))
+            result.entry_of[region.funckey] = region.funckey
+            result.root_labels[region.funckey] = label
+        frontier: List[str] = []
+        for callee, holder, line in seeds:
+            if callee in result.members or callee not in project.functions:
+                continue
+            result.members.add(callee)
+            result.parents[callee] = (holder, line)
+            result.entry_of[callee] = result.entry_of.get(holder, holder)
+            frontier.append(callee)
+        _expand(project, result, frontier)
+        project._lock_held = (result, regions)
+    return project._lock_held

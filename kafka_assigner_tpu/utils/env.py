@@ -15,7 +15,7 @@ else warns and falls back to the default. ``KA_FOO=1`` / ``KA_FOO=0`` remain
 the canonical spellings used in docs.
 
 The registry is machine-checked by the project linter
-(``kafka_assigner_tpu/analysis/kalint.py``): raw ``os.environ`` access to a
+(``kafka_assigner_tpu/analysis/kalint/``): raw ``os.environ`` access to a
 ``KA_*`` name anywhere outside this module is rule KA001, an unregistered
 ``KA_*`` literal is KA003, and a registered knob missing from the README
 knob table is KA004. The README table itself is generated from this registry
@@ -535,6 +535,21 @@ _knob(
         "the legacy `KA_PROFILE`, which still works) and enables the "
         "daemon's `/debug/profile?seconds=N` window capture. Unset "
         "(default): zero profiler overhead, /debug/profile refuses",
+)
+_knob(
+    "KA_LINT_CACHE", "bool", True,
+    doc="serve `python -m kafka_assigner_tpu.analysis.kalint` package runs "
+        "from the content-hash analysis cache (keyed on every source file, "
+        "the linter itself, the registries and the README — any edit "
+        "misses and re-analyzes, so a hit is always current). 0 forces a "
+        "full interprocedural re-analysis every run",
+)
+_knob(
+    "KA_LINT_CACHE_DIR", "str", None,
+    default_doc="`<repo>/.kalint-cache`",
+    doc="where the kalint analysis cache lives; entries are whole-tree "
+        "finding sets keyed by content hash, atomic-written, pruned to "
+        "the newest few",
 )
 _knob(
     "KA_DEVICE_WATCHDOG_S", "float", 0.0, floor=0.0,

@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # The project lint gate: kalint (knob-registry + jit-boundary + write-path
-# + deadline + bulkhead + telemetry-name + metric-unit house rules,
-# KA001-KA014), the README knob-table drift check,
+# + deadline + bulkhead + telemetry-name + metric-unit house rules, plus
+# the ISSUE 12 interprocedural taint/lock/bulkhead-reachability rules,
+# KA001-KA017), the README knob-table and rule-table drift checks,
 # the run-report fixture schema check, the fault-matrix smoke (one injected
 # fault per class — read, write AND daemon seams — strict + best-effort),
 # the exec crash→resume smoke, the daemon lifecycle smoke, and ruff
@@ -13,9 +14,29 @@ cd "$(dirname "$0")/.."
 
 # CPU platform: lint must never contend for (or hang on) the tunneled chip.
 export JAX_PLATFORMS=cpu
+# Pin the analysis cache ON: the warm-run cache-hit assertion below must
+# judge the gate's own behavior, not a KA_LINT_CACHE=0 leaked from the
+# developer's shell.
+export KA_LINT_CACHE=1
 
+# kalint: the interprocedural package pass (import graph + call graph +
+# traced/lock-held taint sets, ISSUE 12). First run populates the
+# content-hash analysis cache (or hits it when the tree is unchanged);
+# the second run emits the machine-readable CI report AND must be served
+# from the cache — the warm path staying a hit is what keeps this gate
+# inside its wall-clock budget, so a miss is a gate failure.
 python -m kafka_assigner_tpu.analysis.kalint
+python -m kafka_assigner_tpu.analysis.kalint --format json --out /tmp/kalint.json \
+    2> /tmp/kalint_cache.log
+grep -q "analysis cache hit" /tmp/kalint_cache.log || {
+    echo "lint.sh: kalint analysis cache did not hit on the warm run" >&2
+    cat /tmp/kalint_cache.log >&2
+    exit 1
+}
 python -m kafka_assigner_tpu.analysis.knobdoc --check
+# Rule-table drift: the README kalint rule table is generated from the
+# RULE_DOCS catalog; staleness fails the gate like knob drift does.
+python -m kafka_assigner_tpu.analysis.ruledoc --check
 # Run-report schema drift: the checked-in fixture must parse and match the
 # emitter's declared version (a schema bump must regenerate the fixture).
 # (python -c, not -m: the package re-exports the module, and -m would warn.)

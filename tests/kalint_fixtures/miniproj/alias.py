@@ -1,0 +1,6 @@
+"""`from x import y as z` aliasing fixture."""
+from .cyc_a import ping as renamed_ping
+
+
+def caller():
+    return renamed_ping(3)

@@ -1,0 +1,11 @@
+"""Jit entry whose traced closure crosses two module boundaries."""
+import jax
+
+from .mid import helper
+
+
+def solve(x):
+    return x + helper()
+
+
+solve_jit = jax.jit(solve)

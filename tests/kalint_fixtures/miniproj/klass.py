@@ -1,0 +1,23 @@
+"""Method-vs-function resolution fixture: `self.report()` must resolve to
+the method, bare `report()` to the module function, and a constructed
+instance's method call must resolve through the local type."""
+
+
+def report():
+    return "module function"
+
+
+class Widget:
+    def __init__(self):
+        self.count = 0
+
+    def report(self):
+        return "method"
+
+    def both(self):
+        return self.report(), report()
+
+
+def use_widget():
+    w = Widget()
+    return w.report()

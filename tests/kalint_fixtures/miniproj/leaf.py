@@ -1,0 +1,6 @@
+"""Hop two: the host-sync sink (a deliberate KA002)."""
+import time
+
+
+def sink():
+    return time.time()

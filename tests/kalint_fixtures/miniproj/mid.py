@@ -1,0 +1,6 @@
+"""Hop one of the traced chain."""
+from .leaf import sink
+
+
+def helper():
+    return sink()

@@ -1,0 +1,1 @@
+# seeded cross-module violation (parsed by kalint, never imported)

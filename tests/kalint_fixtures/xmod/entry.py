@@ -1,0 +1,11 @@
+"""Jitted entry in one module ..."""
+import jax
+
+from .helper import bias
+
+
+def solve(x):
+    return x + bias()
+
+
+solve_jit = jax.jit(solve, static_argnames=())

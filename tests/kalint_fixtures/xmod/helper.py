@@ -1,0 +1,7 @@
+"""... host-sync helper in another: the cross-module KA002 the lint gate
+test must catch with its full --explain chain."""
+import time
+
+
+def bias():
+    return time.time()

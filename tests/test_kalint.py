@@ -475,7 +475,9 @@ def test_ka010_serial_write_methods_are_allowed():
 def test_ka010_repo_wire_module_is_clean():
     from pathlib import Path
 
-    pkg = Path(kalint.__file__).resolve().parent.parent
+    import kafka_assigner_tpu
+
+    pkg = Path(kafka_assigner_tpu.__file__).resolve().parent
     src = (pkg / "io" / "zkwire.py").read_text(encoding="utf-8")
     assert "KA010" not in rules_of(kalint.lint_source(src, "io/zkwire.py"))
 
@@ -928,3 +930,437 @@ def test_ka014_repo_registry_is_clean():
     """The live registry (obs/names.py METRIC_NAMES vs UNITLESS_METRICS)
     passes its own rule — the repo-wide sweep the lint gate runs."""
     assert kalint.check_metric_units() == []
+
+
+# --- ISSUE 12: the project-wide resolution layer ------------------------------
+
+from pathlib import Path as _Path
+
+FIXTURES = _Path(__file__).resolve().parent / "kalint_fixtures"
+
+
+def test_resolution_survives_import_cycles():
+    project = kalint.build_project(FIXTURES / "miniproj")
+    cg = project.call_graph
+    # both halves of the a<->b cycle resolved through the cycle
+    assert "cyc_b.py::pong" in cg["cyc_a.py::ping"]
+    assert "cyc_a.py::ping" in cg["cyc_b.py::pong"]
+    assert "cyc_b.py" in project.import_graph["cyc_a.py"]
+    assert "cyc_a.py" in project.import_graph["cyc_b.py"]
+
+
+def test_resolution_from_import_aliasing():
+    project = kalint.build_project(FIXTURES / "miniproj")
+    # `from .cyc_a import ping as renamed_ping` — the alias dispatches to
+    # the aliased function, not to a phantom `renamed_ping`
+    assert "cyc_a.py::ping" in project.call_graph["alias.py::caller"]
+
+
+def test_resolution_method_vs_function():
+    project = kalint.build_project(FIXTURES / "miniproj")
+    both = project.call_graph["klass.py::Widget.both"]
+    assert "klass.py::Widget.report" in both   # self.report() -> method
+    assert "klass.py::report" in both          # report() -> module function
+    use = project.call_graph["klass.py::use_widget"]
+    assert "klass.py::Widget.__init__" in use  # constructor edge
+    assert "klass.py::Widget.report" in use    # local `w = Widget()` typed
+
+
+def test_two_hop_traced_chain_crosses_modules():
+    project = kalint.build_project(FIXTURES / "miniproj")
+    traced = kalint.traced_set(project)
+    assert "leaf.py::sink" in traced.members
+    keys = [k for k, _line in traced.chain("leaf.py::sink")]
+    assert keys == ["entry.py::solve", "mid.py::helper", "leaf.py::sink"]
+
+
+def test_lint_tree_reports_cross_module_ka002_with_chain():
+    findings = kalint.lint_tree(FIXTURES / "miniproj")
+    ka002 = [f for f in findings if f.rule == "KA002"]
+    assert len(ka002) == 1
+    f = ka002[0]
+    assert f.path.endswith("leaf.py") and f.line == 6
+    assert [hop.split("@")[0] for hop in f.chain] == [
+        "entry.py::solve", "mid.py::helper", "leaf.py::sink",
+    ]
+
+
+# --- KA015/KA016/KA017/KA012-transitive: tmp-tree fixtures --------------------
+
+def _write_tree(tmp_path, files):
+    root = tmp_path / "pkg"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src, encoding="utf-8")
+    return root
+
+
+def test_ka015_blocking_sleep_reachable_under_solve_lock(tmp_path):
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "util.py": (
+            "import time\n\n\n"
+            "def slow_help(x):\n"
+            "    time.sleep(1)\n"
+            "    return x\n"
+        ),
+        "daemon/__init__.py": "",
+        "daemon/supervisor.py": (
+            "import threading\n\n"
+            "from ..util import slow_help\n\n\n"
+            "class ClusterSupervisor:\n"
+            "    def __init__(self):\n"
+            "        self._solve_lock = threading.Lock()\n\n"
+            "    def handle(self, x):\n"
+            "        with self._solve_lock:\n"
+            "            return slow_help(x)\n"
+        ),
+    })
+    findings = kalint.lint_tree(root)
+    ka015 = [f for f in findings if f.rule == "KA015"]
+    assert len(ka015) == 1
+    f = ka015[0]
+    assert f.path.endswith("util.py") and "sleep" in f.message
+    assert any("ClusterSupervisor.handle" in hop for hop in f.chain)
+
+
+def test_ka015_blocking_call_outside_the_lock_is_clean(tmp_path):
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "daemon/__init__.py": "",
+        "daemon/supervisor.py": (
+            "import threading\n"
+            "import time\n\n\n"
+            "class ClusterSupervisor:\n"
+            "    def __init__(self):\n"
+            "        self._solve_lock = threading.Lock()\n\n"
+            "    def handle(self, x):\n"
+            "        time.sleep(0.1)  # before taking the lock: legal\n"
+            "        with self._solve_lock:\n"
+            "            y = x + 1\n"
+            "        time.sleep(0.1)  # after releasing: legal\n"
+            "        return y\n"
+        ),
+    })
+    assert "KA015" not in rules_of(kalint.lint_tree(root))
+
+
+def test_ka015_direct_sink_inside_the_with_body(tmp_path):
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "daemon/__init__.py": "",
+        "daemon/service.py": (
+            "import threading\n"
+            "import time\n\n"
+            "_solve_lock = threading.Lock()\n\n\n"
+            "def dispatch(x):\n"
+            "    with _solve_lock:\n"
+            "        time.sleep(1)\n"
+            "        return x\n"
+        ),
+    })
+    ka015 = [f for f in kalint.lint_tree(root) if f.rule == "KA015"]
+    assert len(ka015) == 1 and ka015[0].line == 9  # the sleep line
+
+
+def test_ka016_trace_time_knob_read_with_chain(tmp_path):
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "kern.py": (
+            "import jax\n\n"
+            "from .cfg import chunk\n\n\n"
+            "def f(x):\n"
+            "    return x * chunk()\n\n\n"
+            "f_jit = jax.jit(f)\n"
+        ),
+        "cfg.py": (
+            "def chunk():\n"
+            "    from ..utils.env import env_int\n"
+            '    return env_int("KA_PLACE_CHUNK")\n'
+        ),
+    })
+    findings = kalint.lint_tree(root)
+    ka016 = [f for f in findings if f.rule == "KA016"]
+    assert len(ka016) == 1
+    f = ka016[0]
+    assert f.path.endswith("cfg.py") and "KA_PLACE_CHUNK" in f.message
+    assert [hop.split("@")[0] for hop in f.chain] == [
+        "kern.py::f", "cfg.py::chunk",
+    ]
+    # the same accessor call OUTSIDE the traced set is legal
+    assert not any(
+        f.rule == "KA016" and f.path.endswith("kern.py") for f in findings
+    )
+
+
+def test_ka017_obs_write_in_traced_code(tmp_path):
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "kern.py": (
+            "import jax\n\n\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    from .obs.metrics import counter_add\n"
+            '    counter_add("zk.reads")\n'
+            "    return x\n"
+        ),
+    })
+    ka017 = [f for f in kalint.lint_tree(root) if f.rule == "KA017"]
+    assert len(ka017) == 1
+    assert "counter_add" in ka017[0].message
+    assert ka017[0].chain  # the chain names the jit entry
+
+
+def test_ka012_transitive_handler_helper_backend_chain(tmp_path):
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "helpers.py": (
+            "from .daemon.supervisor import ClusterSupervisor\n\n\n"
+            "def peek_backend(sup: ClusterSupervisor):\n"
+            "    return sup.backend\n"
+        ),
+        "daemon/__init__.py": "",
+        "daemon/supervisor.py": (
+            "class ClusterSupervisor:\n"
+            "    def __init__(self):\n"
+            "        self.backend = object()\n"
+        ),
+        "daemon/service.py": (
+            "from ..helpers import peek_backend\n"
+            "from .supervisor import ClusterSupervisor\n\n\n"
+            "def do_plan(sup: ClusterSupervisor):\n"
+            "    return peek_backend(sup)\n"
+        ),
+    })
+    findings = kalint.lint_tree(root)
+    ka012 = [f for f in findings if f.rule == "KA012"]
+    assert len(ka012) == 1
+    f = ka012[0]
+    assert f.path.endswith("helpers.py") and ".backend" in f.message
+    assert any("daemon/service.py::do_plan" in hop for hop in f.chain)
+
+
+def test_ka012_supervisor_itself_reading_backend_stays_legal(tmp_path):
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "daemon/__init__.py": "",
+        "daemon/supervisor.py": (
+            "class ClusterSupervisor:\n"
+            "    def __init__(self):\n"
+            "        self.backend = object()\n\n"
+            "    def brokers(self):\n"
+            "        return self.backend\n"
+        ),
+    })
+    assert "KA012" not in rules_of(kalint.lint_tree(root))
+
+
+# --- suppressions on wrapped (multi-line) statements --------------------------
+
+def test_suppression_on_last_line_of_wrapped_call():
+    src = (
+        "import json\n"
+        "\n"
+        "def emit(d):\n"
+        "    return json.dumps(\n"
+        "        d,\n"
+        "        sort_keys=True,\n"
+        "    )  # kalint: disable=KA005 -- fixture payload\n"
+    )
+    assert kalint.lint_source(src, "generator.py") == []
+
+
+def test_suppression_on_middle_line_of_wrapped_call():
+    src = (
+        "import json\n"
+        "\n"
+        "def emit(d):\n"
+        "    return json.dumps(\n"
+        "        d,  # kalint: disable=KA005 -- fixture payload\n"
+        "        sort_keys=True,\n"
+        "    )\n"
+    )
+    assert kalint.lint_source(src, "generator.py") == []
+
+
+def test_suppression_inside_a_block_does_not_leak_to_the_header():
+    # a suppression on a statement INSIDE a while body must not suppress a
+    # finding anchored on the while header itself
+    src = (
+        "def pump(sock):\n"
+        "    while True:\n"
+        "        data = sock.recv(4)  # kalint: disable=KA011 -- wrong line\n"
+    )
+    assert "KA011" in rules_of(kalint.lint_source(src, "io/foo.py"))
+
+
+def test_wrapped_statement_span_does_not_cover_unrelated_lines():
+    # the suppression rides the wrapped statement it is ON, not statements
+    # further down (the legacy rule still covers the line DIRECTLY below,
+    # so the second call sits two lines later)
+    src = (
+        "import json\n"
+        "\n"
+        "def emit(d):\n"
+        "    a = json.dumps(\n"
+        "        d,\n"
+        "    )  # kalint: disable=KA005 -- first call only\n"
+        "\n"
+        "    b = json.dumps(d)\n"
+        "    return a, b\n"
+    )
+    findings = kalint.lint_source(src, "generator.py")
+    assert [f.line for f in findings if f.rule == "KA005"] == [8]
+
+
+# --- deterministic output: sort + dedupe --------------------------------------
+
+def test_finalize_sorts_by_path_line_rule_and_dedupes():
+    f_dup_a = kalint.Finding("KA005", "b.py", 3, 1, "per-module twin")
+    f_other = kalint.Finding("KA001", "a.py", 9, 1, "other file")
+    f_chain = kalint.Finding("KA005", "b.py", 3, 1, "graph twin",
+                             chain=("m.py::f@1",))
+    out = kalint.finalize([f_dup_a, f_other, f_chain])
+    assert [(f.path, f.line, f.rule) for f in out] == [
+        ("a.py", 9, "KA001"), ("b.py", 3, "KA005"),
+    ]
+    # the chain-bearing twin wins the dedupe (it carries the why)
+    assert out[1].chain == ("m.py::f@1",)
+
+
+def test_finalize_keeps_distinct_sinks_sharing_a_line():
+    # two different violations on one physical line (different columns)
+    # are NOT duplicates — only same-node twins merge
+    f_a = kalint.Finding("KA002", "k.py", 5, 12, "time.time() ...")
+    f_b = kalint.Finding("KA002", "k.py", 5, 26, "time.perf_counter() ...")
+    assert len(kalint.finalize([f_a, f_b])) == 2
+
+
+def test_lint_source_output_is_sorted():
+    src = (
+        "import os, json\n"
+        "def f():\n"
+        '    v = os.environ.get("KA_TYPO_ONE")\n'
+        "    return json.dumps(v)\n"
+    )
+    findings = kalint.lint_source(src, "foo.py")
+    keys = [(f.path, f.line, f.rule) for f in findings]
+    assert keys == sorted(keys)
+
+
+# --- KA011: one-hop helper deadline resolution --------------------------------
+
+def test_ka011_deadline_in_same_class_helper_is_honored():
+    src = (
+        "class Client:\n"
+        "    def _deadline_remaining(self):\n"
+        "        from ..utils.env import env_float\n"
+        '        return env_float("KA_EXEC_POLL_TIMEOUT")\n'
+        "\n"
+        "    def pump(self, sock):\n"
+        "        while True:\n"
+        "            if self._deadline_remaining() <= 0:\n"
+        "                break\n"
+        "            data = sock.recv(4)\n"
+    )
+    assert "KA011" not in rules_of(kalint.lint_source(src, "io/foo.py"))
+
+
+def test_ka011_deadline_in_same_module_function_helper_is_honored():
+    src = (
+        "def remaining():\n"
+        "    from ..utils.env import env_float\n"
+        '    return env_float("KA_EXEC_POLL_TIMEOUT")\n'
+        "\n"
+        "def pump(sock):\n"
+        "    while True:\n"
+        "        if remaining() <= 0:\n"
+        "            break\n"
+        "        data = sock.recv(4)\n"
+    )
+    assert "KA011" not in rules_of(kalint.lint_source(src, "io/foo.py"))
+
+
+def test_ka011_two_hops_of_indirection_still_flagged():
+    # ONE hop is the contract: the bound must stay near the loop
+    src = (
+        "def inner():\n"
+        "    from ..utils.env import env_float\n"
+        '    return env_float("KA_EXEC_POLL_TIMEOUT")\n'
+        "\n"
+        "def outer():\n"
+        "    return inner()\n"
+        "\n"
+        "def pump(sock):\n"
+        "    while True:\n"
+        "        if outer() <= 0:\n"
+        "            break\n"
+        "        data = sock.recv(4)\n"
+    )
+    assert "KA011" in rules_of(kalint.lint_source(src, "io/foo.py"))
+
+
+def test_ka011_helper_without_deadline_still_flagged():
+    src = (
+        "class Client:\n"
+        "    def _helper(self):\n"
+        "        return 1\n"
+        "\n"
+        "    def pump(self, sock):\n"
+        "        while True:\n"
+        "            self._helper()\n"
+        "            data = sock.recv(4)\n"
+    )
+    assert "KA011" in rules_of(kalint.lint_source(src, "io/foo.py"))
+
+
+# --- rule catalog / ruledoc ---------------------------------------------------
+
+def test_rule_docs_cover_every_rule():
+    assert set(kalint.RULE_DOCS) == set(kalint.RULES)
+    assert set(kalint.RULES) == {f"KA{n:03d}" for n in range(18)}
+    for rule, (meaning, example) in kalint.RULE_DOCS.items():
+        assert meaning and example, rule
+
+
+def test_ruledoc_renders_and_detects_drift():
+    from kafka_assigner_tpu.analysis import ruledoc
+
+    table = ruledoc.render_table()
+    for rule in kalint.RULES:
+        assert f"| {rule} |" in table
+    fresh = ruledoc.apply(
+        f"head\n{ruledoc.BEGIN_MARK}\nOLDCONTENT\n{ruledoc.END_MARK}\ntail\n"
+    )
+    assert table in fresh and "OLDCONTENT" not in fresh
+    with pytest.raises(ValueError, match="markers"):
+        ruledoc.apply("no markers here")
+
+
+def test_ka015_sibling_with_item_entered_under_the_lock(tmp_path):
+    # `with self._solve_lock, self.slow_setup():` — the second context
+    # manager ENTERS while the lock is held, so its blocking work is
+    # in scope; a manager listed BEFORE the lock enters first and is not
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "daemon/__init__.py": "",
+        "daemon/supervisor.py": (
+            "import threading\n"
+            "import time\n\n\n"
+            "class ClusterSupervisor:\n"
+            "    def __init__(self):\n"
+            "        self._solve_lock = threading.Lock()\n\n"
+            "    def slow_setup(self):\n"
+            "        time.sleep(5)\n\n"
+            "    def quick_setup(self):\n"
+            "        return self\n\n"
+            "    def handle(self, x):\n"
+            "        with self.quick_setup(), self._solve_lock, \\\n"
+            "                self.slow_setup():\n"
+            "            return x\n"
+        ),
+    })
+    ka015 = [f for f in kalint.lint_tree(root) if f.rule == "KA015"]
+    assert len(ka015) == 1 and "sleep" in ka015[0].message
+    assert any("slow_setup" in hop for hop in ka015[0].chain)

@@ -35,3 +35,75 @@ def test_kalint_cli_fails_on_violations_with_rule_and_location(tmp_path):
     assert proc.returncode == 1
     assert "KA001" in proc.stdout and "KA003" in proc.stdout
     assert f"{bad}:2" in proc.stdout  # file:line in the finding
+
+
+def _kalint_env(extra=None):
+    env = {"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": str(ROOT)}
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _run_kalint(args, env=None):
+    import time
+
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", "kafka_assigner_tpu.analysis.kalint", *args],
+        capture_output=True, text=True, cwd=ROOT, timeout=300,
+        env=env or _kalint_env(),
+    )
+    return proc, time.perf_counter() - t0
+
+
+def test_seeded_cross_module_ka002_chain_is_caught_with_explain():
+    """ISSUE 12 acceptance: a host-sync in a helper called from a jitted
+    entry in ANOTHER module is caught by the CLI, and --explain prints the
+    full entry -> helper call chain."""
+    proc, _ = _run_kalint([
+        "--root", "tests/kalint_fixtures/xmod", "--no-cache",
+        "--explain", "KA002",
+    ])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "KA002" in proc.stdout
+    assert "helper.py:7" in proc.stdout          # the sink, file:line
+    out = proc.stdout
+    assert out.index("entry.py::solve") < out.index("helper.py::bias"), out
+    assert "time.time() wall clock" in out
+
+
+def test_json_report_is_deterministic_and_machine_readable(tmp_path):
+    import json
+
+    out1, out2 = tmp_path / "a.json", tmp_path / "b.json"
+    for out in (out1, out2):
+        proc, _ = _run_kalint([
+            "--root", "tests/kalint_fixtures/xmod", "--no-cache",
+            "--format", "json", "--out", str(out),
+        ])
+        assert proc.returncode == 1
+    assert out1.read_bytes() == out2.read_bytes()  # stable across runs
+    payload = json.loads(out1.read_text())
+    assert payload["schema_version"] == 1 and payload["count"] >= 1
+    f = payload["findings"][0]
+    assert f["rule"] == "KA002" and f["path"].endswith("helper.py")
+    assert f["chain"][0].startswith("entry.py::solve")
+    # deduped + sorted: (path, line, rule, col) keys are unique and ordered
+    keys = [(d["path"], d["line"], d["rule"], d["col"])
+            for d in payload["findings"]]
+    assert keys == sorted(keys) and len(keys) == len(set(keys))
+
+
+def test_analysis_cache_cold_then_warm_is_faster(tmp_path):
+    """ISSUE 12 acceptance: the content-hash cache misses cold, hits warm,
+    and the warm run is faster than the cold interprocedural pass."""
+    env = _kalint_env({"KA_LINT_CACHE_DIR": str(tmp_path / "cache")})
+    cold, t_cold = _run_kalint([], env=env)
+    assert cold.returncode == 0, cold.stdout + cold.stderr
+    assert "analysis cache miss" in cold.stderr
+    warm, t_warm = _run_kalint([], env=env)
+    assert warm.returncode == 0, warm.stdout + warm.stderr
+    assert "analysis cache hit" in warm.stderr
+    assert warm.stdout == cold.stdout  # served findings are identical
+    assert t_warm < t_cold, (t_warm, t_cold)
