@@ -52,8 +52,11 @@ from .resolve import (  # noqa: F401
 )
 from .taint import (  # noqa: F401
     JIT_WRAPPER_NAMES,
+    GateRegion,
     LockRegion,
     TaintResult,
+    gate_held_set,
+    gate_regions,
     jit_roots,
     lock_held_set,
     lock_regions,
@@ -80,6 +83,7 @@ from .rules import (  # noqa: F401
     WIRE_MODULE,
     WRITE_OPCODES,
     ZK_WRITE_FUNC_NAMES,
+    check_dead_knobs,
     check_metric_units,
     check_readme,
     project_findings,

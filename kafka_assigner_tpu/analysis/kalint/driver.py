@@ -153,6 +153,13 @@ def lint_tree(root: Path, *, project: Optional[Project] = None,
                 _r.check_readme(readme.read_text(encoding="utf-8"))
             )
         findings.extend(_r.check_metric_units())
+        # KA018 dead-knob sweep: every registered knob must be read
+        # somewhere in the package (fixture trees exercise the checker
+        # directly — their registries are not the live one).
+        findings.extend(_r.check_dead_knobs(
+            {rel: m.tree for rel, m in project.modules.items()},
+            display=display,
+        ))
     return sort_findings(findings)
 
 

@@ -137,6 +137,7 @@ class Project:
         # taint memos (filled by .taint)
         self._traced = None
         self._lock_held = None
+        self._gate_held = None
         #: post-resolution _LocalEnv memo (see :meth:`function_env`)
         self._env_cache: Dict[str, _LocalEnv] = {}
 

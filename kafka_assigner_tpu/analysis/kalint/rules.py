@@ -17,6 +17,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 from .findings import Finding
 from .resolve import Project, split_key
 from .taint import (
+    gate_held_set,
     is_jit_expr,
     lock_held_set,
     traced_set,
@@ -46,6 +47,10 @@ RULES = {
              "(trace-time freeze)",
     "KA017": "obs write API called inside jit-traced code "
              "(host-sync hazard)",
+    "KA018": "dead knob: registered in utils/env.py but never read "
+             "through an accessor anywhere in the project",
+    "KA019": "blocking call reachable while a supervisor's inflight-gate "
+             "admission is held",
 }
 
 #: One-line meaning + example offending chain per rule — the source of the
@@ -173,6 +178,24 @@ RULE_DOCS: Dict[str, Tuple[str, str]] = {
         "set — metrics emission from traced code is a host-sync hazard "
         "KA013 cannot see (it fires at trace time only, then never again)",
         "`kernel_jit` → `helper()` → `counter_add(\"solve.steps\")`",
+    ),
+    "KA018": (
+        "every knob registered in `utils/env.py` must be READ somewhere "
+        "in the project — a typed-accessor call (`env_int`/.../`env_str`, "
+        "`knob_default`) with that literal name outside the registry "
+        "module; a registered-but-never-read knob is dead configuration "
+        "surface operators will set to no effect (the dual of KA003's "
+        "read-without-registration)",
+        "`KA_OLD_TUNABLE` registered, no accessor reads it anywhere",
+    ),
+    "KA019": (
+        "no blocking call — socket read/accept/poll/select, `sleep`, "
+        "`subprocess`, or a ZooKeeper write — reachable while a "
+        "supervisor's `_gate()` admission is held (KA015's twin for the "
+        "per-cluster inflight gate): an admitted request occupies one of "
+        "the cluster's bounded backpressure slots until `_release()`, so "
+        "a blocked holder starves the gate and sheds healthy clients",
+        "`handle` [after `_gate()`] → `helper()` → `time.sleep()`",
     ),
 }
 
@@ -1017,6 +1040,75 @@ def check_readme(readme_text: str, knobs=None, path: str = "README.md"):
     return out
 
 
+#: KA018: accessor call names whose literal first argument constitutes a
+#: READ of a registered knob (the typed accessors plus the programmatic
+#: default lookup the kernels use).
+KNOB_READ_NAMES = ENV_ACCESSOR_NAMES | frozenset({"knob_default"})
+
+
+def check_dead_knobs(
+    trees: "Dict[str, ast.AST]",
+    knobs=None,
+    display: Optional[Dict[str, str]] = None,
+    env_relpath: str = REGISTRY_MODULE,
+) -> List[Finding]:
+    """KA018: every registered ``KA_*`` knob must be READ somewhere in the
+    project — an accessor/``knob_default`` call with that literal name in
+    any module OUTSIDE the registry itself (registration is not a read).
+    The dual of KA003: KA003 kills reads of unregistered names, this
+    kills registrations nothing reads — dead configuration surface an
+    operator will set to no effect. Findings anchor at the registration
+    call in ``utils/env.py``.
+
+    ``trees`` maps module relpaths to parsed ASTs (package mode hands the
+    project's modules over; fixtures call this directly); ``knobs``
+    overrides the live registry's name set for fixture trees."""
+    if knobs is None:
+        from ...utils.env import KNOBS
+
+        knobs = list(KNOBS)
+    display = display or {}
+    reads: Set[str] = set()
+    for relpath, tree in trees.items():
+        if relpath == env_relpath:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_terminal_name(node)
+            if name in KNOB_READ_NAMES and node.args:
+                knob = _knob_literal(node.args[0])
+                if knob is not None:
+                    reads.add(knob)
+    # Registration lines: the _knob("NAME", ...) calls in the registry
+    # module (line 1 when the registry tree is absent — fixture trees).
+    reg_lines: Dict[str, int] = {}
+    env_tree = trees.get(env_relpath)
+    if env_tree is not None:
+        for node in ast.walk(env_tree):
+            if (
+                isinstance(node, ast.Call)
+                and _call_terminal_name(node) == "_knob"
+                and node.args
+            ):
+                knob = _knob_literal(node.args[0])
+                if knob is not None:
+                    reg_lines[knob] = node.lineno
+    path = display.get(env_relpath, env_relpath)
+    out: List[Finding] = []
+    for name in knobs:
+        if name in reads:
+            continue
+        out.append(Finding(
+            "KA018", path, reg_lines.get(name, 1), 1,
+            f"registered knob {name} is never read: no typed accessor "
+            "(env_int/env_float/env_bool/env_choice/env_str/knob_default) "
+            "consumes it anywhere in the project — delete the "
+            "registration, or wire the read it was meant to gate",
+        ))
+    return out
+
+
 # --- project-wide graph passes ----------------------------------------------
 
 def _blocking_sink_desc(node: ast.Call) -> Optional[str]:
@@ -1107,61 +1199,91 @@ def project_findings(project: Project,
                     chain=chain,
                 ))
 
-    # -- KA015: blocking work under the shared solve lock --------------------
-    held, regions = lock_held_set(project)
+    # -- KA015 + KA019: blocking work inside a held region --------------------
+    # One emission pass, two (rule, closure, phrasing) instantiations —
+    # KA019 is KA015's twin over the inflight-gate regions instead of the
+    # solve-lock ones. A sink already under the solve lock is USUALLY
+    # also gate-held (the gate admits before the lock), so the rules
+    # overlap on purpose — a suppression must name both, each with its
+    # own reason (lock stall vs admission-slot starvation).
+    def held_rule(rule: str, held, regions,
+                  sink_tail: str, zk_tail: str) -> None:
+        def finding(path: str, node: ast.Call, desc: str,
+                    chain: Tuple[str, ...], label: str) -> Finding:
+            return Finding(
+                rule, path, node.lineno, node.col_offset + 1,
+                f"{desc} {sink_tail.format(label=label)}",
+                chain=chain,
+            )
 
-    def ka015(path: str, node: ast.Call, desc: str,
-              chain: Tuple[str, ...], label: str) -> Finding:
-        return Finding(
-            "KA015", path, node.lineno, node.col_offset + 1,
-            f"{desc} reachable while the shared solve lock is held "
-            f"(from {label}): the lock serializes every solve-bearing "
-            "request across all clusters, so a blocked holder stalls the "
-            "whole daemon — move the blocking work outside the lock, or "
-            "suppress with a reason citing the chain",
-            chain=chain,
-        )
-
-    for region in regions:
-        path = disp(region.relpath)
-        label = held.root_labels.get(region.funckey, region.funckey)
-        for stmt in region.held_nodes:
-            for node in ast.walk(stmt):
+        for region in regions:
+            path = disp(region.relpath)
+            label = held.root_labels.get(region.funckey, region.funckey)
+            for stmt in region.held_nodes:
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Call):
+                        desc = _blocking_sink_desc(node)
+                        if desc:
+                            out.append(finding(
+                                path, node, desc,
+                                (f"{region.funckey}@{region.line}",),
+                                label,
+                            ))
+        region_keys = {r.funckey for r in regions}
+        for key in sorted(held.members):
+            if key in region_keys:
+                continue  # only a holder's held statements are in scope
+            fn = project.functions.get(key)
+            if fn is None:
+                continue
+            path = disp(fn.relpath)
+            chain = held.chain_strs(key)
+            label = entry_label(held, key)
+            if fn.relpath == WIRE_MODULE and fn.name in ZK_WRITE_FUNC_NAMES:
+                parent, line = held.parents.get(key, (None, fn.node.lineno))
+                anchor_rel, _ = (
+                    split_key(parent) if parent else (fn.relpath, "")
+                )
+                out.append(Finding(
+                    rule, disp(anchor_rel), line, 1,
+                    f"ZooKeeper write {fn.qualname}(...) "
+                    + zk_tail.format(label=label),
+                    chain=chain,
+                ))
+                continue
+            for node in ast.walk(fn.node):
                 if isinstance(node, ast.Call):
                     desc = _blocking_sink_desc(node)
                     if desc:
-                        out.append(ka015(
-                            path, node, desc,
-                            (f"{region.funckey}@{region.line}",), label,
-                        ))
-    region_keys = {r.funckey for r in regions}
-    for key in sorted(held.members):
-        if key in region_keys:
-            continue  # only the with-body of a holder runs under the lock
-        fn = project.functions.get(key)
-        if fn is None:
-            continue
-        path = disp(fn.relpath)
-        chain = held.chain_strs(key)
-        label = entry_label(held, key)
-        if fn.relpath == WIRE_MODULE and fn.name in ZK_WRITE_FUNC_NAMES:
-            parent, line = held.parents.get(key, (None, fn.node.lineno))
-            anchor_rel, _ = split_key(parent) if parent else (fn.relpath, "")
-            out.append(Finding(
-                "KA015", disp(anchor_rel), line, 1,
-                f"ZooKeeper write {fn.qualname}(...) reachable while the "
-                f"shared solve lock is held (from {label}): a quorum "
-                "round-trip under the lock stalls every cluster's "
-                "solve-bearing requests — writes belong on the execute "
-                "path, never under the solve lock",
-                chain=chain,
-            ))
-            continue
-        for node in ast.walk(fn.node):
-            if isinstance(node, ast.Call):
-                desc = _blocking_sink_desc(node)
-                if desc:
-                    out.append(ka015(path, node, desc, chain, label))
+                        out.append(finding(path, node, desc, chain, label))
+
+    held, regions = lock_held_set(project)
+    held_rule(
+        "KA015", held, regions,
+        "reachable while the shared solve lock is held (from {label}): "
+        "the lock serializes every solve-bearing request across all "
+        "clusters, so a blocked holder stalls the whole daemon — move "
+        "the blocking work outside the lock, or suppress with a reason "
+        "citing the chain",
+        "reachable while the shared solve lock is held (from {label}): "
+        "a quorum round-trip under the lock stalls every cluster's "
+        "solve-bearing requests — writes belong on the execute path, "
+        "never under the solve lock",
+    )
+    gheld, gregions = gate_held_set(project)
+    held_rule(
+        "KA019", gheld, gregions,
+        "reachable while an inflight-gate admission is held "
+        "(from {label}): the admitted request occupies one of the "
+        "cluster's bounded backpressure slots until _release(), so a "
+        "blocked holder starves the gate and sheds healthy clients — "
+        "move the blocking work outside the admission, or suppress with "
+        "a reason citing the chain",
+        "reachable while an inflight-gate admission is held "
+        "(from {label}): a quorum round-trip inside an admitted slot "
+        "starves the per-cluster backpressure gate — writes belong on "
+        "the execute path, outside the solve-bearing admission",
+    )
 
     # -- KA012 transitive: bulkhead reachability ------------------------------
     # Roots: every function in a daemon non-bulkhead module. Traversal never

@@ -247,6 +247,114 @@ def lock_regions(project: Project) -> List[LockRegion]:
     return regions
 
 
+# -- inflight-gate regions (KA019, the KA015 twin) ---------------------------
+
+#: The supervisor's admission call: code AFTER a successful ``_gate()``
+#: holds one of the cluster's bounded inflight slots until ``_release()``.
+GATE_CALL_NAME = "_gate"
+
+
+@dataclass
+class GateRegion:
+    """One admission region: the daemon function calling ``_gate()`` and
+    every statement that executes AFTER the call in the same block (the
+    release lives in a ``finally``, so to a static pass the rest of the
+    function body runs admitted — a deliberate over-approximation, same
+    posture as treating a whole lock body as held)."""
+    funckey: str
+    relpath: str
+    line: int
+    held_nodes: List[ast.AST]
+
+
+def _calls_gate(stmt: ast.AST) -> Optional[int]:
+    for node in ast.walk(stmt):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == GATE_CALL_NAME
+        ):
+            return node.lineno
+    return None
+
+
+def gate_regions(project: Project) -> List[GateRegion]:
+    regions: List[GateRegion] = []
+    for relpath, mod in sorted(project.modules.items()):
+        if not relpath.startswith(DAEMON_PREFIX):
+            continue
+        for fn in mod.functions.values():
+            for node in ast.walk(fn.node):
+                for field in ("body", "orelse", "finalbody"):
+                    block = getattr(node, field, None)
+                    if not isinstance(block, list):
+                        continue
+                    for i, stmt in enumerate(block):
+                        line = _calls_gate(stmt)
+                        if line is None:
+                            continue
+                        held = list(block[i + 1:])
+                        if held:
+                            regions.append(GateRegion(
+                                funckey=fn.key, relpath=relpath,
+                                line=line, held_nodes=held,
+                            ))
+    return regions
+
+
+def _region_closure(project: Project, regions, label_fn) -> TaintResult:
+    """The shared held-region closure: seed every call inside each
+    region's held statements, root the holder functions themselves
+    (``label_fn(region)`` names them), and expand over the call graph.
+    One implementation for the solve-lock and inflight-gate sets so the
+    twin rules (KA015/KA019) can never diverge on traversal or
+    provenance semantics."""
+    result = TaintResult()
+    seeds: List[Tuple[str, str, int]] = []
+    for region in regions:
+        mod = project.modules[region.relpath]
+        fn = project.functions[region.funckey]
+        env = project.function_env(mod, fn)
+        for stmt in region.held_nodes:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    callee = project.resolve_call(mod, fn, node, env)
+                    if callee is not None:
+                        seeds.append(
+                            (callee, region.funckey, node.lineno))
+        result.members.add(region.funckey)
+        result.parents.setdefault(region.funckey, (None, region.line))
+        result.entry_of[region.funckey] = region.funckey
+        result.root_labels[region.funckey] = label_fn(region)
+    frontier: List[str] = []
+    for callee, holder, line in seeds:
+        if callee in result.members or callee not in project.functions:
+            continue
+        result.members.add(callee)
+        result.parents[callee] = (holder, line)
+        result.entry_of[callee] = result.entry_of.get(holder, holder)
+        frontier.append(callee)
+    _expand(project, result, frontier)
+    return result
+
+
+def gate_held_set(project: Project) -> Tuple[TaintResult, List[GateRegion]]:
+    """The closure of functions reachable from inside any inflight-gate
+    admission region — structurally the lock-held set's twin (KA019):
+    admitted slots are the per-cluster backpressure budget, so a blocked
+    admitted request starves the gate exactly like a blocked solve-lock
+    holder starves the solve."""
+    if project._gate_held is None:
+        regions = gate_regions(project)
+        result = _region_closure(
+            project, regions,
+            lambda r: (f"inflight-gate region {r.funckey} "
+                       f"(_gate at line {r.line})"),
+        )
+        project._gate_held = (result, regions)
+    return project._gate_held
+
+
 def lock_held_set(project: Project) -> Tuple[TaintResult, List[LockRegion]]:
     """The closure of functions reachable from inside any solve-lock
     region. The REGION-HOLDING functions themselves are roots (labelled
@@ -254,36 +362,10 @@ def lock_held_set(project: Project) -> Tuple[TaintResult, List[LockRegion]]:
     pass's job since only part of the holder's body is under the lock."""
     if project._lock_held is None:
         regions = lock_regions(project)
-        roots: Dict[str, Tuple[int, str]] = {}
-        seeds: List[Tuple[str, str, int]] = []  # (callee, region key, line)
-        for region in regions:
-            mod = project.modules[region.relpath]
-            fn = project.functions[region.funckey]
-            env = project.function_env(mod, fn)
-            for stmt in region.held_nodes:
-                for node in ast.walk(stmt):
-                    if isinstance(node, ast.Call):
-                        callee = project.resolve_call(mod, fn, node, env)
-                        if callee is not None:
-                            seeds.append(
-                                (callee, region.funckey, node.lineno))
-        result = TaintResult()
-        for region in regions:
-            label = (f"solve-lock region {region.funckey} "
-                     f"(with at line {region.line})")
-            result.members.add(region.funckey)
-            result.parents.setdefault(
-                region.funckey, (None, region.line))
-            result.entry_of[region.funckey] = region.funckey
-            result.root_labels[region.funckey] = label
-        frontier: List[str] = []
-        for callee, holder, line in seeds:
-            if callee in result.members or callee not in project.functions:
-                continue
-            result.members.add(callee)
-            result.parents[callee] = (holder, line)
-            result.entry_of[callee] = result.entry_of.get(holder, holder)
-            frontier.append(callee)
-        _expand(project, result, frontier)
+        result = _region_closure(
+            project, regions,
+            lambda r: (f"solve-lock region {r.funckey} "
+                       f"(with at line {r.line})"),
+        )
         project._lock_held = (result, regions)
     return project._lock_held

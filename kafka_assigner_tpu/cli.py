@@ -622,6 +622,245 @@ def daemon_main() -> None:
         sys.exit(EXIT_VALIDATION)
 
 
+def build_groups_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ka-groups",
+        description="Consumer-group workload family (groups/): "
+        "capacity-constrained partition→consumer packing. Plan mode emits "
+        "a sticky, movement-minimizing rebalance plan for each group; "
+        "sweep mode answers \"how many consumers do I need\" by "
+        "evaluating every (consumer count × lag scale) candidate as ONE "
+        "batched on-device fan-out and printing the cost curve. Output "
+        "is a schema-versioned JSON envelope on stdout, byte-stable "
+        "across identical runs.",
+    )
+    p.add_argument("--zk_string", default=None,
+                   help="cluster metadata source: ZK quorum host:port "
+                        "pairs, kafka://bootstrap, or a "
+                        "file://cluster.json snapshot (group state needs "
+                        "a backend with group support — a snapshot "
+                        "\"groups\" section or an AdminClient with the "
+                        "consumer-group offset chain — or --synthetic)")
+    p.add_argument("--mode", default="plan", choices=("plan", "sweep"),
+                   help="plan: per-group packing plan; sweep: the batched "
+                        "autoscale cost curve")
+    p.add_argument("--group", default=None,
+                   help="comma-separated group names (default: every "
+                        "group the backend reports)")
+    p.add_argument("--synthetic", action="store_true",
+                   help="EXPLICIT opt-in to the deterministic synthetic "
+                        "group family (derived from cluster partitions; "
+                        "envelopes carry groups_real=false). Without it, "
+                        "a backend without group support is refused "
+                        "loudly — synthetic inputs never masquerade as "
+                        "cluster truth")
+    p.add_argument("--weight", default="lag", choices=("lag", "throughput"),
+                   help="packing weight column: per-partition consumer "
+                        "lag (from the group state) or produced-byte "
+                        "rate (from the traffic hook, synthetic where "
+                        "the backend has no meters)")
+    p.add_argument("--counts", default=None,
+                   help="sweep candidate consumer counts, comma-separated "
+                        "(default: 1..2x the current membership, capped "
+                        "by KA_GROUPS_MAX_CANDIDATES)")
+    p.add_argument("--scales", default=None,
+                   help="sweep weight scales in percent, comma-separated "
+                        "(default: the KA_GROUPS_DEFAULT_SCALES knob)")
+    p.add_argument("--solver", default="device",
+                   choices=("device", "greedy"),
+                   help="device: the batched packing kernel (program-"
+                        "store warm); greedy: the host packing oracle "
+                        "(same plans, by the parity pin)")
+    p.add_argument("--failure-policy", dest="failure_policy", default=None,
+                   choices=("strict", "best-effort"),
+                   help="strict (default): a crashed device solve exits "
+                        "with the solve code. best-effort: it falls back "
+                        "to the greedy packing oracle (same plan bytes) "
+                        "and the run exits with the degraded-success "
+                        "code")
+    p.add_argument("--report-json", dest="report_json", default=None,
+                   metavar="PATH",
+                   help="emit the schema-versioned run report (groups "
+                        "span family + groups.* counters) to PATH")
+    return p
+
+
+def run_groups(argv: Optional[List[str]] = None) -> int:
+    """``ka-groups``: the consumer-group plan/sweep pipeline. Library
+    callers get raw typed exceptions; :func:`groups_main` maps them to the
+    documented exit codes."""
+    from .utils.compilecache import enable_persistent_cache
+    from .utils.env import env_bool, env_str
+
+    parser = build_groups_parser()
+    args = parser.parse_args(argv)
+    if args.zk_string is None:
+        print("error: --zk_string is required", file=sys.stderr)
+        parser.print_usage(sys.stderr)
+        return EXIT_USAGE
+    enable_persistent_cache()
+
+    report_path = args.report_json or env_str("KA_OBS_REPORT")
+    if report_path is None and not env_bool("KA_OBS_ENABLE"):
+        return _dispatch_groups(args)
+
+    from . import obs
+
+    mode = "GROUPS_PLAN" if args.mode == "plan" else "GROUPS_SWEEP"
+    with obs.run_capture() as run:
+        status, error, rc = "error", None, 1
+        try:
+            with obs.span(f"mode/{mode}") as sp:
+                rc = _dispatch_groups(args)
+                if rc not in (EXIT_OK, EXIT_DEGRADED):
+                    sp.fail()
+            status = (
+                "ok" if rc == EXIT_OK
+                else "degraded" if rc == EXIT_DEGRADED
+                else "error"
+            )
+            return rc
+        except BaseException as e:
+            error = e
+            raise
+        finally:
+            try:
+                report = obs.build_report(
+                    run, status=status, mode=mode,
+                    argv=list(argv) if argv is not None else sys.argv[1:],
+                    error=error,
+                )
+                obs.emit_report(report, report_path)
+            except Exception as e:
+                print(f"obs: could not emit run report: {e}",
+                      file=sys.stderr)
+
+
+def _dispatch_groups(args) -> int:
+    """Backend open → group ingest (or loud refusal) → encode → solve →
+    envelope emission."""
+    import json as json_mod
+
+    from .groups.model import GROUPS_SCHEMA_VERSION
+    from .groups.solve import (
+        build_group_bodies,
+        load_group_states,
+        parse_int_list,
+        subscribed_partitions,
+        throughput_weights,
+    )
+    from .obs.metrics import counter_add
+    from .utils.env import env_choice, env_float, env_int, env_str
+
+    policy = args.failure_policy or env_choice("KA_FAILURE_POLICY")
+    fallback = "greedy" if policy == "best-effort" else "raise"
+    group_names = args.group.split(",") if args.group else None
+    scales = parse_int_list(
+        args.scales, env_str("KA_GROUPS_DEFAULT_SCALES")
+    )
+    counts = parse_int_list(args.counts)
+    headroom = env_float("KA_GROUPS_CAPACITY_HEADROOM")
+    max_cand = env_int("KA_GROUPS_MAX_CANDIDATES")
+
+    backend = open_backend(args.zk_string)
+    try:
+        supports = bool(
+            getattr(backend, "supports_groups", lambda: False)()
+        )
+        if not args.synthetic and not supports:
+            # The loud refusal (never synthetic-as-real): mirror the
+            # rack-blind refusal's shape — a clear error naming the
+            # explicit opt-out, usage exit code.
+            counter_add("groups.refusals")
+            print(
+                "error: this metadata backend cannot read consumer "
+                "groups (no group membership/offset surface), so a "
+                "packing plan would be built on invented inputs. Re-run "
+                "with --synthetic to explicitly opt into the "
+                "deterministic synthetic family (marked "
+                "groups_real=false), or use a snapshot with a \"groups\" "
+                "section / an AdminClient with consumer-group offset "
+                "support.",
+                file=sys.stderr,
+            )
+            return EXIT_USAGE
+        partitions = backend.partition_assignment(backend.all_topics())
+        part_map = {t: sorted(per) for t, per in partitions.items()}
+        states, groups_real = load_group_states(
+            backend, part_map, groups=group_names,
+            synthetic=args.synthetic,
+        )
+        if not states:
+            raise ValueError("the backend reports no consumer groups")
+        weight_values = (
+            # Traffic I/O proportional to the packing problem (the
+            # groups' subscribed topics), not the whole cluster.
+            throughput_weights(
+                backend, subscribed_partitions(states, part_map)
+            )
+            if args.weight == "throughput" else None
+        )
+    finally:
+        backend.close()
+
+    bodies, degraded_by_group = build_group_bodies(
+        states, groups_real, part_map, args.mode, args.weight,
+        weight_values, scales, headroom, max_cand, counts=counts,
+        solver=args.solver, fallback=fallback,
+    )
+    degraded_any = False
+    for g, body in bodies.items():
+        if args.mode == "sweep":
+            counter_add("groups.sweeps")
+        else:
+            counter_add("groups.plans")
+            counter_add("groups.moves", body["moves"])
+        if degraded_by_group[g]:
+            counter_add("groups.solve_fallbacks")
+            degraded_any = True
+
+    if len(bodies) == 1:
+        payload = next(iter(bodies.values()))
+    else:
+        payload = {
+            "schema_version": GROUPS_SCHEMA_VERSION,
+            "kind": (
+                "groups-plan-set" if args.mode == "plan"
+                else "groups-sweep-set"
+            ),
+            "groups_real": groups_real,
+            "groups": bodies,
+        }
+    # kalint: disable=KA005 -- groups envelope emission (new schema-versioned surface), not a Kafka-parseable reassignment payload
+    print(json_mod.dumps(payload, indent=1, sort_keys=True))
+    if degraded_any:
+        print(
+            "ka-groups: degraded success: device solve fell back to the "
+            f"greedy packing oracle; exiting {EXIT_DEGRADED}",
+            file=sys.stderr,
+        )
+        return EXIT_DEGRADED
+    return EXIT_OK
+
+
+def groups_main() -> None:
+    """Console entry point for ``ka-groups`` (pyproject.toml)."""
+    try:
+        sys.exit(run_groups())
+    except IngestError as e:
+        print(f"error: {e}", file=sys.stderr)
+        sys.exit(EXIT_INGEST)
+    except SolveError as e:
+        print(f"error: {e}", file=sys.stderr)
+        sys.exit(EXIT_SOLVE)
+    except (ZkWireError, OSError) as e:
+        print(f"error: metadata ingest failed: {e}", file=sys.stderr)
+        sys.exit(EXIT_INGEST)
+    except (ValueError, KeyError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        sys.exit(EXIT_VALIDATION)
+
+
 def build_execute_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="ka-execute",

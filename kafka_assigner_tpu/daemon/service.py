@@ -39,6 +39,24 @@ endpoint                        method behavior
                                        against KA_HEALTH_MOVE_COST
                                        (?move_cost= overrides). Computed,
                                        flight-recorded, NEVER executed
+/clusters/<name>/groups/plan    GET/   consumer-group packing plan
+                                POST   (ISSUE 13): sticky, movement-
+                                       minimizing partition→consumer
+                                       rebalance per group, solved on
+                                       device under the shared solve
+                                       lock; schema-versioned byte-stable
+                                       envelope. Backend without group
+                                       support → 400 loud refusal unless
+                                       ``synthetic=true`` opts into the
+                                       deterministic synthetic family
+                                       (marked groups_real=false); a
+                                       crashed device solve re-runs on
+                                       the greedy packing oracle
+/clusters/<name>/groups/sweep   GET/   the batched autoscale sweep: every
+                                POST   (consumer count × lag scale)
+                                       candidate in ONE device fan-out;
+                                       cost curve + recommended count
+                                       (``counts``/``scales`` params)
 /clusters/<name>/healthz        GET    that cluster's lifecycle + breaker
 /clusters/<name>/readyz         GET    that cluster's readiness
 /clusters/<name>/state          GET    that cluster's cache introspection
@@ -127,6 +145,29 @@ def _valid_cluster_name(name: str) -> bool:
     return bool(name) and all(
         c.isalnum() or c in "_.-" for c in name
     )
+
+
+#: Query params whose values ARE booleans: only these normalize. A blanket
+#: both-ways coercion would eat legitimate values that merely look boolean
+#: (?counts=1 for a single-candidate sweep, a topic named "on").
+_BOOL_QUERY_PARAMS = frozenset({
+    "resume", "synthetic", "disable_rack_awareness",
+})
+
+
+def _norm_query_value(key: str, raw: str):
+    """Query-param value normalization shared by the POST merge and the
+    groups GET form: for the KNOWN boolean params, spellings map BOTH
+    ways (?synthetic=0 must mean False, not the truthy string \"0\");
+    every other param passes through as the raw string."""
+    if key not in _BOOL_QUERY_PARAMS:
+        return raw
+    low = raw.lower()
+    if low in ("1", "true", "yes", "on"):
+        return True
+    if low in ("0", "false", "no", "off"):
+        return False
+    return raw
 
 
 def _request_id(headers) -> str:
@@ -387,10 +428,16 @@ class AssignerDaemon:
 # --------------------------------------------------------------------------
 
 #: Per-cluster path suffixes the router accepts.
-_POST_SUFFIXES = ("/plan", "/whatif", "/execute")
+_POST_SUFFIXES = (
+    "/plan", "/whatif", "/execute", "/groups/plan", "/groups/sweep",
+)
 _GET_SUFFIXES = (
     "/healthz", "/readyz", "/state", "/debug/flight", "/recommendations",
+    "/groups/plan", "/groups/sweep",
 )
+#: The consumer-group family's endpoints (ISSUE 13): served on GET (query
+#: params) AND POST (JSON body) — both read-only computations.
+_GROUPS_SUFFIXES = ("/groups/plan", "/groups/sweep")
 
 
 def _render_metrics(daemon: AssignerDaemon) -> str:
@@ -662,6 +709,23 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
                 )
                 self._status = body.get("verdict") or body.get("error")
                 self._reply(code, body, headers)
+            elif suffix in _GROUPS_SUFFIXES:
+                # GET form of the groups family (read-only computation):
+                # query params with the same boolean normalization as the
+                # POST merge below.
+                params = {
+                    k: _norm_query_value(k, vals[-1])
+                    for k, vals in parse_qs(split.query).items()
+                }
+                code, body, headers = sup.groups_request(
+                    suffix.rsplit("/", 1)[-1], params,
+                    request_id=self._rid,
+                )
+                self._status = (
+                    "degraded" if body.get("degraded")
+                    else body.get("error") and "error" or "ok"
+                )
+                self._reply(code, body, headers)
             elif suffix == "/debug/flight":
                 rec = flight.recorder()
                 self._reply(
@@ -706,15 +770,18 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
             # boolean spellings normalize BOTH ways — ?resume=0 must mean
             # False, not the truthy string "0".
             for key, vals in parse_qs(split.query).items():
-                raw_v = vals[-1]
-                low = raw_v.lower()
-                if low in ("1", "true", "yes", "on"):
-                    value = True
-                elif low in ("0", "false", "no", "off"):
-                    value = False
-                else:
-                    value = raw_v
-                params.setdefault(key, value)
+                params.setdefault(key, _norm_query_value(key, vals[-1]))
+            if suffix in _GROUPS_SUFFIXES:
+                code, body, headers = sup.groups_request(
+                    suffix.rsplit("/", 1)[-1], params,
+                    request_id=self._rid,
+                )
+                self._status = (
+                    "degraded" if body.get("degraded")
+                    else body.get("error") and "error" or "ok"
+                )
+                self._reply(code, body, headers)
+                return
             if suffix == "/execute":
                 self._status = "stream"
                 self._execute(sup, params)

@@ -699,6 +699,205 @@ class ClusterSupervisor:
             )
             self._release()
 
+    # -- consumer-group workload family (ISSUE 13) --------------------------
+
+    def groups_request(
+        self, kind: str, params: dict,
+        request_id: Optional[str] = None,
+    ) -> Tuple[int, dict, dict]:
+        """The ``/groups/plan`` and ``/groups/sweep`` endpoints: the
+        consumer-group packing family against this cluster's LIVE group
+        state (fetched from the backend per request — membership and lag
+        are fast-moving, a cached copy would be stale by construction)
+        with the partition universe from the metadata cache. Admission
+        through the same :meth:`_gate`/:meth:`_release` accounting and
+        live watchdog as every other solve-bearing endpoint; the device
+        dispatch serializes on the shared solve lock. A backend without
+        group support refuses loudly (400, ``groups.refusals``) unless
+        the request opts into the synthetic family explicitly
+        (``synthetic: true`` → ``groups_real: false`` in the envelope —
+        never synthetic-as-real). A crashed device solve re-runs on the
+        greedy packing oracle (``groups.solve_fallbacks``), per-request
+        isolation like ``/plan``'s."""
+        from ..groups.model import GROUPS_SCHEMA_VERSION
+        from ..groups.solve import (
+            build_group_bodies,
+            load_group_states,
+            parse_int_list,
+            subscribed_partitions,
+            throughput_weights,
+        )
+        from ..utils.env import env_float, env_int, env_str
+
+        refusal = self._gate()
+        if refusal is not None:
+            return refusal
+        t0 = time.perf_counter()
+        ok = False
+        watchdog_timer = self._watchdog(
+            f"/groups/{kind}", self._request_budget(), request_id
+        )
+        try:
+            raw_syn = params.get("synthetic", False)
+            if isinstance(raw_syn, str):
+                # A JSON body may carry boolean STRINGS ("false"); plain
+                # bool() would read "false"/"0" as opting INTO the
+                # synthetic family — the one direction that must never
+                # happen silently.
+                low = raw_syn.strip().lower()
+                if low in ("1", "true", "yes", "on"):
+                    synthetic = True
+                elif low in ("", "0", "false", "no", "off"):
+                    synthetic = False
+                else:
+                    raise ValueError(
+                        f"synthetic must be a boolean, got {raw_syn!r}"
+                    )
+            else:
+                synthetic = bool(raw_syn)
+            weight = params.get("weight") or "lag"
+            raw_groups = params.get("group")
+            if isinstance(raw_groups, str):
+                group_names = raw_groups.split(",")
+            elif raw_groups is None:
+                group_names = None
+            elif isinstance(raw_groups, list) and all(
+                isinstance(g, str) for g in raw_groups
+            ):
+                group_names = raw_groups
+            else:
+                raise ValueError("group must be a name or list of names")
+            backend = self.backend
+            if backend is None:
+                # Quorum blackout mid-reopen: a TRANSIENT outage, not a
+                # capability refusal — telling the operator to pass
+                # synthetic=true here would be exactly the
+                # synthetic-as-real laundering the refusal exists to
+                # prevent.
+                return 503, {
+                    "error": "cluster backend unavailable (session "
+                             "re-establishment in progress)",
+                    "cluster": self.name,
+                }, {"Retry-After": "5"}
+            supports = bool(
+                getattr(backend, "supports_groups", lambda: False)()
+            )
+            if not synthetic and not supports:
+                self._count("groups.refusals")
+                flight.record(
+                    "groups", self.name, op=kind, outcome="refused",
+                    request_id=request_id,
+                )
+                return 400, {
+                    "error": "this cluster's backend cannot read consumer "
+                             "groups (no membership/offset surface); pass "
+                             "synthetic=true to explicitly opt into the "
+                             "deterministic synthetic family (marked "
+                             "groups_real=false)",
+                    "cluster": self.name,
+                }, {}
+            part_map = {
+                t: sorted(per)
+                for t, per in self.state.all_assignments().items()
+            }
+            headroom = env_float("KA_GROUPS_CAPACITY_HEADROOM")
+            max_cand = env_int("KA_GROUPS_MAX_CANDIDATES")
+            scales = parse_int_list(
+                params.get("scales"), env_str("KA_GROUPS_DEFAULT_SCALES")
+            )
+            counts = parse_int_list(params.get("counts"))
+            # Backend I/O happens BEFORE the shared solve lock: group
+            # state and traffic fetches are network round-trips on live
+            # backends, and the solve lock serializes every solve-bearing
+            # request across ALL clusters — a slow coordinator must cost
+            # only this request, never the fleet (exactly the stall class
+            # KA015/KA019 exist to keep out of the lock).
+            states, groups_real = load_group_states(
+                backend, part_map, groups=group_names,
+                synthetic=synthetic,
+            )
+            if not states:
+                raise ValueError(
+                    "the backend reports no consumer groups"
+                )
+            weight_values = (
+                throughput_weights(
+                    backend, subscribed_partitions(states, part_map)
+                )
+                if weight == "throughput" else None
+            )
+            with self._solve_lock:
+                # build_group_bodies is the orchestration both surfaces
+                # share; the probe is the daemon chaos seam
+                # (daemon:solver-crash, @cluster-addressable) — a crash
+                # there, or inside the device dispatch itself, re-runs
+                # that group on the packing oracle: the request survives,
+                # like /plan's solver isolation.
+                bodies, degraded_by_group = build_group_bodies(
+                    states, groups_real, part_map, kind, weight,
+                    weight_values, scales, headroom, max_cand,
+                    counts=counts, fallback="greedy",
+                    probe=lambda: fault_point("daemon", cluster=self.name),
+                )
+            degraded_any = False
+            for g, body in bodies.items():
+                # Per GROUP, like the CLI (the counters' unit is one
+                # packing problem; a request may span groups). The
+                # envelope builders deliberately do NOT count — one
+                # owner per surface, no double-fed scrape series.
+                if kind == "sweep":
+                    self._count("groups.sweeps")
+                else:
+                    self._count("groups.plans")
+                    self._count("groups.moves", body["moves"])
+                if degraded_by_group[g]:
+                    self._count("groups.solve_fallbacks")
+                    degraded_any = True
+                    self._log(
+                        f"groups solve crashed in-request for group "
+                        f"{g!r}; served from the greedy packing oracle"
+                    )
+            if kind == "sweep":
+                hist_observe(
+                    self._metric("groups.sweep_ms"),
+                    (time.perf_counter() - t0) * 1e3,
+                )
+            flight.record(
+                "groups", self.name, op=kind,
+                outcome="degraded" if degraded_any else "ok",
+                groups=sorted(bodies), request_id=request_id,
+            )
+            ok = not degraded_any
+            # Byte-stable by design, like /recommendations: no
+            # timestamps, no request ids in the body.
+            envelope = {
+                "schema_version": GROUPS_SCHEMA_VERSION,
+                "kind": f"groups-{kind}",
+                "cluster": self.name,
+                "groups_real": groups_real,
+                "stale": self.state.stale,
+                "degraded": degraded_any,
+                "groups": bodies,
+            }
+            return 200, envelope, {}
+        except (ValueError, KeyError) as e:
+            return 400, {"error": f"bad groups request: {e}"}, {}
+        except IngestError as e:
+            self._count("groups.refusals")
+            return 400, {"error": str(e), "cluster": self.name}, {}
+        except SolveError as e:
+            return 500, {"error": f"{type(e).__name__}: {e}"}, {}
+        except Exception as e:
+            self._count("daemon.request_errors")
+            return 500, {"error": f"{type(e).__name__}: {e}"}, {}
+        finally:
+            watchdog_timer.cancel()
+            record_span(
+                self._metric("daemon/groups"),
+                (time.perf_counter() - t0) * 1e3, ok,
+            )
+            self._release()
+
     def _resync_with_retries(self) -> bool:
         """The bounded resync: ``KA_DAEMON_RESYNC_RETRIES`` prompt attempts
         with jittered backoff, each failure counted against the breaker; on

@@ -1,0 +1,39 @@
+"""Consumer-group workload family (ISSUE 13): capacity-constrained
+partition→consumer packing plus the batched on-device autoscale sweep.
+
+The second workload the batched integer-assignment machinery speaks,
+end to end (the consumer-group autoscaler problem of arXiv:2206.11170 /
+arXiv:2402.06085):
+
+- :mod:`.model`   — synthetic family + envelope schema/validators;
+- :mod:`.encode`  — ingest → bucketed int32 packing tensors, layered on
+  the same ``_pad8`` bucketing rules as ``models/problem.py``;
+- :mod:`.solve`   — plan + autoscale-sweep pipelines (device dispatch via
+  ``parallel/whatif.py``; host greedy-packing oracle
+  ``solvers/greedypack.py`` as the parity pin and the crash fallback).
+
+Surfaces: the ``ka-groups`` console entry (``cli.py``), the daemon's
+``/clusters/<name>/groups/{plan,sweep}`` endpoints
+(``daemon/supervisor.py``), and the ``groups.*`` metric/span families
+(``obs/names.py``).
+"""
+from .model import (
+    GROUPS_SCHEMA_VERSION,
+    synthetic_group_state,
+    validate_groups_plan,
+    validate_groups_sweep,
+)
+from .encode import GroupEncoding, encode_group
+from .solve import group_plan_envelope, group_sweep_envelope, load_group_states
+
+__all__ = [
+    "GROUPS_SCHEMA_VERSION",
+    "GroupEncoding",
+    "encode_group",
+    "group_plan_envelope",
+    "group_sweep_envelope",
+    "load_group_states",
+    "synthetic_group_state",
+    "validate_groups_plan",
+    "validate_groups_sweep",
+]

@@ -1,0 +1,432 @@
+"""Consumer-group plan + autoscale-sweep pipelines (ISSUE 13).
+
+Ingest (backend hook / explicit synthetic opt-in) → :mod:`.encode` →
+on-device packing through ``parallel/whatif.py``'s store-backed dispatch →
+decode to a sticky rebalance plan / cost curve, with the host greedy
+packing oracle (``solvers/greedypack.py``) as the parity pin AND the
+crash fallback: a device solve that dies mid-request re-runs here —
+same plan bytes, by the parity contract.
+
+Every envelope this module builds is BYTE-STABLE for identical inputs:
+no timestamps, no elapsed times, keys emitted sorted — two identical
+``ka-groups`` runs (or two identical daemon ``/groups/*`` calls over an
+unchanged cache) produce identical bytes, smoke- and test-pinned.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..errors import SolveError
+from ..obs.trace import span
+from .encode import GroupEncoding, decode_plan, encode_group
+from .model import GROUPS_SCHEMA_VERSION, synthetic_group_state
+
+
+def load_group_states(
+    backend,
+    partitions,
+    groups: Optional[Sequence[str]] = None,
+    synthetic: bool = False,
+) -> Tuple[dict, bool]:
+    """Resolve the packing inputs: ``(states {group: state}, groups_real)``.
+
+    ``synthetic=True`` is the EXPLICIT opt-in for the deterministic
+    synthetic family (derived from ``partitions`` — the caller's cached
+    topic universe); otherwise the backend hook serves real state or
+    refuses loudly (``io/base.py:fetch_consumer_groups`` contract —
+    never synthetic-as-real)."""
+    if synthetic:
+        names = list(groups) if groups else ["synthetic"]
+        return (
+            {g: synthetic_group_state(g, partitions) for g in names},
+            False,
+        )
+    states = backend.fetch_consumer_groups(groups)
+    return dict(states), bool(
+        getattr(backend, "supports_groups", lambda: False)()
+    )
+
+
+def parse_int_list(value, default_csv: Optional[str] = None):
+    """Normalize a counts/scales input: a list of ints, a comma-separated
+    string (flags and query params; blank entries — trailing commas —
+    forgiven), or the default CSV when ``value`` is None (``None`` default
+    → ``None``). One parser for the CLI and the daemon, so the two
+    surfaces cannot drift on what they accept. Raises ``ValueError`` on
+    junk."""
+    if value is None:
+        if default_csv is None:
+            return None
+        value = default_csv
+    if isinstance(value, str):
+        value = [v for v in value.split(",") if v.strip()]
+    if not isinstance(value, list):
+        raise ValueError(
+            f"expected a list or CSV of integers, got {value!r}"
+        )
+    return [int(v) for v in value]
+
+
+def build_group_bodies(
+    states: dict,
+    groups_real: bool,
+    part_map,
+    kind: str,
+    weight: str,
+    weight_values,
+    scales: Sequence[int],
+    headroom: float,
+    max_candidates: int,
+    counts: Optional[Sequence[int]] = None,
+    solver: str = "device",
+    fallback: str = "greedy",
+    probe=None,
+) -> Tuple[Dict[str, dict], Dict[str, bool]]:
+    """The per-group orchestration both surfaces share (the CLI's
+    ``_dispatch_groups`` and the daemon's ``groups_request``): row
+    universe → candidate counts → fan-out cap → encode → envelope, per
+    group in sorted order. Returns ``(bodies, degraded_by_group)``.
+
+    ``probe`` (the daemon's ``daemon:solver-crash`` chaos seam) runs
+    before each group's device build; an :class:`InjectedSolverCrash`
+    from it re-runs that group on the packing oracle under
+    ``fallback="greedy"`` (marked ``solver: greedy-fallback``) or maps to
+    :class:`SolveError` under ``fallback="raise"`` — identical policy to
+    a crash inside the dispatch itself. Counters are deliberately NOT
+    emitted here: each surface owns its own accounting (the CLI's global
+    counters, the supervisor's cluster-labeled ones), derived from the
+    returned bodies."""
+    from ..faults.inject import InjectedSolverCrash
+
+    bodies: Dict[str, dict] = {}
+    degraded_by_group: Dict[str, bool] = {}
+    for g in sorted(states):
+        st = states[g]
+        universe = group_partition_universe(st, part_map)
+        if kind == "sweep":
+            counts_g = list(counts) if counts else default_counts(
+                len(st.members), len(scales), max_candidates
+            )
+            if len(counts_g) * len(scales) > max_candidates:
+                raise ValueError(
+                    f"sweep fan-out {len(counts_g) * len(scales)} "
+                    f"exceeds KA_GROUPS_MAX_CANDIDATES={max_candidates}; "
+                    "narrow counts/scales or raise the knob"
+                )
+            enc = encode_group(
+                st, partitions=universe, weight=weight,
+                weight_values=weight_values,
+                max_consumers=max(counts_g), max_scale_pct=max(scales),
+                capacity_headroom=headroom,
+            )
+
+            def builder(sv, enc=enc, counts_g=counts_g):
+                return group_sweep_envelope(
+                    enc, counts_g, scales, groups_real,
+                    solver=sv, fallback=fallback,
+                )
+        else:
+            enc = encode_group(
+                st, partitions=universe, weight=weight,
+                weight_values=weight_values, capacity_headroom=headroom,
+            )
+
+            def builder(sv, enc=enc):
+                return group_plan_envelope(
+                    enc, groups_real, solver=sv, fallback=fallback,
+                )
+        try:
+            if probe is not None:
+                probe()
+            body, degraded = builder(solver)
+        except InjectedSolverCrash as e:
+            if fallback != "greedy":
+                raise SolveError(
+                    f"groups solve crashed in-request "
+                    f"({type(e).__name__}: {e})"
+                ) from e
+            body, _ = builder("greedy")
+            body["solver"] = "greedy-fallback"
+            degraded = True
+        bodies[g] = body
+        degraded_by_group[g] = degraded
+    return bodies, degraded_by_group
+
+
+def subscribed_partitions(states: dict, part_map) -> dict:
+    """The union of every requested group's row universe — what a
+    ``weight="throughput"`` traffic fetch should cover (backend I/O
+    proportional to the packing problem, not the cluster)."""
+    out: Dict[str, list] = {}
+    for st in states.values():
+        out.update(group_partition_universe(st, part_map))
+    return out
+
+
+def group_partition_universe(state, part_map) -> dict:
+    """The row universe for one group: the cluster's partition lists
+    (``part_map``, from the metadata cache) restricted to the topics the
+    group SUBSCRIBES to (mentions in its assignment or lag maps) — so a
+    group whose committed offsets cover only part of a topic still packs
+    the topic's every partition, without dragging unrelated topics into
+    its problem. This is the reconciliation the ``ConsumerGroupState``
+    contract promises (io/base.py)."""
+    subscribed = set(state.assignment) | set(state.lags)
+    return {
+        t: part_map[t] for t in sorted(subscribed) if t in part_map
+    }
+
+
+def _member_view(enc: GroupEncoding, load) -> List[dict]:
+    """The envelope's member table over the REAL membership columns."""
+    out = []
+    for col in range(enc.c):
+        cap = int(enc.capacities[col])
+        out.append({
+            "member": enc.members[col],
+            "capacity": cap,
+            "load": int(load[col]),
+            "load_frac": round(int(load[col]) / max(cap, 1), 4),
+        })
+    return out
+
+
+def _host_pack(enc: GroupEncoding, alive, scale_pct: int = 100):
+    """The oracle run in the device tuple's shape (the fallback lane)."""
+    from ..solvers.greedypack import pack_consumers, scale_weights
+
+    w = scale_weights([int(x) for x in enc.weights], scale_pct, enc.p)
+    res = pack_consumers(
+        w, [int(x) for x in enc.capacities],
+        [int(x) for x in enc.current], [int(x) for x in enc.proc_order],
+        [bool(x) for x in alive], enc.p,
+    )
+    return (
+        np.asarray(res.assigned, dtype=np.int32),
+        np.asarray(res.load, dtype=np.int32),
+        res.moved,
+        res.overflowed,
+        not res.feasible,
+    )
+
+
+def group_plan_envelope(
+    enc: GroupEncoding,
+    groups_real: bool,
+    solver: str = "device",
+    fallback: str = "greedy",
+) -> Tuple[dict, bool]:
+    """One group's sticky, movement-minimizing rebalance plan body.
+
+    ``solver="device"`` dispatches the packing kernel (program-store
+    warm); ``"greedy"`` runs the host oracle directly. A crashed device
+    solve falls back to the oracle when ``fallback="greedy"``
+    (``groups.solve_fallbacks``; plan bytes unchanged by the parity pin)
+    or re-raises as :class:`SolveError` under ``fallback="raise"`` —
+    the strict-policy lane. Returns ``(body, degraded)``."""
+    from ..parallel.whatif import pack_group_on_device
+
+    alive = enc.alive(enc.c if enc.real_members == 0 else enc.real_members)
+    degraded = False
+    used = solver
+    with span("groups/plan"):
+        if solver == "device":
+            try:
+                assigned, load, moved, overflowed, infeasible = (
+                    pack_group_on_device(
+                        enc.weights, enc.capacities, enc.current,
+                        enc.proc_order, alive, enc.p,
+                    )
+                )
+            except (ValueError, KeyError):
+                raise  # malformed inputs are client errors, not crashes
+            except Exception as e:
+                if fallback != "greedy":
+                    raise SolveError(
+                        f"groups packing solve crashed "
+                        f"({type(e).__name__}: {e})"
+                    ) from e
+                degraded = True
+                used = "greedy-fallback"
+                assigned, load, moved, overflowed, infeasible = _host_pack(
+                    enc, alive
+                )
+        else:
+            used = "greedy"
+            assigned, load, moved, overflowed, infeasible = _host_pack(
+                enc, alive
+            )
+    plan = {
+        t: {str(p): m for p, m in sorted(per.items())}
+        for t, per in sorted(decode_plan(enc, assigned).items())
+    }
+    body = {
+        "schema_version": GROUPS_SCHEMA_VERSION,
+        "kind": "groups-plan",
+        "group": enc.group,
+        "groups_real": groups_real,
+        "weight": enc.weight_kind,
+        "solver": used,
+        "members": _member_view(enc, load),
+        "plan": plan,
+        "moves": int(moved),
+        "overflowed": int(overflowed),
+        "feasible": not bool(infeasible),
+        "partitions": enc.p,
+        "total_weight": enc.total_weight,
+        "weight_shift": enc.shift,
+    }
+    return body, degraded
+
+
+def group_sweep_envelope(
+    enc: GroupEncoding,
+    counts: Sequence[int],
+    scale_pcts: Sequence[int],
+    groups_real: bool,
+    solver: str = "device",
+    fallback: str = "greedy",
+) -> Tuple[dict, bool]:
+    """The autoscale cost curve for one group: every (consumer count ×
+    lag-scale) candidate evaluated as ONE batched device fan-out.
+    Returns ``(body, degraded)``; candidates are emitted sorted by
+    (scale, consumers), and ``recommended_consumers`` answers the
+    headline question — the smallest candidate count that packs feasibly
+    at the LOWEST swept scale (None when none does)."""
+    from ..parallel.whatif import evaluate_group_candidates
+
+    counts = sorted({int(k) for k in counts if int(k) >= 1})
+    scale_pcts = sorted({max(int(s), 1) for s in scale_pcts})
+    if not counts or not scale_pcts:
+        raise ValueError("sweep needs at least one count and one scale")
+    if max(counts) > enc.c:
+        # Columns past enc.c are PAD columns (capacity 0, no member id):
+        # letting a candidate mark one alive would score feasibility
+        # against a consumer that does not exist.
+        raise ValueError(
+            f"candidate count {max(counts)} exceeds the encoding's "
+            f"usable consumer columns ({enc.c}); re-encode with "
+            f"max_consumers={max(counts)}"
+        )
+    cand = [(s, k) for s in scale_pcts for k in counts]
+    alive_masks = np.zeros((len(cand), enc.c_pad), dtype=bool)
+    for i, (_s, k) in enumerate(cand):
+        alive_masks[i, :k] = True
+    scales = np.array([s for s, _k in cand], dtype=np.int32)
+
+    degraded = False
+    used = solver
+    with span("groups/sweep"):
+        if solver == "device":
+            try:
+                moved, overflowed, infeasible, load = (
+                    evaluate_group_candidates(
+                        enc.weights, enc.capacities, enc.current,
+                        enc.proc_order, alive_masks, scales, enc.p,
+                    )
+                )
+            except (ValueError, KeyError):
+                raise  # malformed inputs are client errors, not crashes
+            except Exception as e:
+                if fallback != "greedy":
+                    raise SolveError(
+                        f"groups autoscale sweep crashed "
+                        f"({type(e).__name__}: {e})"
+                    ) from e
+                degraded = True
+                used = "greedy-fallback"
+                moved, overflowed, infeasible, load = _host_sweep(
+                    enc, alive_masks, scales
+                )
+        else:
+            used = "greedy"
+            moved, overflowed, infeasible, load = _host_sweep(
+                enc, alive_masks, scales
+            )
+    candidates = []
+    for i, (s, k) in enumerate(cand):
+        caps = enc.capacities[:k].astype(np.int64)
+        row_load = np.asarray(load[i][:k], dtype=np.int64)
+        frac = float(
+            (row_load / np.maximum(caps, 1)).max()
+        ) if k else 0.0
+        candidates.append({
+            "consumers": k,
+            "scale_pct": s,
+            "feasible": not bool(infeasible[i]),
+            "moved": int(moved[i]),
+            "overflowed": int(overflowed[i]),
+            "max_load_frac": round(frac, 4),
+        })
+    base_scale = scale_pcts[0]
+    feasible_at_base = sorted(
+        c["consumers"] for c in candidates
+        if c["scale_pct"] == base_scale and c["feasible"]
+    )
+    body = {
+        "schema_version": GROUPS_SCHEMA_VERSION,
+        "kind": "groups-sweep",
+        "group": enc.group,
+        "groups_real": groups_real,
+        "weight": enc.weight_kind,
+        "solver": used,
+        "candidates": candidates,
+        "recommended_consumers": (
+            feasible_at_base[0] if feasible_at_base else None
+        ),
+        "counts": counts,
+        "scales_pct": scale_pcts,
+        "partitions": enc.p,
+        "total_weight": enc.total_weight,
+        "weight_shift": enc.shift,
+    }
+    return body, degraded
+
+
+def _host_sweep(enc: GroupEncoding, alive_masks, scales):
+    """Oracle fallback for the whole candidate batch (slow lane — only
+    taken when the device sweep crashed)."""
+    moved, overflowed, infeasible, loads = [], [], [], []
+    for i in range(len(alive_masks)):
+        _a, load, m, o, inf = _host_pack(
+            enc, alive_masks[i], int(scales[i])
+        )
+        moved.append(m)
+        overflowed.append(o)
+        infeasible.append(inf)
+        loads.append(load)
+    return (
+        np.asarray(moved, dtype=np.int64),
+        np.asarray(overflowed, dtype=np.int64),
+        np.asarray(infeasible, dtype=bool),
+        np.stack(loads),
+    )
+
+
+def default_counts(
+    real_members: int, n_scales: int, max_candidates: int
+) -> List[int]:
+    """The sweep's default candidate counts: 1..2× the current membership
+    (at least 1..4), truncated so counts × scales stays inside the
+    fan-out cap (``KA_GROUPS_MAX_CANDIDATES``)."""
+    top = max(2 * max(real_members, 1), 4)
+    counts = list(range(1, top + 1))
+    budget = max(max_candidates // max(n_scales, 1), 1)
+    return counts[:budget]
+
+
+def throughput_weights(backend, partitions) -> Dict[Tuple[str, int], float]:
+    """The throughput weight column: per-partition produced-byte rates
+    through the PR 11 traffic hook (real where the backend has meters,
+    the deterministic synthetic series elsewhere — the envelope's
+    ``weight`` field names the column either way)."""
+    stats = backend.fetch_partition_traffic(
+        {t: sorted(parts) for t, parts in partitions.items()}
+    )
+    return {
+        (t, int(p)): float(tr.in_bytes)
+        for t, per in stats.items()
+        for p, tr in per.items()
+    }

@@ -50,6 +50,33 @@ class PartitionTraffic(NamedTuple):
     lag: int          # worst consumer-group lag, in messages
 
 
+class GroupMember(NamedTuple):
+    """One consumer-group member, as the consumer-group workload family
+    ingests it (ISSUE 13): a stable member id and a consumption-capacity
+    estimate in weight units/s (the same units as the lag column the
+    packing solve weighs partitions by). ``capacity <= 0`` means unknown —
+    the encoder substitutes the documented fair-share default
+    (``groups/encode.py``)."""
+
+    member_id: str
+    capacity: float = 0.0
+
+
+class ConsumerGroupState(NamedTuple):
+    """One consumer group's packing problem, backend-normalized: members,
+    the current partition→member ownership, and per-partition lag (the
+    default weight column). ``assignment`` maps ``topic -> partition ->
+    member_id`` (``None`` = currently unowned); ``lags`` maps ``topic ->
+    partition -> messages``. Partitions may appear in ``lags`` without an
+    owner and vice versa — the encoder reconciles both against the
+    caller's partition universe."""
+
+    group: str
+    members: Tuple[GroupMember, ...]
+    assignment: Dict[str, Dict[int, Optional[str]]]
+    lags: Dict[str, Dict[int, int]]
+
+
 class PartitionState(NamedTuple):
     """One partition's convergence-relevant state, as the execution engine
     polls it (ISSUE 7): the assigned replica list and the in-sync subset.
@@ -180,6 +207,41 @@ class MetadataBackend(Protocol):
         from ..obs.health import synthetic_partition_traffic
 
         return synthetic_partition_traffic(partitions)
+
+    # -- consumer-group surface (ISSUE 13) ---------------------------------
+
+    def supports_groups(self) -> bool:
+        """True when this backend reports REAL consumer-group state from
+        :meth:`fetch_consumer_groups`. Default False — and unlike the
+        traffic hook there is NO silent synthetic fallback here: a packing
+        plan against invented membership is an operator lie, so callers
+        must either refuse loudly (the default contract) or take the
+        deterministic synthetic family through an EXPLICIT opt-in
+        (``ka-groups --synthetic`` / the ``synthetic`` request param),
+        which stamps ``groups_real: false`` into every envelope."""
+        return False
+
+    def fetch_consumer_groups(
+        self, groups: Optional[Sequence[str]] = None
+    ) -> Dict[str, ConsumerGroupState]:
+        """Consumer-group membership + current ownership + per-partition
+        lag for the named groups (all groups when ``None``). The default
+        is a LOUD REFUSAL, not a stub and not a synthetic stand-in: a
+        backend that cannot see consumer groups must say so
+        (``IngestError``) rather than let synthetic packing inputs
+        masquerade as cluster truth. Implementations: the snapshot
+        backend's ``groups`` section (hermetic), the AdminClient bridge
+        when the client carries the whole group-offset chain (real lag,
+        PR 11's ``_real_lags`` machinery)."""
+        from ..errors import IngestError
+
+        raise IngestError(
+            f"{type(self).__name__} cannot read consumer groups (no group "
+            "membership/offset surface on this backend); use a snapshot "
+            "with a \"groups\" section, a Kafka AdminClient with consumer-"
+            "group offset support, or opt into the deterministic "
+            "synthetic family explicitly (--synthetic)"
+        )
 
     # -- plan execution surface (ISSUE 7) ---------------------------------
 

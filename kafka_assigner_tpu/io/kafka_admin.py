@@ -278,6 +278,115 @@ class KafkaAdminBackend:
                 lags[key] = max(lags.get(key, 0), lag)
         return lags
 
+    # -- consumer-group surface (ISSUE 13) ---------------------------------
+
+    def supports_groups(self) -> bool:
+        """Real consumer-group packing inputs need the WHOLE chain the lag
+        column needs (:meth:`supports_traffic` — group listing, committed
+        offsets, an end-offset source) PLUS group description for
+        membership. Anything less keeps the io/base.py loud-refusal
+        default: a packing plan over invented members would be
+        synthetic-as-real, the exact lie this flag exists to prevent."""
+        return self.supports_traffic() and hasattr(
+            self._admin, "describe_consumer_groups"
+        )
+
+    def fetch_consumer_groups(self, groups=None):
+        """Membership from ``describe_consumer_groups`` (duck-typed across
+        kafka-python versions: member assignments accepted as parsed
+        ``(topic, partitions)`` pairs or skipped when only opaque bytes are
+        exposed — an unowned partition is a valid packing input), current
+        ownership from those assignments, lag per partition from the same
+        batched end-offset sweep PR 11's traffic hook uses. Capacity
+        estimates are not observable over an admin connection (they need
+        per-member metering); members report ``capacity=0`` (unknown) and
+        the encoder's documented fair-share default applies."""
+        from ..errors import IngestError
+        from .base import ConsumerGroupState, GroupMember
+
+        if not self.supports_groups():
+            raise IngestError(
+                "this Kafka AdminClient cannot read consumer groups (needs "
+                "kafka-python with list/describe_consumer_groups, "
+                "list_consumer_group_offsets and an end_offsets source); "
+                "use a snapshot with a \"groups\" section or --synthetic"
+            )
+        self._fault_reply()
+        counter_add("zk.reads")
+        if groups is None:
+            groups = [
+                g[0] if isinstance(g, tuple) else g
+                for g in self._admin.list_consumer_groups()
+            ]
+        wanted_groups = list(dict.fromkeys(groups))
+        # ONE batched describe for the whole set — the API takes a list,
+        # and a per-group RPC would make membership the dominant request
+        # cost on group-heavy clusters (same batching rule as the
+        # end-offset sweep in _real_lags).
+        with hist_ms("zk.op_ms"):
+            all_described = self._admin.describe_consumer_groups(
+                wanted_groups
+            )
+        described_of: Dict[str, list] = {g: [] for g in wanted_groups}
+        unattributed = False
+        for desc in all_described:
+            gid = str(getattr(desc, "group", getattr(desc, "group_id", "")))
+            if gid:
+                described_of.setdefault(gid, []).append(desc)
+            else:
+                unattributed = True
+        if unattributed:
+            # A client whose description objects carry no group id:
+            # results come back in request order — map positionally.
+            described_of = {
+                g: [d] for g, d in zip(wanted_groups, all_described)
+            }
+        out = {}
+        for group in wanted_groups:
+            members = []
+            assignment: Dict[str, Dict[int, str]] = {}
+            for desc in described_of.get(group, []):
+                for m in getattr(desc, "members", []) or []:
+                    member_id = str(getattr(m, "member_id", m))
+                    members.append(GroupMember(member_id, 0.0))
+                    massign = getattr(m, "member_assignment", None)
+                    pairs = getattr(massign, "assignment", None)
+                    if not pairs:
+                        continue  # opaque/undecoded bytes: ownership unknown
+                    for topic, parts in pairs:
+                        per = assignment.setdefault(str(topic), {})
+                        for p in parts:
+                            per[int(p)] = member_id
+            # THIS group's lag (not the cross-group worst the traffic hook
+            # publishes): committed offsets per partition vs ONE batched
+            # end-offset read — the PR 11 lag chain, group-scoped.
+            offsets = self._admin.list_consumer_group_offsets(group)
+            lags: Dict[str, Dict[int, int]] = {}
+            if offsets:
+                ends_raw = self._end_offsets_fn()(sorted(
+                    offsets, key=lambda tp: (tp.topic, int(tp.partition))
+                ))
+                ends = {
+                    (tp.topic, int(tp.partition)): off
+                    for tp, off in ends_raw.items() if off is not None
+                }
+                for tp, meta in offsets.items():
+                    key = (tp.topic, int(tp.partition))
+                    committed = getattr(meta, "offset", None)
+                    if key not in ends or committed is None \
+                            or committed < 0:
+                        continue
+                    lags.setdefault(key[0], {})[key[1]] = max(
+                        0, int(ends[key]) - int(committed)
+                    )
+            out[group] = ConsumerGroupState(
+                group=group,
+                members=tuple(sorted(members)),
+                assignment=assignment,
+                lags=lags,
+            )
+        return out
+
     # -- plan execution surface (ISSUE 7) ---------------------------------
 
     def supports_execution(self) -> bool:

@@ -32,7 +32,13 @@ from typing import Dict, Iterator, List, Sequence, Tuple
 
 from ..faults.inject import active_injector
 from ..obs.metrics import counter_add
-from .base import BrokerInfo, PartitionState, PartitionTraffic
+from .base import (
+    BrokerInfo,
+    ConsumerGroupState,
+    GroupMember,
+    PartitionState,
+    PartitionTraffic,
+)
 
 
 class SnapshotBackend:
@@ -77,6 +83,35 @@ class SnapshotBackend:
             }
             for t, per in self._traffic_raw.items()
         }
+        # Optional consumer-group section (ISSUE 13):
+        #   "groups": {"analytics": {
+        #       "members": {"c-0": 120.0, "c-1": null},
+        #       "assignment": {"events": {"0": "c-0"}},
+        #       "lag": {"events": {"0": 500}}}}
+        # ``members`` maps member id -> capacity estimate (null/absent =
+        # unknown, the encoder's fair-share default applies). Absent
+        # section => supports_groups() False and the loud-refusal default
+        # from io/base.py stays in force (never synthetic-as-real).
+        self._groups_raw: Dict = dict(data.get("groups", {}) or {})
+        self._groups: Dict[str, ConsumerGroupState] = {}
+        for g, spec in self._groups_raw.items():
+            members = tuple(
+                GroupMember(str(m), float(c) if c is not None else 0.0)
+                for m, c in sorted((spec.get("members") or {}).items())
+            )
+            assignment = {
+                t: {int(p): (str(m) if m is not None else None)
+                    for p, m in per.items()}
+                for t, per in (spec.get("assignment") or {}).items()
+            }
+            lags = {
+                t: {int(p): int(v) for p, v in per.items()}
+                for t, per in (spec.get("lag") or {}).items()
+            }
+            self._groups[str(g)] = ConsumerGroupState(
+                group=str(g), members=members,
+                assignment=assignment, lags=lags,
+            )
         # Simulated-convergence execution state (module docstring): pending
         # moves and their remaining poll countdowns. Resolved once per
         # backend so a run's fault schedule is coherent.
@@ -141,6 +176,36 @@ class SnapshotBackend:
                 for p in parts
             }
         return out
+
+    # -- consumer-group surface (ISSUE 13) ---------------------------------
+
+    def supports_groups(self) -> bool:
+        """True only when the snapshot file carried a ``groups`` section —
+        a bare metadata snapshot keeps the loud-refusal default (the
+        synthetic family is an explicit caller opt-in, never a silent
+        fallback)."""
+        return bool(self._groups)
+
+    def fetch_consumer_groups(self, groups=None):
+        counter_add("zk.reads")
+        if not self._groups:
+            from ..errors import IngestError
+
+            # Same loud-refusal contract as the io/base.py default: a
+            # snapshot with no groups section has nothing real to serve.
+            raise IngestError(
+                f"snapshot {self.path!r} carries no \"groups\" section; "
+                "record one, or opt into the deterministic synthetic "
+                "family explicitly (--synthetic)"
+            )
+        if groups is None:
+            return {
+                g: st for g, st in sorted(self._groups.items())
+            }
+        missing = [g for g in groups if g not in self._groups]
+        if missing:
+            raise KeyError(f"groups not in snapshot: {missing}")
+        return {g: self._groups[g] for g in dict.fromkeys(groups)}
 
     # -- plan execution surface (simulated convergence; module docstring) --
 
@@ -210,7 +275,8 @@ class SnapshotBackend:
 
         try:
             write_snapshot(self.path, self._brokers, self._topics,
-                           traffic=self._traffic_raw)
+                           traffic=self._traffic_raw,
+                           groups=self._groups_raw)
         except OSError as e:
             print(
                 f"kafka-assigner: snapshot persist failed for "
@@ -227,6 +293,7 @@ def write_snapshot(
     brokers: Sequence[BrokerInfo],
     topics: Dict[str, Dict[int, List[int]]],
     traffic: Dict | None = None,
+    groups: Dict | None = None,
 ) -> None:
     """Serialize cluster metadata to a snapshot file (inverse of the
     loader). Atomic + fsync'd (``utils/atomicwrite.py``): the execution
@@ -253,6 +320,10 @@ def write_snapshot(
         # Round-trip the optional traffic section (ISSUE 11): a converged
         # wave's persist must not silently strip the cluster's meters.
         data["traffic"] = traffic
+    if groups:
+        # Same round-trip contract for the consumer-group section
+        # (ISSUE 13): execution persists must not strip the groups.
+        data["groups"] = groups
     # kalint: disable=KA005 -- snapshot capture file, not a byte-compat plan payload
     atomic_write_text(path, json.dumps(data, indent=1),
                       prefix=".ka_snapshot_")

@@ -30,7 +30,7 @@ def _compile(compiler_cmd: list, lib_path: str) -> None:
     # the loser of the race just overwrites with identical bits.
     tmp = f"{lib_path}.tmp.{os.getpid()}"
     try:
-        # kalint: disable=KA015 -- first-use lazy build, once per process and 120s-capped: the daemon chain _handle_admitted[solve-lock] -> _run_whatif -> print_decommission_ranking -> evaluate_removal_scenarios -> encode_topic_group -> _hostcodec -> load_hostcodec -> _compile only fires when the .so is missing AND the hostcodec knob is on; every warm request takes the dlopen-cached path
+        # kalint: disable=KA015,KA019 -- first-use lazy build, once per process and 120s-capped: the daemon chain _handle_admitted[solve-lock, gate-admitted] -> _run_whatif -> print_decommission_ranking -> evaluate_removal_scenarios -> encode_topic_group -> _hostcodec -> load_hostcodec -> _compile only fires when the .so is missing AND the hostcodec knob is on; every warm request takes the dlopen-cached path — the one-time stall is acceptable to BOTH the solve lock (KA015) and the admission slot (KA019) because it replaces an unconditionally slower first solve
         proc = subprocess.run(
             compiler_cmd + ["-o", tmp], capture_output=True, text=True,
             timeout=120,

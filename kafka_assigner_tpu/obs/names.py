@@ -98,6 +98,12 @@ METRIC_NAMES: frozenset = frozenset({
     # per-scenario what-if solve latency (ISSUE 10 follow-up, landed in
     # ISSUE 11): request wall ms / scenario count, per cluster
     "whatif.scenario_ms",
+    # groups.* — the consumer-group workload family (ISSUE 13): packing
+    # plans, autoscale-sweep fan-out, greedy-oracle crash fallbacks and
+    # the loud backend refusals
+    "groups.plans", "groups.sweeps", "groups.moves",
+    "groups.candidates", "groups.dispatches", "groups.fanout",
+    "groups.solve_fallbacks", "groups.refusals", "groups.sweep_ms",
 })
 
 #: Span names (``span(...)`` / ``record_span(...)`` first argument).
@@ -114,6 +120,7 @@ SPAN_NAMES: frozenset = frozenset({
     "warmup",
     "exec/wave", "exec/submit", "exec/poll", "exec/verify",
     "daemon/request", "daemon/resync", "daemon/recommend",
+    "groups/plan", "groups/sweep", "groups/dispatch", "daemon/groups",
 })
 
 #: Both namespaces — what the supervisor's ``_metric`` wrapper may label.
@@ -174,6 +181,11 @@ UNITLESS_METRICS: frozenset = frozenset({
     "health.rack_violations", "health.score", "health.movement_debt",
     # traffic.lag is messages; the series accounting gauges are counts
     "traffic.lag", "traffic.series_dropped", "traffic.fetch_failures",
+    # groups.* event/item counts (moved partitions, candidate rows,
+    # dispatches, padded fan-out width, fallbacks, refusals)
+    "groups.plans", "groups.sweeps", "groups.moves",
+    "groups.candidates", "groups.dispatches", "groups.fanout",
+    "groups.solve_fallbacks", "groups.refusals",
     # grandfathered: unit (bytes) lives mid-name, predates KA014; renaming
     # the scrape family would orphan existing dashboards
     "zk.wire_bytes_in", "zk.wire_bytes_out",

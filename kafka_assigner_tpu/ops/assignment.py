@@ -1490,3 +1490,177 @@ def whatif_subset_sweep(
 whatif_subset_sweep_jit = jax.jit(
     whatif_subset_sweep, static_argnames=("n", "rf", "r_cap")
 )
+
+
+# ---------------------------------------------------------------------------
+# Consumer-group packing (ISSUE 13): the second workload family.
+#
+# Same problem shape as partition→broker placement — integer assignment
+# under hard constraints with a movement term — but the capacity constraint
+# is WEIGHTED (sum of per-partition lag/throughput weights per consumer
+# <= that consumer's capacity) instead of the count capacity
+# ceil(P*RF/N), and each partition takes exactly one owner (RF == 1, no
+# rack axis). The objective mirrors the placement family's: a sticky
+# (movement-minimizing) term — keep a partition on its current owner
+# whenever the capacity gate admits it — plus the packing term (first-fit-
+# decreasing onto max-headroom consumers keeps per-consumer load tight and
+# flags true overflow), with the leadership analogue absent by construction
+# (consumer groups have no replica ordering).
+#
+# Semantics are EXACTLY the host greedy packing oracle's
+# (solvers/greedypack.py) — parity is pinned per assignment cell, like the
+# placement family pins against solvers/greedy.py:
+#
+#   1. sticky admission, ascending partition row per owner: partition p
+#      stays on its current owner c iff c is alive and the PREFIX weight of
+#      p and all earlier rows currently on c fits cap[c] (prefix semantics,
+#      not running-kept-sum: one vectorized segmented cumsum on device, one
+#      identical rule on the host — deliberate, documented divergence from
+#      a per-row re-check, in the solver's orphan-choice freedom);
+#   2. orphan spread, first-fit-decreasing: unkept real rows in descending
+#      BASE-weight order (ties: ascending row — ``proc_order``, computed
+#      once on the host because positive scaling never reorders it) each
+#      take the alive consumer with the most remaining headroom that fits
+#      (ties: lowest consumer index); nothing fits => the row lands on the
+#      max-headroom alive consumer anyway and counts as overflow (the
+#      infeasibility signal — the autoscale sweep's cost curve needs the
+#      overload magnitude, not a bare failure flag).
+#
+# All weights/capacities arrive as int32 in a caller-scaled domain
+# (groups/encode.py guarantees no int32 overflow under the largest scale
+# it will sweep), so device/host parity is exact integer equality.
+# ---------------------------------------------------------------------------
+
+
+class PackState(NamedTuple):
+    """Carried orphan-scan state for the consumer-pack kernel."""
+
+    assigned: jnp.ndarray    # (P_pad,) consumer index or -1
+    load: jnp.ndarray        # (C_pad + 1,) weight per consumer (+1 scratch)
+    overflowed: jnp.ndarray  # () int32: rows placed over capacity
+
+
+def pack_group(
+    weights: jnp.ndarray,     # (P_pad,) int32 scaled weights (0 on pad rows)
+    capacities: jnp.ndarray,  # (C_pad,) int32 scaled capacities
+    current: jnp.ndarray,     # (P_pad,) int32 current consumer index or -1
+    proc_order: jnp.ndarray,  # (P_pad,) int32 rows by (-base weight, row)
+    alive: jnp.ndarray,       # (C_pad,) bool consumer liveness
+    p_real: jnp.ndarray,      # scalar int32 real partition rows
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One group's capacity-constrained partition→consumer packing.
+
+    Returns ``(assigned (P_pad,), load (C_pad,), moved, overflowed,
+    infeasible)``; see the family comment above for the exact semantics.
+    """
+    p_pad = weights.shape[0]
+    c_pad = capacities.shape[0]
+    rows_real = jnp.arange(p_pad, dtype=jnp.int32) < p_real
+    cur = jnp.where(rows_real, current, -1)
+    safe_cur = jnp.clip(cur, 0, c_pad - 1)
+    sticky_cand = (cur >= 0) & alive[safe_cur]
+
+    # Sticky admission via ONE segmented prefix sum: stable argsort on the
+    # owner key groups each consumer's candidate rows in ascending-row
+    # order; the inclusive in-segment prefix is the cumsum minus the total
+    # through the previous segment.
+    key = jnp.where(sticky_cand, cur, jnp.int32(c_pad))
+    order = jnp.argsort(key, stable=True).astype(jnp.int32)
+    kw = jnp.where(sticky_cand, weights, 0)[order]
+    csum = jnp.cumsum(kw)
+    sk = key[order]
+    first = jnp.searchsorted(sk, sk, side="left").astype(jnp.int32)
+    seg_base = jnp.where(first > 0, csum[jnp.maximum(first - 1, 0)], 0)
+    prefix = csum - seg_base
+    cap_of = capacities[jnp.clip(sk, 0, c_pad - 1)]
+    keep_sorted = sticky_cand[order] & (prefix <= cap_of)
+    kept = jnp.zeros(p_pad, dtype=bool).at[order].set(keep_sorted)
+
+    load0 = (
+        jnp.zeros(c_pad + 1, dtype=jnp.int32)
+        .at[jnp.where(kept, safe_cur, c_pad)]
+        .add(jnp.where(kept, weights, 0))
+    )
+    state = PackState(
+        assigned=jnp.where(kept, cur, -1),
+        load=load0,
+        overflowed=jnp.int32(0),
+    )
+
+    def step(state: PackState, row: jnp.ndarray) -> Tuple[PackState, None]:
+        w = weights[row]
+        need = rows_real[row] & ~kept[row]
+        headroom = jnp.where(
+            alive, capacities - state.load[:c_pad], jnp.int32(-BIG)
+        )
+        fits = alive & (headroom >= w)
+        any_fit = jnp.any(fits)
+        # argmax returns the FIRST maximum — the lowest-index tie-break
+        # the host oracle uses.
+        pick_fit = jnp.argmax(jnp.where(fits, headroom, -BIG))
+        pick_any = jnp.argmax(headroom)
+        pick = jnp.where(any_fit, pick_fit, pick_any).astype(jnp.int32)
+        assigned = state.assigned.at[row].set(
+            jnp.where(need, pick, state.assigned[row])
+        )
+        load = state.load.at[jnp.where(need, pick, jnp.int32(c_pad))].add(
+            jnp.where(need, w, 0)
+        )
+        overflowed = state.overflowed + jnp.where(need & ~any_fit, 1, 0)
+        return PackState(assigned, load, overflowed), None
+
+    state, _ = lax.scan(step, state, proc_order)
+    moved = jnp.sum(
+        rows_real & (cur >= 0) & (state.assigned != cur),
+        dtype=jnp.int32,
+    )
+    return (
+        state.assigned,
+        state.load[:c_pad],
+        moved,
+        state.overflowed,
+        state.overflowed > 0,
+    )
+
+
+pack_group_jit = jax.jit(pack_group)
+
+
+def group_pack_sweep(
+    weights: jnp.ndarray,      # (P_pad,) int32 BASE weights
+    capacities: jnp.ndarray,   # (C_pad,) int32
+    current: jnp.ndarray,      # (P_pad,) int32
+    proc_order: jnp.ndarray,   # (P_pad,) int32 (scale-invariant, host-built)
+    alive_masks: jnp.ndarray,  # (S, C_pad) one consumer-liveness row per
+                               # candidate ("k consumers" = first k alive)
+    scale_pcts: jnp.ndarray,   # (S,) int32 weight scale, percent (lag
+                               # growth scenarios; capacities stay fixed)
+    p_real: jnp.ndarray,       # scalar int32
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The autoscale sweep: N candidate consumer counts × M lag scenarios
+    evaluated as ONE vmapped dispatch — the "how many consumers do I need"
+    cost curve in a single device round-trip, exactly the way the broker
+    what-if sweep batches its liveness scenarios. Returns per-candidate
+    ``(moved (S,), overflowed (S,), infeasible (S,), load (S, C_pad))``.
+
+    Scaled weights floor at 1 on real rows (a sub-100% scale must not zero
+    a partition's cost — an owned partition always occupies capacity), and
+    the host-built ``proc_order`` is shared by every scenario: positive
+    scaling preserves the descending-weight order even where integer
+    division collapses distinct weights into ties.
+    """
+    p_pad = weights.shape[0]
+    rows_real = jnp.arange(p_pad, dtype=jnp.int32) < p_real
+
+    def one(alive, scale):
+        w = (weights * scale) // 100
+        w = jnp.maximum(w, jnp.where(rows_real, 1, 0))
+        assigned, load, moved, overflowed, infeasible = pack_group(
+            w, capacities, current, proc_order, alive, p_real
+        )
+        return moved, overflowed, infeasible, load
+
+    return jax.vmap(one)(alive_masks, scale_pcts)
+
+
+group_pack_sweep_jit = jax.jit(group_pack_sweep)

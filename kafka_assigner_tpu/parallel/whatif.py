@@ -39,6 +39,19 @@ _SWEEP_SPECS = {
         ((None, "p", "p", None), ("n",), (None, "p"), (None, "p"),
          (None, "n")),
     ),
+    # Consumer-group packing (ISSUE 13): the second workload family rides
+    # the same store-backed dispatch — partition rows on the "p" bucket,
+    # consumer columns on the "n" bucket, sweep batch on "b".
+    "group_pack": (
+        "pack_group_jit",
+        (),
+        (("p",), ("n",), ("p",), ("p",), ("n",)),
+    ),
+    "group_sweep": (
+        "group_pack_sweep_jit",
+        (),
+        (("p",), ("n",), ("p",), ("p",), ("b", "n"), ("b",)),
+    ),
 }
 
 
@@ -438,6 +451,92 @@ def evaluate_removal_scenarios(
         )
         for s in range(s_real)
     ]
+
+
+def pack_group_on_device(
+    weights: np.ndarray,
+    capacities: np.ndarray,
+    current: np.ndarray,
+    proc_order: np.ndarray,
+    alive: np.ndarray,
+    p_real: int,
+):
+    """One group's packing solve through the store-backed dispatch
+    (``ops/assignment.py:pack_group``). Returns host arrays
+    ``(assigned, load, moved, overflowed, infeasible)`` — the same tuple
+    the host oracle (``solvers/greedypack.py``) computes, cell-for-cell
+    (the parity pin). The ``solve`` fault scope fires here, exactly like
+    the placement solver's dispatch, so the chaos matrix can crash this
+    family's device solve deterministically."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..faults.inject import fault_point
+
+    pack_group_jit = _sweep_program("group_pack")
+
+    fault_point("solve")
+    counter_add("groups.dispatches")
+    with span("groups/dispatch", hist="whatif.dispatch_ms"):
+        return jax.device_get(
+            pack_group_jit(
+                jnp.asarray(weights), jnp.asarray(capacities),
+                jnp.asarray(current), jnp.asarray(proc_order),
+                jnp.asarray(alive), jnp.int32(p_real),
+            )
+        )
+
+
+def evaluate_group_candidates(
+    weights: np.ndarray,
+    capacities: np.ndarray,
+    current: np.ndarray,
+    proc_order: np.ndarray,
+    alive_masks: np.ndarray,   # (S_real, C_pad) bool
+    scale_pcts,                # (S_real,) int
+    p_real: int,
+):
+    """The autoscale sweep's device half: ALL candidate (consumer count ×
+    lag scenario) rows in ONE batched dispatch — the batch axis pads to
+    the power-of-two bucket (inert all-dead, scale-100 rows) so the
+    program store serves every sweep size from a handful of programs, and
+    per-candidate recompiles are structurally impossible (the acceptance
+    bar the compile counters pin). Returns per-candidate host arrays
+    ``(moved (S,), overflowed (S,), infeasible (S,), load (S, C_pad))``
+    trimmed to the real candidates."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..faults.inject import fault_point
+
+    group_sweep_jit = _sweep_program("group_sweep")
+
+    s_real = len(alive_masks)
+    s_pad = batch_bucket(s_real)
+    alive = np.zeros((s_pad, alive_masks.shape[1]), dtype=bool)
+    alive[:s_real] = alive_masks
+    scales = np.full(s_pad, 100, dtype=np.int32)
+    scales[:s_real] = np.asarray(scale_pcts, dtype=np.int32)
+
+    counter_add("groups.candidates", s_real)
+    counter_add("groups.dispatches")
+    gauge_set("groups.fanout", int(s_pad))
+    fault_point("solve")
+    with span("groups/dispatch", hist="whatif.dispatch_ms"):
+        moved, overflowed, infeasible, load = jax.device_get(
+            group_sweep_jit(
+                jnp.asarray(weights), jnp.asarray(capacities),
+                jnp.asarray(current), jnp.asarray(proc_order),
+                jnp.asarray(alive), jnp.asarray(scales),
+                jnp.int32(p_real),
+            )
+        )
+    return (
+        np.asarray(moved)[:s_real],
+        np.asarray(overflowed)[:s_real],
+        np.asarray(infeasible)[:s_real],
+        np.asarray(load)[:s_real],
+    )
 
 
 def rank_decommission_candidates(

@@ -405,6 +405,32 @@ _knob(
         "full resync — identical responses, more metadata I/O",
 )
 
+# --- consumer-group workload family (ka-groups / daemon /groups/*) ----------
+_knob(
+    "KA_GROUPS_DEFAULT_SCALES", "str", "100,150,200",
+    doc="default lag-growth scenarios for the `ka-groups` autoscale sweep "
+        "(comma-separated percentages of the observed weight column): each "
+        "candidate consumer count is evaluated under every scale in one "
+        "batched device fan-out; the `--scales` flag / `scales` request "
+        "param override per run",
+)
+_knob(
+    "KA_GROUPS_MAX_CANDIDATES", "int", 256, floor=1,
+    doc="fan-out cap for the autoscale sweep: (consumer counts × lag "
+        "scales) candidate rows per dispatch — the batch pads to its "
+        "power-of-two bucket, so the cap bounds device memory and keeps "
+        "the program-store bucket set small. Requests past the cap are "
+        "refused loudly, never truncated silently",
+)
+_knob(
+    "KA_GROUPS_CAPACITY_HEADROOM", "float", 1.25, floor=1.0,
+    doc="capacity default for members (and synthetic consumers) without a "
+        "declared estimate: the fair share of the group's total weight "
+        "times this factor (`groups/encode.py`) — 1.0 means an exactly "
+        "saturated default packing, larger values leave slack the sticky "
+        "pass can keep partitions in place with",
+)
+
 # --- runtime / observability ------------------------------------------------
 _knob(
     "KA_COMPILE_CACHE", "bool", True,

@@ -96,15 +96,12 @@ class RunResult:
         self.wall_s, self.hung = wall_s, hung
 
 
-def run_mode3(port, solver, policy, report_path, timeout_s):
-    """One CLI mode-3 run in a watchdog thread: a hang is a soak failure,
-    never a wait-forever."""
-    argv = [
-        "--zk_string", f"127.0.0.1:{port}",
-        "--mode", "PRINT_REASSIGNMENT", "--solver", solver,
-        "--failure-policy", policy,
-        "--report-json", report_path,
-    ]
+def _watchdog_cli_run(entry, timeout_s):
+    """The shared CLI watchdog harness: run ``entry()`` (which returns an
+    exit code, or raises — undocumented escapes re-raise to the caller)
+    on a daemon thread with stdout/stderr captured; a hang is a
+    :class:`RunResult` with ``hung=True``, never a wait-forever. One
+    implementation for every in-process CLI the matrices drive."""
     result = {}
     out_buf, err_buf = io.StringIO(), io.StringIO()
 
@@ -112,7 +109,7 @@ def run_mode3(port, solver, policy, report_path, timeout_s):
         with contextlib.redirect_stdout(out_buf), \
                 contextlib.redirect_stderr(err_buf):
             try:
-                result["rc"] = run(argv)
+                result["rc"] = entry()
             except BaseException as e:  # undocumented escape: report it
                 result["exc"] = e
 
@@ -128,6 +125,17 @@ def run_mode3(port, solver, policy, report_path, timeout_s):
         raise result["exc"]
     return RunResult(result["rc"], out_buf.getvalue(), err_buf.getvalue(),
                      wall)
+
+
+def run_mode3(port, solver, policy, report_path, timeout_s):
+    """One CLI mode-3 run under the shared watchdog harness."""
+    argv = [
+        "--zk_string", f"127.0.0.1:{port}",
+        "--mode", "PRINT_REASSIGNMENT", "--solver", solver,
+        "--failure-policy", policy,
+        "--report-json", report_path,
+    ]
+    return _watchdog_cli_run(lambda: run(argv), timeout_s)
 
 
 def with_server(fn):
@@ -226,6 +234,156 @@ def soak_matrix(args, report_dir):
                 )
             print(f"chaos_soak: {tag}: rc={res.rc} ok "
                   f"({res.wall_s:.2f}s)", file=sys.stderr)
+    return failures
+
+
+# ---------------------------------------------------------------------------
+# The consumer-group matrix (ISSUE 13): the second workload family's two
+# chaos contracts —
+#   * solver crash: the device packing solve dies (solve:0=crash); strict
+#     exits with the documented solve code, best-effort falls back to the
+#     greedy packing oracle with the SAME plan content (the parity pin —
+#     only the envelope's "solver" field may differ) and the degraded code;
+#   * refusal: a backend with NO group support (the live ZooKeeper tree)
+#     is refused loudly with the usage code and EMPTY stdout — synthetic
+#     inputs never masquerade as real; the explicit --synthetic opt-in
+#     serves the deterministic family marked groups_real=false.
+# ---------------------------------------------------------------------------
+
+
+def _groups_snapshot_path(report_dir):
+    snap = {
+        "brokers": [
+            {"id": i, "host": f"b{i}", "port": 9092, "rack": f"r{i % 2}"}
+            for i in range(4)
+        ],
+        "topics": {"events": {str(p): [0, 1] for p in range(6)}},
+        "groups": {"g": {
+            "members": {"c-0": 300.0, "c-1": 300.0},
+            "assignment": {
+                "events": {str(p): f"c-{p % 2}" for p in range(6)},
+            },
+            "lag": {"events": {str(p): (p + 1) * 9 for p in range(6)}},
+        }},
+    }
+    path = os.path.join(report_dir, "groups_cluster.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(snap, f)
+    return path
+
+
+def run_groups_cli(argv, timeout_s):
+    """One ka-groups run under the shared watchdog harness, with the
+    console entry's exit-code mapping applied inline."""
+    from kafka_assigner_tpu.cli import run_groups
+    from kafka_assigner_tpu.errors import IngestError, SolveError
+
+    def entry():
+        try:
+            return run_groups(argv)
+        except SolveError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return EXIT_SOLVE
+        except IngestError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return EXIT_INGEST
+        except (ValueError, KeyError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 5
+
+    return _watchdog_cli_run(entry, timeout_s)
+
+
+def _plan_content(stdout_text):
+    """The parity-comparable view of a groups envelope: everything except
+    the solver lane marker (device vs greedy-fallback IS the degradation
+    being tested; the packing itself must not change)."""
+    body = json.loads(stdout_text)
+    body.pop("solver", None)
+    return body
+
+
+def soak_groups_matrix(args, report_dir):
+    failures = []
+    snap = _groups_snapshot_path(report_dir)
+    base_argv = ["--zk_string", snap, "--mode", "plan"]
+
+    set_schedule({})
+    base = run_groups_cli(base_argv, args.timeout)
+    if base.hung or base.rc != EXIT_OK:
+        raise SystemExit(
+            f"FAIL: no-fault ka-groups baseline broken (rc={base.rc} "
+            f"hung={base.hung})\n{base.err}"
+        )
+
+    # Row 1: device packing solve crash, both policies.
+    for policy, want_rc in (
+        ("strict", EXIT_SOLVE), ("best-effort", EXIT_DEGRADED),
+    ):
+        set_schedule({}, spec="solve:0=crash")
+        res = run_groups_cli(
+            base_argv + ["--failure-policy", policy], args.timeout
+        )
+        tag = f"groups[crash/{policy}]"
+        if res.hung:
+            failures.append(f"{tag}: HUNG after {args.timeout}s")
+        elif res.rc != want_rc:
+            failures.append(
+                f"{tag}: rc={res.rc}, expected {want_rc}\n{res.err}"
+            )
+        elif policy == "best-effort" and (
+            _plan_content(res.out) != _plan_content(base.out)
+        ):
+            failures.append(
+                f"{tag}: fallback plan content diverged from the device "
+                "baseline (parity pin broken)"
+            )
+        elif policy == "strict" and res.out:
+            failures.append(f"{tag}: strict crash still emitted a plan")
+        else:
+            print(f"chaos_soak: {tag}: rc={res.rc} ok "
+                  f"({res.wall_s:.2f}s)", file=sys.stderr)
+
+    # Row 2: loud refusal on a group-less backend (live ZK), both with and
+    # without the explicit synthetic opt-in.
+    def _refusal(server):
+        set_schedule({"KA_ZK_CLIENT": "wire"})
+        argv = ["--zk_string", f"127.0.0.1:{server.port}", "--mode", "plan"]
+        res = run_groups_cli(argv, args.timeout)
+        if res.hung:
+            failures.append("groups[refusal]: HUNG")
+            return
+        if res.rc != 1 or res.out.strip():
+            failures.append(
+                f"groups[refusal]: rc={res.rc} stdout={res.out[:120]!r} "
+                "(expected usage refusal with empty stdout)"
+            )
+            return
+        if "--synthetic" not in res.err:
+            failures.append(
+                "groups[refusal]: refusal does not name the explicit "
+                "synthetic opt-in"
+            )
+            return
+        set_schedule({"KA_ZK_CLIENT": "wire"})
+        res2 = run_groups_cli(argv + ["--synthetic"], args.timeout)
+        if res2.hung or res2.rc != EXIT_OK:
+            failures.append(
+                f"groups[refusal]: --synthetic rc={res2.rc} "
+                f"hung={res2.hung}\n{res2.err}"
+            )
+            return
+        body = json.loads(res2.out)
+        if body.get("groups_real") is not False:
+            failures.append(
+                "groups[refusal]: synthetic envelope not marked "
+                "groups_real=false"
+            )
+            return
+        print("chaos_soak: groups[refusal]: refused loudly, synthetic "
+              "opt-in marked ok", file=sys.stderr)
+
+    with_server(_refusal)
     return failures
 
 
@@ -1112,6 +1270,7 @@ def main(argv=None):
         with tempfile.TemporaryDirectory(prefix="chaos_soak_") as report_dir:
             if args.matrix:
                 failures = soak_matrix(args, report_dir)
+                failures += soak_groups_matrix(args, report_dir)
                 failures += soak_exec_matrix(args, report_dir)
                 failures += soak_daemon_matrix(args, report_dir)
                 failures += soak_multicluster_matrix(args, report_dir)

@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Consumer-group workload smoke (tier-1, via scripts/lint.sh): the
+ISSUE 13 family end to end against a REAL ``ka-daemon`` subprocess
+serving a snapshot cluster whose file carries a ``groups`` section.
+
+What it proves, in a few seconds:
+
+1.  ``GET /groups/plan`` returns a schema-valid (``groups/model.py``
+    validators) packing-plan envelope that is BYTE-STABLE across two
+    identical calls, and the POST form returns the identical bytes;
+2.  ``POST /groups/sweep`` with >= 64 (consumer count × lag scale)
+    candidates returns a schema-valid, byte-stable cost curve, and the
+    COMPILE COUNTERS prove the batching claim: between the first and the
+    second identical sweep, ``ka_compile_store_misses_total`` and
+    ``ka_compile_store_unbucketed_total`` do not grow — every candidate
+    rides the one already-compiled batched program, no per-candidate
+    recompiles;
+3.  ``/metrics`` exposes the ``groups.*`` family (plans/sweeps/candidates
+    counters, the sweep-latency histogram) and the whole exposition
+    round-trips the in-tree parser with every histogram consistent;
+4.  a cluster whose backend has NO group support refuses ``/groups/plan``
+    loudly (400 naming the synthetic opt-in) and serves the synthetic
+    family only under ``synthetic=1``, marked ``groups_real=false`` —
+    never synthetic-as-real;
+5.  SIGTERM drains and the daemon exits 0.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from scripts.health_smoke import _req, _start_daemon  # noqa: E402
+
+
+def _snapshot(with_groups: bool) -> str:
+    snap = {
+        "brokers": [
+            {"id": i, "host": f"b{i}", "port": 9092, "rack": f"r{i % 2}"}
+            for i in range(4)
+        ],
+        "topics": {
+            "events": {str(p): [0, 1] for p in range(8)},
+            "logs": {str(p): [1, 2] for p in range(3)},
+        },
+    }
+    if with_groups:
+        snap["groups"] = {
+            "analytics": {
+                "members": {"c-0": 400.0, "c-1": 400.0, "c-2": None},
+                "assignment": {
+                    "events": {str(p): f"c-{p % 2}" for p in range(8)},
+                },
+                "lag": {
+                    "events": {str(p): (p + 1) * 17 for p in range(8)},
+                    "logs": {str(p): 5 * (p + 1) for p in range(3)},
+                },
+            },
+        }
+    fd, path = tempfile.mkstemp(suffix=".json", prefix="ka_groups_smoke_")
+    with os.fdopen(fd, "w") as f:
+        json.dump(snap, f)
+    return path
+
+
+def _scrape(port):
+    from kafka_assigner_tpu.obs import promtext
+
+    s, raw, _ = _req(port, "GET", "/metrics")
+    if s != 200:
+        raise SystemExit(f"FAIL: /metrics http={s}")
+    families = promtext.parse(raw.decode("utf-8"))
+    for fam, data in families.items():
+        if data["type"] == "histogram":
+            problems = promtext.check_histogram(data)
+            if problems:
+                raise SystemExit(
+                    f"FAIL: histogram {fam} inconsistent: {problems}"
+                )
+    return families
+
+
+def _counter(families, fam):
+    data = families.get(fam)
+    if data is None:
+        return 0.0
+    return sum(v for _n, _labels, v in data["samples"])
+
+
+def main() -> int:
+    from kafka_assigner_tpu.groups.model import (
+        validate_groups_plan,
+        validate_groups_sweep,
+    )
+
+    snap = _snapshot(with_groups=True)
+    bare = _snapshot(with_groups=False)
+    env = {
+        **os.environ,
+        "KA_DAEMON_RESYNC_INTERVAL": "30",
+    }
+    daemon = None
+    stderr_lines = []
+    try:
+        daemon, port, stderr_lines = _start_daemon(
+            f"g={snap};bare={bare}", env
+        )
+
+        # 1. /groups/plan: schema-valid, byte-stable, GET == POST
+        s, plan1, _ = _req(port, "GET", "/clusters/g/groups/plan")
+        if s != 200:
+            print(f"FAIL: /groups/plan http={s}: {plan1[:300]}",
+                  file=sys.stderr)
+            return 1
+        envelope = json.loads(plan1)
+        problems = validate_groups_plan(envelope["groups"]["analytics"])
+        if problems:
+            print(f"FAIL: plan envelope invalid: {problems}",
+                  file=sys.stderr)
+            return 1
+        if not envelope["groups_real"]:
+            print("FAIL: snapshot groups section must count as real "
+                  "inputs", file=sys.stderr)
+            return 1
+        s, plan2, _ = _req(port, "GET", "/clusters/g/groups/plan")
+        if plan2 != plan1:
+            print("FAIL: /groups/plan not byte-stable", file=sys.stderr)
+            return 1
+        s, plan3, _ = _req(port, "POST", "/clusters/g/groups/plan", {})
+        if plan3 != plan1:
+            print("FAIL: POST /groups/plan differs from GET",
+                  file=sys.stderr)
+            return 1
+
+        # 2. the >=64-candidate sweep, twice; compile counters must not
+        # grow between the two identical dispatches.
+        sweep_body = {
+            "counts": [1, 2, 3, 4, 5, 6, 7, 8],
+            "scales": [100, 125, 150, 200, 300, 400, 600, 800],
+        }
+        s, sw1, _ = _req(
+            port, "POST", "/clusters/g/groups/sweep", sweep_body
+        )
+        if s != 200:
+            print(f"FAIL: /groups/sweep http={s}: {sw1[:300]}",
+                  file=sys.stderr)
+            return 1
+        sw_env = json.loads(sw1)
+        body = sw_env["groups"]["analytics"]
+        problems = validate_groups_sweep(body)
+        if problems:
+            print(f"FAIL: sweep envelope invalid: {problems}",
+                  file=sys.stderr)
+            return 1
+        if len(body["candidates"]) < 64:
+            print(f"FAIL: sweep evaluated only "
+                  f"{len(body['candidates'])} candidates",
+                  file=sys.stderr)
+            return 1
+        fams = _scrape(port)
+        misses0 = _counter(fams, "ka_compile_store_misses_total")
+        unbucketed0 = _counter(fams, "ka_compile_store_unbucketed_total")
+        dispatches0 = _counter(fams, "ka_groups_dispatches_total")
+        s, sw2, _ = _req(
+            port, "POST", "/clusters/g/groups/sweep", sweep_body
+        )
+        if sw2 != sw1:
+            print("FAIL: /groups/sweep not byte-stable across two "
+                  "identical calls", file=sys.stderr)
+            return 1
+        fams = _scrape(port)
+        misses1 = _counter(fams, "ka_compile_store_misses_total")
+        unbucketed1 = _counter(fams, "ka_compile_store_unbucketed_total")
+        dispatches1 = _counter(fams, "ka_groups_dispatches_total")
+        if misses1 != misses0 or unbucketed1 != unbucketed0:
+            print(
+                f"FAIL: warm sweep recompiled (store misses "
+                f"{misses0}->{misses1}, unbucketed "
+                f"{unbucketed0}->{unbucketed1}) — the batched fan-out "
+                "must reuse one compiled program", file=sys.stderr)
+            return 1
+        if dispatches1 - dispatches0 != 1:
+            print(
+                f"FAIL: the 64-candidate sweep took "
+                f"{dispatches1 - dispatches0} device dispatches "
+                "(expected exactly 1)", file=sys.stderr)
+            return 1
+
+        # 3. groups.* scrape series present
+        for fam in ("ka_groups_plans_total", "ka_groups_sweeps_total",
+                    "ka_groups_candidates_total", "ka_groups_sweep_ms"):
+            if fam not in fams:
+                print(f"FAIL: scrape missing family {fam}",
+                      file=sys.stderr)
+                return 1
+
+        # 4. refusal + explicit synthetic on the groups-less cluster
+        s, raw, _ = _req(port, "GET", "/clusters/bare/groups/plan")
+        if s != 400 or b"synthetic" not in raw:
+            print(f"FAIL: groups-less backend not refused loudly "
+                  f"(http={s}: {raw[:200]})", file=sys.stderr)
+            return 1
+        s, raw, _ = _req(
+            port, "GET", "/clusters/bare/groups/plan?synthetic=1"
+        )
+        body = json.loads(raw)
+        if s != 200 or body["groups_real"] is not False:
+            print(f"FAIL: synthetic opt-in wrong (http={s}, "
+                  f"groups_real={body.get('groups_real')!r})",
+                  file=sys.stderr)
+            return 1
+        problems = validate_groups_plan(body["groups"]["synthetic"])
+        if problems:
+            print(f"FAIL: synthetic plan envelope invalid: {problems}",
+                  file=sys.stderr)
+            return 1
+
+        # 5. clean SIGTERM drain
+        daemon.send_signal(signal.SIGTERM)
+        rc = daemon.wait(timeout=60)
+        if rc != 0:
+            print(f"FAIL: daemon exit code {rc} after SIGTERM\n"
+                  + "".join(stderr_lines), file=sys.stderr)
+            return 1
+
+        print("groups_smoke: PASS (plan + sweep byte-stable, "
+              "64-candidate sweep = one dispatch with zero warm "
+              "recompiles, groups.* scrape series parse-consistent, "
+              "loud refusal + marked synthetic, clean drain)",
+              file=sys.stderr)
+        return 0
+    finally:
+        if daemon is not None and daemon.poll() is None:
+            daemon.kill()
+        for p in (snap, bare):
+            try:
+                os.unlink(p)
+            except OSError:  # kalint: disable=KA008 -- best-effort tmp cleanup
+                pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,832 @@
+"""Consumer-group workload family (ISSUE 13): encode invariants, the
+device↔host packing parity pin on randomized instances, the one-dispatch
+autoscale sweep, the CLI surface, backend hooks (snapshot section, loud
+refusal, explicit synthetic), and the daemon endpoints with crash
+fallback."""
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import random
+
+import numpy as np
+import pytest
+
+from kafka_assigner_tpu import faults
+from kafka_assigner_tpu.cli import run_groups
+from kafka_assigner_tpu.errors import IngestError, SolveError
+from kafka_assigner_tpu.groups.encode import decode_plan, encode_group
+from kafka_assigner_tpu.groups.model import (
+    GROUPS_SCHEMA_VERSION,
+    synthetic_group_state,
+    validate_groups_plan,
+    validate_groups_sweep,
+)
+from kafka_assigner_tpu.groups.solve import (
+    default_counts,
+    group_plan_envelope,
+    group_sweep_envelope,
+    load_group_states,
+)
+from kafka_assigner_tpu.io.base import ConsumerGroupState, GroupMember
+from kafka_assigner_tpu.io.snapshot import SnapshotBackend, write_snapshot
+from kafka_assigner_tpu.solvers.greedypack import (
+    pack_consumers,
+    scale_weights,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_injector():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _state(rng, n_topics=2, max_parts=6, n_members=3, owned=0.8):
+    topics = {
+        f"t{i}": list(range(rng.randint(1, max_parts)))
+        for i in range(n_topics)
+    }
+    members = tuple(
+        GroupMember(f"m{i:02d}", float(rng.choice([0, 40, 200, 900])))
+        for i in range(n_members)
+    )
+    ids = [m.member_id for m in members]
+    assignment, lags = {}, {}
+    for t, parts in topics.items():
+        for p in parts:
+            if rng.random() < owned:
+                assignment.setdefault(t, {})[p] = rng.choice(ids + [None])
+            lags.setdefault(t, {})[p] = rng.choice(
+                [0, 1, 7, 120, 5000, 10**6]
+            )
+    return ConsumerGroupState("g", members, assignment, lags)
+
+
+def _host(enc, alive, scale=100):
+    w = scale_weights([int(x) for x in enc.weights], scale, enc.p)
+    return pack_consumers(
+        w, [int(x) for x in enc.capacities],
+        [int(x) for x in enc.current], [int(x) for x in enc.proc_order],
+        [bool(x) for x in alive], enc.p,
+    )
+
+
+# --- encode -------------------------------------------------------------------
+
+def test_encode_buckets_and_weights():
+    st = _state(random.Random(0))
+    enc = encode_group(st, max_consumers=10, max_scale_pct=400)
+    assert enc.p_pad % 8 == 0 and enc.p_pad >= enc.p
+    assert enc.c_pad % 8 == 0 and enc.c_pad >= 10
+    # Real rows carry weight >= 1 (an owned partition always costs);
+    # padding rows are inert.
+    assert (enc.weights[: enc.p] >= 1).all()
+    assert (enc.weights[enc.p:] == 0).all()
+    # proc_order visits every row once, descending weight over real rows.
+    assert sorted(enc.proc_order.tolist()) == list(range(enc.p_pad))
+    real = enc.proc_order[: enc.p]
+    ws = [int(enc.weights[r]) for r in real]
+    assert ws == sorted(ws, reverse=True)
+
+
+def test_encode_overflow_guard_shifts_the_domain():
+    st = ConsumerGroupState(
+        "big", (GroupMember("m0", 0.0), GroupMember("m1", 0.0)),
+        {"t": {0: "m0", 1: "m1"}},
+        {"t": {0: 2**30, 1: 2**29}},
+    )
+    enc = encode_group(st, max_scale_pct=800)
+    assert enc.shift > 0
+    total = int(enc.weights.astype(np.int64).sum())
+    assert total * 8 < 2**30  # the largest sweep scale stays int32-exact
+
+
+def test_encode_rejects_unknown_weight_kind():
+    st = _state(random.Random(1))
+    with pytest.raises(ValueError, match="weight column"):
+        encode_group(st, weight="entropy")
+    with pytest.raises(ValueError, match="weight_values"):
+        encode_group(st, weight="throughput")
+
+
+# --- the host oracle's semantics ---------------------------------------------
+
+def test_oracle_sticky_keeps_fitting_owners():
+    # Two partitions on m0 fit (10+10 <= 25); the third overflows the
+    # prefix and moves to m1 (first-fit-decreasing, max headroom).
+    res = pack_consumers(
+        weights=[10, 10, 10, 0],
+        capacities=[25, 100],
+        current=[0, 0, 0, -1],
+        proc_order=[0, 1, 2, 3],
+        alive=[True, True],
+        p_real=3,
+    )
+    assert res.assigned[:3] == [0, 0, 1]
+    assert res.load == [20, 10]
+    assert res.moved == 1 and res.feasible
+
+
+def test_oracle_overflow_counts_not_crashes():
+    res = pack_consumers(
+        weights=[50, 50], capacities=[60], current=[-1, -1],
+        proc_order=[0, 1], alive=[True], p_real=2,
+    )
+    assert res.assigned == [0, 0]
+    assert res.overflowed == 1 and not res.feasible
+    assert res.load == [100]
+
+
+def test_oracle_dead_consumer_orphans_its_partitions():
+    res = pack_consumers(
+        weights=[5, 5], capacities=[100, 100], current=[1, 1],
+        proc_order=[0, 1], alive=[True, False], p_real=2,
+    )
+    assert res.assigned == [0, 0] and res.moved == 2
+
+
+# --- the parity pin -----------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(8))
+def test_device_matches_oracle_randomized(seed):
+    from kafka_assigner_tpu.parallel.whatif import pack_group_on_device
+
+    rng = random.Random(seed)
+    # Skewed lag, heterogeneous capacities, consumers > partitions and
+    # vice versa (the satellite's explicit instance classes).
+    n_members = rng.choice([1, 2, 5, 12])
+    st = _state(
+        rng, n_topics=rng.randint(1, 3), max_parts=rng.choice([2, 9]),
+        n_members=n_members, owned=rng.choice([0.3, 0.95]),
+    )
+    enc = encode_group(st, max_consumers=2 * n_members, max_scale_pct=300)
+    alive = enc.alive(enc.real_members)
+    dev = pack_group_on_device(
+        enc.weights, enc.capacities, enc.current, enc.proc_order,
+        alive, enc.p,
+    )
+    host = _host(enc, alive)
+    assert [int(x) for x in dev[0]] == host.assigned
+    assert [int(x) for x in dev[1]] == host.load
+    assert int(dev[2]) == host.moved
+    assert int(dev[3]) == host.overflowed
+    assert bool(dev[4]) == (not host.feasible)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_sweep_matches_oracle_per_candidate(seed):
+    from kafka_assigner_tpu.parallel.whatif import (
+        evaluate_group_candidates,
+    )
+
+    rng = random.Random(100 + seed)
+    st = _state(rng, n_members=rng.choice([2, 4]))
+    enc = encode_group(st, max_consumers=8, max_scale_pct=300)
+    cand = [(s, k) for s in (100, 150, 300) for k in (1, 2, 4, 8)]
+    alive = np.zeros((len(cand), enc.c_pad), dtype=bool)
+    for i, (_s, k) in enumerate(cand):
+        alive[i, :k] = True
+    scales = [s for s, _k in cand]
+    moved, over, infeas, load = evaluate_group_candidates(
+        enc.weights, enc.capacities, enc.current, enc.proc_order,
+        alive, scales, enc.p,
+    )
+    for i, (s, k) in enumerate(cand):
+        host = _host(enc, alive[i], scale=s)
+        assert int(moved[i]) == host.moved, (s, k)
+        assert int(over[i]) == host.overflowed, (s, k)
+        assert [int(x) for x in load[i]] == host.load, (s, k)
+
+
+def test_sweep_64_candidates_is_one_dispatch():
+    from kafka_assigner_tpu import obs
+
+    st = _state(random.Random(42), n_members=4)
+    enc = encode_group(st, max_consumers=8, max_scale_pct=800)
+    counts = [1, 2, 3, 4, 5, 6, 7, 8]
+    scales = [100, 125, 150, 200, 300, 400, 600, 800]
+    with obs.run_capture() as run:
+        body, degraded = group_sweep_envelope(
+            enc, counts, scales, groups_real=True,
+        )
+    assert not degraded
+    assert len(body["candidates"]) == 64
+    assert run.counters["groups.candidates"] == 64
+    # The acceptance bar: ONE batched device fan-out, not 64 solves.
+    assert run.counters["groups.dispatches"] == 1
+    assert validate_groups_sweep(body) == []
+
+
+def test_sweep_monotone_feasibility_and_recommendation():
+    # Uniform weights, exact capacities: k consumers of capacity C pack
+    # k*C of weight, so feasibility is monotone in k and the recommended
+    # count is the true knee.
+    members = tuple(GroupMember(f"m{i}", 100.0) for i in range(8))
+    st = ConsumerGroupState(
+        "g", members,
+        {"t": {p: None for p in range(12)}},
+        {"t": {p: 49 for p in range(12)}},  # weight 50 each, total 600
+    )
+    enc = encode_group(st, max_consumers=8, max_scale_pct=100)
+    body, _ = group_sweep_envelope(
+        enc, [1, 2, 3, 4, 5, 6, 7, 8], [100], groups_real=True,
+    )
+    feas = {c["consumers"]: c["feasible"] for c in body["candidates"]}
+    assert body["recommended_consumers"] == 6  # 600 weight / 100 cap
+    for k in range(1, 9):
+        assert feas[k] == (k >= 6)
+
+
+def test_sweep_rejects_counts_beyond_the_bucket():
+    st = _state(random.Random(3), n_members=2)
+    enc = encode_group(st, max_consumers=4, max_scale_pct=100)
+    with pytest.raises(ValueError, match="usable consumer columns"):
+        # Even counts inside the PAD range (c < k <= c_pad) must refuse:
+        # pad columns have capacity 0 and no member behind them.
+        group_sweep_envelope(enc, [enc.c + 1], [100], True)
+
+
+def test_default_counts_respects_the_candidate_budget():
+    counts = default_counts(real_members=10, n_scales=3, max_candidates=12)
+    assert counts == [1, 2, 3, 4]
+    assert default_counts(0, 1, 256)[:4] == [1, 2, 3, 4]
+
+
+# --- plan envelopes + crash fallback -----------------------------------------
+
+def test_plan_envelope_schema_and_stability():
+    st = _state(random.Random(5))
+    enc = encode_group(st)
+    body1, d1 = group_plan_envelope(enc, groups_real=True)
+    body2, d2 = group_plan_envelope(enc, groups_real=True)
+    assert not d1 and not d2
+    assert json.dumps(body1, sort_keys=True) \
+        == json.dumps(body2, sort_keys=True)
+    assert validate_groups_plan(body1) == []
+    # Every real partition row decodes to an owner.
+    decoded = decode_plan(enc, [
+        enc.members.index(body1["plan"][t][str(p)])
+        for t, p in enc.rows
+    ])
+    assert decoded == {
+        t: {int(p): m for p, m in per.items()}
+        for t, per in body1["plan"].items()
+    }
+
+
+def test_plan_device_crash_falls_back_to_oracle_bytes(monkeypatch):
+    st = _state(random.Random(6))
+    enc = encode_group(st)
+    base, _ = group_plan_envelope(enc, groups_real=True)
+
+    monkeypatch.setenv("KA_FAULTS_SPEC", "solve:0=crash")
+    faults.reset()
+    body, degraded = group_plan_envelope(
+        enc, groups_real=True, fallback="greedy",
+    )
+    assert degraded and body["solver"] == "greedy-fallback"
+    strip = lambda b: {k: v for k, v in b.items() if k != "solver"}  # noqa: E731
+    assert strip(body) == strip(base)  # the parity pin, end to end
+
+    faults.reset()
+    with pytest.raises(SolveError):
+        group_plan_envelope(enc, groups_real=True, fallback="raise")
+
+
+# --- backend hooks ------------------------------------------------------------
+
+def _snapshot_file(tmp_path, with_groups=True):
+    snap = {
+        "brokers": [
+            {"id": i, "host": f"b{i}", "port": 9092} for i in range(3)
+        ],
+        "topics": {"events": {str(p): [0, 1] for p in range(4)}},
+    }
+    if with_groups:
+        snap["groups"] = {"g": {
+            "members": {"c-0": 90.0, "c-1": None},
+            "assignment": {"events": {"0": "c-0", "1": "c-1"}},
+            "lag": {"events": {str(p): 10 * (p + 1) for p in range(4)}},
+        }}
+    path = tmp_path / "cluster.json"
+    path.write_text(json.dumps(snap), encoding="utf-8")
+    return str(path)
+
+
+def test_snapshot_groups_section_parses(tmp_path):
+    b = SnapshotBackend(_snapshot_file(tmp_path))
+    assert b.supports_groups()
+    states = b.fetch_consumer_groups()
+    st = states["g"]
+    assert [m.member_id for m in st.members] == ["c-0", "c-1"]
+    assert st.members[0].capacity == 90.0
+    assert st.members[1].capacity == 0.0  # null = unknown
+    assert st.assignment["events"][1] == "c-1"
+    assert st.lags["events"][3] == 40
+    with pytest.raises(KeyError, match="not in snapshot"):
+        b.fetch_consumer_groups(["nope"])
+
+
+def test_snapshot_without_section_refuses_loudly(tmp_path):
+    b = SnapshotBackend(_snapshot_file(tmp_path, with_groups=False))
+    assert not b.supports_groups()
+    with pytest.raises(IngestError, match="groups"):
+        b.fetch_consumer_groups()
+
+
+def test_base_protocol_default_refuses(tmp_path):
+    class Duck:
+        pass
+
+    from kafka_assigner_tpu.io.base import MetadataBackend
+
+    class Sub(MetadataBackend):
+        def brokers(self):
+            return []
+
+        def all_topics(self):
+            return []
+
+        def partition_assignment(self, topics):
+            return {}
+
+    with pytest.raises(IngestError, match="cannot read consumer groups"):
+        Sub().fetch_consumer_groups()
+    assert Sub().supports_groups() is False
+
+
+def test_write_snapshot_roundtrips_groups(tmp_path):
+    path = str(tmp_path / "rt.json")
+    groups_raw = {"g": {
+        "members": {"c-0": 5.0},
+        "assignment": {"t": {"0": "c-0"}},
+        "lag": {"t": {"0": 3}},
+    }}
+    write_snapshot(
+        path, [], {"t": {0: [1]}}, groups=groups_raw,
+    )
+    b = SnapshotBackend(path)
+    assert b.supports_groups()
+    assert b.fetch_consumer_groups()["g"].lags == {"t": {0: 3}}
+
+
+def test_load_group_states_synthetic_is_explicit_and_marked(tmp_path):
+    b = SnapshotBackend(_snapshot_file(tmp_path, with_groups=False))
+    parts = {"events": [0, 1, 2, 3]}
+    with pytest.raises(IngestError):
+        load_group_states(b, parts)
+    states, real = load_group_states(b, parts, synthetic=True)
+    assert not real and set(states) == {"synthetic"}
+    st = states["synthetic"]
+    # Deterministic: the same inputs rebuild the identical state.
+    st2 = synthetic_group_state("synthetic", parts)
+    assert st == st2
+    # Capacities stay UNKNOWN (0): the encoder's fair-share default then
+    # derives them from whichever weight column the run packs, so the
+    # synthetic family is coherent for lag AND throughput weights.
+    assert all(m.capacity == 0 for m in st.members)
+
+
+# --- the CLI surface ----------------------------------------------------------
+
+def _run_cli(argv):
+    out, err = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+        rc = run_groups(argv)
+    return rc, out.getvalue(), err.getvalue()
+
+
+def test_cli_plan_byte_stable_and_valid(tmp_path):
+    path = _snapshot_file(tmp_path)
+    rc1, out1, _ = _run_cli(["--zk_string", path, "--mode", "plan"])
+    rc2, out2, _ = _run_cli(["--zk_string", path, "--mode", "plan"])
+    assert rc1 == rc2 == 0 and out1 == out2
+    body = json.loads(out1)
+    assert validate_groups_plan(body) == []
+    assert body["groups_real"] is True
+
+
+def test_cli_sweep_64_candidates_byte_stable(tmp_path):
+    path = _snapshot_file(tmp_path)
+    argv = ["--zk_string", path, "--mode", "sweep",
+            "--counts", "1,2,3,4,5,6,7,8",
+            "--scales", "100,125,150,200,300,400,600,800"]
+    rc1, out1, _ = _run_cli(argv)
+    rc2, out2, _ = _run_cli(argv)
+    assert rc1 == rc2 == 0 and out1 == out2
+    body = json.loads(out1)
+    assert validate_groups_sweep(body) == []
+    assert len(body["candidates"]) == 64
+
+
+def test_cli_refusal_and_synthetic(tmp_path):
+    path = _snapshot_file(tmp_path, with_groups=False)
+    rc, out, err = _run_cli(["--zk_string", path, "--mode", "plan"])
+    assert rc == 1 and out == ""
+    assert "--synthetic" in err
+    rc, out, _ = _run_cli(
+        ["--zk_string", path, "--mode", "plan", "--synthetic"]
+    )
+    assert rc == 0
+    body = json.loads(out)
+    assert body["groups_real"] is False
+
+
+def test_cli_crash_fallback_policies(tmp_path, monkeypatch):
+    path = _snapshot_file(tmp_path)
+    rc, base_out, _ = _run_cli(["--zk_string", path, "--mode", "plan"])
+    assert rc == 0
+
+    monkeypatch.setenv("KA_FAULTS_SPEC", "solve:0=crash")
+    faults.reset()
+    with pytest.raises(SolveError):
+        _run_cli(["--zk_string", path, "--mode", "plan",
+                  "--failure-policy", "strict"])
+
+    faults.reset()
+    rc, out, err = _run_cli(
+        ["--zk_string", path, "--mode", "plan",
+         "--failure-policy", "best-effort"]
+    )
+    assert rc == 6 and "degraded" in err
+    strip = lambda b: {k: v for k, v in b.items() if k != "solver"}  # noqa: E731
+    assert strip(json.loads(out)) == strip(json.loads(base_out))
+
+
+def test_cli_greedy_solver_matches_device(tmp_path):
+    path = _snapshot_file(tmp_path)
+    _rc, dev, _ = _run_cli(["--zk_string", path, "--mode", "plan"])
+    _rc, host, _ = _run_cli(
+        ["--zk_string", path, "--mode", "plan", "--solver", "greedy"]
+    )
+    strip = lambda raw: {  # noqa: E731
+        k: v for k, v in json.loads(raw).items() if k != "solver"
+    }
+    assert strip(dev) == strip(host)
+
+
+def test_cli_throughput_weight_column(tmp_path):
+    path = _snapshot_file(tmp_path)
+    rc, out, _ = _run_cli(
+        ["--zk_string", path, "--mode", "plan", "--weight", "throughput"]
+    )
+    assert rc == 0
+    assert json.loads(out)["weight"] == "throughput"
+
+
+# --- the daemon endpoints -----------------------------------------------------
+
+def _daemon(tmp_path, with_groups=True):
+    from kafka_assigner_tpu.daemon import AssignerDaemon
+
+    d = AssignerDaemon(
+        _snapshot_file(tmp_path, with_groups=with_groups), solver="greedy",
+    )
+    d.start()
+    return d
+
+
+def _req(port, method, path, payload=None):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        conn.request(
+            method, path,
+            body=None if payload is None else json.dumps(payload),
+        )
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def test_daemon_groups_plan_get_post_identical(tmp_path):
+    d = _daemon(tmp_path)
+    try:
+        s1, raw1 = _req(d.http_port, "GET", "/groups/plan")
+        s2, raw2 = _req(d.http_port, "POST", "/groups/plan", {})
+        assert s1 == s2 == 200 and raw1 == raw2
+        env = json.loads(raw1)
+        assert env["schema_version"] == GROUPS_SCHEMA_VERSION
+        assert env["kind"] == "groups-plan"
+        assert validate_groups_plan(env["groups"]["g"]) == []
+        assert d.supervisor().counters()["groups.plans"] == 2
+    finally:
+        d.shutdown()
+
+
+def test_daemon_groups_sweep_params_and_counters(tmp_path):
+    d = _daemon(tmp_path)
+    try:
+        s, raw = _req(d.http_port, "POST", "/groups/sweep", {
+            "counts": [1, 2, 3, 4, 5, 6, 7, 8],
+            "scales": [100, 150, 200, 300, 400, 500, 600, 800],
+        })
+        assert s == 200
+        body = json.loads(raw)["groups"]["g"]
+        assert validate_groups_sweep(body) == []
+        assert len(body["candidates"]) == 64
+        counters = d.supervisor().counters()
+        assert counters["groups.sweeps"] == 1
+        # GET query form with CSV lists
+        s, raw = _req(
+            d.http_port, "GET", "/groups/sweep?counts=1,2&scales=100"
+        )
+        assert s == 200
+        assert len(json.loads(raw)["groups"]["g"]["candidates"]) == 2
+    finally:
+        d.shutdown()
+
+
+def test_daemon_groups_refusal_and_synthetic(tmp_path):
+    d = _daemon(tmp_path, with_groups=False)
+    try:
+        s, raw = _req(d.http_port, "GET", "/groups/plan")
+        assert s == 400 and b"synthetic" in raw
+        assert d.supervisor().counters()["groups.refusals"] == 1
+        s, raw = _req(d.http_port, "GET", "/groups/plan?synthetic=1")
+        assert s == 200
+        body = json.loads(raw)
+        assert body["groups_real"] is False
+        assert validate_groups_plan(body["groups"]["synthetic"]) == []
+    finally:
+        d.shutdown()
+
+
+def test_daemon_groups_solver_crash_falls_back(tmp_path, monkeypatch):
+    monkeypatch.setenv("KA_FAULTS_SPEC", "daemon:0=solver-crash")
+    faults.reset()
+    d = _daemon(tmp_path)
+    try:
+        s, raw = _req(d.http_port, "GET", "/groups/plan")
+        assert s == 200
+        env = json.loads(raw)
+        assert env["degraded"] is True
+        assert env["groups"]["g"]["solver"] == "greedy-fallback"
+        counters = d.supervisor().counters()
+        assert counters["groups.solve_fallbacks"] == 1
+        # The next request (fault exhausted) serves clean and the packing
+        # content matches the degraded one — the parity pin, live.
+        s, raw2 = _req(d.http_port, "GET", "/groups/plan")
+        clean = json.loads(raw2)
+        assert clean["degraded"] is False
+        strip = lambda b: {  # noqa: E731
+            k: v for k, v in b["groups"]["g"].items() if k != "solver"
+        }
+        assert strip(clean) == strip(env)
+    finally:
+        d.shutdown()
+
+
+def test_daemon_groups_bad_params_are_400(tmp_path):
+    d = _daemon(tmp_path)
+    try:
+        s, raw = _req(
+            d.http_port, "POST", "/groups/sweep", {"counts": "x,y"}
+        )
+        assert s == 400 and b"bad groups request" in raw
+        s, raw = _req(
+            d.http_port, "POST", "/groups/plan", {"group": ["g", 3]}
+        )
+        assert s == 400
+        s, raw = _req(
+            d.http_port, "POST", "/groups/plan", {"group": "nope"}
+        )
+        assert s == 400  # unknown group: KeyError from the snapshot
+    finally:
+        d.shutdown()
+
+
+# --- validators (negative space) ---------------------------------------------
+
+def test_validators_catch_missing_fields():
+    assert validate_groups_plan({}) != []
+    assert validate_groups_plan("nope") != []
+    good_sweepish = {
+        "schema_version": GROUPS_SCHEMA_VERSION, "kind": "groups-sweep",
+        "group": "g", "groups_real": True, "weight": "lag",
+        "candidates": [{}], "recommended_consumers": None,
+    }
+    probs = validate_groups_sweep(good_sweepish)
+    assert any("consumers" in p for p in probs)
+    assert validate_groups_sweep(
+        {**good_sweepish, "candidates": []}
+    ) != []
+
+
+# --- review-hardening regressions --------------------------------------------
+
+def test_partition_universe_widens_to_subscribed_topics():
+    from kafka_assigner_tpu.groups.solve import group_partition_universe
+
+    st = ConsumerGroupState(
+        "g", (GroupMember("c-0", 100.0),),
+        {"events": {0: "c-0"}},          # group only mentions partition 0
+        {"events": {0: 5}},
+    )
+    part_map = {"events": [0, 1, 2, 3], "unrelated": [0, 1]}
+    universe = group_partition_universe(st, part_map)
+    # Subscribed topic widens to the cluster's full partition list;
+    # unsubscribed topics stay out of the packing problem.
+    assert universe == {"events": [0, 1, 2, 3]}
+    enc = encode_group(st, partitions=universe)
+    assert enc.rows == [("events", 0), ("events", 1), ("events", 2),
+                        ("events", 3)]
+    body, _ = group_plan_envelope(enc, groups_real=True)
+    assert set(body["plan"]["events"]) == {"0", "1", "2", "3"}
+
+
+def test_cli_plan_covers_cluster_partitions_of_subscribed_topics(tmp_path):
+    snap = {
+        "brokers": [{"id": 0, "host": "b0", "port": 9092}],
+        "topics": {
+            "events": {str(p): [0] for p in range(6)},
+            "other": {"0": [0]},
+        },
+        "groups": {"g": {
+            "members": {"c-0": 1000.0},
+            "assignment": {"events": {"0": "c-0"}},  # partial coverage
+            "lag": {"events": {"0": 3}},
+        }},
+    }
+    path = tmp_path / "partial.json"
+    path.write_text(json.dumps(snap), encoding="utf-8")
+    rc, out, _ = _run_cli(["--zk_string", str(path), "--mode", "plan"])
+    assert rc == 0
+    body = json.loads(out)
+    assert set(body["plan"]) == {"events"}  # "other" is unsubscribed
+    assert set(body["plan"]["events"]) == {str(p) for p in range(6)}
+
+
+def test_daemon_get_single_value_counts_and_scales(tmp_path):
+    # ?counts=1 must stay the string "1", not coerce to boolean True
+    # (the query normalization is keyed to the known boolean params).
+    d = _daemon(tmp_path)
+    try:
+        s, raw = _req(
+            d.http_port, "GET", "/groups/sweep?counts=1&scales=100"
+        )
+        assert s == 200, raw
+        cands = json.loads(raw)["groups"]["g"]["candidates"]
+        assert [(c["consumers"], c["scale_pct"]) for c in cands] \
+            == [(1, 100)]
+    finally:
+        d.shutdown()
+
+
+def test_daemon_groups_counters_not_double_fed(tmp_path):
+    # One request, one group => exactly one groups.plans increment in the
+    # cumulative registry (the envelope builders do not also count).
+    from kafka_assigner_tpu.obs import promtext
+
+    d = _daemon(tmp_path)
+    try:
+        s, _raw = _req(d.http_port, "GET", "/groups/plan")
+        assert s == 200
+        s, m = _req(d.http_port, "GET", "/metrics")
+        fams = promtext.parse(m.decode("utf-8"))
+        plans = sum(
+            v for _n, _labels, v in
+            fams["ka_groups_plans_total"]["samples"]
+        )
+        assert plans == 1.0
+    finally:
+        d.shutdown()
+
+
+def test_groups_ingest_happens_outside_the_solve_lock(tmp_path):
+    # A slow backend group fetch must not serialize behind (or hold) the
+    # shared solve lock: with the lock HELD by another thread, the fetch
+    # still runs; the request only blocks at the dispatch stage.
+    import threading
+    import time as time_mod
+
+    from kafka_assigner_tpu.daemon import AssignerDaemon
+
+    d = AssignerDaemon(_snapshot_file(tmp_path), solver="greedy")
+    d.start()
+    try:
+        sup = d.supervisor()
+        fetched = threading.Event()
+        orig_fetch = sup.backend.fetch_consumer_groups
+
+        def marking_fetch(groups=None):
+            fetched.set()
+            return orig_fetch(groups)
+
+        sup.backend.fetch_consumer_groups = marking_fetch
+        with d._solve_lock:  # simulate another cluster's long solve
+            t = threading.Thread(
+                target=sup.groups_request, args=("plan", {}), daemon=True,
+            )
+            t.start()
+            deadline = time_mod.monotonic() + 10
+            while not fetched.is_set() \
+                    and time_mod.monotonic() < deadline:
+                time_mod.sleep(0.01)
+            # The ingest completed while the solve lock was held.
+            assert fetched.is_set()
+        t.join(timeout=30)
+        assert not t.is_alive()
+    finally:
+        d.shutdown()
+
+
+def test_capacity_default_is_fair_share_times_headroom():
+    # Mixed declared/unknown capacities: the undeclared member gets the
+    # fair share of total weight times the headroom factor — the
+    # KA_GROUPS_CAPACITY_HEADROOM contract — NOT the declared members'
+    # average (which would leave the knob silently dead).
+    st = ConsumerGroupState(
+        "g",
+        (GroupMember("c-0", 400.0), GroupMember("c-1", 400.0),
+         GroupMember("c-2", 0.0)),
+        {"t": {p: None for p in range(3)}},
+        {"t": {p: 99 for p in range(3)}},  # weight 100 each, total 300
+    )
+    enc1 = encode_group(st, capacity_headroom=1.0)
+    enc2 = encode_group(st, capacity_headroom=2.0)
+    assert int(enc1.capacities[0]) == int(enc2.capacities[0]) == 400
+    assert int(enc1.capacities[2]) == 100   # ceil(300 * 1.0 / 3)
+    assert int(enc2.capacities[2]) == 200   # the knob is live
+
+
+def test_parse_int_list_forgives_trailing_commas():
+    from kafka_assigner_tpu.groups.solve import parse_int_list
+
+    assert parse_int_list("100,150,") == [100, 150]
+    assert parse_int_list(None, "1,2") == [1, 2]
+    assert parse_int_list(None) is None
+    assert parse_int_list([3, "4"]) == [3, 4]
+    with pytest.raises(ValueError):
+        parse_int_list(True)
+    with pytest.raises(ValueError):
+        parse_int_list("x,y")
+
+
+def test_cli_forgives_trailing_comma_in_scales(tmp_path):
+    path = _snapshot_file(tmp_path)
+    rc, out, _ = _run_cli(
+        ["--zk_string", path, "--mode", "sweep",
+         "--counts", "1,2,", "--scales", "100,"]
+    )
+    assert rc == 0
+    assert len(json.loads(out)["candidates"]) == 2
+
+
+def test_synthetic_throughput_weights_are_coherent(tmp_path):
+    # --synthetic --weight throughput: capacities derive from the SAME
+    # byte-rate column as the weights (fair share x headroom), so the
+    # default packing is feasible — not lag-unit capacities against
+    # byte-unit weights.
+    path = _snapshot_file(tmp_path, with_groups=False)
+    rc, out, _ = _run_cli(
+        ["--zk_string", path, "--mode", "plan", "--synthetic",
+         "--weight", "throughput"]
+    )
+    assert rc == 0
+    body = json.loads(out)
+    assert body["weight"] == "throughput"
+    assert body["feasible"] is True and body["overflowed"] == 0
+
+
+def test_daemon_synthetic_string_false_is_not_an_opt_in(tmp_path):
+    d = _daemon(tmp_path, with_groups=False)
+    try:
+        s, raw = _req(
+            d.http_port, "POST", "/groups/plan", {"synthetic": "false"}
+        )
+        assert s == 400 and b"synthetic" in raw  # the refusal, not a plan
+        s, raw = _req(
+            d.http_port, "POST", "/groups/plan", {"synthetic": "junk"}
+        )
+        assert s == 400 and b"must be a boolean" in raw
+        s, raw = _req(
+            d.http_port, "POST", "/groups/plan", {"synthetic": "true"}
+        )
+        assert s == 200
+        assert json.loads(raw)["groups_real"] is False
+    finally:
+        d.shutdown()
+
+
+def test_daemon_backend_blackout_is_503_not_refusal(tmp_path):
+    d = _daemon(tmp_path)
+    try:
+        sup = d.supervisor()
+        real_backend = sup.backend
+        sup.backend = None  # the mid-reopen window of a quorum blackout
+        try:
+            code, body, headers = sup.groups_request("plan", {})
+        finally:
+            sup.backend = real_backend
+        assert code == 503
+        assert "unavailable" in body["error"]
+        assert headers.get("Retry-After")
+        assert "groups.refusals" not in sup.counters()
+    finally:
+        d.shutdown()

@@ -1319,7 +1319,7 @@ def test_ka011_helper_without_deadline_still_flagged():
 
 def test_rule_docs_cover_every_rule():
     assert set(kalint.RULE_DOCS) == set(kalint.RULES)
-    assert set(kalint.RULES) == {f"KA{n:03d}" for n in range(18)}
+    assert set(kalint.RULES) == {f"KA{n:03d}" for n in range(20)}
     for rule, (meaning, example) in kalint.RULE_DOCS.items():
         assert meaning and example, rule
 
@@ -1364,3 +1364,179 @@ def test_ka015_sibling_with_item_entered_under_the_lock(tmp_path):
     ka015 = [f for f in kalint.lint_tree(root) if f.rule == "KA015"]
     assert len(ka015) == 1 and "sleep" in ka015[0].message
     assert any("slow_setup" in hop for hop in ka015[0].chain)
+
+
+# --- KA019: blocking work while an inflight-gate admission is held -----------
+
+def test_ka019_blocking_sleep_after_gate_admission(tmp_path):
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "util.py": (
+            "import time\n\n\n"
+            "def slow_help(x):\n"
+            "    time.sleep(1)\n"
+            "    return x\n"
+        ),
+        "daemon/__init__.py": "",
+        "daemon/supervisor.py": (
+            "from ..util import slow_help\n\n\n"
+            "class ClusterSupervisor:\n"
+            "    def _gate(self):\n"
+            "        return None\n\n"
+            "    def _release(self):\n"
+            "        pass\n\n"
+            "    def handle(self, x):\n"
+            "        refusal = self._gate()\n"
+            "        if refusal is not None:\n"
+            "            return refusal\n"
+            "        try:\n"
+            "            return slow_help(x)\n"
+            "        finally:\n"
+            "            self._release()\n"
+        ),
+    })
+    ka019 = [f for f in kalint.lint_tree(root) if f.rule == "KA019"]
+    assert len(ka019) == 1
+    f = ka019[0]
+    assert f.path.endswith("util.py") and "sleep" in f.message
+    assert "inflight-gate" in f.message
+    assert any("ClusterSupervisor.handle" in hop for hop in f.chain)
+
+
+def test_ka019_blocking_before_the_gate_is_clean(tmp_path):
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "daemon/__init__.py": "",
+        "daemon/supervisor.py": (
+            "import time\n\n\n"
+            "class ClusterSupervisor:\n"
+            "    def _gate(self):\n"
+            "        return None\n\n"
+            "    def handle(self, x):\n"
+            "        time.sleep(0.1)  # pre-admission wait: legal\n"
+            "        refusal = self._gate()\n"
+            "        return refusal\n"
+        ),
+    })
+    assert "KA019" not in rules_of(kalint.lint_tree(root))
+
+
+def test_ka019_direct_sink_after_gate_in_same_block(tmp_path):
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "daemon/__init__.py": "",
+        "daemon/service.py": (
+            "import time\n\n\n"
+            "class Gatekeeper:\n"
+            "    def _gate(self):\n"
+            "        return None\n\n"
+            "    def serve(self, x):\n"
+            "        self._gate()\n"
+            "        time.sleep(1)\n"
+            "        return x\n"
+        ),
+    })
+    ka019 = [f for f in kalint.lint_tree(root) if f.rule == "KA019"]
+    assert len(ka019) == 1 and ka019[0].line == 10  # the sleep line
+
+
+def test_ka019_outside_daemon_package_is_clean(tmp_path):
+    # The gate discipline is a daemon/ house rule; other packages may
+    # name a method _gate without adopting it.
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "other.py": (
+            "import time\n\n\n"
+            "class Thing:\n"
+            "    def _gate(self):\n"
+            "        return None\n\n"
+            "    def run(self):\n"
+            "        self._gate()\n"
+            "        time.sleep(1)\n"
+        ),
+    })
+    assert "KA019" not in rules_of(kalint.lint_tree(root))
+
+
+def test_ka019_repo_chain_is_suppressed_with_reasons():
+    # The one sanctioned blocking chain (the first-use lazy native build)
+    # must stay suppressed for BOTH the lock rule and its gate twin.
+    findings = kalint.lint_package(use_cache=False)
+    assert not [f for f in findings if f.rule in ("KA015", "KA019")]
+
+
+# --- KA018: dead-knob detection ---------------------------------------------
+
+_ENV_FIXTURE = (
+    "KNOBS = {}\n\n\n"
+    "def _knob(name, type_, default):\n"
+    "    KNOBS[name] = (type_, default)\n\n\n"
+    '_knob("KA_LIVE_KNOB", "int", 1)\n'
+    '_knob("KA_DEFAULTED_KNOB", "int", 2)\n'
+    '_knob("KA_DEAD_KNOB", "int", 3)\n'
+)
+
+
+def _dead_knob_findings(reader_src):
+    import ast as _ast
+
+    trees = {
+        "utils/env.py": _ast.parse(_ENV_FIXTURE),
+        "consumer.py": _ast.parse(reader_src),
+    }
+    return kalint.check_dead_knobs(
+        trees,
+        knobs=["KA_LIVE_KNOB", "KA_DEFAULTED_KNOB", "KA_DEAD_KNOB"],
+    )
+
+
+def test_ka018_flags_only_the_never_read_knob():
+    findings = _dead_knob_findings(
+        "from .utils.env import env_int, knob_default\n\n\n"
+        "def f():\n"
+        '    return env_int("KA_LIVE_KNOB")\n\n\n'
+        "def g():\n"
+        '    return knob_default("KA_DEFAULTED_KNOB")\n'
+    )
+    assert [f.rule for f in findings] == ["KA018"]
+    f = findings[0]
+    assert "KA_DEAD_KNOB" in f.message
+    # Anchored at the registration call in the registry module.
+    assert f.path == "utils/env.py" and f.line == 10
+
+
+def test_ka018_registration_is_not_a_read():
+    # Nothing reads anything: every registered knob is dead, and the
+    # _knob(...) registrations themselves must not count as reads.
+    findings = _dead_knob_findings("X = 1\n")
+    assert sorted(
+        k for f in findings for k in (
+            "KA_LIVE_KNOB", "KA_DEFAULTED_KNOB", "KA_DEAD_KNOB",
+        ) if k in f.message
+    ) == ["KA_DEAD_KNOB", "KA_DEFAULTED_KNOB", "KA_LIVE_KNOB"]
+
+
+def test_ka018_read_inside_the_registry_module_does_not_count():
+    import ast as _ast
+
+    trees = {
+        "utils/env.py": _ast.parse(
+            _ENV_FIXTURE + '\n\ndef self_read():\n'
+            '    return KNOBS["KA_DEAD_KNOB"]\n'
+        ),
+    }
+    findings = kalint.check_dead_knobs(trees, knobs=["KA_DEAD_KNOB"])
+    assert [f.rule for f in findings] == ["KA018"]
+
+
+def test_ka018_repo_sweep_is_clean():
+    # Every knob the live registry declares is read somewhere in the
+    # package — the sweep that now gates tier-1 via lint_package.
+    findings = kalint.lint_package(use_cache=False)
+    assert not [f for f in findings if f.rule == "KA018"]
+
+
+def test_ka018_and_ka019_are_documented():
+    for rule in ("KA018", "KA019"):
+        assert rule in kalint.RULES
+        assert rule in kalint.RULE_DOCS
