@@ -35,6 +35,15 @@ from kafka_assigner_tpu.utils.compilecache import enable_persistent_cache  # noq
 
 enable_persistent_cache()
 
+# The native fast paths are load-only on the solve/request paths since
+# ISSUE 14 (no compiler subprocess may run under the daemon's solve queue);
+# the test process is a startup site like the CLI entry points, so build
+# both artifacts here, best-effort — failure degrades to the device scan /
+# numpy codec exactly like a toolchain-less production box.
+from kafka_assigner_tpu.native.build import prebuild_native_libraries  # noqa: E402
+
+prebuild_native_libraries()
+
 
 # One pytest process compiles every test module's XLA programs and jax's
 # compilation cache never evicts; each compiled executable holds LLVM JIT

@@ -135,6 +135,19 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _prebuild_native() -> None:
+    """Best-effort startup build of the native fast paths (ISSUE 14): the
+    solve/request paths are load-only (``native/build.py``), so any
+    process that wants the C greedy oracle or the boundary codec must
+    compile them at startup, before work begins — never under the
+    daemon's solve queue or an admitted inflight slot (the deleted
+    KA015/KA019 lazy-build chains). Failure degrades exactly like the old
+    lazy builds did (device scan / numpy codec), byte-identically."""
+    from .native.build import prebuild_native_libraries
+
+    prebuild_native_libraries(err=sys.stderr)
+
+
 def run_tool(argv: Optional[List[str]] = None) -> int:
     """Parse, validate, connect, dispatch (``KafkaAssignmentGenerator.java:256-299``)."""
     # Persistent XLA compile cache, honoring KA_COMPILE_CACHE (never fatal).
@@ -144,6 +157,7 @@ def run_tool(argv: Optional[List[str]] = None) -> int:
     from .utils.compilecache import enable_persistent_cache
 
     enable_persistent_cache()
+    _prebuild_native()
 
     parser = build_parser()
     args = parser.parse_args(argv)
@@ -381,6 +395,7 @@ def run_warm(argv: Optional[List[str]] = None) -> int:
     parser = build_warm_parser()
     args = parser.parse_args(argv)
     enable_persistent_cache()
+    _prebuild_native()
 
     if (args.buckets is None) == (args.zk_string is None):
         print("error: pass exactly one of --zk_string or --buckets",
@@ -591,6 +606,10 @@ def run_daemon(argv: Optional[List[str]] = None) -> int:
             print(f"error: {e}", file=sys.stderr)
             parser.print_usage(sys.stderr)
             return EXIT_USAGE
+    # Build the native artifacts BEFORE the solver fail-fast: the load
+    # paths no longer compile (ISSUE 14), so `--solver native` on a box
+    # with a toolchain but no prebuilt .so must build here, not refuse.
+    _prebuild_native()
     # Fail fast on an unavailable solver backend, like the one-shot CLI.
     get_solver(args.solver)
     enable_persistent_cache()
@@ -699,6 +718,7 @@ def run_groups(argv: Optional[List[str]] = None) -> int:
         parser.print_usage(sys.stderr)
         return EXIT_USAGE
     enable_persistent_cache()
+    _prebuild_native()
 
     report_path = args.report_json or env_str("KA_OBS_REPORT")
     if report_path is None and not env_bool("KA_OBS_ENABLE"):

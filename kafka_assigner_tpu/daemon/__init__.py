@@ -4,9 +4,11 @@ multi-cluster since ISSUE 9.
 See :mod:`.service` for the HTTP surface and routing, :mod:`.supervisor`
 for the per-cluster bulkhead (session, watch loop, lifecycle, circuit
 breaker, /execute single-flight), :mod:`.state` for the watch-maintained
-metadata cache + incremental group encode. The console entry point is
-``ka-daemon`` (``cli.daemon_main``).
+metadata cache + incremental group encode, :mod:`.dispatch` for the
+request-coalescing batched solve dispatcher (ISSUE 14). The console entry
+point is ``ka-daemon`` (``cli.daemon_main``).
 """
+from .dispatch import SolveDispatcher
 from .service import DEFAULT_CLUSTER, AssignerDaemon, run_daemon_process
 from .state import CacheBackend, DaemonState
 from .supervisor import CircuitBreaker, ClusterSupervisor
@@ -18,5 +20,6 @@ __all__ = [
     "ClusterSupervisor",
     "DEFAULT_CLUSTER",
     "DaemonState",
+    "SolveDispatcher",
     "run_daemon_process",
 ]

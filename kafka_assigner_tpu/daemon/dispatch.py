@@ -1,0 +1,465 @@
+"""``SolveDispatcher`` — request-coalescing batched solve dispatch
+(ISSUE 14 tentpole).
+
+Since PR 8 every solve-bearing daemon endpoint serialized through ONE
+shared ``threading.Lock``: N concurrent clients each got 1/N of the device
+even though the solver is batch-native — the what-if fan-out already
+evaluates 256 scenarios in one dispatch at ~1.6 ms/scenario warm vs.
+hundreds of ms for a solo solve (BENCH_onchip_r05). This module replaces
+the lock with a **gather-window queue**: request handlers submit typed
+solve jobs and block on a per-job future; ONE dispatcher thread gathers
+jobs for a short window (``KA_DISPATCH_WINDOW_MS``, or until
+``KA_DISPATCH_MAX_BATCH`` jobs are queued), packs COMPATIBLE jobs — across
+clusters — into a single device dispatch padded to the existing KA009
+power-of-two bucket shapes, then demultiplexes per-request result slices.
+The same amortization argument as the elastic reconfiguration batching in
+arXiv:1602.03770 and the sweep-based autoscaler evaluation in
+arXiv:2402.06085, applied to the serving plane.
+
+Job types and their coalescing semantics:
+
+========================== ===============================================
+job                        coalescing
+========================== ===============================================
+what-if scenario rows      rows whose batch key matches (same sweep entry,
+(``/whatif``, dense and    identical shared operand bytes + static args —
+incremental sweeps)        which holds across clusters whenever their
+                           encodings agree) concatenate along the batch
+                           axis into ONE ``whatif_sweep`` /
+                           ``whatif_subset_sweep`` dispatch; padding rows
+                           are inert, the padded batch lands on the same
+                           power-of-two bucket the program store already
+                           holds — no new compile keys beyond the bucketed
+                           batch dimension
+group autoscale rows       ditto, through ``group_pack_sweep``
+(``/groups/sweep``)
+identical request bodies   concurrent requests with equal (cluster, cache
+(``/plan``, ``/whatif``,   version, params) keys dedup into ONE run of the
+``/recommendations``)      body whose stdout bytes serve every waiter
+                           (deterministic pipeline ⇒ the bytes each waiter
+                           would have produced solo) — the
+                           dashboard-hammering case goes near-flat;
+                           distinct PLANS additionally serialize through
+                           the dispatcher's plan lock (their device half
+                           is not row-packable) — exactly today's
+                           behavior, while distinct what-ifs run
+                           concurrently and coalesce their rows above
+========================== ===============================================
+
+Singleton or incompatible jobs degrade to the solo path (the behavior the
+shared lock gave): they still run one-at-a-time on the dispatcher thread,
+counted as ``dispatch.solo_fallbacks``. ``KA_DISPATCH=0`` is the
+kill-switch — the daemon constructs no dispatcher at all and every handler
+takes the shared solve lock exactly as before (byte- and
+metric-compatible, test-pinned).
+
+Failure containment: a solver crash inside a coalesced dispatch (the
+``dispatch:i=crash`` fault seam fires here, on the dispatcher thread)
+fails ONLY that batch's futures — each submitter retries its own rows solo
+and, if that fails too, falls through its endpoint's existing per-request
+degradation (the parity-pinned greedy oracle for plans/groups). Other
+batches in the same gather cycle — other clusters' in-flight requests —
+are untouched, and the dispatcher thread itself never dies. Queue wait
+counts against the request watchdog (the watchdog timer arms before
+submission) and is telemetered separately from solve time
+(``daemon.solve.queue_ms`` vs. the ``dispatch`` span); a draining daemon
+flushes the queue before exit (``close()`` dispatches every queued job
+immediately, then joins the thread).
+
+Obs-capture discipline: per-request captures are thread-local (PR 9/10),
+so the request-side accounting — stdout, request IDs, the queue-wait
+histogram — lands in each request's own capture, byte-identical to a solo
+run; work executed ON the dispatcher thread (the coalesced device call,
+the ``dispatch`` span, batch counters) records into the process-lifetime
+cumulative registry only.
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import io
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..faults.inject import fault_point
+from ..obs import flight
+from ..obs.metrics import counter_add, hist_observe
+from ..obs.trace import record_span
+
+#: Thread-local broker installation: the supervisor wraps a request body in
+#: :func:`dispatch_scope` so the sweep machinery (``parallel/whatif.py``)
+#: can find the dispatcher WITHOUT a process-global — an in-process CLI run
+#: (tests, embedders) on another thread never routes through a daemon's
+#: queue.
+_tls = threading.local()
+
+
+def active_broker() -> Optional["SolveDispatcher"]:
+    """The dispatcher installed for the CURRENT thread, or None (the
+    one-shot CLI, the kill-switch lock path, non-request threads)."""
+    return getattr(_tls, "broker", None)
+
+
+class dispatch_scope:
+    """Install ``broker`` as the current thread's dispatch target for the
+    duration of a request body. Re-entrant in the trivial sense (nested
+    scopes restore the previous broker)."""
+
+    def __init__(self, broker: Optional["SolveDispatcher"]) -> None:
+        self._broker = broker
+        self._prev: Optional["SolveDispatcher"] = None
+
+    def __enter__(self) -> Optional["SolveDispatcher"]:
+        self._prev = getattr(_tls, "broker", None)
+        _tls.broker = self._broker
+        return self._broker
+
+    def __exit__(self, *exc) -> None:
+        _tls.broker = self._prev
+        return None
+
+
+def batch_key(entry: str, shared_arrays, statics: tuple) -> str:
+    """The compatibility class of one row job: the sweep entry, a content
+    digest of every SHARED (non-batch-axis) operand, and the static args.
+    Jobs with equal keys would dispatch byte-identical programs on
+    byte-identical shared operands — concatenating their batch rows is
+    therefore exactly the fan-out widening the sweep machinery already
+    performs within one request, which is what makes CROSS-cluster packing
+    sound: two clusters whose encodings agree produce the same key."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(entry.encode("utf-8"))
+    h.update(repr(statics).encode("utf-8"))
+    for a in shared_arrays:
+        arr = np.ascontiguousarray(a)
+        h.update(str(arr.dtype).encode("utf-8"))
+        h.update(str(arr.shape).encode("utf-8"))
+        h.update(arr.tobytes())
+    return f"{entry}:{h.hexdigest()}"
+
+
+class _RowJob:
+    """One batch-axis solve job: ``rows`` (each array's axis 0 is the
+    packable axis, length ``n_rows``), the device ``call`` to run on the
+    (possibly concatenated) padded rows, and the ``pad`` factory producing
+    k inert rows."""
+
+    __slots__ = (
+        "entry", "key", "rows", "n_rows", "call", "pad", "cluster",
+        "done", "result", "error", "t_submit", "t_start",
+    )
+
+    def __init__(self, entry, key, rows, n_rows, call, pad, cluster):
+        self.entry = entry
+        self.key = key
+        self.rows = rows
+        self.n_rows = n_rows
+        self.call = call
+        self.pad = pad
+        self.cluster = cluster
+        self.done = threading.Event()
+        self.result: Optional[tuple] = None
+        self.error: Optional[BaseException] = None
+        self.t_submit = time.perf_counter()
+        self.t_start: float = 0.0
+
+
+class _PlanEntry:
+    """One in-flight body solve: the leader runs, followers wait."""
+
+    __slots__ = ("done", "stdout", "degraded", "error", "followers")
+
+    def __init__(self) -> None:
+        self.done = threading.Event()
+        self.stdout: Optional[str] = None
+        self.degraded = False
+        self.error: Optional[BaseException] = None
+        self.followers = 0
+
+
+class SolveDispatcher:
+    """The coalescing queue + its single dispatcher thread (module doc)."""
+
+    def __init__(self, err=None) -> None:
+        import sys
+
+        self.err = err if err is not None else sys.stderr
+        self._cv = threading.Condition()
+        self._queue: List[_RowJob] = []
+        self._closed = False
+        #: Identical-plan dedup (single-flight by content key) and the
+        #: serialization of DISTINCT plan bodies — the non-batchable jobs
+        #: keep exactly the old lock's pairwise exclusion among themselves.
+        self._plan_mu = threading.Lock()
+        self._plan_entries: Dict[str, _PlanEntry] = {}
+        self._plan_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._loop, name="ka-dispatch", daemon=True
+        )
+        self._thread.start()
+
+    # -- live knobs ---------------------------------------------------------
+
+    @staticmethod
+    def _window_s() -> float:
+        from ..utils.env import env_float
+
+        return env_float("KA_DISPATCH_WINDOW_MS") / 1000.0
+
+    @staticmethod
+    def _max_batch() -> int:
+        from ..utils.env import env_int
+
+        return env_int("KA_DISPATCH_MAX_BATCH")
+
+    # -- row jobs (what-if scenario rows, group autoscale rows) -------------
+
+    def submit_rows(
+        self,
+        entry: str,
+        key: str,
+        rows: Dict[str, np.ndarray],
+        n_rows: int,
+        pad: Callable[[int], Dict[str, np.ndarray]],
+        call: Callable[[Dict[str, np.ndarray]], tuple],
+        cluster: Optional[str] = None,
+    ) -> Optional[tuple]:
+        """Queue one row job and block until its slice of a coalesced
+        dispatch is ready. Returns the output arrays (each sliced to this
+        job's ``n_rows`` on axis 0), or ``None`` when the dispatcher is
+        closed — the caller then runs the direct path itself. Raises the
+        batch's error on a mid-batch solver crash (the caller owns its
+        per-job solo retry/degradation)."""
+        job = _RowJob(entry, key, rows, n_rows, call, pad, cluster)
+        with self._cv:
+            if self._closed:
+                return None
+            self._queue.append(job)
+            self._cv.notify_all()
+        counter_add("dispatch.jobs")
+        job.done.wait()
+        # Queue wait (submit → device-dispatch start), recorded on the
+        # REQUEST thread so it lands in this request's capture too —
+        # separated from solve time by construction.
+        hist_observe(
+            "daemon.solve.queue_ms",
+            (job.t_start - job.t_submit) * 1000.0,
+        )
+        if job.error is not None:
+            raise job.error
+        return job.result
+
+    # -- body jobs (identical-request dedup; plans also serialize) ----------
+
+    def run_job(
+        self,
+        key: str,
+        fn: Callable[[io.StringIO], bool],
+        out: io.StringIO,
+        exclusive: bool = True,
+    ) -> Optional[Tuple[bool, bool]]:
+        """Run one whole-request solve body (``/plan``, ``/whatif``, the
+        ``/recommendations`` candidate plan): identical concurrent jobs
+        (equal ``key`` — cluster, cache version, params) coalesce into ONE
+        run of ``fn`` whose stdout bytes serve every waiter — the
+        deterministic pipeline makes those exactly the bytes each waiter
+        would have produced solo.
+
+        ``exclusive=True`` (plans): distinct jobs additionally serialize
+        through the plan lock — their device half (``assign_many``) is not
+        row-packable, so they keep the old lock's pairwise exclusion among
+        themselves. ``exclusive=False`` (what-if bodies): distinct jobs
+        run CONCURRENTLY on their request threads — their device rows
+        coalesce in this dispatcher's row queue, which is the whole point.
+
+        Returns ``(degraded, coalesced)`` — ``coalesced`` True for a
+        follower served from the leader's bytes — or ``None`` when the
+        dispatcher is closed (caller falls back to its lock path). The
+        leader's exception propagates to the leader only; followers retry
+        solo (per-job failure isolation)."""
+        with self._cv:
+            if self._closed:
+                return None
+        counter_add("dispatch.jobs")
+        t0 = time.perf_counter()
+        with self._plan_mu:
+            entry = self._plan_entries.get(key)
+            leader = entry is None
+            if leader:
+                entry = _PlanEntry()
+                self._plan_entries[key] = entry
+            else:
+                entry.followers += 1
+        if leader:
+            try:
+                with self._plan_lock if exclusive else contextlib.nullcontext():
+                    hist_observe(
+                        "daemon.solve.queue_ms",
+                        (time.perf_counter() - t0) * 1000.0,
+                    )
+                    local = io.StringIO()
+                    try:
+                        entry.degraded = fn(local)
+                        entry.stdout = local.getvalue()
+                    except BaseException as e:
+                        entry.error = e
+            finally:
+                with self._plan_mu:
+                    self._plan_entries.pop(key, None)
+                    followers = entry.followers
+                entry.done.set()
+            if followers:
+                counter_add("dispatch.batches")
+                hist_observe("dispatch.batch_size", 1 + followers)
+                flight.record(
+                    "dispatch", None, entry="body", jobs=1 + followers,
+                    coalesced=True,
+                )
+            else:
+                counter_add("dispatch.solo_fallbacks")
+            if entry.error is not None:
+                raise entry.error
+            out.write(entry.stdout)
+            return entry.degraded, False
+        entry.done.wait()
+        hist_observe(
+            "daemon.solve.queue_ms", (time.perf_counter() - t0) * 1000.0,
+        )
+        if entry.error is not None:
+            # Per-job isolation: the leader's crash is the leader's to
+            # handle; this follower re-runs solo (its own fn carries its
+            # own fallback chain).
+            counter_add("dispatch.solo_fallbacks")
+            with self._plan_lock if exclusive else contextlib.nullcontext():
+                degraded = fn(out)
+            return degraded, False
+        out.write(entry.stdout)
+        return entry.degraded, True
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        """Flush-and-stop: refuse new jobs, dispatch every queued one
+        immediately (the drain contract — a draining daemon's in-flight
+        requests are blocked on these futures), then join the thread."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=30.0)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -- the dispatcher thread ----------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait(0.1)
+                if not self._queue and self._closed:
+                    return
+                # Gather: from the FIRST queued job's submit time, wait out
+                # the window for companions — unless the size trigger fires
+                # or the daemon is draining (flush immediately).
+                deadline = self._queue[0].t_submit + self._window_s()
+                max_batch = self._max_batch()
+                while not self._closed \
+                        and len(self._queue) < max_batch:
+                    left = deadline - time.perf_counter()
+                    if left <= 0:
+                        break
+                    self._cv.wait(left)
+                # The size trigger also CAPS the cycle: jobs beyond
+                # max_batch stay queued (already past their window, so the
+                # next cycle dispatches them immediately). An uncapped
+                # grab under a storm would widen the padded batch into
+                # bucket shapes nothing has compiled.
+                batch = self._queue[:max_batch]
+                del self._queue[:max_batch]
+            groups: Dict[str, List[_RowJob]] = {}
+            order: List[str] = []
+            for job in batch:
+                if job.key not in groups:
+                    groups[job.key] = []
+                    order.append(job.key)
+                groups[job.key].append(job)
+            for key in order:
+                self._run_group(groups[key])
+
+    def _run_group(self, jobs: List[_RowJob]) -> None:
+        """One coalesced device dispatch: concatenate the group's batch
+        rows, pad to the power-of-two bucket, run the FIRST job's device
+        call (equal keys ⇒ byte-identical shared operands), slice results
+        back per job. Any escape fails only THIS group's futures."""
+        from ..models.problem import batch_bucket
+
+        t0 = time.perf_counter()
+        t_start = time.perf_counter()
+        for job in jobs:
+            job.t_start = t_start
+        ok = False
+        try:
+            # The chaos seam: a crash here must fail only this batch.
+            fault_point("dispatch", cluster=jobs[0].cluster)
+            total = sum(j.n_rows for j in jobs)
+            padded_total = batch_bucket(total)
+            names = list(jobs[0].rows)
+            rows: Dict[str, np.ndarray] = {}
+            if len(jobs) == 1 and jobs[0].n_rows == padded_total:
+                rows = jobs[0].rows
+            else:
+                parts = {name: [j.rows[name] for j in jobs]
+                         for name in names}
+                if padded_total > total:
+                    pad_rows = jobs[0].pad(padded_total - total)
+                    for name in names:
+                        parts[name].append(pad_rows[name])
+                rows = {
+                    name: np.concatenate(parts[name], axis=0)
+                    for name in names
+                }
+            outs = jobs[0].call(rows)
+            off = 0
+            for job in jobs:
+                job.result = tuple(
+                    np.asarray(a)[off:off + job.n_rows] for a in outs
+                )
+                off += job.n_rows
+            ok = True
+        except BaseException as e:
+            for job in jobs:
+                job.error = e
+            print(
+                f"ka-dispatch: coalesced {jobs[0].entry} dispatch failed "
+                f"({type(e).__name__}: {e}); {len(jobs)} job(s) degrade "
+                "per-job",
+                file=self.err,
+            )
+        finally:
+            ms = (time.perf_counter() - t0) * 1000.0
+            record_span("dispatch", ms, ok)
+            if ok:
+                # Crashed dispatches produced nothing: their jobs re-run
+                # solo and are counted at the retry sites — counting them
+                # here too would both overstate healthy coalescing and
+                # double-count the jobs.
+                hist_observe("dispatch.batch_size", len(jobs))
+                if len(jobs) > 1:
+                    counter_add("dispatch.batches")
+                else:
+                    counter_add("dispatch.solo_fallbacks")
+            flight.record(
+                "dispatch", jobs[0].cluster if len(jobs) == 1 else None,
+                entry=jobs[0].entry, jobs=len(jobs),
+                rows=sum(j.n_rows for j in jobs),
+                coalesced=len(jobs) > 1, ok=ok, ms=round(ms, 3),
+            )
+            for job in jobs:
+                job.done.set()

@@ -132,6 +132,7 @@ from urllib.parse import parse_qs, urlsplit
 from ..obs import flight
 from ..obs import metrics as obs_metrics
 from ..obs.report import REPORT_SCHEMA_VERSION, TOOL_NAME, AccessLog
+from .dispatch import SolveDispatcher
 from .supervisor import POLL_S, ClusterSupervisor
 
 #: The implicit cluster name of a single-cluster (``--zk_string``) daemon.
@@ -198,7 +199,7 @@ class AssignerDaemon:
         access_log: Optional[str] = None,
         err=None,
     ) -> None:
-        from ..utils.env import env_float, env_int, env_str
+        from ..utils.env import env_bool, env_float, env_int, env_str
 
         if (zk_string is None) == (clusters is None):
             raise ValueError(
@@ -240,6 +241,18 @@ class AssignerDaemon:
         #: ONE solve lock across every cluster: one device, one capture
         #: discipline. Admission/shedding stay per-cluster (bulkheads).
         self._solve_lock = threading.Lock()
+        #: The request-coalescing batched dispatcher (ISSUE 14), daemon-
+        #: wide like the lock it supersedes: concurrent solve jobs gather
+        #: for a short window and compatible device work packs — across
+        #: clusters — into one bucketed dispatch. ``KA_DISPATCH=0`` is the
+        #: kill-switch: no dispatcher, every handler takes the lock
+        #: exactly as PR 8-13 did (byte- and metric-compatible,
+        #: test-pinned). Read once per daemon lifetime — the regime is
+        #: program structure, not a per-request knob.
+        self.dispatcher: Optional[SolveDispatcher] = (
+            SolveDispatcher(err=self.err)
+            if env_bool("KA_DISPATCH") else None
+        )
         self.supervisors: Dict[str, ClusterSupervisor] = {
             name: ClusterSupervisor(
                 name, spec,
@@ -249,6 +262,7 @@ class AssignerDaemon:
                 draining=self.draining,
                 stopped=self.stopped,
                 solve_lock=self._solve_lock,
+                dispatcher=self.dispatcher,
                 err=self.err,
             )
             for name, spec in clusters.items()
@@ -305,6 +319,16 @@ class AssignerDaemon:
         flight.record(
             "daemon", event="start", clusters=sorted(self.supervisors),
         )
+        # Startup pre-warm of the native fast paths (ISSUE 14 satellite):
+        # the solve paths are load-only (native/build.py), so the one
+        # place their compilers may run is HERE — next to the program warm
+        # hooks, never under the solve queue or an admitted inflight slot
+        # (the deleted KA015/KA019 lazy-build chains). Best-effort:
+        # failure degrades to the device scan / numpy codec,
+        # byte-identically.
+        from ..native.build import prebuild_native_libraries
+
+        prebuild_native_libraries(err=self.err)
         for sup in self.supervisors.values():
             sup.start(require_sync=self.single)
         self.httpd = _build_http_server(self, self.bind, self.port)
@@ -364,6 +388,13 @@ class AssignerDaemon:
         if self.httpd is not None:
             self.httpd.shutdown()
             self.httpd.server_close()
+        if self.dispatcher is not None:
+            # Flush-and-stop AFTER the drain window: any straggler request
+            # the drain timed out on is still blocked on a queued future —
+            # close() dispatches every queued job immediately, then joins
+            # the dispatcher thread (jobs submitted from here on degrade
+            # to the callers' direct paths).
+            self.dispatcher.close()
         for sup in self.supervisors.values():
             sup.teardown()
         if self._serve_thread is not None:
@@ -843,9 +874,17 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
                     file=daemon.err,
                 )
 
-    httpd = ThreadingHTTPServer((bind, port), Handler)
-    httpd.daemon_threads = True
-    return httpd
+    class Server(ThreadingHTTPServer):
+        daemon_threads = True
+        #: listen(2) backlog. socketserver's default of 5 makes a burst of
+        #: concurrent clients SYN-drop into kernel connect retries
+        #: (seconds of invisible latency before the daemon even sees the
+        #: request) — absorbing exactly such bursts is the batched
+        #: dispatcher's whole point (ISSUE 14), so the accept queue must
+        #: outsize the gather it feeds.
+        request_queue_size = 128
+
+    return Server((bind, port), Handler)
 
 
 # --------------------------------------------------------------------------

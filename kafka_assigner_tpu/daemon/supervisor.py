@@ -75,6 +75,7 @@ from ..obs import metrics as obs_metrics
 from ..obs.metrics import counter_add, gauge_set, hist_ms, hist_observe
 from ..obs.trace import record_span
 from ..utils.backoff import JitteredBackoff
+from .dispatch import SolveDispatcher, dispatch_scope
 from .state import CacheBackend, DaemonState
 
 #: Watch-poll block per loop iteration (also the drain-check cadence).
@@ -183,6 +184,7 @@ class ClusterSupervisor:
         draining: threading.Event,
         stopped: threading.Event,
         solve_lock: threading.Lock,
+        dispatcher: Optional[SolveDispatcher] = None,
         err=None,
     ) -> None:
         from ..utils.env import env_bool, env_choice, env_float, env_int
@@ -226,6 +228,11 @@ class ClusterSupervisor:
         #: discipline): admission and shedding are per-cluster, the solve
         #: itself is not.
         self._solve_lock = solve_lock
+        #: The request-coalescing batched dispatcher (ISSUE 14), shared
+        #: daemon-wide like the lock it supersedes; None under the
+        #: KA_DISPATCH=0 kill-switch — then every handler takes
+        #: ``_solve_lock`` exactly as PR 8-13 did, byte-for-byte.
+        self._dispatcher = dispatcher
         #: The per-cluster bulkhead: admitted-request count, gated per
         #: request against the LIVE KA_DAEMON_MAX_INFLIGHT knob.
         self._active = 0
@@ -630,12 +637,12 @@ class ClusterSupervisor:
         try:
             solver = params.get("solver") or self.solver
             out = io.StringIO()
-            with self._solve_lock:
+            with self._solve_lock_scope():
                 topics = self.state.all_assignments()
                 broker_ids = self.state.broker_id_set()
                 rack = self.state.rack_map()
                 current = health.score_assignment(broker_ids, topics, rack)
-                degraded = self._run_plan({"solver": solver}, out)
+                degraded = self._solve_plan({"solver": solver}, out)
             proposed, _order = parse_plan_payload(
                 out.getvalue(), origin="recommendation plan",
             )
@@ -826,13 +833,16 @@ class ClusterSupervisor:
                 )
                 if weight == "throughput" else None
             )
-            with self._solve_lock:
+            with self._solve_lock_scope():
                 # build_group_bodies is the orchestration both surfaces
                 # share; the probe is the daemon chaos seam
                 # (daemon:solver-crash, @cluster-addressable) — a crash
                 # there, or inside the device dispatch itself, re-runs
                 # that group on the packing oracle: the request survives,
-                # like /plan's solver isolation.
+                # like /plan's solver isolation. Under the dispatcher the
+                # scope routes the autoscale sweep's candidate rows into
+                # the coalescing queue (ISSUE 14) instead of excluding
+                # other requests.
                 bodies, degraded_by_group = build_group_bodies(
                     states, groups_real, part_map, kind, weight,
                     weight_values, scales, headroom, max_cand,
@@ -1152,6 +1162,68 @@ class ClusterSupervisor:
         with self._active_lock:
             self._active -= 1
 
+    def _solve_lock_scope(self):
+        """The serialization regime for one solve-bearing request body.
+        ``KA_DISPATCH=0`` (no dispatcher): the shared solve lock — exactly
+        the PR 8-13 behavior. Otherwise: the coalescing dispatcher's
+        thread scope (``daemon/dispatch.py``) — the body runs CONCURRENTLY
+        with other requests (host encode/format overlap across clients)
+        and only its device work serializes, coalesced, on the dispatcher
+        thread. Queue wait still counts against the request watchdog: the
+        timer arms before this scope is entered."""
+        if self._dispatcher is None:
+            return self._solve_lock
+        return dispatch_scope(self._dispatcher)
+
+    def _solve_body(self, kind: str, runner, params: dict,
+                    out: io.StringIO, exclusive: bool) -> bool:
+        """One solve body behind the dispatch regime: direct under the
+        lock path (the caller already holds the shared lock); under the
+        dispatcher, identical concurrent bodies (same cluster, cache
+        version and params) coalesce into ONE run whose stdout bytes
+        serve every waiter — the deterministic pipeline makes those the
+        exact bytes each waiter would have produced solo. ``exclusive``
+        (plans) keeps distinct bodies on the dispatcher's plan lock (the
+        pairwise exclusion the shared lock gave their non-row-packable
+        device half); what-if bodies run concurrently instead — their
+        scenario rows coalesce in the row queue, which is where the
+        cross-request (and cross-cluster) device amortization happens."""
+        d = self._dispatcher
+        if d is None:
+            return runner(params, out)
+        res = d.run_job(
+            self._body_job_key(kind, params),
+            lambda buf: runner(params, buf),
+            out,
+            exclusive=exclusive,
+        )
+        if res is None:
+            # Dispatcher already draining/closed: the straggler takes the
+            # lock path (today's behavior, nobody else holds it).
+            with self._solve_lock:
+                return runner(params, out)
+        degraded, _coalesced = res
+        return degraded
+
+    def _solve_plan(self, params: dict, out: io.StringIO) -> bool:
+        return self._solve_body("plan", self._run_plan, params, out,
+                                exclusive=True)
+
+    def _solve_whatif(self, params: dict, out: io.StringIO) -> bool:
+        return self._solve_body("whatif", self._run_whatif, params, out,
+                                exclusive=False)
+
+    def _body_job_key(self, kind: str, params: dict) -> str:
+        """Identical-request coalescing key: endpoint, cluster identity,
+        the cache version the solve would read, and the full request
+        params — equal keys provably produce byte-identical stdout."""
+        # kalint: disable=KA005 -- dedup key material, not a plan payload
+        payload = json.dumps(params, sort_keys=True, default=repr)
+        return (
+            f"{kind}|{self.name}|{self.state.version}|{self.solver}|"
+            f"{self.failure_policy}|{payload}"
+        )
+
     def _watchdog(self, path: str, budget: float,
                   request_id: Optional[str],
                   overran: Optional[threading.Event] = None,
@@ -1223,7 +1295,7 @@ class ClusterSupervisor:
         # Per-request capture is THREAD-LOCAL (obs/trace.py): concurrent
         # requests from other clusters can never tear each other's span
         # stacks or steal each other's metrics.
-        with self._solve_lock, obs.run_capture(local=True) as run:
+        with self._solve_lock_scope(), obs.run_capture(local=True) as run:
             if request_id is not None:
                 # FIRST thing in the capture: every span this request
                 # records carries the correlation id (ISSUE 10).
@@ -1231,9 +1303,9 @@ class ClusterSupervisor:
             try:
                 with obs.span(self._metric("daemon/request")) as sp:
                     if path == "/plan":
-                        degraded = self._run_plan(params, out)
+                        degraded = self._solve_plan(params, out)
                     elif path == "/whatif":
-                        degraded = self._run_whatif(params, out)
+                        degraded = self._solve_whatif(params, out)
                     else:
                         raise ValueError(f"unknown endpoint {path!r}")
                     if degraded or self.state.stale:

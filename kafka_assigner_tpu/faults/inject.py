@@ -62,6 +62,13 @@ stall      resync           one daemon resync attempt dies mid-flight
 solver-crash daemon         the solve crashes inside a served request
                             (``InjectedSolverCrash``); the request degrades
                             to the greedy fallback in isolation
+crash      dispatch         one coalesced device dispatch of the batched
+                            solve dispatcher crashes mid-batch
+                            (``InjectedSolverCrash``) — only that batch's
+                            jobs degrade, each per-job (ISSUE 14)
+stall      dispatch         the dispatcher stalls ``arg`` seconds before a
+                            coalesced dispatch — visible as queue wait and
+                            watchdog overrun, never a hang
 ========== ================ ==============================================
 
 Spec grammar (``KA_FAULTS_SPEC``): semicolon-separated events
@@ -120,6 +127,11 @@ FAULT_SCOPES: Dict[str, Tuple[str, ...]] = {
     "session": ("expire",),
     "resync": ("stall",),
     "daemon": ("solver-crash",),
+    # The batched solve dispatcher (ISSUE 14): consulted once per coalesced
+    # device dispatch, ON the dispatcher thread — a crash must fail only
+    # that batch's jobs (each degrades per-job), a stall must surface as
+    # queue wait, never a hang.
+    "dispatch": ("crash", "stall"),
 }
 FAULT_KINDS = tuple(k for kinds in FAULT_SCOPES.values() for k in kinds)
 
@@ -129,7 +141,7 @@ FAULT_KINDS = tuple(k for kinds in FAULT_SCOPES.values() for k in kinds)
 RANDOM_HORIZON: Dict[str, int] = {
     "connect": 3, "handshake": 3, "reply": 64, "solve": 2, "warmup": 2,
     "write": 8, "converge": 8, "wave": 4,
-    "watch": 8, "session": 4, "resync": 4, "daemon": 4,
+    "watch": 8, "session": 4, "resync": 4, "daemon": 4, "dispatch": 4,
 }
 
 #: The scope iteration order of :func:`random_schedule`. Frozen EXPLICITLY —
@@ -142,6 +154,7 @@ RANDOM_ORDER: Tuple[str, ...] = (
     "connect", "handshake", "reply", "solve", "warmup",
     "write", "converge", "wave",
     "watch", "session", "resync", "daemon",
+    "dispatch",
 )
 
 ERR_NONODE = -101
@@ -516,6 +529,28 @@ class FaultInjector:
                 "injected fault: daemon resync attempt stalled"
             )
 
+    def dispatch_attempt(self, cluster: Optional[str] = None) -> None:
+        """Called by the batched solve dispatcher once per coalesced device
+        dispatch, on the dispatcher thread (ISSUE 14). ``crash`` raises
+        :class:`InjectedSolverCrash` into THAT batch only — every job in it
+        degrades per-job (whatif rows re-run solo, plans fall back through
+        their own crash handling) while other batches, other clusters and
+        the dispatcher thread itself survive. ``stall`` sleeps ``arg``
+        seconds (default 0.05) before the dispatch — the stall shows up as
+        queue wait (``daemon.solve.queue_ms``) and watchdog overrun,
+        never a hang."""
+        ev = self._next("dispatch", cluster)
+        if ev is None:
+            return
+        if ev.kind == "crash":
+            self._fire(ev)
+            raise InjectedSolverCrash(
+                "injected fault: coalesced solve dispatch crashed mid-batch"
+            )
+        if ev.kind == "stall":
+            self._fire(ev)
+            time.sleep(ev.arg if ev.arg is not None else 0.05)
+
     def daemon_solve(self, cluster: Optional[str] = None) -> None:
         """Called at the daemon's per-request solve dispatch boundary;
         ``solver-crash`` raises :class:`InjectedSolverCrash` — the request
@@ -601,3 +636,5 @@ def fault_point(scope: str, cluster: Optional[str] = None) -> None:
         inj.resync_attempt(cluster)
     elif scope == "daemon":
         inj.daemon_solve(cluster)
+    elif scope == "dispatch":
+        inj.dispatch_attempt(cluster)

@@ -30,7 +30,6 @@ def _compile(compiler_cmd: list, lib_path: str) -> None:
     # the loser of the race just overwrites with identical bits.
     tmp = f"{lib_path}.tmp.{os.getpid()}"
     try:
-        # kalint: disable=KA015,KA019 -- first-use lazy build, once per process and 120s-capped: the daemon chain _handle_admitted[solve-lock, gate-admitted] -> _run_whatif -> print_decommission_ranking -> evaluate_removal_scenarios -> encode_topic_group -> _hostcodec -> load_hostcodec -> _compile only fires when the .so is missing AND the hostcodec knob is on; every warm request takes the dlopen-cached path — the one-time stall is acceptable to BOTH the solve lock (KA015) and the admission slot (KA019) because it replaces an unconditionally slower first solve
         proc = subprocess.run(
             compiler_cmd + ["-o", tmp], capture_output=True, text=True,
             timeout=120,
@@ -51,9 +50,29 @@ def _build() -> None:
     )
 
 
+def build_native_library() -> bool:
+    """Compile the greedy-oracle library when missing or stale — the only
+    place its compiler subprocess runs (ISSUE 14; the same build/load
+    split as the hostcodec below, for the same reason: the lazy first-use
+    build was reachable from the daemon's solve queue through the ingest
+    warm-up's leadership-backend resolution). Returns True when a fresh
+    compile happened. Raises NativeBuildError when the toolchain is
+    missing."""
+    with _lock:
+        if (
+            os.path.exists(_LIB)
+            and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
+        ):
+            return False
+        _build()
+        return True
+
+
 def load_native_library() -> ctypes.CDLL:
-    """Compile (if stale) and load the greedy oracle; raises NativeBuildError
-    when the toolchain is missing."""
+    """Load the ALREADY-BUILT greedy oracle; raises NativeBuildError when
+    the library is missing/stale (build at a process startup site via
+    :func:`build_native_library` / :func:`prebuild_native_libraries` —
+    the solve path never compiles) or the toolchain never produced one."""
     global _cached
     with _lock:
         if _cached is not None:
@@ -62,7 +81,11 @@ def load_native_library() -> ctypes.CDLL:
             not os.path.exists(_LIB)
             or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
         ):
-            _build()
+            raise NativeBuildError(
+                "native greedy library not built; call "
+                "native.build.build_native_library() at process startup "
+                "(the solve path never compiles)"
+            )
         lib = ctypes.CDLL(_LIB)
         fn = lib.ka_solve_topic
         fn.restype = ctypes.c_int32
@@ -115,37 +138,102 @@ def load_native_library() -> ctypes.CDLL:
         return lib
 
 
+def build_hostcodec() -> bool:
+    """Compile the ``ka_hostcodec`` extension when missing or stale — the
+    ONLY place the codec's compiler subprocess runs (ISSUE 14). Callers are
+    process STARTUP sites (``cli.run_tool``, the daemon's startup pre-warm,
+    tests/bench harnesses), never the request path: :func:`load_hostcodec`
+    below is dlopen-only, so no compiler can stall a request that holds the
+    daemon's solve queue or an admitted inflight slot (the re-audited
+    KA015/KA019 chain — the old first-use lazy build under the lock carried
+    a reasoned suppression; this split deletes the reachability instead).
+    Returns True when a fresh compile happened, False when the on-disk
+    library was already current. Raises NativeBuildError when the toolchain
+    or Python headers are missing; a successful build clears any cached
+    load failure so later :func:`load_hostcodec` calls see the new
+    library."""
+    global _codec_cached
+    with _lock:
+        if (
+            os.path.exists(_CODEC_LIB)
+            and os.path.getmtime(_CODEC_LIB) >= os.path.getmtime(_CODEC_SRC)
+        ):
+            return False
+        import sysconfig
+
+        inc = sysconfig.get_paths().get("include")
+        if not inc or not os.path.exists(os.path.join(inc, "Python.h")):
+            raise NativeBuildError("Python.h not found; cannot build codec")
+        _compile(
+            ["gcc", "-O2", "-shared", "-fPIC", f"-I{inc}", _CODEC_SRC],
+            _CODEC_LIB,
+        )
+        if isinstance(_codec_cached, NativeBuildError):
+            _codec_cached = None
+        return True
+
+
+def prebuild_native_libraries(err=None) -> bool:
+    """The best-effort startup build of BOTH native artifacts — the greedy
+    oracle and (honoring ``KA_HOSTCODEC``) the boundary codec. The load
+    paths above are dlopen-only by design (ISSUE 14): no compiler may run
+    under the daemon's solve queue or an admitted inflight slot, so every
+    process that wants the native fast paths compiles them HERE, at its
+    entry point (``cli.py`` run_* functions, the daemon's startup
+    pre-warm). Failures degrade exactly like the pre-split lazy builds
+    did: the greedy library falls back to the device leadership scan /
+    python oracle silently (``auto`` semantics — an absent toolchain is an
+    expected environment, not an error), the codec warns once and falls
+    back to the numpy paths, byte-identically. Returns whether the codec
+    is usable."""
+    import sys
+
+    from ..utils.env import env_bool
+
+    try:
+        build_native_library()
+    except Exception:  # kalint: disable=KA008 -- toolchain-less boxes are expected; leadership_backend() resolves `auto` to the device scan and the python oracle stands in for the C solver, both loudly typed at their own call sites
+        pass
+    if not env_bool("KA_HOSTCODEC"):
+        return False
+    try:
+        build_hostcodec()
+        return True
+    except Exception as e:
+        print(
+            f"kafka-assigner: hostcodec unavailable ({e}); using the "
+            "numpy boundary codec",
+            file=err if err is not None else sys.stderr,
+        )
+        return False
+
+
 def load_hostcodec():
-    """Compile (if stale) and import the ``ka_hostcodec`` CPython extension —
-    the dict<->tensor boundary codec (``hostcodec.c``). Raises
-    NativeBuildError when the toolchain or Python headers are missing;
-    callers fall back to the numpy path (``KA_HOSTCODEC=0`` forces that).
-    Failures are cached: the codec sits on every solve's encode AND decode,
-    so a broken toolchain must cost one compile attempt, not one per call."""
+    """Import the ALREADY-BUILT ``ka_hostcodec`` CPython extension — the
+    dict<->tensor boundary codec (``hostcodec.c``). Load-only by design:
+    a missing or stale library raises NativeBuildError WITHOUT caching the
+    failure (a later :func:`build_hostcodec` must unblock this process),
+    and callers fall back to the numpy path (``KA_HOSTCODEC=0`` forces
+    that). Unusable-library failures (bad symbols, broken .so) ARE cached:
+    the codec sits on every solve's encode AND decode, so a broken build
+    must cost one load attempt, not one per call."""
     global _codec_cached
     with _lock:
         if isinstance(_codec_cached, NativeBuildError):
             raise _codec_cached
         if _codec_cached is not None:
             return _codec_cached
+        if (
+            not os.path.exists(_CODEC_LIB)
+            or os.path.getmtime(_CODEC_LIB) < os.path.getmtime(_CODEC_SRC)
+        ):
+            # Deliberately NOT cached — "not built yet" is a transient
+            # state the startup pre-warm resolves, not a broken codec.
+            raise NativeBuildError(
+                "hostcodec not built; call native.build.build_hostcodec() "
+                "at process startup (the request path never compiles)"
+            )
         try:
-            if (
-                not os.path.exists(_CODEC_LIB)
-                or os.path.getmtime(_CODEC_LIB) < os.path.getmtime(_CODEC_SRC)
-            ):
-                import sysconfig
-
-                inc = sysconfig.get_paths().get("include")
-                if not inc or not os.path.exists(
-                    os.path.join(inc, "Python.h")
-                ):
-                    raise NativeBuildError(
-                        "Python.h not found; cannot build codec"
-                    )
-                _compile(
-                    ["gcc", "-O2", "-shared", "-fPIC", f"-I{inc}", _CODEC_SRC],
-                    _CODEC_LIB,
-                )
             import importlib.machinery
             import importlib.util
 
@@ -155,9 +243,6 @@ def load_hostcodec():
             spec = importlib.util.spec_from_loader("ka_hostcodec", loader)
             mod = importlib.util.module_from_spec(spec)
             loader.exec_module(mod)
-        except NativeBuildError as e:
-            _codec_cached = e
-            raise
         except Exception as e:  # ImportError (missing symbol), OSError, ...
             _codec_cached = NativeBuildError(f"codec unusable: {e}")
             raise _codec_cached from e

@@ -36,6 +36,10 @@ profile    ``seconds``, ``dir``: a /debug/profile window capture
 recommendation ``verdict``, ``moves``, ``improvement``, ``request_id``:
            one observe-mode /recommendations evaluation (ISSUE 11) —
            the audit trail proving advice was computed, never executed
+dispatch   ``entry``, ``jobs``, ``coalesced`` (+ ``rows``, ``ok``,
+           ``ms`` for device batches): one batched-dispatcher execution —
+           a coalesced device dispatch or a deduped body family
+           (ISSUE 14)
 ========== ===========================================================
 
 Activation model, same as the rest of ``obs/``: nothing records until

@@ -104,6 +104,12 @@ METRIC_NAMES: frozenset = frozenset({
     "groups.plans", "groups.sweeps", "groups.moves",
     "groups.candidates", "groups.dispatches", "groups.fanout",
     "groups.solve_fallbacks", "groups.refusals", "groups.sweep_ms",
+    # dispatch.* — the request-coalescing batched solve dispatcher
+    # (ISSUE 14): coalesced device dispatches, jobs routed through the
+    # queue, jobs that degraded to the solo path, the per-batch job count
+    # and the queue wait (separated from solve time by construction)
+    "dispatch.batches", "dispatch.jobs", "dispatch.solo_fallbacks",
+    "dispatch.batch_size", "daemon.solve.queue_ms",
 })
 
 #: Span names (``span(...)`` / ``record_span(...)`` first argument).
@@ -121,6 +127,9 @@ SPAN_NAMES: frozenset = frozenset({
     "exec/wave", "exec/submit", "exec/poll", "exec/verify",
     "daemon/request", "daemon/resync", "daemon/recommend",
     "groups/plan", "groups/sweep", "groups/dispatch", "daemon/groups",
+    # one span per coalesced device solve the batched dispatcher runs
+    # (ISSUE 14; recorded on the dispatcher thread — cumulative-only)
+    "dispatch",
 })
 
 #: Both namespaces — what the supervisor's ``_metric`` wrapper may label.
@@ -186,6 +195,10 @@ UNITLESS_METRICS: frozenset = frozenset({
     "groups.plans", "groups.sweeps", "groups.moves",
     "groups.candidates", "groups.dispatches", "groups.fanout",
     "groups.solve_fallbacks", "groups.refusals",
+    # dispatch.* job/batch counts (dimensionless); batch_size is a
+    # histogram of jobs-per-coalesced-dispatch
+    "dispatch.batches", "dispatch.jobs", "dispatch.solo_fallbacks",
+    "dispatch.batch_size",
     # grandfathered: unit (bytes) lives mid-name, predates KA014; renaming
     # the scrape family would orphan existing dashboards
     "zk.wire_bytes_in", "zk.wire_bytes_out",

@@ -72,6 +72,66 @@ def _sweep_program(name: str):
         return jit_fn
 
 
+def _active_dispatch_broker():
+    """The daemon's coalescing SolveDispatcher, when the calling thread is
+    a daemon request thread running under ``dispatch_scope`` (ISSUE 14).
+    None everywhere else — the one-shot CLI, the ``KA_DISPATCH=0`` lock
+    path, and library embedders keep their direct dispatch, byte-for-byte.
+    Imported lazily: ``parallel/`` must not depend on ``daemon/`` at
+    import time."""
+    try:
+        from ..daemon.dispatch import active_broker
+    except Exception:  # pragma: no cover - packaging subset without daemon/
+        return None
+    return active_broker()
+
+
+def _submit_coalesced(entry, shared, statics, rows, n_rows, pad, call,
+                      cluster=None):
+    """Route batch-axis rows through the installed dispatcher, with
+    per-job failure isolation: a mid-batch solver crash (another request's
+    rows may share the batch) retries THIS job's rows solo ONCE on the
+    calling thread; a second failure propagates to the caller's own
+    degradation path (greedy fallback / policy handling). ``shared`` +
+    ``statics`` fingerprint the compatibility class (the non-batch-axis
+    operands every packed job must agree on — content-hashed, so two
+    CLUSTERS whose encodings agree pack together); neither is hashed when
+    no dispatcher is routing. Returns the sliced output arrays, or None
+    when no dispatcher is routing (caller runs its direct path)."""
+    import sys
+
+    broker = _active_dispatch_broker()
+    if broker is None:
+        return None
+    from ..daemon.dispatch import batch_key
+
+    key = batch_key(entry, shared, statics)
+    try:
+        res = broker.submit_rows(
+            entry, key, rows, n_rows, pad, call, cluster=cluster
+        )
+    except Exception as e:
+        counter_add("dispatch.solo_fallbacks")
+        print(
+            f"kafka-assigner: coalesced {entry} batch failed "
+            f"({type(e).__name__}: {e}); re-running this request's "
+            f"{n_rows} row(s) solo",
+            file=sys.stderr,
+        )
+        total = batch_bucket(n_rows)
+        if total > n_rows:
+            pad_rows = pad(total - n_rows)
+            padded = {
+                name: np.concatenate([rows[name], pad_rows[name]], axis=0)
+                for name in rows
+            }
+        else:
+            padded = rows
+        outs = call(padded)
+        return tuple(np.asarray(a)[:n_rows] for a in outs)
+    return res
+
+
 def _topic_rfs(items, replication_factor):
     """Per-topic RF: the desired override, else inferred from each topic's
     own replica lists (clusters routinely mix RFs) with the assigner's
@@ -258,16 +318,50 @@ def _evaluate_incremental(
         )
         moved_s, infeas_s, loads_s = map(np.asarray, fetch_global(outs))
     else:
-        moved_s, infeas_s, loads_s = map(
-            np.asarray,
-            jax.device_get(
-                whatif_subset_sweep_jit(
-                    jnp.asarray(sc), jnp.asarray(cluster.rack_idx),
-                    jnp.asarray(sj), jnp.asarray(sp), jnp.asarray(alive),
-                    n=n, rf=rf, rfs=jnp.asarray(srf), r_cap=r_cap,
+        # The incremental sweep's operands are almost all PER-SCENARIO
+        # (subset tensors, jhashes, p counts, per-row RFs, alive masks) —
+        # only the rack encoding and the static bucket shapes are shared,
+        # so concurrent requests whose buckets agree coalesce into one
+        # subset dispatch even ACROSS clusters (ISSUE 14).
+        def _subset_rows(rows):
+            return tuple(
+                np.asarray(a) for a in jax.device_get(
+                    whatif_subset_sweep_jit(
+                        jnp.asarray(rows["sc"]),
+                        jnp.asarray(cluster.rack_idx),
+                        jnp.asarray(rows["sj"]), jnp.asarray(rows["sp"]),
+                        jnp.asarray(rows["alive"]),
+                        n=n, rf=rf, rfs=jnp.asarray(rows["srf"]),
+                        r_cap=r_cap,
+                    )
                 )
-            ),
+            )
+
+        def _subset_pad(k):
+            block = np.zeros((k, alive.shape[1]), dtype=bool)
+            block[:, :n] = True
+            return {
+                "sc": np.full((k, t_pad, p_pad, w), -1, dtype=np.int32),
+                "sj": np.zeros((k, t_pad), dtype=np.int32),
+                "sp": np.zeros((k, t_pad), dtype=np.int32),
+                "srf": np.full((k, t_pad), rf, dtype=np.int32),
+                "alive": block,
+            }
+
+        routed = _submit_coalesced(
+            "whatif_subset_sweep",
+            (cluster.rack_idx,),
+            ("subset", n, rf, r_cap, t_pad, p_pad, w, alive.shape[1]),
+            {"sc": sc[:s_real], "sj": sj[:s_real], "sp": sp[:s_real],
+             "srf": srf[:s_real], "alive": np.array(alive[:s_real])},
+            s_real, _subset_pad, _subset_rows,
         )
+        if routed is not None:
+            moved_s, infeas_s, loads_s = routed
+        else:
+            moved_s, infeas_s, loads_s = _subset_rows(
+                {"sc": sc, "sj": sj, "sp": sp, "srf": srf, "alive": alive}
+            )
     moved = np.zeros(s_real, dtype=np.int64)
     infeasible = np.zeros(s_real, dtype=bool)
     load_vec = np.repeat(base_load[None, :], s_real, axis=0)
@@ -314,6 +408,17 @@ def evaluate_removal_scenarios(
     from jax.sharding import PartitionSpec
 
     whatif_sweep_jit = _sweep_program("whatif_sweep")
+
+    if mesh is not None and _active_dispatch_broker() is not None:
+        # Daemon request thread under the coalescing dispatcher
+        # (ISSUE 14): run unsharded. Mesh-sharded dispatches bypass the
+        # persistent program store (sharding-specific executables) and
+        # cannot pack rows across requests — on the serving plane the
+        # parallelism axis is request concurrency through the bucketed,
+        # store-warm programs, not intra-request sharding. The one-shot
+        # CLI (no dispatcher) keeps its auto-mesh; sharded == unsharded is
+        # test-pinned either way.
+        mesh = None
 
     all_items = list(topic_assignments.items())
     all_rfs = _topic_rfs(all_items, replication_factor)
@@ -416,7 +521,47 @@ def evaluate_removal_scenarios(
                 ),
             )
 
-    if s_pad <= s_chunk:
+    routed = None
+    if mesh is None and s_pad <= s_chunk:
+        # The coalescing route (ISSUE 14): only the scenario masks are
+        # per-request; the topic tensors and statics are the compatibility
+        # class, so concurrent rankings over byte-identical encodings —
+        # same cluster, or different clusters whose caches agree — pack
+        # into one dispatch on the same bucketed batch programs the store
+        # already holds.
+        def _dense_rows(rows):
+            with span("whatif/dispatch", hist="whatif.dispatch_ms"):
+                return tuple(
+                    np.array(a) for a in jax.device_get(
+                        whatif_sweep_jit(
+                            jnp.asarray(currents),
+                            jnp.asarray(enc0.rack_idx),
+                            jnp.asarray(jhashes),
+                            jnp.asarray(p_reals),
+                            jnp.asarray(rows["alive"]),
+                            n=enc0.n,
+                            rf=rf,
+                            rfs=jnp.asarray(rfs),
+                            r_cap=enc0.r_cap,
+                        )
+                    )
+                )
+
+        def _dense_pad(k):
+            block = np.zeros((k, enc0.n_pad), dtype=bool)
+            block[:, :enc0.n] = True
+            return {"alive": block}
+
+        routed = _submit_coalesced(
+            "whatif_sweep",
+            (currents, enc0.rack_idx, jhashes, p_reals, rfs),
+            ("dense", enc0.n, rf, enc0.r_cap),
+            {"alive": np.array(alive[:s_real])}, s_real,
+            _dense_pad, _dense_rows,
+        )
+    if routed is not None:
+        moved, infeasible, max_load = routed
+    elif s_pad <= s_chunk:
         moved, infeasible, max_load = sweep_block(alive)
     else:
         # Fixed-size blocks (last one padded all-alive) so every dispatch
@@ -513,29 +658,57 @@ def evaluate_group_candidates(
 
     s_real = len(alive_masks)
     s_pad = batch_bucket(s_real)
+
+    counter_add("groups.candidates", s_real)
+    fault_point("solve")
+
+    def _sweep_rows(rows):
+        counter_add("groups.dispatches")
+        gauge_set("groups.fanout", int(len(rows["alive"])))
+        with span("groups/dispatch", hist="whatif.dispatch_ms"):
+            moved, overflowed, infeasible, load = jax.device_get(
+                group_sweep_jit(
+                    jnp.asarray(weights), jnp.asarray(capacities),
+                    jnp.asarray(current), jnp.asarray(proc_order),
+                    jnp.asarray(rows["alive"]), jnp.asarray(rows["scales"]),
+                    jnp.int32(p_real),
+                )
+            )
+        return (
+            np.asarray(moved), np.asarray(overflowed),
+            np.asarray(infeasible), np.asarray(load),
+        )
+
+    def _sweep_pad(k):
+        return {
+            "alive": np.zeros((k, alive_masks.shape[1]), dtype=bool),
+            "scales": np.full(k, 100, dtype=np.int32),
+        }
+
+    # Candidate rows coalesce across concurrent requests whose group
+    # tensors agree (ISSUE 14) — the padded batch stays on the power-of-two
+    # bucket either way, so the program store serves both routes from the
+    # same handful of programs.
+    routed = _submit_coalesced(
+        "group_sweep",
+        (weights, capacities, current, proc_order),
+        ("group", int(p_real), int(alive_masks.shape[1])),
+        {"alive": np.asarray(alive_masks, dtype=bool),
+         "scales": np.asarray(scale_pcts, dtype=np.int32)},
+        s_real, _sweep_pad, _sweep_rows,
+    )
+    if routed is not None:
+        return routed
     alive = np.zeros((s_pad, alive_masks.shape[1]), dtype=bool)
     alive[:s_real] = alive_masks
     scales = np.full(s_pad, 100, dtype=np.int32)
     scales[:s_real] = np.asarray(scale_pcts, dtype=np.int32)
-
-    counter_add("groups.candidates", s_real)
-    counter_add("groups.dispatches")
-    gauge_set("groups.fanout", int(s_pad))
-    fault_point("solve")
-    with span("groups/dispatch", hist="whatif.dispatch_ms"):
-        moved, overflowed, infeasible, load = jax.device_get(
-            group_sweep_jit(
-                jnp.asarray(weights), jnp.asarray(capacities),
-                jnp.asarray(current), jnp.asarray(proc_order),
-                jnp.asarray(alive), jnp.asarray(scales),
-                jnp.int32(p_real),
-            )
-        )
+    moved, overflowed, infeasible, load = _sweep_rows(
+        {"alive": alive, "scales": scales}
+    )
     return (
-        np.asarray(moved)[:s_real],
-        np.asarray(overflowed)[:s_real],
-        np.asarray(infeasible)[:s_real],
-        np.asarray(load)[:s_real],
+        moved[:s_real], overflowed[:s_real],
+        infeasible[:s_real], load[:s_real],
     )
 
 

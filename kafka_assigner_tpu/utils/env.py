@@ -404,6 +404,32 @@ _knob(
         "(or run on a watchless backend) to fall back to interval-only "
         "full resync — identical responses, more metadata I/O",
 )
+_knob(
+    "KA_DISPATCH", "bool", True,
+    doc="request-coalescing batched solve dispatch (`daemon/dispatch.py`): "
+        "concurrent solve-bearing requests queue into a gather window and "
+        "compatible device work (what-if scenario rows, group autoscale "
+        "rows, identical plan solves) packs into ONE batched dispatch "
+        "padded to the existing power-of-two bucket shapes. Set to 0 to "
+        "restore the PR 8-13 shared solve lock byte-for-byte (the "
+        "kill-switch; per-request output is identical either way, "
+        "test-pinned). Read once at daemon startup",
+)
+_knob(
+    "KA_DISPATCH_WINDOW_MS", "float", 3.0, floor=0.0,
+    doc="gather window of the batched solve dispatcher: after the first "
+        "queued job the dispatcher waits up to this many milliseconds for "
+        "more jobs to coalesce before dispatching. 0 disables gathering "
+        "(every job dispatches immediately, still serialized through the "
+        "dispatcher thread). Read live per gather cycle",
+)
+_knob(
+    "KA_DISPATCH_MAX_BATCH", "int", 64, floor=1,
+    doc="size trigger of the batched solve dispatcher: once this many jobs "
+        "are queued the gather window closes immediately — bounds both the "
+        "coalesced batch width and the latency a storm can add to the "
+        "first queued request. Read live per gather cycle",
+)
 
 # --- consumer-group workload family (ka-groups / daemon /groups/*) ----------
 _knob(

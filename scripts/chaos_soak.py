@@ -853,6 +853,145 @@ def soak_daemon_matrix(args, report_dir):
 
 
 # ---------------------------------------------------------------------------
+# The batched-dispatch matrix (ISSUE 14): the request-coalescing solve
+# dispatcher under one deterministic fault per class, both policies. The
+# acceptance invariants per row: a mid-batch fault degrades ONLY that
+# batch's jobs — and each of those per-job (crash → solo retry, so every
+# response is STILL 200 and byte-identical to the solo baseline; stall →
+# queue wait, never divergence) — zero hangs, and the daemon keeps serving
+# coalesced requests afterwards (the dispatcher thread survives).
+# ---------------------------------------------------------------------------
+
+DISPATCH_MATRIX = [
+    ("dispatch-crash", "dispatch:0=crash"),
+    ("dispatch-stall", "dispatch:0=stall"),
+]
+
+
+def _whatif_baseline(port, timeout_s):
+    """Fault-free RANK_DECOMMISSION stdout — the dispatch rows' byte
+    oracle (the coalesced responses must carry exactly these bytes)."""
+    set_schedule({})
+    argv = [
+        "--zk_string", f"127.0.0.1:{port}",
+        "--mode", "RANK_DECOMMISSION", "--solver", "greedy",
+    ]
+    res = _watchdog_cli_run(lambda: run(argv), timeout_s)
+    if res.hung or res.rc != EXIT_OK:
+        raise SystemExit(
+            f"FAIL: no-fault whatif baseline broken (rc={res.rc} "
+            f"hung={res.hung})\n{res.err}"
+        )
+    return res.out
+
+
+def soak_dispatch_matrix(args, report_dir):
+    from kafka_assigner_tpu.daemon import AssignerDaemon
+
+    failures = []
+    for name, spec in DISPATCH_MATRIX:
+        for policy in ("strict", "best-effort"):
+            tag = f"dispatch[{name}/{policy}]"
+            sa = JuteZkServer(cluster_tree())
+            sa.start()
+            sb = JuteZkServer(cluster_tree())
+            sb.start()
+            daemon = None
+            t0 = time.perf_counter()
+            try:
+                # Identical trees: the two clusters' encodings agree, so
+                # their rows share a compatibility class and the injected
+                # fault provably lands on a COALESCED, cross-cluster batch.
+                base = _whatif_baseline(sa.port, args.timeout)
+                env = dict(DAEMON_ENV)
+                env["KA_DISPATCH_WINDOW_MS"] = "250"
+                set_schedule(env, spec=spec)
+                daemon = AssignerDaemon(
+                    clusters={
+                        "a": f"127.0.0.1:{sa.port}",
+                        "b": f"127.0.0.1:{sb.port}",
+                    },
+                    solver="greedy", failure_policy=policy,
+                )
+                daemon.start()
+                port = daemon.http_port
+                row_fail = None
+                barrier = threading.Barrier(4)
+                results = {}
+
+                def one(i, cluster):
+                    try:
+                        barrier.wait(timeout=30)
+                        results[i] = _daemon_post(
+                            port, args.timeout,
+                            path=f"/clusters/{cluster}/whatif",
+                        )
+                    except Exception as e:  # kalint: disable=KA008 -- the row reports the failure below
+                        results[i] = ("exc", e)
+
+                threads = [
+                    threading.Thread(target=one, args=(i, c))
+                    for i, c in enumerate(("a", "a", "b", "b"))
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=args.timeout)
+                if any(t.is_alive() for t in threads):
+                    row_fail = "request HUNG under a dispatch fault"
+                for i, res in sorted(results.items()):
+                    if row_fail:
+                        break
+                    if res[0] == "exc":
+                        row_fail = f"request {i} raised {res[1]!r}"
+                    elif res[0] != 200:
+                        row_fail = f"request {i} http {res[0]}"
+                    elif res[1]["result"]["stdout"] != base:
+                        row_fail = (
+                            f"request {i} diverged from the solo baseline "
+                            "(a dispatch fault may cost retries, never "
+                            "bytes)"
+                        )
+                inj = faults.active_injector()
+                if row_fail is None and (
+                    inj is None or [str(e) for e in inj.fired] != [spec]
+                ):
+                    row_fail = (
+                        f"fault never fired (fired="
+                        f"{[str(e) for e in inj.fired] if inj else None})"
+                    )
+                if row_fail is None:
+                    # The dispatcher thread must have survived: a later
+                    # coalesced request on each cluster still serves.
+                    for cluster in ("a", "b"):
+                        status, body = _daemon_post(
+                            port, args.timeout,
+                            path=f"/clusters/{cluster}/whatif",
+                        )
+                        if status != 200 \
+                                or body["result"]["stdout"] != base:
+                            row_fail = (
+                                f"post-fault request on {cluster} broken "
+                                f"(http {status})"
+                            )
+                            break
+                if row_fail:
+                    failures.append(f"{tag}: {row_fail}")
+                else:
+                    print(
+                        f"chaos_soak: {tag}: ok "
+                        f"({time.perf_counter() - t0:.2f}s)",
+                        file=sys.stderr,
+                    )
+            finally:
+                if daemon is not None:
+                    daemon.shutdown()
+                sa.shutdown()
+                sb.shutdown()
+    return failures
+
+
+# ---------------------------------------------------------------------------
 # The multi-cluster matrix (ISSUE 9): per-cluster supervisors under
 # cluster-addressed faults. Three rows x both policies:
 #   bulkhead       session:expire@a + resync:stall@a while hammering
@@ -1274,6 +1413,7 @@ def main(argv=None):
                 failures += soak_exec_matrix(args, report_dir)
                 failures += soak_daemon_matrix(args, report_dir)
                 failures += soak_multicluster_matrix(args, report_dir)
+                failures += soak_dispatch_matrix(args, report_dir)
             else:
                 failures = soak_random(args, report_dir)
     finally:

@@ -89,6 +89,12 @@ python scripts/health_smoke.py
 # /metrics scraping parse-consistent groups.* series, refusal + synthetic
 # marking correct, SIGTERM exit 0.
 python scripts/groups_smoke.py
+# Batched-dispatch smoke (ISSUE 14): real two-cluster ka-daemon — 8
+# concurrent /plan+/whatif clients byte-identical to solo baselines,
+# dispatch.batches >= 1 (cross-cluster packing), zero warm recompiles
+# across a coalesced round (compile counters pinned), /metrics
+# parse-consistent, KA_DISPATCH=0 kill-switch parity, SIGTERM exit 0.
+python scripts/dispatch_smoke.py
 # Warm-start smoke (ISSUE 6): program store populate -> clear-memory -> hit
 # on the CPU backend, byte-identical output, compile.store.hits >= 1. The
 # fresh-process bench is the slow-marked tests/test_bench_warmstart.py.

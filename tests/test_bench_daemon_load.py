@@ -1,0 +1,34 @@
+"""Slow-marked wrapper around ``scripts/bench_daemon_load.py`` (ISSUE 14
+acceptance): a real ``ka-daemon`` subprocess driven at concurrency
+{1, 8, 64} under the batched dispatcher AND the ``KA_DISPATCH=0`` lock —
+the script itself asserts batched solve-bound p99@64 <= 3x the
+single-client p99 (measured from the daemon's own /metrics histograms)
+and byte-identity of every response against fresh-process solo baselines.
+Kept out of tier-1 (the lock-mode comparison point alone queues ~64 full
+solves); the fast coalescing cycle is the tier-1
+``scripts/dispatch_smoke.py`` lint-gate smoke."""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_daemon_load(tmp_path):
+    out = tmp_path / "BENCH_daemon_load.json"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "bench_daemon_load.py"),
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    report = json.loads(out.read_text())
+    assert report["headline"]["pass"] is True
+    assert report["headline"]["batched_ratio_64_vs_1"] <= 3.0

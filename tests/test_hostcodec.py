@@ -16,8 +16,12 @@ from kafka_assigner_tpu.models.problem import (
 )
 
 try:
-    from kafka_assigner_tpu.native.build import load_hostcodec
+    from kafka_assigner_tpu.native.build import build_hostcodec, load_hostcodec
 
+    # The load path is dlopen-only since ISSUE 14 (no compiler may run
+    # under the daemon's solve queue); tests are a startup site, so build
+    # explicitly first — the same split the CLI/daemon entry points use.
+    build_hostcodec()
     load_hostcodec()
     HAVE_CODEC = True
 except Exception:  # toolchain-less environment: numpy path only

@@ -18,8 +18,13 @@ from kafka_assigner_tpu.ops.assignment import leadership_order, order_batched
 try:
     from kafka_assigner_tpu.native.leadership import order_many
 
-    from kafka_assigner_tpu.native.build import load_native_library
+    from kafka_assigner_tpu.native.build import (
+        build_native_library,
+        load_native_library,
+    )
 
+    # Load-only since ISSUE 14: tests are a startup site, so build first.
+    build_native_library()
     load_native_library()
     HAVE_NATIVE = True
 except Exception:
