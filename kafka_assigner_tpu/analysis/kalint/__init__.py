@@ -83,6 +83,8 @@ from .rules import (  # noqa: F401
     WIRE_MODULE,
     WRITE_OPCODES,
     ZK_WRITE_FUNC_NAMES,
+    BUDGET_KNOB,
+    check_blocking_budget,
     check_dead_knobs,
     check_metric_units,
     check_readme,

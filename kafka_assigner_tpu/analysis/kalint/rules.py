@@ -51,6 +51,9 @@ RULES = {
              "through an accessor anywhere in the project",
     "KA019": "blocking call reachable while a supervisor's inflight-gate "
              "admission is held",
+    "KA020": "blocking-call budget: a chain under the solve lock or an "
+             "inflight-gate admission whose worst-case timeout/retry "
+             "envelope exceeds KA_DAEMON_REQUEST_TIMEOUT",
 }
 
 #: One-line meaning + example offending chain per rule — the source of the
@@ -196,6 +199,19 @@ RULE_DOCS: Dict[str, Tuple[str, str]] = {
         "the cluster's bounded backpressure slots until `_release()`, so "
         "a blocked holder starves the gate and sheds healthy clients",
         "`handle` [after `_gate()`] → `helper()` → `time.sleep()`",
+    ),
+    "KA020": (
+        "blocking-call budget (KA015/KA019's quantitative twin): along "
+        "any chain reachable under the shared solve lock or an "
+        "inflight-gate admission, the summed worst-case wall clock of "
+        "the `KA_*` deadline knobs the chain consults — each function's "
+        "TIMEOUT knob defaults times (1 + its RETRIES knob default), "
+        "`*_MS` names read as milliseconds — must not exceed the "
+        "`KA_DAEMON_REQUEST_TIMEOUT` watchdog budget: a chain that can "
+        "legally block longer than the watchdog's patience turns every "
+        "overrun into a flagged-but-unfixable alert",
+        "`handle` [after `_gate()`] → `poll_loop()` consulting "
+        "`KA_EXEC_POLL_TIMEOUT` (600 s > 30 s budget)",
     ),
 }
 
@@ -1130,6 +1146,136 @@ def _blocking_sink_desc(node: ast.Call) -> Optional[str]:
     return None
 
 
+#: KA020 knob-name classification tokens.
+_BUDGET_TIMEOUT_TOKEN = "TIMEOUT"
+_BUDGET_RETRIES_TOKEN = "RETRIES"
+#: The watchdog-budget knob KA020 compares chain envelopes against.
+BUDGET_KNOB = "KA_DAEMON_REQUEST_TIMEOUT"
+
+
+def _knob_seconds(name: str, value) -> Optional[float]:
+    """A knob default as seconds (``*_MS`` names are milliseconds); None
+    when the default is not a priceable number."""
+    if not isinstance(value, (int, float)) or isinstance(value, bool):
+        return None
+    v = float(value)
+    return v / 1000.0 if name.endswith("_MS") else v
+
+
+def _fn_budget_envelope(fn_node: ast.AST,
+                        defaults) -> Tuple[float, List[str]]:
+    """One function's worst-case blocking envelope from the deadline
+    knobs IT consults: sum of its TIMEOUT knob defaults (seconds) times
+    ``1 + max(RETRIES defaults)`` when it also consults a retries knob —
+    the shape every retry loop in the tree has (each retry re-arms the
+    timeout). Returns ``(seconds, [knob names that contributed])``."""
+    timeouts: List[Tuple[str, float]] = []
+    retries: List[Tuple[str, float]] = []
+    for call in ast.walk(fn_node):
+        # Anchored on typed-accessor CALLS (env_float("KA_..."), the KA016
+        # pattern) — a knob name merely mentioned in a docstring or log
+        # message is documentation, not a deadline consult, and must not
+        # price into the envelope.
+        if not isinstance(call, ast.Call) or not call.args:
+            continue
+        if _call_terminal_name(call) not in KNOB_READ_NAMES:
+            continue
+        name = _knob_literal(call.args[0])
+        if name is None or name == BUDGET_KNOB:
+            continue
+        if _BUDGET_TIMEOUT_TOKEN in name:
+            secs = _knob_seconds(name, defaults.get(name))
+            if secs is not None:
+                timeouts.append((name, secs))
+        elif _BUDGET_RETRIES_TOKEN in name:
+            val = defaults.get(name)
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                retries.append((name, float(val)))
+    if not timeouts:
+        return 0.0, []
+    mult = 1.0 + max((v for _n, v in retries), default=0.0)
+    total = sum(v for _n, v in timeouts) * mult
+    names = sorted({n for n, _v in timeouts} | {n for n, _v in retries})
+    return total, names
+
+
+def check_blocking_budget(
+    project: Project,
+    display: Dict[str, str],
+    knob_defaults=None,
+    budget: Optional[float] = None,
+) -> List[Finding]:
+    """KA020: the quantitative twin of KA015/KA019 — for every function
+    reachable under the shared solve lock or an inflight-gate admission,
+    sum the worst-case envelopes of the functions along its reaching
+    chain; a total exceeding the ``KA_DAEMON_REQUEST_TIMEOUT`` budget is
+    a finding (anchored at the contributing function, chain attached).
+    One finding per chain function that itself contributes envelope —
+    pass-through hops stay silent so a deep chain reads as one finding
+    per deadline consult, not one per hop."""
+    from .taint import gate_held_set, lock_held_set
+
+    if knob_defaults is None:
+        from ...utils.env import KNOBS
+
+        knob_defaults = {name: k.default for name, k in KNOBS.items()}
+    if budget is None:
+        b = _knob_seconds(BUDGET_KNOB, knob_defaults.get(BUDGET_KNOB))
+        budget = b if b is not None else 30.0
+
+    env_cache: Dict[str, Tuple[float, List[str]]] = {}
+
+    def envelope(key: str) -> Tuple[float, List[str]]:
+        if key not in env_cache:
+            fn = project.functions.get(key)
+            env_cache[key] = (
+                _fn_budget_envelope(fn.node, knob_defaults)
+                if fn is not None else (0.0, [])
+            )
+        return env_cache[key]
+
+    out: List[Finding] = []
+    seen: Set[Tuple[str, str]] = set()
+    for held, where in (
+        (lock_held_set(project)[0], "the shared solve lock"),
+        (gate_held_set(project)[0], "an inflight-gate admission"),
+    ):
+        for key in sorted(held.members):
+            fn = project.functions.get(key)
+            if fn is None:
+                continue
+            own_secs, own_knobs = envelope(key)
+            if own_secs <= 0.0:
+                continue  # anchor findings where envelope is added
+            chain = held.chain(key)
+            total = 0.0
+            knobs: List[str] = []
+            for hop_key, _line in chain:
+                secs, names = envelope(hop_key)
+                total += secs
+                knobs.extend(names)
+            if total <= budget:
+                continue
+            dedupe = (where, key)
+            if dedupe in seen:
+                continue
+            seen.add(dedupe)
+            out.append(Finding(
+                "KA020", display.get(fn.relpath, fn.relpath),
+                fn.node.lineno, fn.node.col_offset + 1,
+                f"worst-case blocking envelope ~{total:g} s (deadline "
+                f"knobs along the chain: {', '.join(sorted(set(knobs)))}) "
+                f"reachable while {where} is held exceeds the "
+                f"{BUDGET_KNOB} watchdog budget ({budget:g} s): the "
+                "request can legally block longer than the watchdog's "
+                "patience — shrink the envelope, move the waiting off "
+                "the held region, or suppress citing why the bound is "
+                "unreachable",
+                chain=held.chain_strs(key),
+            ))
+    return out
+
+
 def project_findings(project: Project,
                      display: Dict[str, str]) -> List[Finding]:
     """Every graph-backed finding over one resolved project: the traced-set
@@ -1270,6 +1416,10 @@ def project_findings(project: Project,
         "solve-bearing requests — writes belong on the execute path, "
         "never under the solve lock",
     )
+    # KA020 rides the same two closures: the qualitative rules above kill
+    # unbounded blocking; the budget rule prices the BOUNDED kind.
+    out.extend(check_blocking_budget(project, display))
+
     gheld, gregions = gate_held_set(project)
     held_rule(
         "KA019", gheld, gregions,

@@ -508,7 +508,11 @@ def build_daemon_parser() -> argparse.ArgumentParser:
                         "strings may contain commas), e.g. "
                         "'west=zk1:2181,zk2:2181;east=file://east.json', "
                         "or a path to a JSON file mapping names to connect "
-                        "strings. One ClusterSupervisor per entry: own "
+                        "strings. Append '#controller=off|observe|auto' "
+                        "to an entry (or use the JSON object form "
+                        "{\"connect\": ..., \"controller\": ...}) to "
+                        "override the KA_CONTROLLER policy per cluster. "
+                        "One ClusterSupervisor per entry: own "
                         "session, watch loop, cache, inflight gate, "
                         "watchdog and circuit breaker — one sick quorum "
                         "never takes down planning for the others. "
@@ -541,8 +545,12 @@ def build_daemon_parser() -> argparse.ArgumentParser:
 
 def parse_clusters_spec(spec: str) -> dict:
     """Parse the ``--clusters`` value: a ``*.json``/``file://`` path to a
-    ``{name: connect}`` mapping, or inline semicolon-separated
-    ``name=connect`` pairs (connect strings keep their commas)."""
+    ``{name: connect}`` mapping — each value a connect string or an
+    object ``{"connect": ..., "controller": "off|observe|auto"}`` (the
+    per-cluster controller-policy override, ISSUE 15) — or inline
+    semicolon-separated ``name=connect`` pairs (connect strings keep
+    their commas; append ``#controller=<policy>`` per entry for the same
+    override)."""
     import json as json_mod
 
     # Inline entries always carry '='; a bare path never does (a connect
@@ -554,12 +562,13 @@ def parse_clusters_spec(spec: str) -> dict:
         with open(path, "r", encoding="utf-8") as f:
             raw = json_mod.load(f)
         if not isinstance(raw, dict) or not raw or not all(
-            isinstance(k, str) and isinstance(v, str)
+            isinstance(k, str) and isinstance(v, (str, dict))
             for k, v in raw.items()
         ):
             raise ValueError(
                 f"--clusters file {path!r} must be a non-empty JSON "
-                "object mapping cluster names to connect strings"
+                "object mapping cluster names to connect strings (or "
+                "{\"connect\": ..., \"controller\": ...} objects)"
             )
         return dict(raw)
     clusters = {}

@@ -5,9 +5,12 @@ See :mod:`.service` for the HTTP surface and routing, :mod:`.supervisor`
 for the per-cluster bulkhead (session, watch loop, lifecycle, circuit
 breaker, /execute single-flight), :mod:`.state` for the watch-maintained
 metadata cache + incremental group encode, :mod:`.dispatch` for the
-request-coalescing batched solve dispatcher (ISSUE 14). The console entry
-point is ``ka-daemon`` (``cli.daemon_main``).
+request-coalescing batched solve dispatcher (ISSUE 14), and
+:mod:`.controller` for the closed-loop autonomous rebalance controller
+(ISSUE 15). The console entry point is ``ka-daemon``
+(``cli.daemon_main``).
 """
+from .controller import RebalanceController
 from .dispatch import SolveDispatcher
 from .service import DEFAULT_CLUSTER, AssignerDaemon, run_daemon_process
 from .state import CacheBackend, DaemonState
@@ -20,6 +23,7 @@ __all__ = [
     "ClusterSupervisor",
     "DEFAULT_CLUSTER",
     "DaemonState",
+    "RebalanceController",
     "SolveDispatcher",
     "run_daemon_process",
 ]

@@ -1,0 +1,747 @@
+"""``RebalanceController`` — the auto-execute rung of the observe →
+recommend → auto-execute ladder (ISSUE 15 tentpole).
+
+PR 11 built the first two rungs: continuous ``ka_health_*`` scoring and the
+read-only ``/recommendations`` endpoint whose recommend/hold verdict is
+computed, flight-recorded, and never executed. This module closes the loop:
+one controller per cluster, owned by that cluster's
+:class:`~.supervisor.ClusterSupervisor`, that periodically re-runs the SAME
+recommendation pipeline and — only under the explicit ``KA_CONTROLLER=auto``
+opt-in (per-cluster override in the ``--clusters`` spec) — dispatches the
+recommended plan through the existing supervised single-flight ``/execute``
+machinery. Grounded in PAPERS.md: reconfiguration under an explicit safety
+envelope (arXiv:1602.03770) and verdict-gated actuation with hysteresis
+(the autoscaler control loop of arXiv:2402.06085).
+
+The safety rails, every one of them machine-visible in the decision trail:
+
+- **Policy ladder** (``off`` → ``observe`` → ``auto``): ``off`` starts no
+  thread; ``observe`` evaluates and records — including the ``would-act``
+  decision that proves what ``auto`` WOULD have done — but can never reach
+  a write; ``auto`` acts.
+- **Hysteresis**: ``KA_CONTROLLER_CONFIRMATIONS`` consecutive ``recommend``
+  verdicts for the SAME plan bytes (fingerprint-compared) are required
+  before an action; a verdict flap or a plan change resets the streak.
+- **Blast-radius cap**: ``KA_CONTROLLER_MAX_MOVES`` bounds the replica
+  moves per action — an oversize plan is truncated to a prefix-wave subset
+  (whole partitions only, in plan order) or held, never partially trusted —
+  AND per ``KA_CONTROLLER_WINDOW`` rolling window, whose executed-move
+  ledger persists in the journal dir so a daemon restart cannot reset it.
+- **Jittered cooldown**: ``KA_CONTROLLER_COOLDOWN`` (0.5–1.5x jitter)
+  between actions; evaluations continue during the cooldown so hysteresis
+  stays warm, but actions hold.
+- **Refusal to act** while the cluster is degraded/syncing, its session
+  breaker is not closed, the daemon is draining, or an execution is
+  already in flight (the single-flight lock is honored twice: checked
+  before acting, and the ``/execute`` machinery would 409 anyway).
+- **Breaker-gated abort-to-rollback**: a mid-loop execution failure, a
+  non-ok terminal status, or a post-move health regression (achieved score
+  worse than projected by more than ``KA_CONTROLLER_REGRESSION_TOL``,
+  re-scored from the verify pass's observed state via the engine's
+  ``on_verified`` hook) triggers the journaled rollback path — the plan's
+  own ``CURRENT ASSIGNMENT:`` snapshot driven back through the same wave
+  engine — and opens a controller-scoped circuit breaker, so a flapping
+  objective can never oscillate the cluster.
+
+Every decision (hold/confirmed/act/acted/would-act/truncate/abort/rollback/
+breaker transitions/pause/resume) is one flight-recorder ``controller``
+event plus a ring entry served at ``/clusters/<name>/controller`` (POST
+``{"action": "pause"|"resume"}`` gates the loop at runtime), and the
+``controller.*`` counters/gauges land in the cumulative registry per
+cluster. Chaos seams ``controller:{verdict-flap,exec-crash,regress}``
+(``faults/inject.py``) drive the ``soak_controller_matrix`` rows that prove
+an injected mid-loop fault never leaves a cluster scoring worse than it
+found it.
+
+Bulkhead discipline (kalint KA012): this module never touches a
+supervisor's session or cache — everything routes through
+``ClusterSupervisor`` methods (``controller_evaluate``,
+``controller_execute``, ``score_with_overlay``, ``lifecycle``, ...).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Deque, Dict, List, Optional, Tuple
+
+from ..faults.inject import InjectedExecCrash, controller_fault
+from ..io.json_io import format_reassignment_json
+from ..obs import flight
+from ..obs.metrics import gauge_set
+from ..obs.trace import record_span
+from ..utils.atomicwrite import atomic_write_text
+from ..utils.backoff import JitteredBackoff
+from ..utils.env import env_choice, env_float, env_int, env_str
+
+#: Decision-history ring capacity (the ``/controller`` endpoint's view).
+DECISION_RING = 64
+
+#: The policy ladder, weakest to strongest.
+POLICIES = ("off", "observe", "auto")
+
+
+def resolve_policy(override: Optional[str]) -> str:
+    """The effective policy for one cluster: the per-cluster ``--clusters``
+    override when given, else the ``KA_CONTROLLER`` knob (default off)."""
+    if override is not None:
+        if override not in POLICIES:
+            raise ValueError(
+                f"unknown controller policy {override!r} "
+                f"(expected one of {list(POLICIES)})"
+            )
+        return override
+    return env_choice("KA_CONTROLLER")
+
+
+class RebalanceController:
+    """One cluster's supervised closed-loop rebalance controller."""
+
+    def __init__(self, sup, policy: str) -> None:
+        self.sup = sup
+        self.policy = policy
+        self._mutex = threading.Lock()
+        self._paused = False
+        self._thread: Optional[threading.Thread] = None
+        #: Decision ring + monotonically increasing decision sequence.
+        self._decisions: Deque[dict] = collections.deque(
+            maxlen=DECISION_RING
+        )
+        self._seq = 0
+        #: Hysteresis: consecutive agreeing ``recommend`` verdicts.
+        self._streak = 0
+        self._last_sha: Optional[str] = None
+        #: Cooldown gate (monotonic deadline; 0 = no action yet).
+        self._next_action_at = 0.0
+        #: Controller-scoped breaker (independent of the session breaker).
+        self._breaker = "closed"
+        self._breaker_until = 0.0
+        self._breaker_backoff = self._fresh_breaker_backoff()
+        #: Rolling-window move ledger: [(epoch seconds, moves)], persisted
+        #: under the journal dir so restarts keep the budget accounting.
+        self._ledger: List[Tuple[float, int]] = []
+        self._ledger_loaded = False
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _count(self, name: str, n: int = 1) -> None:
+        self.sup._count(name, n)
+
+    def _metric(self, name: str) -> str:
+        return self.sup._metric(name)
+
+    def _log(self, msg: str) -> None:
+        self.sup._log(f"controller: {msg}")
+
+    def _fresh_breaker_backoff(self) -> JitteredBackoff:
+        base = max(
+            env_float("KA_CONTROLLER_COOLDOWN"),
+            env_float("KA_CONTROLLER_INTERVAL"),
+            0.05,
+        )
+        return JitteredBackoff(base, cap=env_float("KA_CONTROLLER_WINDOW"))
+
+    def _decide(self, decision: str, **fields) -> dict:
+        """Record one decision: ring entry + flight event (+ the holds
+        counter — the other decision counters live at their call sites,
+        where the decision is made exactly once)."""
+        clean = {k: v for k, v in fields.items() if v is not None}
+        with self._mutex:
+            self._seq += 1
+            entry = {
+                "seq": self._seq,
+                "t": round(time.time(), 3),
+                "decision": decision,
+            }
+            entry.update(clean)
+            self._decisions.append(entry)
+        flight.record(
+            "controller", self.sup.name, decision=decision, **clean
+        )
+        if decision == "hold":
+            self._count("controller.holds")
+        return entry
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the evaluation loop (no-op under ``off`` — an operator who
+        never opted in pays zero threads and zero solves)."""
+        if self.policy == "off" or self._thread is not None:
+            return
+        self._load_ledger()
+        self._thread = threading.Thread(
+            target=self._loop,
+            name=f"ka-controller-{self.sup.name}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def join(self, timeout: float = 30.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    def _loop(self) -> None:
+        while not self.sup.stopped.is_set():
+            if self.sup.stopped.wait(env_float("KA_CONTROLLER_INTERVAL")):
+                return
+            try:
+                self.tick()
+            except Exception as e:
+                # The loop must never die: an unexpected error is one
+                # missed evaluation, loudly.
+                self._log(
+                    f"evaluation loop error ({type(e).__name__}: {e}); "
+                    "next interval continues"
+                )
+
+    # -- pause / resume ------------------------------------------------------
+
+    def pause(self) -> dict:
+        """Gate the loop: evaluations and actions stop after the current
+        tick completes (an IN-FLIGHT action is never aborted — the journal,
+        not the pause flag, owns execution safety)."""
+        with self._mutex:
+            already = self._paused
+            self._paused = True
+        if not already:
+            self._decide("paused")
+        return self.view()
+
+    def resume(self) -> dict:
+        with self._mutex:
+            was = self._paused
+            self._paused = False
+        if was:
+            self._decide("resumed")
+        return self.view()
+
+    def paused(self) -> bool:
+        with self._mutex:
+            return self._paused
+
+    # -- the rolling-window move ledger --------------------------------------
+
+    def _ledger_path(self) -> str:
+        jdir = env_str("KA_DAEMON_JOURNAL_DIR") or "."
+        return os.path.join(
+            jdir, f"ka-controller-{self.sup.name}.window.json"
+        )
+
+    def _load_ledger(self) -> None:
+        """Window accounting survives a daemon kill (ISSUE 15 satellite):
+        the budget is a property of the CLUSTER's recent history, not of
+        one process's memory. A missing/corrupt ledger starts fresh,
+        loudly on corruption."""
+        self._ledger_loaded = True
+        path = self._ledger_path()
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                raw = json.load(f)
+            self._ledger = [
+                (float(t), int(n)) for t, n in raw.get("actions", [])
+            ]
+        except FileNotFoundError:
+            self._ledger = []
+        except (OSError, ValueError, TypeError) as e:
+            self._ledger = []
+            self._log(
+                f"window ledger {path!r} unreadable ({e}); budget "
+                "accounting restarts empty"
+            )
+
+    def _save_ledger(self) -> None:
+        try:
+            # kalint: disable=KA005 -- controller window ledger, not a plan payload
+            atomic_write_text(
+                self._ledger_path(),
+                json.dumps({"actions": [[t, n] for t, n in self._ledger]}),
+                prefix=".ka_controller_",
+            )
+        except OSError as e:
+            self._log(
+                f"window ledger persist failed ({e}); accounting is "
+                "in-memory only until the next action"
+            )
+
+    def _window_moves(self) -> int:
+        """Executed moves inside the rolling window (pruning as time
+        passes); forward actions AND rollbacks both count — each is real
+        replica movement the blast-radius budget exists to bound."""
+        if not self._ledger_loaded:
+            # Harness paths drive tick()/view() without start(): the
+            # persisted budget must load before anything reads — or
+            # worse, overwrites — the ledger.
+            self._load_ledger()
+        horizon = time.time() - env_float("KA_CONTROLLER_WINDOW")
+        with self._mutex:
+            self._ledger = [(t, n) for t, n in self._ledger if t >= horizon]
+            total = sum(n for _t, n in self._ledger)
+        if self.policy != "off":
+            # A GET /controller on a never-opted-in cluster must not mint
+            # a controller scrape series: `off` = zero controller
+            # activity, the metrics plane included.
+            gauge_set(self._metric("controller.window_moves"), total)
+        return total
+
+    def _record_moves(self, moves: int) -> None:
+        if moves <= 0:
+            return
+        if not self._ledger_loaded:
+            self._load_ledger()
+        with self._mutex:
+            self._ledger.append((round(time.time(), 3), int(moves)))
+        self._count("controller.moves", moves)
+        self._save_ledger()
+        self._window_moves()
+
+    # -- controller breaker --------------------------------------------------
+
+    def breaker_view(self) -> dict:
+        with self._mutex:
+            out = {"state": self._breaker}
+            if self._breaker == "open":
+                out["retry_in_s"] = round(
+                    max(0.0, self._breaker_until - time.monotonic()), 3
+                )
+            return out
+
+    def _breaker_allow(self) -> bool:
+        """Closed/half-open: evaluate. Open: only once the cooldown
+        elapsed, which half-opens the breaker for exactly one probe
+        action."""
+        with self._mutex:
+            if self._breaker != "open":
+                return True
+            if time.monotonic() < self._breaker_until:
+                return False
+            self._breaker = "half-open"
+        self._decide("breaker-half-open")
+        return True
+
+    def _breaker_open(self, reason: str) -> None:
+        with self._mutex:
+            self._breaker = "open"
+            self._breaker_until = (
+                time.monotonic() + self._breaker_backoff.next_delay()
+            )
+        self._count("controller.breaker_opened")
+        self._decide("breaker-open", reason=reason)
+        self._log(
+            f"breaker OPEN ({reason}); actions gated on the cooldown "
+            "envelope"
+        )
+
+    def _breaker_close(self) -> None:
+        with self._mutex:
+            was = self._breaker
+            self._breaker = "closed"
+            self._breaker_until = 0.0
+            self._breaker_backoff = self._fresh_breaker_backoff()
+        if was != "closed":
+            self._count("controller.breaker_closed")
+            self._decide("breaker-closed")
+
+    # -- one evaluation ------------------------------------------------------
+
+    def tick(self) -> Optional[dict]:
+        """One controller iteration: safety refusals → evaluation →
+        hysteresis → (auto only) blast-radius gates → supervised action.
+        Returns the decision recorded, or None when nothing was decided
+        (off/paused/draining)."""
+        if self.policy == "off" or self.paused():
+            return None
+        if self.sup.draining.is_set() or self.sup.stopped.is_set():
+            return None
+        lifecycle = self.sup.lifecycle()
+        if lifecycle != "ready":
+            # Degraded/syncing: the cache is suspect — advice computed
+            # from it must not move data (the observe plane keeps its own
+            # stale marker for the same reason).
+            return self._decide("hold", reason=f"cluster {lifecycle}")
+        if self.sup.breaker.snapshot()["state"] != "closed":
+            return self._decide("hold", reason="session breaker not closed")
+        if self.sup.execution_in_flight():
+            return self._decide("hold", reason="execution in flight")
+        if not self._breaker_allow():
+            return self._decide("hold", reason="controller breaker open")
+
+        t0 = time.perf_counter()
+        status, ev = self.sup.controller_evaluate()
+        record_span(
+            self._metric("controller/evaluate"),
+            (time.perf_counter() - t0) * 1e3,
+            status == "ok",
+        )
+        if status != "ok":
+            return self._decide("hold", reason=str(ev))
+        self._count("controller.evaluations")
+
+        verdict = ev["verdict"]
+        flapped = controller_fault("verdict-flap", self.sup.name)
+        if flapped:
+            verdict = "hold" if verdict == "recommend" else "recommend"
+        if verdict != "recommend":
+            with self._mutex:
+                self._streak = 0
+                self._last_sha = None
+            gauge_set(self._metric("controller.streak"), 0)
+            return self._decide(
+                "hold", reason="verdict hold", verdict=verdict,
+                flapped=flapped or None, improvement=ev["improvement"],
+                moves=ev["moves"],
+            )
+        sha = ev["plan_sha"]
+        with self._mutex:
+            if sha == self._last_sha:
+                self._streak += 1
+            else:
+                self._streak = 1
+                self._last_sha = sha
+            streak = self._streak
+        gauge_set(self._metric("controller.streak"), streak)
+        need = env_int("KA_CONTROLLER_CONFIRMATIONS")
+        if streak < need:
+            return self._decide(
+                "confirmed", verdict=verdict, streak=streak,
+                required=need, plan_sha=sha[:12], moves=ev["moves"],
+                flapped=flapped or None,
+            )
+
+        max_moves = env_int("KA_CONTROLLER_MAX_MOVES")
+        window_moves = self._window_moves()
+        budget = max_moves - window_moves
+        if self.policy == "observe":
+            # The proof rung: everything up to (and including) the
+            # decision AUTO would take, with zero writes by construction —
+            # this path can never reach controller_execute.
+            return self._decide(
+                "would-act", verdict=verdict, streak=streak,
+                plan_sha=sha[:12], moves=ev["moves"],
+                window_budget=budget,
+            )
+        now = time.monotonic()
+        with self._mutex:
+            cooling = now < self._next_action_at
+            retry_in = round(max(0.0, self._next_action_at - now), 3)
+        if cooling:
+            return self._decide(
+                "hold", reason="cooldown", retry_in_s=retry_in,
+                streak=streak,
+            )
+        if budget <= 0:
+            return self._decide(
+                "hold", reason="window budget spent",
+                window_moves=window_moves, max_moves=max_moves,
+            )
+
+        plan_text, moves, act_sha = ev["plan_text"], ev["moves"], sha
+        projected = ev["projected"]
+        # budget = max_moves - window_moves <= max_moves always: the
+        # per-action and per-window caps meet in one number.
+        cap = budget
+        if moves > cap:
+            plan_text, moves, act_sha = self._truncate(plan_text, cap)
+            if moves == 0:
+                return self._decide(
+                    "hold",
+                    reason="oversize plan has no prefix inside the cap",
+                    cap=cap,
+                )
+            # The regression check must judge the TRUNCATED action
+            # against its own projection — the full plan's score is a
+            # target this action never promised to reach.
+            from ..exec.engine import parse_plan_payload
+
+            new_sub, _ = parse_plan_payload(
+                plan_text, origin="truncated controller plan"
+            )
+            projected = self.sup.score_with_overlay(
+                new_sub, base=ev["topics"]
+            )
+            self._count("controller.truncations")
+            self._decide(
+                "truncate", moves=moves, cap=cap,
+                full_moves=ev["moves"], plan_sha=act_sha[:12],
+            )
+        return self._act(ev, plan_text, moves, act_sha, projected)
+
+    # -- acting --------------------------------------------------------------
+
+    def _arm_cooldown(self) -> None:
+        cooldown = env_float("KA_CONTROLLER_COOLDOWN")
+        jittered = JitteredBackoff(cooldown, factor=1.0).next_delay()
+        with self._mutex:
+            self._next_action_at = time.monotonic() + jittered
+
+    def _journal_path(self, sha: str, rollback: bool = False) -> str:
+        jdir = env_str("KA_DAEMON_JOURNAL_DIR") or "."
+        suffix = ".rollback.journal" if rollback else ".journal"
+        return os.path.join(
+            jdir, f"ka-controller-{self.sup.name}-{sha[:12]}{suffix}"
+        )
+
+    def _act(self, ev: dict, plan_text: str, moves: int,
+             sha: str, projected) -> dict:
+        """One supervised action: forward execution through the
+        single-flight ``/execute`` machinery, post-move re-score, and the
+        breaker-gated abort-to-rollback on any failure or regression."""
+        with self._mutex:
+            half_open = self._breaker == "half-open"
+        journal = self._journal_path(sha)
+        achieved_box: Dict[str, object] = {}
+
+        def on_start() -> None:
+            # Admission won — execution is really about to begin. Only
+            # now does the action exist: a single-flight refusal must
+            # leave no phantom `act` in the counters or the trail, and
+            # must not reset a hysteresis streak the world never saw.
+            with self._mutex:
+                # The world is about to change: any future recommendation
+                # must re-confirm from scratch.
+                self._streak = 0
+                self._last_sha = None
+            gauge_set(self._metric("controller.streak"), 0)
+            self._decide(
+                "act", plan_sha=sha[:12], moves=moves,
+                probe=half_open or None,
+            )
+            self._count("controller.actions")
+
+        def on_verified(observed) -> None:
+            # Overlay onto the EVALUATION-time baseline the projection
+            # was scored against — not the live cache, whose unrelated
+            # mid-action churn would read as a regression of this plan.
+            achieved_box["scores"] = self.sup.score_with_overlay(
+                observed, base=ev["topics"]
+            )
+
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            try:
+                terminal = self.sup.controller_execute(
+                    plan_text,
+                    probe=lambda: controller_fault(
+                        "exec-crash", self.sup.name
+                    ),
+                    on_verified=on_verified,
+                    on_start=on_start,
+                    journal=journal,
+                )
+            except InjectedExecCrash as e:
+                # The chaos kill stand-in fired mid-loop: the forward
+                # journal retains every committed wave; the supervised
+                # response is abort-to-rollback, exactly what an operator
+                # babysitting ka-execute would do.
+                self._count("controller.exec_failures")
+                self._record_moves(moves)
+                self._arm_cooldown()
+                self.sup.controller_refresh()
+                self._decide("abort", reason=f"execution crashed: {e}")
+                return self._rollback(sha, plan_text, journal, moves,
+                                      reason="exec-crash")
+            if "refused" in terminal:
+                # Lost the single-flight race (or a drain began): not a
+                # failure of the plan — no rollback, no breaker, just
+                # hold and re-confirm later.
+                return self._decide(
+                    "hold", reason=f"execute refused: {terminal['refused']}"
+                )
+            # The ledger's currency is REPLICA moves (the cap's unit, the
+            # same movement_debt currency the verdict prices) — the
+            # engine's moves_submitted counts partition writes, a
+            # different unit. Planned moves are charged even when some
+            # turned out to be noops: conservative accounting.
+            self._record_moves(moves)
+            self._arm_cooldown()
+            self.sup.controller_refresh()
+            if terminal.get("event") != "exec/done" \
+                    or terminal.get("status") != "ok":
+                self._count("controller.exec_failures")
+                why = (
+                    terminal.get("status")
+                    or terminal.get("kind")
+                    or "unknown execution failure"
+                )
+                self._decide("abort", reason=f"execution {why}")
+                return self._rollback(sha, plan_text, journal, moves,
+                                      reason=f"execution {why}")
+
+            achieved = achieved_box.get("scores")
+            delta = None
+            regressed = False
+            if achieved is not None:
+                tol = env_float("KA_CONTROLLER_REGRESSION_TOL")
+                delta = round(achieved.score - projected.score, 6)
+                regressed = delta > tol
+            if controller_fault("regress", self.sup.name):
+                regressed = True
+            if regressed:
+                self._count("controller.regressions")
+                self._decide(
+                    "abort",
+                    reason="post-move health regression",
+                    achieved=(
+                        achieved.score if achieved is not None else None
+                    ),
+                    projected=projected.score, delta=delta,
+                )
+                return self._rollback(sha, plan_text, journal, moves,
+                                      reason="regression")
+            ok = True
+            if half_open:
+                self._breaker_close()
+            return self._decide(
+                "acted", plan_sha=sha[:12], moves=moves,
+                achieved=achieved.score if achieved is not None else None,
+                projected=projected.score, delta=delta,
+            )
+        finally:
+            record_span(
+                self._metric("controller/act"),
+                (time.perf_counter() - t0) * 1e3, ok,
+            )
+
+    def _rollback(self, sha: str, plan_text: str, forward_journal: str,
+                  moves: int, reason: str) -> dict:
+        """The journaled abort-to-rollback: drive the plan's own CURRENT
+        snapshot back through the wave engine (the ``ka-execute
+        --rollback`` path), then open the controller breaker. The window
+        ledger charges the rollback's movement too — undoing a rebalance
+        is replica traffic like any other."""
+        self._count("controller.rollbacks")
+        try:
+            terminal = self.sup.controller_execute(
+                plan_text, section="current",
+                journal=self._journal_path(sha, rollback=True),
+            )
+        except InjectedExecCrash as e:
+            terminal = {"event": "exec/error", "kind": "crash",
+                        "message": str(e)}
+        except Exception as e:
+            terminal = {"event": "exec/error", "kind": "internal",
+                        "message": f"{type(e).__name__}: {e}"}
+        rolled = (
+            terminal.get("event") == "exec/done"
+            and terminal.get("status") == "ok"
+        )
+        if rolled:
+            # Same replica-move currency as the forward charge: undoing a
+            # rebalance is replica traffic like any other.
+            self._record_moves(moves)
+            self.sup.controller_refresh()
+            # The forward journal is superseded: its interrupted record
+            # would otherwise block a future forward run of the same plan
+            # bytes behind a refuse-to-clobber error.
+            try:
+                os.unlink(forward_journal)
+            except FileNotFoundError:  # kalint: disable=KA008 -- an already-gone journal IS the goal state here
+                pass
+            except OSError as e:
+                self._log(
+                    f"could not remove superseded forward journal "
+                    f"{forward_journal!r} ({e})"
+                )
+        else:
+            why = terminal.get("message") or terminal.get("status")
+            self._log(
+                f"ROLLBACK DID NOT COMPLETE ({why}); journals retained — "
+                f"finish with ka-execute --resume "
+                f"(forward: {forward_journal!r})"
+            )
+        decision = self._decide(
+            "rollback", reason=reason, ok=rolled,
+            status=terminal.get("status") or terminal.get("kind"),
+        )
+        self._breaker_open(reason)
+        return decision
+
+    # -- plan truncation -----------------------------------------------------
+
+    @staticmethod
+    def _truncate(plan_text: str, cap: int) -> Tuple[str, int, str]:
+        """Truncate an oversize plan to a PREFIX-WAVE subset of at most
+        ``cap`` replica moves: whole partitions, in plan order, stopping
+        at the first entry that would overflow — never a partially
+        trusted replica list. Entries with no rollback anchor (absent
+        from the CURRENT section) are excluded: an action the controller
+        cannot undo is an action it must not take. Returns
+        ``(plan_text, moves, plan_sha)`` — ``moves == 0`` means nothing
+        fit and the caller holds."""
+        from ..exec.engine import parse_plan_payload
+        from ..exec.journal import plan_fingerprint
+
+        new_plan, order = parse_plan_payload(
+            plan_text, origin="controller plan"
+        )
+        cur_plan, _ = parse_plan_payload(
+            plan_text, section="current", origin="controller plan"
+        )
+        new_sub: Dict[str, Dict[int, List[int]]] = {}
+        cur_sub: Dict[str, Dict[int, List[int]]] = {}
+        sub_order: List[str] = []
+        spent = 0
+        full = False
+        for t in order:
+            if full:
+                break
+            for p in sorted(new_plan[t]):
+                cur = cur_plan.get(t, {}).get(p)
+                if cur is None:
+                    continue  # no rollback anchor — skip, never trust
+                new = new_plan[t][p]
+                n = len(set(new) - set(cur)) if new else len(set(cur))
+                if n == 0:
+                    continue  # noop: nothing to execute or roll back
+                if spent + n > cap:
+                    full = True
+                    break
+                if t not in new_sub:
+                    new_sub[t] = {}
+                    cur_sub[t] = {}
+                    sub_order.append(t)
+                new_sub[t][p] = list(new)
+                cur_sub[t][p] = list(cur)
+                spent += n
+        if spent == 0:
+            return plan_text, 0, ""
+        text = (
+            "CURRENT ASSIGNMENT:\n"
+            + format_reassignment_json(cur_sub, topic_order=sub_order)
+            + "\nNEW ASSIGNMENT:\n"
+            + format_reassignment_json(new_sub, topic_order=sub_order)
+            + "\n"
+        )
+        return text, spent, plan_fingerprint(new_sub, sub_order)
+
+    # -- introspection -------------------------------------------------------
+
+    def view(self) -> dict:
+        """The ``/clusters/<name>/controller`` body: live policy/rail
+        state, the last decision, and the decision-history ring."""
+        now = time.monotonic()
+        with self._mutex:
+            decisions = list(self._decisions)
+            streak = self._streak
+            paused = self._paused
+            cooldown = round(max(0.0, self._next_action_at - now), 3)
+        return {
+            "cluster": self.sup.name,
+            "policy": self.policy,
+            "paused": paused,
+            "breaker": self.breaker_view(),
+            "streak": streak,
+            "confirmations_required": env_int("KA_CONTROLLER_CONFIRMATIONS"),
+            "interval_s": env_float("KA_CONTROLLER_INTERVAL"),
+            "cooldown_remaining_s": cooldown,
+            "window": {
+                "seconds": env_float("KA_CONTROLLER_WINDOW"),
+                "max_moves": env_int("KA_CONTROLLER_MAX_MOVES"),
+                "moves": self._window_moves(),
+            },
+            "last_decision": decisions[-1] if decisions else None,
+            "decisions": decisions,
+        }

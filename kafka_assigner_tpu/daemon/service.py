@@ -57,6 +57,15 @@ endpoint                        method behavior
                                        candidate in ONE device fan-out;
                                        cost curve + recommended count
                                        (``counts``/``scales`` params)
+/clusters/<name>/controller     GET/   the closed-loop rebalance
+                                POST   controller (ISSUE 15): GET returns
+                                       policy (off/observe/auto), pause
+                                       state, controller-breaker state,
+                                       hysteresis streak, window budget,
+                                       the last decision and the
+                                       decision-history ring; POST
+                                       {"action": "pause"|"resume"}
+                                       gates the loop at runtime
 /clusters/<name>/healthz        GET    that cluster's lifecycle + breaker
 /clusters/<name>/readyz         GET    that cluster's readiness
 /clusters/<name>/state          GET    that cluster's cache introspection
@@ -148,6 +157,46 @@ def _valid_cluster_name(name: str) -> bool:
     )
 
 
+def _split_cluster_spec(name: str, spec) -> "Tuple[str, Optional[str]]":
+    """``(connect, controller_policy_override)`` from one cluster's spec:
+    a plain connect string; ``connect#controller=<policy>`` (the inline
+    ``--clusters`` override grammar — split on the LAST ``#`` so quorum
+    strings keep theirs, if any); or the JSON-file object form
+    ``{"connect": ..., "controller": ...}``."""
+    if isinstance(spec, dict):
+        connect = spec.get("connect")
+        if not isinstance(connect, str) or not connect:
+            raise ValueError(
+                f"cluster {name!r}: object spec needs a non-empty "
+                "'connect' string"
+            )
+        policy = spec.get("controller")
+        if policy is not None and not isinstance(policy, str):
+            raise ValueError(
+                f"cluster {name!r}: 'controller' must be a string policy"
+            )
+        unknown = set(spec) - {"connect", "controller"}
+        if unknown:
+            raise ValueError(
+                f"cluster {name!r}: unknown spec keys {sorted(unknown)}"
+            )
+        return connect, policy
+    if not isinstance(spec, str) or not spec:
+        raise ValueError(
+            f"cluster {name!r}: spec must be a connect string or an "
+            f"object, got {spec!r}"
+        )
+    if "#controller=" in spec:
+        connect, _, policy = spec.rpartition("#controller=")
+        if not connect or not policy:
+            raise ValueError(
+                f"cluster {name!r}: malformed controller override in "
+                f"{spec!r} (expected connect#controller=off|observe|auto)"
+            )
+        return connect, policy
+    return spec, None
+
+
 #: Query params whose values ARE booleans: only these normalize. A blanket
 #: both-ways coercion would eat legitimate values that merely look boolean
 #: (?counts=1 for a single-candidate sweep, a topic named "on").
@@ -211,12 +260,19 @@ class AssignerDaemon:
             clusters = {DEFAULT_CLUSTER: zk_string}
         if not clusters:
             raise ValueError("clusters must name at least one cluster")
-        for name in clusters:
+        # Normalize each cluster's spec: a plain connect string, an
+        # inline `connect#controller=auto` override, or the JSON object
+        # form {"connect": ..., "controller": ...} — the per-cluster
+        # controller-policy override of ISSUE 15 (None = the KA_CONTROLLER
+        # knob decides).
+        normalized: Dict[str, Tuple[str, Optional[str]]] = {}
+        for name, spec in clusters.items():
             if not _valid_cluster_name(name):
                 raise ValueError(
                     f"invalid cluster name {name!r} (letters, digits, "
                     "'_', '.', '-' only)"
                 )
+            normalized[name] = _split_cluster_spec(name, spec)
         self.solver = solver
         self.bind = bind if bind is not None else env_str("KA_DAEMON_BIND")
         self.port = port if port is not None else env_int("KA_DAEMON_PORT")
@@ -255,7 +311,7 @@ class AssignerDaemon:
         )
         self.supervisors: Dict[str, ClusterSupervisor] = {
             name: ClusterSupervisor(
-                name, spec,
+                name, connect,
                 solver=solver,
                 failure_policy=failure_policy,
                 label="" if self.single else name,
@@ -263,9 +319,10 @@ class AssignerDaemon:
                 stopped=self.stopped,
                 solve_lock=self._solve_lock,
                 dispatcher=self.dispatcher,
+                controller_policy=controller_policy,
                 err=self.err,
             )
-            for name, spec in clusters.items()
+            for name, (connect, controller_policy) in normalized.items()
         }
         self.httpd: Optional[ThreadingHTTPServer] = None
         self._serve_thread: Optional[threading.Thread] = None
@@ -461,10 +518,11 @@ class AssignerDaemon:
 #: Per-cluster path suffixes the router accepts.
 _POST_SUFFIXES = (
     "/plan", "/whatif", "/execute", "/groups/plan", "/groups/sweep",
+    "/controller",
 )
 _GET_SUFFIXES = (
     "/healthz", "/readyz", "/state", "/debug/flight", "/recommendations",
-    "/groups/plan", "/groups/sweep",
+    "/groups/plan", "/groups/sweep", "/controller",
 )
 #: The consumer-group family's endpoints (ISSUE 13): served on GET (query
 #: params) AND POST (JSON body) — both read-only computations.
@@ -757,6 +815,12 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
                     else body.get("error") and "error" or "ok"
                 )
                 self._reply(code, body, headers)
+            elif suffix == "/controller":
+                # The closed-loop controller's introspection view
+                # (ISSUE 15): policy, rails, breaker, last decision, and
+                # the decision-history ring. POST {"action": ...} on the
+                # same path pauses/resumes.
+                self._reply(200, sup.controller_view())
             elif suffix == "/debug/flight":
                 rec = flight.recorder()
                 self._reply(
@@ -810,6 +874,14 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
                 self._status = (
                     "degraded" if body.get("degraded")
                     else body.get("error") and "error" or "ok"
+                )
+                self._reply(code, body, headers)
+                return
+            if suffix == "/controller":
+                code, body, headers = sup.controller_request(params)
+                self._status = (
+                    body.get("error") and "error"
+                    or ("paused" if body.get("paused") else "ok")
                 )
                 self._reply(code, body, headers)
                 return

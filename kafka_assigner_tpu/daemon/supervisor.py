@@ -75,6 +75,7 @@ from ..obs import metrics as obs_metrics
 from ..obs.metrics import counter_add, gauge_set, hist_ms, hist_observe
 from ..obs.trace import record_span
 from ..utils.backoff import JitteredBackoff
+from .controller import RebalanceController, resolve_policy
 from .dispatch import SolveDispatcher, dispatch_scope
 from .state import CacheBackend, DaemonState
 
@@ -185,6 +186,7 @@ class ClusterSupervisor:
         stopped: threading.Event,
         solve_lock: threading.Lock,
         dispatcher: Optional[SolveDispatcher] = None,
+        controller_policy: Optional[str] = None,
         err=None,
     ) -> None:
         from ..utils.env import env_bool, env_choice, env_float, env_int
@@ -254,8 +256,20 @@ class ClusterSupervisor:
         #: Prompt-resync request from the request path (session seam) for
         #: the watchless case, where no poll exists to raise.
         self._prompt_resync = False
+        #: Session-reopen request honored by the watch loop (the one
+        #: session-owning thread) before its next resync: set after a
+        #: controller action, whose writes a load-once backend (the
+        #: snapshot file) would otherwise never show the cache.
+        self._reopen_requested = False
         #: Last computed health scores (ISSUE 11), surfaced in /state.
         self._last_health: Optional[health.HealthScores] = None
+        #: The closed-loop rebalance controller (ISSUE 15): one per
+        #: cluster, policy from the per-cluster ``--clusters`` override or
+        #: the KA_CONTROLLER knob (default off — an explicit opt-in; under
+        #: off no thread ever starts).
+        self.controller = RebalanceController(
+            self, resolve_policy(controller_policy)
+        )
 
     # -- counters (cluster-lifetime; mirrored into any active obs capture) --
 
@@ -362,6 +376,10 @@ class ClusterSupervisor:
             daemon=True,
         )
         self._watch_thread.start()
+        # The closed-loop controller (ISSUE 15): a no-op under the default
+        # `off` policy — only an explicit observe/auto opt-in starts the
+        # evaluation thread.
+        self.controller.start()
 
     def _open_backend(self) -> None:
         self.backend = open_backend(self.spec)
@@ -394,6 +412,7 @@ class ClusterSupervisor:
         the watch loop, join any live warm threads, close the backend."""
         if self._watch_thread is not None:
             self._watch_thread.join(timeout=5.0)
+        self.controller.join()
         for t in self._warm_threads:
             # In-process harness hygiene (same contract as the ingest
             # warm-up's join): no stray background compile may bleed
@@ -609,8 +628,9 @@ class ClusterSupervisor:
         write opcode; the recommendation is computed, recorded in the
         flight ring, and never executed (the auto-execute rung of the
         observe → recommend → auto-execute ladder is deliberately NOT
-        this endpoint's job)."""
-        from ..exec.engine import parse_plan_payload
+        this endpoint's job — that rung is the controller's,
+        ``daemon/controller.py``, which consumes the same
+        :meth:`_score_candidate` core)."""
         from ..utils.env import env_float
 
         raw_cost = params.get("move_cost")
@@ -636,27 +656,11 @@ class ClusterSupervisor:
         )
         try:
             solver = params.get("solver") or self.solver
-            out = io.StringIO()
-            with self._solve_lock_scope():
-                topics = self.state.all_assignments()
-                broker_ids = self.state.broker_id_set()
-                rack = self.state.rack_map()
-                current = health.score_assignment(broker_ids, topics, rack)
-                degraded = self._solve_plan({"solver": solver}, out)
-            proposed, _order = parse_plan_payload(
-                out.getvalue(), origin="recommendation plan",
-            )
-            projected_topics = dict(topics)
-            projected_topics.update(proposed)
-            projected = health.score_assignment(
-                broker_ids, projected_topics, rack
-            )
-            moves, leader_moves = health.movement_debt(topics, proposed)
-            improvement = round(current.score - projected.score, 6)
-            cost = round(moves * move_cost, 6)
-            verdict = (
-                "recommend" if moves > 0 and improvement > cost else "hold"
-            )
+            ev = self._score_candidate(solver, move_cost)
+            current, projected = ev["current"], ev["projected"]
+            moves, leader_moves = ev["moves"], ev["leader_moves"]
+            improvement, cost = ev["improvement"], ev["cost"]
+            verdict, degraded = ev["verdict"], ev["degraded"]
             gauge_set(self._metric("health.movement_debt"), moves)
             self._count("daemon.recommendations")
             flight.record(
@@ -705,6 +709,192 @@ class ClusterSupervisor:
                 (time.perf_counter() - t0) * 1e3, ok,
             )
             self._release()
+
+    def _score_candidate(self, solver: str, move_cost: float) -> dict:
+        """The shared recommend/hold evaluation core (ISSUE 11 endpoint +
+        ISSUE 15 controller): solve one candidate plan against the live
+        cache under the dispatch regime, score current vs projected, and
+        price the movement. The caller holds an admission slot."""
+        from ..exec.engine import parse_plan_payload
+        from ..exec.journal import plan_fingerprint
+
+        out = io.StringIO()
+        with self._solve_lock_scope():
+            topics = self.state.all_assignments()
+            broker_ids = self.state.broker_id_set()
+            rack = self.state.rack_map()
+            current = health.score_assignment(broker_ids, topics, rack)
+            degraded = self._solve_plan({"solver": solver}, out)
+        proposed, order = parse_plan_payload(
+            out.getvalue(), origin="recommendation plan",
+        )
+        projected_topics = dict(topics)
+        projected_topics.update(proposed)
+        projected = health.score_assignment(
+            broker_ids, projected_topics, rack
+        )
+        moves, leader_moves = health.movement_debt(topics, proposed)
+        improvement = round(current.score - projected.score, 6)
+        cost = round(moves * move_cost, 6)
+        verdict = (
+            "recommend" if moves > 0 and improvement > cost else "hold"
+        )
+        return {
+            "current": current,
+            "projected": projected,
+            # The evaluation-time assignment snapshot: the baseline every
+            # later overlay re-score (truncation projection, post-verify
+            # achieved) must share with the projection above.
+            "topics": topics,
+            "moves": moves,
+            "leader_moves": leader_moves,
+            "improvement": improvement,
+            "cost": cost,
+            "verdict": verdict,
+            "degraded": degraded,
+            "plan_text": out.getvalue(),
+            "plan_sha": plan_fingerprint(proposed, order),
+        }
+
+    # -- the closed-loop controller's supervisor surface (ISSUE 15) ---------
+
+    def execution_in_flight(self) -> bool:
+        """Whether this cluster's single-flight execution slot is taken —
+        the controller refuses to even evaluate an action against a
+        cluster that is mid-reassignment."""
+        return self._exec_lock.locked()
+
+    def controller_evaluate(self) -> Tuple[str, object]:
+        """One controller evaluation of the live recommendation pipeline:
+        admission-gated and watchdog-armed exactly like every other
+        solve-bearing caller (the controller competes for the same
+        per-cluster inflight slots as clients — a controller must never
+        starve the operators it serves). Returns ``("ok", eval dict)`` or
+        ``("skip", reason)`` — evaluation problems are SKIPS, never
+        raises: the loop's next interval retries."""
+        from ..utils.env import env_float
+
+        refusal = self._gate()
+        if refusal is not None:
+            return (
+                "skip",
+                f"admission refused: "
+                f"{refusal[1].get('error', refusal[0])}",
+            )
+        watchdog_timer = self._watchdog(
+            "/controller", self._request_budget(), None
+        )
+        try:
+            ev = self._score_candidate(
+                self.solver, env_float("KA_HEALTH_MOVE_COST")
+            )
+            return ("ok", ev)
+        except (InjectedSolverCrash, SolveError, ValueError, KeyError,
+                IngestError) as e:
+            return ("skip", f"evaluation failed: {type(e).__name__}: {e}")
+        except Exception as e:
+            self._count("daemon.request_errors")
+            return ("skip", f"evaluation error: {type(e).__name__}: {e}")
+        finally:
+            watchdog_timer.cancel()
+            self._release()
+
+    def controller_execute(
+        self, plan_text: str, *,
+        section: str = "new",
+        probe=None,
+        on_verified=None,
+        on_start=None,
+        journal: Optional[str] = None,
+    ) -> dict:
+        """Dispatch one controller action (or rollback,
+        ``section="current"``) through the SAME supervised single-flight
+        ``/execute`` machinery a client request uses: same 409 semantics
+        (returned as ``{"refused": ...}``), same journaling, same fresh
+        write-path session. ``on_start`` fires once admission is won and
+        execution is about to begin — never on a refusal. Returns the
+        terminal event dict (``exec/done``/``exec/error``);
+        :class:`InjectedExecCrash` propagates — the controller owns
+        abort-to-rollback, exactly like a supervisor owns a killed
+        ``ka-execute``."""
+        params: dict = {"plan_text": plan_text, "section": section}
+        if journal is not None:
+            params["journal"] = journal
+        prep = self.prepare_execute(params)
+        if prep[0] == "error":
+            _, code, body = prep
+            return {"refused": body.get("error", f"http {code}")}
+        _, ctx = prep
+        ctx["probe"] = probe
+        ctx["on_verified"] = on_verified
+        if on_start is not None:
+            # Admission is won: the caller's pre-execution bookkeeping
+            # (the controller's `act` decision) runs only for an
+            # execution that actually starts, never for a refusal.
+            on_start()
+        terminal: dict = {}
+
+        def collect(event: dict) -> None:
+            if event.get("event") in ("exec/done", "exec/error"):
+                terminal.update(event)
+
+        self.run_execute(ctx, collect)
+        if not terminal:
+            terminal.update({
+                "event": "exec/error", "kind": "internal",
+                "message": "execution ended without a terminal event",
+            })
+        return terminal
+
+    def controller_refresh(self) -> None:
+        """After an executed controller action (or rollback) the cache
+        provably lags the cluster it just moved: mark it stale and prompt
+        the watch loop's resync, so the next evaluation scores the
+        post-move world instead of re-recommending the pre-move one."""
+        self.state.mark_stale()
+        self.note_lifecycle()
+        self._reopen_requested = True
+        self._prompt_resync = True
+
+    def score_with_overlay(self, observed,
+                           base=None) -> health.HealthScores:
+        """Score the cluster as the verify pass just OBSERVED it: the
+        cached assignment overlaid with the executed topics' read-back
+        state — the achieved post-move score the controller compares
+        against the plan's projection. ``base`` pins the baseline topics
+        to the EVALUATION-time snapshot the projection was scored
+        against: both sides of the regression comparison must see the
+        same world, or unrelated mid-action churn (a watch delta landing
+        during execution) reads as a regression of a correctly-executed
+        plan."""
+        topics = (
+            {t: dict(parts) for t, parts in base.items()}
+            if base is not None else self.state.all_assignments()
+        )
+        for t, parts in observed.items():
+            merged = dict(topics.get(t, {}))
+            merged.update(
+                {int(p): list(r) for p, r in parts.items() if r}
+            )
+            topics[t] = merged
+        return health.score_assignment(
+            self.state.broker_id_set(), topics, self.state.rack_map()
+        )
+
+    def controller_view(self) -> dict:
+        return self.controller.view()
+
+    def controller_request(self, params: dict) -> Tuple[int, dict, dict]:
+        """POST ``/clusters/<name>/controller``: the pause/resume gate."""
+        action = params.get("action")
+        if action == "pause":
+            return 200, self.controller.pause(), {}
+        if action == "resume":
+            return 200, self.controller.resume(), {}
+        return 400, {
+            "error": f"unknown controller action {action!r} "
+                     "(expected \"pause\" or \"resume\")",
+        }, {}
 
     # -- consumer-group workload family (ISSUE 13) --------------------------
 
@@ -1051,9 +1241,31 @@ class ClusterSupervisor:
                         or (self._prompt_resync and self.state.stale):
                     prompted = self._prompt_resync
                     self._prompt_resync = False
+                    reopened = False
+                    if self._reopen_requested:
+                        # A controller action just moved the cluster: a
+                        # load-once backend (snapshot) must re-read its
+                        # source or the cache resyncs the pre-move world
+                        # forever. Done HERE because this thread owns the
+                        # session.
+                        try:
+                            self._reopen_backend()
+                            reopened = True
+                            self._reopen_requested = False
+                        except Exception as e:
+                            # The request stays armed: consuming it on a
+                            # failed reopen would leave a load-once
+                            # backend resyncing the pre-move world
+                            # forever.
+                            self._count("daemon.resync_failures")
+                            self._log(
+                                f"post-action session reopen failed "
+                                f"({type(e).__name__}: {e}); retrying on "
+                                "the interval cadence"
+                            )
                     if prompted or self.state.stale \
                             or not self.state.synced_once:
-                        self._probe_or_resync()
+                        self._probe_or_resync(fresh_session=reopened)
                     else:
                         # Routine interval resync of a HEALTHY cluster: the
                         # lost-notification escape hatch, not a recovery —
@@ -1597,7 +1809,18 @@ class ClusterSupervisor:
                 plan_text = json.dumps(plan_obj)
             if not isinstance(plan_text, str):
                 raise ValueError("'plan_text' must be a string")
-            plan, topic_order = parse_plan_payload(plan_text)
+            # ``section`` selects which half of a saved mode-3 stdout to
+            # drive (ISSUE 15): "new" (default, forward) or "current" —
+            # the rollback snapshot, exactly `ka-execute --rollback`'s
+            # target. A bare plan JSON only carries "new".
+            section = params.get("section") or "new"
+            if section not in ("new", "current"):
+                raise ValueError(
+                    f"section must be 'new' or 'current', got {section!r}"
+                )
+            plan, topic_order = parse_plan_payload(
+                plan_text, section=section
+            )
             plan_hash = plan_fingerprint(plan, topic_order)
             journal = params.get("journal")
             if journal is None:
@@ -1681,6 +1904,8 @@ class ClusterSupervisor:
                 err=self.err,
                 cluster=self.spec,
                 on_event=safe_emit,
+                probe=ctx.get("probe"),
+                on_verified=ctx.get("on_verified"),
             )
             try:
                 outcome = executor.execute()
@@ -1769,6 +1994,11 @@ class ClusterSupervisor:
             "cluster": self.name,
             "breaker": self.breaker.snapshot(),
             "execution_in_flight": self._exec_lock.locked(),
+            "controller": {
+                "policy": self.controller.policy,
+                "paused": self.controller.paused(),
+                "breaker": self.controller.breaker_view(),
+            },
             "health": (
                 self._last_health.as_dict()
                 if self._last_health is not None else None

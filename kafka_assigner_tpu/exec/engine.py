@@ -154,6 +154,8 @@ class PlanExecutor:
         err: Optional[TextIO] = None,
         cluster: Optional[str] = None,
         on_event=None,
+        probe=None,
+        on_verified=None,
     ) -> None:
         from ..utils.env import env_float, env_int
 
@@ -185,8 +187,23 @@ class PlanExecutor:
         #: family. A failing callback disables itself — progress streaming
         #: must never abort an execution.
         self.on_event = on_event
+        #: Per-wave-boundary probe (the autonomous controller's chaos seam,
+        #: ISSUE 15): called right after the engine's own ``wave`` fault
+        #: point, BEFORE the wave submits. Exceptions propagate exactly
+        #: like the engine's own injected crashes — a supervising caller
+        #: observes them where it would observe a dead process.
+        self.probe = probe
+        #: Post-verify health re-score hook (ISSUE 15): called with the
+        #: OBSERVED ``{topic: {partition: [replicas]}}`` state the verify
+        #: pass just read, so a supervising controller can score the
+        #: achieved assignment without a second cluster read. A failing
+        #: hook is reported and swallowed — re-scoring must never fail an
+        #: execution that already converged.
+        self.on_verified = on_verified
         self.plan_hash = plan_fingerprint(self.plan, self.topic_order)
         self.outcome = ExecOutcome()
+        #: The verify pass's observed assignment (fed to ``on_verified``).
+        self.observed_state: Dict[str, Dict[int, List[int]]] = {}
 
     def _emit(self, event: dict) -> None:
         if self.on_event is None:
@@ -488,6 +505,8 @@ class PlanExecutor:
                 "expected": want_bytes, "observed": got_bytes,
                 "kind": "byte-diff",
             })
+        #: What the verify pass actually READ, for the post-verify hook.
+        self.observed_state = observed
         return mismatches
 
     # -- drive -------------------------------------------------------------
@@ -517,7 +536,11 @@ class PlanExecutor:
         for i in range(first, journal.waves_total):
             # The kill-between-waves seam (`wave:i=crash`): fires BEFORE the
             # wave submits, exactly where a process kill leaves the journal.
+            # The caller's probe (the controller's `controller:exec-crash`
+            # seam) fires at the same boundary — same journal semantics.
             fault_point("wave")
+            if self.probe is not None:
+                self.probe()
             if i > first and self.throttle > 0:
                 time.sleep(self.throttle)
             wave = journal.wave(i)
@@ -569,6 +592,16 @@ class PlanExecutor:
             "event": "exec/verify",
             "mismatches": len(out.mismatches),
         })
+        if self.on_verified is not None:
+            try:
+                self.on_verified(self.observed_state)
+            except Exception as e:
+                print(
+                    f"ka-execute: post-verify hook failed "
+                    f"({type(e).__name__}: {e}); execution outcome "
+                    "unaffected",
+                    file=self.err,
+                )
         journal.complete()
         if obs_active():
             gauge_set("plan.waves", journal.waves_total)

@@ -69,6 +69,19 @@ crash      dispatch         one coalesced device dispatch of the batched
 stall      dispatch         the dispatcher stalls ``arg`` seconds before a
                             coalesced dispatch — visible as queue wait and
                             watchdog overrun, never a hang
+verdict-flap controller     one controller evaluation's verdict is flipped
+                            (recommend⇄hold) — the hysteresis gate must
+                            reset its confirmation streak, never act on a
+                            flapping objective (ISSUE 15)
+exec-crash controller       the controller's supervised forward execution
+                            dies at a wave boundary (``InjectedExecCrash``)
+                            — abort-to-rollback must restore the
+                            byte-identical pre-action assignment and open
+                            the controller breaker
+regress    controller       the post-move re-score reads as a health
+                            regression (achieved worse than projected) —
+                            the same rollback path fires and the breaker
+                            opens
 ========== ================ ==============================================
 
 Spec grammar (``KA_FAULTS_SPEC``): semicolon-separated events
@@ -132,6 +145,14 @@ FAULT_SCOPES: Dict[str, Tuple[str, ...]] = {
     # that batch's jobs (each degrades per-job), a stall must surface as
     # queue wait, never a hang.
     "dispatch": ("crash", "stall"),
+    # The autonomous rebalance controller (ISSUE 15): three seams, each
+    # consulted with its OWN per-kind counter (`controller_point`) —
+    # verdict-flap flips one evaluation's verdict (hysteresis must hold),
+    # exec-crash kills the supervised forward execution at a wave boundary
+    # (abort-to-rollback must restore the pre-action bytes), regress makes
+    # the post-move re-score read as a health regression (same rollback
+    # path, breaker opens).
+    "controller": ("verdict-flap", "exec-crash", "regress"),
 }
 FAULT_KINDS = tuple(k for kinds in FAULT_SCOPES.values() for k in kinds)
 
@@ -142,6 +163,7 @@ RANDOM_HORIZON: Dict[str, int] = {
     "connect": 3, "handshake": 3, "reply": 64, "solve": 2, "warmup": 2,
     "write": 8, "converge": 8, "wave": 4,
     "watch": 8, "session": 4, "resync": 4, "daemon": 4, "dispatch": 4,
+    "controller": 4,
 }
 
 #: The scope iteration order of :func:`random_schedule`. Frozen EXPLICITLY —
@@ -155,6 +177,7 @@ RANDOM_ORDER: Tuple[str, ...] = (
     "write", "converge", "wave",
     "watch", "session", "resync", "daemon",
     "dispatch",
+    "controller",
 )
 
 ERR_NONODE = -101
@@ -551,6 +574,46 @@ class FaultInjector:
             self._fire(ev)
             time.sleep(ev.arg if ev.arg is not None else 0.05)
 
+    def controller_point(self, kind: str,
+                         cluster: Optional[str] = None) -> bool:
+        """Called by the autonomous rebalance controller (ISSUE 15) at its
+        three seams, each identified by the KIND it consults for:
+        ``verdict-flap`` once per evaluation (a firing flips that
+        evaluation's verdict — the hysteresis gate must absorb it),
+        ``exec-crash`` once per forward-execution wave boundary (raises
+        :class:`InjectedExecCrash` mid-loop — abort-to-rollback must
+        restore the pre-action assignment bytes), ``regress`` once per
+        post-move re-score (a firing makes the achieved score read as a
+        regression — same rollback path, controller breaker opens).
+
+        Unlike the single-seam scopes, each kind keeps its OWN consult
+        counter, so ``controller:1=exec-crash`` means "the second wave
+        boundary" regardless of how many evaluations ran before it. The
+        schedule still keys events ``(scope, cluster, index)``, so one
+        schedule can carry at most one controller event per index."""
+        key = f"controller.{kind}"
+        i = self._counts.get(key, 0)
+        self._counts[key] = i + 1
+        ev = self._events.get(("controller", None, i))
+        if ev is not None and ev.kind != kind:
+            ev = None
+        if ev is None and cluster is not None:
+            ckey = (key, cluster)
+            j = self._cluster_counts.get(ckey, 0)
+            self._cluster_counts[ckey] = j + 1
+            ev = self._events.get(("controller", cluster, j))
+            if ev is not None and ev.kind != kind:
+                ev = None
+        if ev is None:
+            return False
+        self._fire(ev)
+        if kind == "exec-crash":
+            raise InjectedExecCrash(
+                "injected fault: controller forward execution killed at a "
+                "wave boundary"
+            )
+        return True
+
     def daemon_solve(self, cluster: Optional[str] = None) -> None:
         """Called at the daemon's per-request solve dispatch boundary;
         ``solver-crash`` raises :class:`InjectedSolverCrash` — the request
@@ -615,6 +678,18 @@ def active_injector() -> Optional[FaultInjector]:
         )
     _ENV_CACHE = ((spec, seed), injector)
     return injector
+
+
+def controller_fault(kind: str, cluster: Optional[str] = None) -> bool:
+    """The controller's per-kind fault consult (ISSUE 15): returns True
+    when the scheduled ``controller`` event of this ``kind`` fired
+    (``verdict-flap``/``regress``); ``exec-crash`` raises
+    :class:`InjectedExecCrash` instead. No-op False without an active
+    injector."""
+    inj = active_injector()
+    if inj is None:
+        return False
+    return inj.controller_point(kind, cluster)
 
 
 def fault_point(scope: str, cluster: Optional[str] = None) -> None:

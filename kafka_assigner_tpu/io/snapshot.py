@@ -123,7 +123,14 @@ class SnapshotBackend:
         return list(self._brokers)
 
     def all_topics(self) -> List[str]:
-        return list(self._topics)
+        # Sorted, like every other backend (zk/kafka_admin) and the daemon
+        # cache: topic ORDER is part of the stdout byte contract, and a
+        # file-order listing made daemon and fresh-CLI output disagree for
+        # >10 numerically-named topics unless fixtures zero-padded their
+        # names (the ISSUE 14 bench workaround, now dropped) — ordering is
+        # canonicalized HERE, at the backend boundary, so no consumer ever
+        # sees insertion order again.
+        return sorted(self._topics)
 
     def fetch_topics(
         self, topics: Sequence[str], missing: str = "raise"

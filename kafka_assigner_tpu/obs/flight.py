@@ -40,6 +40,12 @@ dispatch   ``entry``, ``jobs``, ``coalesced`` (+ ``rows``, ``ok``,
            ``ms`` for device batches): one batched-dispatcher execution —
            a coalesced device dispatch or a deduped body family
            (ISSUE 14)
+controller ``decision``: hold / confirmed / would-act / truncate / act /
+           acted / abort / rollback / breaker-open / breaker-half-open /
+           breaker-closed / paused / resumed (+ ``reason``, ``verdict``,
+           ``moves``, ``streak``, ``plan_sha``): one decision of the
+           autonomous rebalance controller (ISSUE 15) — the audit trail
+           the chaos matrix diffs after every injected mid-loop fault
 ========== ===========================================================
 
 Activation model, same as the rest of ``obs/``: nothing records until

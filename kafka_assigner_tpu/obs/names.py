@@ -110,6 +110,16 @@ METRIC_NAMES: frozenset = frozenset({
     # and the queue wait (separated from solve time by construction)
     "dispatch.batches", "dispatch.jobs", "dispatch.solo_fallbacks",
     "dispatch.batch_size", "daemon.solve.queue_ms",
+    # controller.* — the closed-loop rebalance controller (ISSUE 15):
+    # evaluation/decision counters, executed actions and their moves,
+    # safety-rail firings (truncations, window holds), the
+    # abort-to-rollback path and the controller breaker, plus the live
+    # hysteresis-streak and window-budget gauges
+    "controller.evaluations", "controller.holds", "controller.actions",
+    "controller.truncations", "controller.rollbacks",
+    "controller.regressions", "controller.exec_failures",
+    "controller.breaker_opened", "controller.breaker_closed",
+    "controller.moves", "controller.window_moves", "controller.streak",
 })
 
 #: Span names (``span(...)`` / ``record_span(...)`` first argument).
@@ -130,6 +140,10 @@ SPAN_NAMES: frozenset = frozenset({
     # one span per coalesced device solve the batched dispatcher runs
     # (ISSUE 14; recorded on the dispatcher thread — cumulative-only)
     "dispatch",
+    # the rebalance controller (ISSUE 15): one evaluation of the live
+    # recommendation pipeline, and one supervised action (forward
+    # execution + post-move re-score + any rollback)
+    "controller/evaluate", "controller/act",
 })
 
 #: Both namespaces — what the supervisor's ``_metric`` wrapper may label.
@@ -199,6 +213,13 @@ UNITLESS_METRICS: frozenset = frozenset({
     # histogram of jobs-per-coalesced-dispatch
     "dispatch.batches", "dispatch.jobs", "dispatch.solo_fallbacks",
     "dispatch.batch_size",
+    # controller.* event/item counts (decisions, actions, executed moves,
+    # rail firings, breaker transitions) and the streak/window gauges
+    "controller.evaluations", "controller.holds", "controller.actions",
+    "controller.truncations", "controller.rollbacks",
+    "controller.regressions", "controller.exec_failures",
+    "controller.breaker_opened", "controller.breaker_closed",
+    "controller.moves", "controller.window_moves", "controller.streak",
     # grandfathered: unit (bytes) lives mid-name, predates KA014; renaming
     # the scrape family would orphan existing dashboards
     "zk.wire_bytes_in", "zk.wire_bytes_out",

@@ -241,8 +241,10 @@ _knob(
     doc="fault-injection schedule for the harness in `faults/inject.py`: "
         "semicolon-separated `scope[@cluster]:index=kind[:arg]` events "
         "(scopes connect/handshake/reply/solve/warmup plus the write seams "
-        "write/converge/wave and the daemon seams watch/session/resync/"
-        "daemon; kinds blackhole, expire, drop, trunc, slow, nonode, "
+        "write/converge/wave, the daemon seams watch/session/resync/"
+        "daemon/dispatch and the controller seams "
+        "controller:{verdict-flap,exec-crash,regress}; kinds blackhole, "
+        "expire, drop, trunc, slow, nonode, "
         "crash, lost, stall, solver-crash), or the word `random` for a "
         "seed-deterministic schedule (`KA_FAULTS_SEED`/`KA_FAULTS_RATE`). "
         "`@cluster` addresses one cluster of the multi-cluster daemon "
@@ -429,6 +431,70 @@ _knob(
         "are queued the gather window closes immediately — bounds both the "
         "coalesced batch width and the latency a storm can add to the "
         "first queued request. Read live per gather cycle",
+)
+
+# --- autonomous rebalance controller (daemon/controller.py) -----------------
+_knob(
+    "KA_CONTROLLER", "choice", "off", choices=("off", "observe", "auto"),
+    doc="the closed-loop rebalance controller's policy ladder "
+        "(`daemon/controller.py`, per cluster; the `--clusters` spec "
+        "overrides per entry via `name=connect#controller=auto` or the "
+        "JSON object form). `off` (default): no controller thread at all. "
+        "`observe`: evaluate the recommendation pipeline on the interval "
+        "and flight-record every decision — including `would-act` — but "
+        "NEVER execute. `auto`: a `recommend` verdict that survives "
+        "hysteresis is dispatched through the supervised /execute "
+        "machinery under the blast-radius/cooldown/breaker safety rails. "
+        "An explicit opt-in knob: nothing rebalances a cluster unless an "
+        "operator set this",
+)
+_knob(
+    "KA_CONTROLLER_INTERVAL", "float", 30.0, floor=0.05,
+    doc="seconds between controller evaluations of the live "
+        "recommendation pipeline (each evaluation is one solve under the "
+        "shared dispatch regime, so the cadence trades advice freshness "
+        "against device work). Read live per loop iteration",
+)
+_knob(
+    "KA_CONTROLLER_CONFIRMATIONS", "int", 3, floor=1,
+    doc="hysteresis gate: consecutive evaluations that must return a "
+        "`recommend` verdict for the SAME plan bytes before the "
+        "controller acts — a flapping objective (verdict or plan "
+        "changing between evaluations) resets the streak and can never "
+        "oscillate the cluster (the verdict-gated actuation posture of "
+        "arXiv:2402.06085)",
+)
+_knob(
+    "KA_CONTROLLER_MAX_MOVES", "int", 16, floor=1,
+    doc="blast-radius cap, enforced twice: per ACTION (an oversize plan "
+        "is truncated to a prefix-wave subset of at most this many "
+        "replica moves — or held — never partially trusted) and per "
+        "`KA_CONTROLLER_WINDOW` rolling window (actions stop once the "
+        "window's executed-move budget is spent, resuming as old actions "
+        "age out). Read live per evaluation",
+)
+_knob(
+    "KA_CONTROLLER_WINDOW", "float", 3600.0, floor=1.0,
+    doc="the rolling window (seconds) of the blast-radius move budget: "
+        "moves executed by controller actions inside this window count "
+        "against `KA_CONTROLLER_MAX_MOVES`. The window ledger persists "
+        "in the journal dir (`ka-controller-<cluster>.window.json`), so "
+        "a daemon restart cannot reset the budget",
+)
+_knob(
+    "KA_CONTROLLER_COOLDOWN", "float", 300.0, floor=0.0,
+    doc="minimum seconds between controller actions on one cluster, "
+        "jittered 0.5-1.5x per action so a fleet of controllers never "
+        "rebalances in lockstep; evaluations continue during the "
+        "cooldown (keeping hysteresis warm) but actions hold",
+)
+_knob(
+    "KA_CONTROLLER_REGRESSION_TOL", "float", 0.0, floor=0.0,
+    doc="post-move regression tolerance: after a completed action the "
+        "achieved composite health score (re-scored from the verify "
+        "pass's observed state) may exceed the plan's projected score by "
+        "at most this much; anything worse triggers the journaled "
+        "abort-to-rollback path and opens the controller breaker",
 )
 
 # --- consumer-group workload family (ka-groups / daemon /groups/*) ----------

@@ -66,7 +66,7 @@ def _snapshot() -> str:
             for i in range(nb)
         ],
         "topics": {
-            f"t{t:03d}": {
+            f"t{t}": {
                 str(p): [(t + p + k) % nb for k in range(rf)]
                 for p in range(npart)
             }

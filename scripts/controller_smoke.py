@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Closed-loop controller smoke (tier-1, via scripts/lint.sh): the ISSUE 15
+auto-execute rung end to end against REAL ``ka-daemon`` subprocesses, each
+serving two snapshot clusters — ``a`` opted into ``controller=auto`` via
+the per-cluster ``--clusters`` override, ``b`` left on the default ``off``.
+
+Phase 1 — convergence: cluster ``a`` is seeded imbalanced (every replica
+on brokers 1-2 of 4). The controller must confirm the recommendation
+through hysteresis and ACT: the ``/clusters/a/controller`` decision trail
+shows ``acted``, the action journal on disk is ``complete``, the snapshot
+file's re-scored composite health improves, and ``/metrics`` exposes
+``ka_controller_actions_total`` for ``a`` only. Cluster ``b`` (policy
+``off``) shows zero controller activity and untouched bytes. SIGTERM
+drains to exit 0.
+
+Phase 2 — abort-to-rollback: a fresh daemon with
+``KA_FAULTS_SPEC=controller@a:1=exec-crash`` kills the forward execution
+at its second wave boundary (real movement already committed). The
+controller must roll the cluster back to the BYTE-IDENTICAL pre-action
+assignment, open its breaker (visible in the endpoint view and the
+decision trail), and leave ``b`` untouched again. SIGTERM exit 0.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from scripts.health_smoke import _req, _start_daemon  # noqa: E402
+
+
+def _imbalanced_snapshot(workdir, name):
+    snap = {
+        "brokers": [
+            {"id": i, "host": f"b{i}", "port": 9092, "rack": f"r{i}"}
+            for i in range(1, 5)
+        ],
+        "topics": {
+            "hot": {str(p): [1, 2] for p in range(4)},
+            "events": {"0": [1, 2, 3]},
+        },
+    }
+    path = os.path.join(workdir, name)
+    with open(path, "w") as f:
+        json.dump(snap, f)
+    return path
+
+
+def _topics(path):
+    with open(path) as f:
+        return json.load(f)["topics"]
+
+
+def _score(path):
+    from kafka_assigner_tpu.obs.health import score_assignment
+
+    with open(path) as f:
+        data = json.load(f)
+    return score_assignment(
+        {b["id"] for b in data["brokers"]},
+        {t: {int(p): r for p, r in parts.items()}
+         for t, parts in data["topics"].items()},
+        {b["id"]: b["rack"] for b in data["brokers"] if b.get("rack")},
+    ).score
+
+
+def _controller_view(port, cluster):
+    s, raw, _ = _req(port, "GET", f"/clusters/{cluster}/controller")
+    if s != 200:
+        raise SystemExit(
+            f"FAIL: /clusters/{cluster}/controller http={s}: {raw[:200]}"
+        )
+    return json.loads(raw)
+
+
+def _await_decision(port, cluster, decision, deadline_s=90.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        view = _controller_view(port, cluster)
+        if any(e["decision"] == decision for e in view["decisions"]):
+            return view
+        time.sleep(0.25)
+    raise SystemExit(
+        f"FAIL: controller on {cluster!r} never reached {decision!r} "
+        f"(trail: {[e['decision'] for e in view['decisions']]})"
+    )
+
+
+def _drain(daemon, stderr_lines):
+    daemon.send_signal(signal.SIGTERM)
+    rc = daemon.wait(timeout=60)
+    if rc != 0:
+        raise SystemExit(
+            f"FAIL: daemon exit code {rc} after SIGTERM\n"
+            + "".join(stderr_lines)
+        )
+
+
+def _counter_total(port, fam, cluster=None):
+    from kafka_assigner_tpu.obs import promtext
+
+    s, raw, _ = _req(port, "GET", "/metrics")
+    if s != 200:
+        raise SystemExit(f"FAIL: /metrics http={s}")
+    families = promtext.parse(raw.decode("utf-8"))
+    data = families.get(fam)
+    if data is None:
+        return None
+    total = 0.0
+    seen = False
+    for _n, labels, v in data["samples"]:
+        if cluster is None or dict(labels).get("cluster") == cluster:
+            total += v
+            seen = True
+    return total if seen else None
+
+
+def main() -> int:
+    workdir = tempfile.mkdtemp(prefix="ka_controller_smoke_")
+    base_env = {
+        **os.environ,
+        "KA_CONTROLLER_INTERVAL": "0.2",
+        "KA_CONTROLLER_CONFIRMATIONS": "2",
+        "KA_CONTROLLER_COOLDOWN": "600",
+        "KA_CONTROLLER_MAX_MOVES": "32",
+        "KA_DAEMON_RESYNC_INTERVAL": "0.3",
+        "KA_DAEMON_JOURNAL_DIR": workdir,
+        "KA_EXEC_POLL_INTERVAL": "0.01",
+    }
+
+    # ---- phase 1: seeded imbalance converges to an acted rebalance ----
+    snap_a = _imbalanced_snapshot(workdir, "a.json")
+    snap_b = _imbalanced_snapshot(workdir, "b.json")
+    pre_b = _topics(snap_b)
+    pre_score = _score(snap_a)
+    daemon = None
+    try:
+        daemon, port, lines = _start_daemon(
+            f"a={snap_a}#controller=auto;b={snap_b}", base_env
+        )
+        view = _await_decision(port, "a", "acted")
+        if view["policy"] != "auto" or view["breaker"]["state"] != "closed":
+            print(f"FAIL: unexpected acted-view {view['policy']}/"
+                  f"{view['breaker']}", file=sys.stderr)
+            return 1
+        post_score = _score(snap_a)
+        if not post_score < pre_score:
+            print(f"FAIL: health score did not improve "
+                  f"({pre_score} -> {post_score})", file=sys.stderr)
+            return 1
+        journals = [
+            p for p in os.listdir(workdir)
+            if p.startswith("ka-controller-a-") and p.endswith(".journal")
+        ]
+        if not journals:
+            print("FAIL: no action journal on disk", file=sys.stderr)
+            return 1
+        for p in journals:
+            with open(os.path.join(workdir, p)) as f:
+                if json.load(f).get("status") != "complete":
+                    print(f"FAIL: journal {p} not complete",
+                          file=sys.stderr)
+                    return 1
+        acted = _counter_total(
+            port, "ka_controller_actions_total", cluster="a"
+        )
+        if not acted or acted < 1:
+            print(f"FAIL: ka_controller_actions_total for a = {acted}",
+                  file=sys.stderr)
+            return 1
+        # The off cluster: zero controller activity, untouched bytes.
+        view_b = _controller_view(port, "b")
+        if view_b["policy"] != "off" or view_b["decisions"]:
+            print(f"FAIL: off cluster shows controller activity "
+                  f"({view_b['policy']}, {len(view_b['decisions'])} "
+                  "decisions)", file=sys.stderr)
+            return 1
+        if _counter_total(
+            port, "ka_controller_evaluations_total", cluster="b"
+        ) is not None:
+            print("FAIL: off cluster minted controller scrape series",
+                  file=sys.stderr)
+            return 1
+        _drain(daemon, lines)
+        daemon = None
+        if _topics(snap_b) != pre_b:
+            print("FAIL: off cluster bytes changed", file=sys.stderr)
+            return 1
+
+        # ---- phase 2: injected exec-crash rolls back, breaker opens ----
+        snap_a2 = _imbalanced_snapshot(workdir, "a2.json")
+        snap_b2 = _imbalanced_snapshot(workdir, "b2.json")
+        pre_a2 = _topics(snap_a2)
+        env2 = {
+            **base_env,
+            "KA_EXEC_WAVE_SIZE": "2",
+            "KA_FAULTS_SPEC": "controller@a:1=exec-crash",
+        }
+        daemon, port, lines = _start_daemon(
+            f"a={snap_a2}#controller=auto;b={snap_b2}", env2
+        )
+        view = _await_decision(port, "a", "rollback")
+        decs = [e["decision"] for e in view["decisions"]]
+        for expected in ("act", "abort", "rollback", "breaker-open"):
+            if expected not in decs:
+                print(f"FAIL: decision trail missing {expected!r} "
+                      f"({decs})", file=sys.stderr)
+                return 1
+        if view["breaker"]["state"] != "open":
+            print(f"FAIL: breaker not open after rollback "
+                  f"({view['breaker']})", file=sys.stderr)
+            return 1
+        _drain(daemon, lines)
+        daemon = None
+        if _topics(snap_a2) != pre_a2:
+            print("FAIL: rolled-back cluster differs from the "
+                  "pre-action assignment", file=sys.stderr)
+            return 1
+
+        print(
+            "controller_smoke: PASS (auto cluster converged to an acted "
+            "rebalance with a complete journal and improved score, "
+            "injected controller:exec-crash rolled back byte-identically "
+            "with the breaker open, off cluster fully inert, clean "
+            "SIGTERM drains)",
+            file=sys.stderr,
+        )
+        return 0
+    finally:
+        if daemon is not None and daemon.poll() is None:
+            daemon.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,6 +95,13 @@ python scripts/groups_smoke.py
 # across a coalesced round (compile counters pinned), /metrics
 # parse-consistent, KA_DISPATCH=0 kill-switch parity, SIGTERM exit 0.
 python scripts/dispatch_smoke.py
+# Closed-loop controller smoke (ISSUE 15): real two-cluster ka-daemon over
+# snapshots, one cluster controller=auto and one off — seeded imbalance
+# converges to an acted rebalance (complete journal, improved health
+# score), injected controller:exec-crash rolls back to the byte-identical
+# pre-action assignment with the breaker open, the off cluster shows zero
+# controller activity, SIGTERM exit 0.
+python scripts/controller_smoke.py
 # Warm-start smoke (ISSUE 6): program store populate -> clear-memory -> hit
 # on the CPU backend, byte-identical output, compile.store.hits >= 1. The
 # fresh-process bench is the slow-marked tests/test_bench_warmstart.py.

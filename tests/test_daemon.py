@@ -490,6 +490,44 @@ def test_snapshot_backend_daemon(tmp_path):
         d.shutdown()
 
 
+def test_snapshot_topic_order_canonical(tmp_path):
+    """Topic ORDER is canonicalized at the backend boundary (ISSUE 15
+    satellite): a snapshot whose file lists >10 numerically-named topics
+    WITHOUT zero-padding (so lexicographic != insertion order) must serve
+    the same stdout bytes from the daemon cache (sorted by construction)
+    and from a fresh CLI run over the file — the pre-existing ordering
+    dependence ISSUE 14's bench had to zero-pad around."""
+    from kafka_assigner_tpu.io.snapshot import SnapshotBackend
+
+    snap = tmp_path / "many.json"
+    snap.write_text(json.dumps({
+        "brokers": [
+            {"id": i, "host": f"b{i}", "port": 9092, "rack": f"r{i % 2}"}
+            for i in range(4)
+        ],
+        # File order t0, t1, ... t11: lexicographic order interleaves
+        # (t0, t1, t10, t11, t2, ...), so an insertion-order listing
+        # diverges from the cache's sorted one.
+        "topics": {
+            f"t{t}": {str(p): [(t + p) % 4, (t + p + 1) % 4]
+                      for p in range(2)}
+            for t in range(12)
+        },
+    }))
+    assert SnapshotBackend(str(snap)).all_topics() == sorted(
+        f"t{t}" for t in range(12)
+    )
+    base = fresh_cli(str(snap), "--solver", "greedy")
+    d = AssignerDaemon(str(snap), solver="greedy")
+    d.start()
+    try:
+        s, body, _ = req(d.http_port, "POST", "/plan", {})
+        assert s == 200 and body["status"] == "ok"
+        assert body["result"]["stdout"] == base
+    finally:
+        d.shutdown()
+
+
 # --- ISSUE 9: multi-cluster supervisors, bulkheads, breakers, /execute ------
 
 import os

@@ -726,3 +726,55 @@ def test_global_event_does_not_swallow_cluster_event():
     assert inj.session_check(cluster="west")  # the global event fires
     assert inj.session_check(cluster="west")  # west's own event, not lost
     assert len(inj.fired) == 2
+
+
+# --- the controller seams (ISSUE 15) -----------------------------------------
+
+def test_controller_spec_parses_and_validates():
+    events = parse_spec(
+        "controller:0=verdict-flap;controller:1=exec-crash;"
+        "controller@west:0=regress"
+    )
+    assert [(e.scope, e.index, e.kind, e.cluster) for e in events] == [
+        ("controller", 0, "verdict-flap", None),
+        ("controller", 1, "exec-crash", None),
+        ("controller", 0, "regress", "west"),
+    ]
+    with pytest.raises(FaultSpecError):
+        parse_spec("controller:0=drop")  # not a controller kind
+    with pytest.raises(FaultSpecError):
+        parse_spec("reply:0=verdict-flap")  # controller-only kind
+
+
+def test_controller_point_keeps_per_kind_counters():
+    # controller:1=exec-crash means "the SECOND wave boundary", however
+    # many evaluations (verdict-flap consults) ran before it — each seam
+    # counts its own consults.
+    inj = FaultInjector(parse_spec("controller:1=exec-crash"))
+    from kafka_assigner_tpu.faults.inject import InjectedExecCrash
+
+    assert inj.controller_point("verdict-flap") is False  # eval 0
+    assert inj.controller_point("verdict-flap") is False  # eval 1
+    assert inj.controller_point("exec-crash") is False    # wave 0
+    with pytest.raises(InjectedExecCrash):
+        inj.controller_point("exec-crash")                # wave 1: fires
+    assert [str(e) for e in inj.fired] == ["controller:1=exec-crash"]
+
+
+def test_controller_point_kind_mismatch_never_fires():
+    # A scheduled regress event is invisible to the exec-crash seam even
+    # at the matching index: kinds bind to their seams.
+    inj = FaultInjector(parse_spec("controller:0=regress"))
+    assert inj.controller_point("exec-crash") is False
+    assert inj.controller_point("verdict-flap") is False
+    assert inj.controller_point("regress") is True
+    assert [e.kind for e in inj.fired] == ["regress"]
+
+
+def test_controller_point_cluster_addressing():
+    inj = FaultInjector(parse_spec("controller@a:0=verdict-flap"))
+    # Another cluster's consults never fire it and never consume a's index.
+    assert inj.controller_point("verdict-flap", cluster="b") is False
+    assert inj.controller_point("verdict-flap", cluster="a") is True
+    assert inj.controller_point("verdict-flap", cluster="a") is False
+    assert [str(e) for e in inj.fired] == ["controller@a:0=verdict-flap"]

@@ -1319,7 +1319,7 @@ def test_ka011_helper_without_deadline_still_flagged():
 
 def test_rule_docs_cover_every_rule():
     assert set(kalint.RULE_DOCS) == set(kalint.RULES)
-    assert set(kalint.RULES) == {f"KA{n:03d}" for n in range(20)}
+    assert set(kalint.RULES) == {f"KA{n:03d}" for n in range(21)}
     for rule, (meaning, example) in kalint.RULE_DOCS.items():
         assert meaning and example, rule
 
@@ -1540,3 +1540,186 @@ def test_ka018_and_ka019_are_documented():
     for rule in ("KA018", "KA019"):
         assert rule in kalint.RULES
         assert rule in kalint.RULE_DOCS
+
+
+# --- KA020: the blocking-call budget (KA015/KA019's quantitative twin) -------
+
+def test_ka020_gate_chain_exceeding_budget_flags(tmp_path):
+    # KA_EXEC_POLL_TIMEOUT defaults to 600 s — one consult under an
+    # admission blows the 30 s watchdog budget 20x over.
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "util.py": (
+            "def poll_loop(env_float):\n"
+            '    t = env_float("KA_EXEC_POLL_TIMEOUT")\n'
+            "    return t\n"
+        ),
+        "daemon/__init__.py": "",
+        "daemon/supervisor.py": (
+            "from ..util import poll_loop\n\n\n"
+            "class ClusterSupervisor:\n"
+            "    def _gate(self):\n"
+            "        return None\n\n"
+            "    def handle(self, env_float):\n"
+            "        refusal = self._gate()\n"
+            "        if refusal is not None:\n"
+            "            return refusal\n"
+            "        return poll_loop(env_float)\n"
+        ),
+    })
+    ka020 = [f for f in kalint.lint_tree(root) if f.rule == "KA020"]
+    assert len(ka020) == 1
+    f = ka020[0]
+    assert f.path.endswith("util.py")
+    assert "KA_EXEC_POLL_TIMEOUT" in f.message
+    assert "600" in f.message and "30" in f.message
+    assert any("ClusterSupervisor.handle" in hop for hop in f.chain)
+
+
+def test_ka020_within_budget_is_clean(tmp_path):
+    # KA_DAEMON_DRAIN_TIMEOUT defaults to 10 s: inside the 30 s budget.
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "daemon/__init__.py": "",
+        "daemon/supervisor.py": (
+            "class ClusterSupervisor:\n"
+            "    def _gate(self):\n"
+            "        return None\n\n"
+            "    def handle(self, env_float):\n"
+            "        self._gate()\n"
+            '        return env_float("KA_DAEMON_DRAIN_TIMEOUT")\n'
+        ),
+    })
+    assert "KA020" not in rules_of(kalint.lint_tree(root))
+
+
+def test_ka020_retries_multiply_the_timeout(tmp_path):
+    # 10 s drain timeout alone is fine; consulted NEXT TO a retries knob
+    # (KA_ZK_CONNECT_RETRIES default 3) the worst case is 10 * (1+3) =
+    # 40 s > 30 s — each retry re-arms the timeout.
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "daemon/__init__.py": "",
+        "daemon/supervisor.py": (
+            "class ClusterSupervisor:\n"
+            "    def _gate(self):\n"
+            "        return None\n\n"
+            "    def handle(self, env_float, env_int):\n"
+            "        self._gate()\n"
+            '        n = env_int("KA_ZK_CONNECT_RETRIES")\n'
+            '        t = env_float("KA_DAEMON_DRAIN_TIMEOUT")\n'
+            "        return n * t\n"
+        ),
+    })
+    ka020 = [f for f in kalint.lint_tree(root) if f.rule == "KA020"]
+    assert len(ka020) == 1
+    assert "KA_ZK_CONNECT_RETRIES" in ka020[0].message
+    assert "40" in ka020[0].message
+
+
+def test_ka020_solve_lock_chain_flags_too(tmp_path):
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "daemon/__init__.py": "",
+        "daemon/service.py": (
+            "import threading\n\n\n"
+            "class Daemon:\n"
+            "    def __init__(self):\n"
+            "        self._solve_lock = threading.Lock()\n\n"
+            "    def converge(self, env_float):\n"
+            '        return env_float("KA_EXEC_POLL_TIMEOUT")\n\n'
+            "    def serve(self, env_float):\n"
+            "        with self._solve_lock:\n"
+            "            return self.converge(env_float)\n"
+        ),
+    })
+    ka020 = [f for f in kalint.lint_tree(root) if f.rule == "KA020"]
+    assert len(ka020) == 1
+    assert "solve lock" in ka020[0].message
+
+
+def test_ka020_envelope_sums_across_chain_hops(tmp_path):
+    # Each hop is under budget alone; the CHAIN is not — the rule prices
+    # the path, not the function. Custom defaults via the public API.
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "daemon/__init__.py": "",
+        "daemon/supervisor.py": (
+            "class ClusterSupervisor:\n"
+            "    def _gate(self):\n"
+            "        return None\n\n"
+            "    def first(self, env_float):\n"
+            '        t = env_float("KA_HOP_TIMEOUT")\n'
+            "        return self.second(env_float) + t\n\n"
+            "    def second(self, env_float):\n"
+            '        return env_float("KA_HOP_TIMEOUT")\n\n'
+            "    def handle(self, env_float):\n"
+            "        self._gate()\n"
+            "        return self.first(env_float)\n"
+        ),
+    })
+    project = kalint.build_project(root)
+    defaults = {"KA_HOP_TIMEOUT": 4.0, kalint.BUDGET_KNOB: 6.0}
+    findings = kalint.check_blocking_budget(project, {}, defaults)
+    # `second`'s chain is handle -> first (4s) -> second (4s) = 8s > 6s;
+    # `first` alone is 4s and stays clean.
+    assert [f.rule for f in findings] == ["KA020"]
+    assert "8 s" in findings[0].message
+
+
+def test_ka020_ms_knobs_price_as_milliseconds(tmp_path):
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "daemon/__init__.py": "",
+        "daemon/supervisor.py": (
+            "class ClusterSupervisor:\n"
+            "    def _gate(self):\n"
+            "        return None\n\n"
+            "    def handle(self, env_float):\n"
+            "        self._gate()\n"
+            '        return env_float("KA_GATHER_TIMEOUT_MS")\n'
+        ),
+    })
+    project = kalint.build_project(root)
+    # 5000 ms = 5 s: under a 6 s budget despite the large raw number.
+    assert kalint.check_blocking_budget(
+        project, {},
+        {"KA_GATHER_TIMEOUT_MS": 5000.0, kalint.BUDGET_KNOB: 6.0},
+    ) == []
+    # 9000 ms = 9 s: over it.
+    flagged = kalint.check_blocking_budget(
+        project, {},
+        {"KA_GATHER_TIMEOUT_MS": 9000.0, kalint.BUDGET_KNOB: 6.0},
+    )
+    assert [f.rule for f in flagged] == ["KA020"]
+
+
+def test_ka020_suppression_with_reason(tmp_path):
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "daemon/__init__.py": "",
+        "daemon/supervisor.py": (
+            "class ClusterSupervisor:\n"
+            "    def _gate(self):\n"
+            "        return None\n\n"
+            "    def handle(self, env_float):  # kalint: disable=KA020 -- bound unreachable: the poll exits on the drain event first\n"
+            "        self._gate()\n"
+            '        return env_float("KA_EXEC_POLL_TIMEOUT")\n'
+        ),
+    })
+    assert "KA020" not in rules_of(kalint.lint_tree(root))
+
+
+def test_ka020_repo_sweep_is_clean():
+    # The repo's own held regions price under the watchdog budget: every
+    # long-deadline consult (exec convergence polls, connect retries)
+    # lives OUTSIDE the solve lock and the admission gates — the
+    # controller's act path (ISSUE 15) deliberately executes after
+    # releasing its evaluation slot for exactly this reason.
+    findings = kalint.lint_package(use_cache=False)
+    assert not [f for f in findings if f.rule == "KA020"]
+
+
+def test_ka020_is_documented():
+    assert "KA020" in kalint.RULES
+    assert "KA020" in kalint.RULE_DOCS
