@@ -15,7 +15,18 @@ reachable from a ``with <solve-lock>`` region in ``daemon/``), so KA002/
 KA007 fire anywhere in the traced set, KA012 is transitive, and the graph
 powers three rules a single-file pass cannot see (KA015–KA017).
 
-The rule catalog (KA000–KA017) lives in :data:`RULES` with one-line
+Since ISSUE 16 the graph also carries a THREAD-TOPOLOGY layer
+(:mod:`.threads`): discovered thread entries (``Thread``/``Timer``/
+executor targets, the HTTP handler surface, the daemon main thread),
+per-entry reachable sets, an attribute-level shared-state model over the
+``daemon/``/``exec/`` classes, and lock-set inference generalized from
+the solve lock to every in-project ``threading.Lock/RLock/Condition`` —
+feeding the race rules (KA021 unguarded multi-thread writes, KA022
+inconsistent guarding) and the deadlock rule (KA023 lock-order cycles).
+The smoke harnesses under ``scripts/`` are grafted into the same graph,
+so their plumbing is swept too.
+
+The rule catalog (KA000–KA023) lives in :data:`RULES` with one-line
 meanings and example chains in :data:`RULE_DOCS`; the README rule table is
 generated from it (``python -m kafka_assigner_tpu.analysis.ruledoc
 --write``).
@@ -84,11 +95,27 @@ from .rules import (  # noqa: F401
     WRITE_OPCODES,
     ZK_WRITE_FUNC_NAMES,
     BUDGET_KNOB,
+    CONTROLLER_BUDGET_KNOB,
+    CONTROLLER_MODULE,
     check_blocking_budget,
     check_dead_knobs,
     check_metric_units,
     check_readme,
+    check_thread_safety,
     project_findings,
+)
+from .threads import (  # noqa: F401
+    HTTP_SURFACE_SEEDS,
+    LOCK_CTOR_NAMES,
+    MAIN_THREAD_SEEDS,
+    SHARED_STATE_PREFIXES,
+    LockEdge,
+    SharedAccess,
+    ThreadEntry,
+    ThreadModel,
+    discover_locks,
+    discover_thread_entries,
+    thread_model,
 )
 from .driver import (  # noqa: F401
     lint_package,

@@ -16,9 +16,16 @@ Output:
   violation (same rule/path/line/col — e.g. a graph finding's per-module
   twin) merged chain-preferentially, chains included. Cache status goes
   to stderr only, so two identical runs produce byte-identical payloads.
+- ``--format sarif [--out FILE]`` — SARIF 2.1.0 for code-scanning UIs:
+  one run, one result per finding, chains rendered as the result's
+  ``codeFlows`` thread-flow locations. Deterministic like the JSON.
 - ``--explain KA0NN`` (repeatable) — after the findings, print every
   offending call chain (entry → … → sink) for that rule's graph-backed
   findings.
+- ``--changed-only`` — restrict the REPORT (never the analysis: graph
+  rules need the whole tree) to findings in files modified since the
+  analysis cache entry was last written — the fast pre-commit loop.
+  With no cache baseline (cache off/cold) every finding is kept.
 """
 from __future__ import annotations
 
@@ -59,6 +66,94 @@ def _json_payload(findings: Sequence[Finding], root: str) -> dict:
     }
 
 
+#: The SARIF version/schema pair the ``--format sarif`` payload declares.
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://docs.oasis-open.org/sarif/sarif/v2.1.0/errata01/os/schemas/"
+    "sarif-schema-2.1.0.json"
+)
+
+
+def _sarif_payload(findings: Sequence[Finding]) -> dict:
+    """SARIF 2.1.0: the whole rule catalog in the driver (stable ids for
+    scanning UIs), one ``result`` per finding, the provenance chain as a
+    single thread flow (each ``key@line`` hop located in its module)."""
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {
+                        "startLine": max(f.line, 1),
+                        "startColumn": max(f.col, 1),
+                    },
+                },
+            }],
+        }
+        if f.chain:
+            flow = []
+            for hop in f.chain:
+                key, _, line = hop.rpartition("@")
+                relpath = key.partition("::")[0]
+                try:
+                    lineno = max(int(line), 1)
+                except ValueError:
+                    lineno = 1
+                flow.append({
+                    "location": {
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": relpath},
+                            "region": {"startLine": lineno},
+                        },
+                        "message": {"text": hop},
+                    },
+                })
+            result["codeFlows"] = [
+                {"threadFlows": [{"locations": flow}]}
+            ]
+        results.append(result)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "kalint",
+                "informationUri":
+                    "https://github.com/SiftScience/kafka-assigner",
+                "rules": [
+                    {"id": rule,
+                     "shortDescription": {"text": desc}}
+                    for rule, desc in sorted(RULES.items())
+                ],
+            }},
+            "columnKind": "utf16CodeUnits",
+            "results": results,
+        }],
+    }
+
+
+def _changed_only(findings: Sequence[Finding], repo: Path,
+                  baseline: Optional[float]) -> List[Finding]:
+    """Drop findings in files not modified since ``baseline`` (the cache
+    entry's pre-run mtime). No baseline, or an unstattable path, keeps
+    the finding — restriction must only ever hide KNOWN-stale results."""
+    if baseline is None:
+        return list(findings)
+    kept = []
+    for f in findings:
+        try:
+            if (repo / f.path).stat().st_mtime <= baseline:
+                continue
+        except OSError:  # kalint: disable=KA008 -- unstattable paths stay reported
+            pass
+        kept.append(f)
+    return kept
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="kalint", description="project-native static analysis "
@@ -73,7 +168,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--root", metavar="DIR",
                         help="package tree to lint instead of the installed "
                              "kafka_assigner_tpu (fixture trees, tests)")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text", dest="fmt")
     parser.add_argument("--out", metavar="FILE",
                         help="write the report there instead of stdout")
@@ -84,6 +179,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "(repeatable)")
     parser.add_argument("--no-cache", action="store_true",
                         help="bypass the content-hash analysis cache")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="report only findings in files modified "
+                             "since the analysis cache entry (analysis "
+                             "still runs whole-tree; package mode only)")
     args = parser.parse_args(argv)
     if args.list_rules:
         for rule, desc in RULES.items():
@@ -115,12 +214,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         )
         root_desc = args.root or "kafka_assigner_tpu"
     findings = finalize(findings)
-    if args.fmt == "json":
+    if args.changed_only and not args.paths:
+        repo = Path(args.root).resolve().parent if args.root \
+            else Path(__file__).resolve().parents[3]
+        findings = _changed_only(
+            findings, repo, status.get("baseline_mtime"))
+    if args.fmt in ("json", "sarif"):
         import json as _json
 
+        payload = (_sarif_payload(findings) if args.fmt == "sarif"
+                   else _json_payload(findings, root_desc))
         # kalint: disable=KA005 -- lint report for CI, not a Kafka plan payload
-        text = _json.dumps(_json_payload(findings, root_desc), indent=1,
-                           sort_keys=True)
+        text = _json.dumps(payload, indent=1, sort_keys=True)
         if args.out:
             Path(args.out).write_text(text + "\n", encoding="utf-8")
         else:

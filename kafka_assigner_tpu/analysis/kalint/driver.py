@@ -57,6 +57,38 @@ def _module_findings(
     )
 
 
+def _smoke_scripts(repo: Path) -> List[tuple]:
+    """The repo's smoke-test harnesses (``scripts/*_smoke.py``), as
+    ``(relpath, path)`` pairs for :func:`~.resolve.build_project`'s
+    ``extra_modules``: resolving them into the project graph puts their
+    hand-rolled request plumbing under the interprocedural sweeps
+    (KA013/KA015/KA019 and friends) instead of leaving it invisible."""
+    scripts = repo / "scripts"
+    if not scripts.is_dir():
+        return []
+    return [(f"scripts/{p.name}", p)
+            for p in sorted(scripts.glob("*_smoke.py"))]
+
+
+def _script_module_findings(
+    tree: ast.AST, relpath: str, path: str,
+    knobs: Set[str], metric_names: Set[str], span_names: Set[str],
+) -> List[Finding]:
+    """The per-module rule subset for injected smoke scripts: the
+    hygiene rules that travel (raw knob reads KA001, knob-name typos
+    KA003, swallowed exceptions KA008, unbounded blocking loops KA011,
+    obs-name typos KA013). The package house rules stay out of scope —
+    a test harness legitimately emits its own JSON (KA005), shells out
+    (KA015 sinks), and never touches kernels or the wire client."""
+    return (
+        _r.check_ka001(tree, relpath, path)
+        + _r.check_ka003(tree, knobs, path)
+        + _r.check_ka008(tree, path)
+        + _r.check_ka011(tree, path)
+        + _r.check_ka013(tree, path, metric_names, span_names)
+    )
+
+
 def lint_source(
     src: str,
     relpath: str,
@@ -105,21 +137,32 @@ def lint_tree(root: Path, *, project: Optional[Project] = None,
     repo = root.parent
     knobs, metric_names, span_names = _registries()
     if project is None:
-        project = build_project(root)
+        project = build_project(root,
+                                extra_modules=_smoke_scripts(repo))
     display: Dict[str, str] = {}
     indexes: Dict[str, SuppressionIndex] = {}
     findings: List[Finding] = []
     for relpath in sorted(project.modules):
         mod = project.modules[relpath]
-        path = _display_path(root / relpath, repo)
+        injected = relpath.split("/", 1)[0] in project.extra_tops
+        # injected modules live under the REPO (scripts/), not the
+        # package root: their relpath already IS the repo-relative path
+        path = relpath if injected \
+            else _display_path(root / relpath, repo)
         display[relpath] = path
         idx = SuppressionIndex(mod.src, path, mod.tree)
         indexes[path] = idx
         findings.extend(idx.metas)
-        findings.extend(idx.apply(_module_findings(
-            mod.tree, relpath, path, knobs, metric_names, span_names,
-            interprocedural=True,
-        )))
+        if injected:
+            findings.extend(idx.apply(_script_module_findings(
+                mod.tree, relpath, path, knobs, metric_names,
+                span_names,
+            )))
+        else:
+            findings.extend(idx.apply(_module_findings(
+                mod.tree, relpath, path, knobs, metric_names,
+                span_names, interprocedural=True,
+            )))
     # unparsable files never make it into the project: lint them alone so
     # their KA000 still surfaces
     for p in sorted(root.rglob("*.py")):
@@ -188,11 +231,31 @@ def lint_package(root: Optional[Path | str] = None,
     )
     readme = repo / "README.md"
     extra = [readme] if readme.is_file() else []
+    # the injected smoke scripts are analysis inputs too: editing one
+    # must invalidate the cached result like editing a package module
+    extra.extend(p for _rel, p in _smoke_scripts(repo))
     key = _cache.tree_fingerprint(pkg, extra_files=extra,
                                   registry_blob=blob)
     cache_dir = _cache.default_cache_dir(
         Path(__file__).resolve().parents[3]
     )
+    # --changed-only baseline: the cache entry's mtime marks the last
+    # time this exact tree state was analyzed/validated. Stat BEFORE
+    # load() — a hit re-stamps the entry (LRU freshness), which would
+    # otherwise collapse the "changed since" window to zero. On a miss
+    # (tree edited), the newest surviving entry marks the previous run.
+    entry = cache_dir / f"{key}.json"
+    try:
+        status["baseline_mtime"] = entry.stat().st_mtime
+    except OSError:
+        mtimes = []
+        for p in cache_dir.glob("*.json"):
+            try:
+                mtimes.append(p.stat().st_mtime)
+            except OSError:  # kalint: disable=KA008 -- entry pruned mid-scan; not a baseline
+                continue
+        if mtimes:
+            status["baseline_mtime"] = max(mtimes)
     cached = _cache.load(cache_dir, key)
     if cached is not None:
         status["cache"] = "hit"

@@ -138,6 +138,13 @@ class Project:
         self._traced = None
         self._lock_held = None
         self._gate_held = None
+        #: thread/shared-state model memo (filled by .threads)
+        self._threads = None
+        #: top-level dotted names of injected out-of-package modules
+        #: (``scripts`` for the smoke harnesses) — absolute imports of
+        #: these resolve in-project even though they sit outside
+        #: ``pkg_name``'s namespace.
+        self.extra_tops: Set[str] = set()
         #: post-resolution _LocalEnv memo (see :meth:`function_env`)
         self._env_cache: Dict[str, _LocalEnv] = {}
 
@@ -311,6 +318,11 @@ class Project:
             return ""
         if node.module.startswith(self.pkg_name + "."):
             return node.module[len(self.pkg_name) + 1:]
+        if node.module.split(".")[0] in self.extra_tops:
+            # injected module namespace (smoke scripts import each other
+            # as `from scripts.health_smoke import ...`): their dotted
+            # names ARE their project-relative names
+            return node.module
         return None
 
     def _resolve_record(self, record: Tuple) -> Optional[Target]:
@@ -590,10 +602,16 @@ class Project:
 
 
 def build_project(root: Path | str,
-                  pkg_name: Optional[str] = None) -> Project:
+                  pkg_name: Optional[str] = None,
+                  extra_modules: Sequence[Tuple[str, Path]] = (),
+                  ) -> Project:
     """Parse and resolve every ``*.py`` under ``root`` (one package tree).
     ``pkg_name`` defaults to the root directory's name — what absolute
-    imports of the package are matched against."""
+    imports of the package are matched against. ``extra_modules`` grafts
+    out-of-package files (the ``scripts/*_smoke.py`` harnesses) into the
+    same graph under their given relpaths: their top directory becomes an
+    importable namespace (``from scripts.health_smoke import ...``) and
+    their absolute ``pkg_name.*`` imports resolve like anyone else's."""
     root = Path(root).resolve()
     project = Project(root, pkg_name or root.name)
     for p in sorted(root.rglob("*.py")):
@@ -604,6 +622,13 @@ def build_project(root: Path | str,
             src = p.read_text(encoding="utf-8")
         except OSError:  # kalint: disable=KA008 -- file raced away mid-walk; no module to add
             continue
+        project._add_module(rel, src)
+    for rel, path in extra_modules:
+        try:
+            src = Path(path).read_text(encoding="utf-8")
+        except OSError:  # kalint: disable=KA008 -- file raced away mid-walk; no module to add
+            continue
+        project.extra_tops.add(rel.split("/", 1)[0])
         project._add_module(rel, src)
     project._resolve_bindings()
     project._resolve_classes()

@@ -51,9 +51,16 @@ RULES = {
              "through an accessor anywhere in the project",
     "KA019": "blocking call reachable while a supervisor's inflight-gate "
              "admission is held",
-    "KA020": "blocking-call budget: a chain under the solve lock or an "
-             "inflight-gate admission whose worst-case timeout/retry "
-             "envelope exceeds KA_DAEMON_REQUEST_TIMEOUT",
+    "KA020": "blocking-call budget: a chain under the solve lock, an "
+             "inflight-gate admission, or a controller loop whose "
+             "worst-case timeout/retry envelope exceeds its deadline "
+             "budget (KA_DAEMON_REQUEST_TIMEOUT / KA_CONTROLLER_INTERVAL)",
+    "KA021": "shared attribute written by >=2 threads with an empty "
+             "common lock-set (data race)",
+    "KA022": "shared attribute guarded by a lock on some reaching paths "
+             "and unguarded on others (forgotten lock)",
+    "KA023": "lock-order cycle across the discovered lock set "
+             "(potential deadlock)",
 }
 
 #: One-line meaning + example offending chain per rule — the source of the
@@ -202,16 +209,51 @@ RULE_DOCS: Dict[str, Tuple[str, str]] = {
     ),
     "KA020": (
         "blocking-call budget (KA015/KA019's quantitative twin): along "
-        "any chain reachable under the shared solve lock or an "
-        "inflight-gate admission, the summed worst-case wall clock of "
-        "the `KA_*` deadline knobs the chain consults — each function's "
-        "TIMEOUT knob defaults times (1 + its RETRIES knob default), "
-        "`*_MS` names read as milliseconds — must not exceed the "
-        "`KA_DAEMON_REQUEST_TIMEOUT` watchdog budget: a chain that can "
+        "any chain reachable under the shared solve lock, an "
+        "inflight-gate admission, or a controller-loop thread entry, the "
+        "summed worst-case wall clock of the `KA_*` deadline knobs the "
+        "chain consults — each function's TIMEOUT knob defaults times "
+        "(1 + its RETRIES knob default), `*_MS` names read as "
+        "milliseconds — must not exceed the region's deadline budget: "
+        "`KA_DAEMON_REQUEST_TIMEOUT` for held regions (a chain that can "
         "legally block longer than the watchdog's patience turns every "
-        "overrun into a flagged-but-unfixable alert",
+        "overrun into a flagged-but-unfixable alert), "
+        "`KA_CONTROLLER_INTERVAL` for controller loops (a tick that can "
+        "legally outlast the cadence starves every later tick)",
         "`handle` [after `_gate()`] → `poll_loop()` consulting "
         "`KA_EXEC_POLL_TIMEOUT` (600 s > 30 s budget)",
+    ),
+    "KA021": (
+        "no mutable shared attribute (a `self.attr` on a `daemon/`/"
+        "`exec/` class, per the one-level instance typing) may be "
+        "WRITTEN by two or more thread entries — discovered "
+        "`Thread`/`Timer`/executor targets, the HTTP handler surface "
+        "(concurrent with itself), the daemon main thread — with an "
+        "empty common lock-set across the writes (`__init__` bodies are "
+        "happens-before and excluded); guard every write with one lock "
+        "or suppress citing the serializing protocol",
+        "`watch thread → _watch_loop → self._generation += 1` vs "
+        "`HTTP handle → self._generation = 0`, no common lock",
+    ),
+    "KA022": (
+        "no shared attribute whose WRITES all agree on a guarding lock "
+        "may be touched on some reaching path with that lock NOT held "
+        "(lexically or by must-hold inference along every reaching call "
+        "chain) — the classic forgotten-lock bug; take the lock on the "
+        "unguarded path or suppress citing why that path cannot race",
+        "`self._counters` guarded by `_counters_lock` in 6 writers, "
+        "read bare in `healthz_view`",
+    ),
+    "KA023": (
+        "no cycle in the lock-order graph — an edge A→B wherever lock B "
+        "is acquired while A is held, lexically or anywhere in the "
+        "call closure of an A-held region; locks are identified by name "
+        "(may-alias), self-edges are re-entry, not inversion — a cycle "
+        "means two threads can each hold one lock and wait on the other "
+        "(deadlock); impose a global acquisition order or suppress "
+        "citing the protocol that keeps the cycle unreachable",
+        "`_plan_mu` → `_cv` in `submit()` but `_cv` → `_plan_mu` in "
+        "`_loop()`",
     ),
 }
 
@@ -1149,8 +1191,15 @@ def _blocking_sink_desc(node: ast.Call) -> Optional[str]:
 #: KA020 knob-name classification tokens.
 _BUDGET_TIMEOUT_TOKEN = "TIMEOUT"
 _BUDGET_RETRIES_TOKEN = "RETRIES"
-#: The watchdog-budget knob KA020 compares chain envelopes against.
+#: The watchdog-budget knob KA020 compares held-region chain envelopes
+#: against.
 BUDGET_KNOB = "KA_DAEMON_REQUEST_TIMEOUT"
+#: The controller-loop cadence knob KA020 compares controller-thread chain
+#: envelopes against: a tick that can legally outlast one interval starves
+#: every later tick (and the default envelope fallback, matching the
+#: knob's registered default).
+CONTROLLER_BUDGET_KNOB = "KA_CONTROLLER_INTERVAL"
+CONTROLLER_MODULE = "daemon/controller.py"
 
 
 def _knob_seconds(name: str, value) -> Optional[float]:
@@ -1206,14 +1255,17 @@ def check_blocking_budget(
     budget: Optional[float] = None,
 ) -> List[Finding]:
     """KA020: the quantitative twin of KA015/KA019 — for every function
-    reachable under the shared solve lock or an inflight-gate admission,
-    sum the worst-case envelopes of the functions along its reaching
-    chain; a total exceeding the ``KA_DAEMON_REQUEST_TIMEOUT`` budget is
-    a finding (anchored at the contributing function, chain attached).
+    reachable under the shared solve lock, an inflight-gate admission,
+    or a controller-loop thread entry, sum the worst-case envelopes of
+    the functions along its reaching chain; a total exceeding the
+    region's deadline budget (``KA_DAEMON_REQUEST_TIMEOUT`` for held
+    regions, ``KA_CONTROLLER_INTERVAL`` for controller loops) is a
+    finding (anchored at the contributing function, chain attached).
     One finding per chain function that itself contributes envelope —
     pass-through hops stay silent so a deep chain reads as one finding
     per deadline consult, not one per hop."""
     from .taint import gate_held_set, lock_held_set
+    from .threads import thread_model
 
     if knob_defaults is None:
         from ...utils.env import KNOBS
@@ -1222,6 +1274,9 @@ def check_blocking_budget(
     if budget is None:
         b = _knob_seconds(BUDGET_KNOB, knob_defaults.get(BUDGET_KNOB))
         budget = b if b is not None else 30.0
+    cb = _knob_seconds(
+        CONTROLLER_BUDGET_KNOB, knob_defaults.get(CONTROLLER_BUDGET_KNOB))
+    controller_budget = cb if cb is not None else 30.0
 
     env_cache: Dict[str, Tuple[float, List[str]]] = {}
 
@@ -1234,12 +1289,42 @@ def check_blocking_budget(
             )
         return env_cache[key]
 
+    held_tail = (
+        "the request can legally block longer than the watchdog's "
+        "patience — shrink the envelope, move the waiting off the held "
+        "region, or suppress citing why the bound is unreachable"
+    )
+    sources: List[Tuple] = [
+        (lock_held_set(project)[0],
+         f"reachable while the shared solve lock is held exceeds the "
+         f"{BUDGET_KNOB} watchdog budget",
+         budget, held_tail),
+        (gate_held_set(project)[0],
+         f"reachable while an inflight-gate admission is held exceeds "
+         f"the {BUDGET_KNOB} watchdog budget",
+         budget, held_tail),
+    ]
+    # Controller loops (the carried ROADMAP KA020 extension): a thread
+    # entry targeting the controller module runs on the loop cadence, so
+    # its chains price against one interval, not the request watchdog.
+    model = thread_model(project)
+    for entry in model.entries:
+        if split_key(entry.key)[0] != CONTROLLER_MODULE:
+            continue
+        sources.append((
+            model.reach[entry.key],
+            f"reachable on the controller loop ({entry.key}) exceeds "
+            f"the {CONTROLLER_BUDGET_KNOB} loop-cadence budget",
+            controller_budget,
+            "one tick can legally outlast the loop cadence and starve "
+            "every later tick — shrink the envelope, move the waiting "
+            "off the loop thread, or suppress citing why the bound is "
+            "unreachable",
+        ))
+
     out: List[Finding] = []
     seen: Set[Tuple[str, str]] = set()
-    for held, where in (
-        (lock_held_set(project)[0], "the shared solve lock"),
-        (gate_held_set(project)[0], "an inflight-gate admission"),
-    ):
+    for held, mid, src_budget, tail in sources:
         for key in sorted(held.members):
             fn = project.functions.get(key)
             if fn is None:
@@ -1254,9 +1339,9 @@ def check_blocking_budget(
                 secs, names = envelope(hop_key)
                 total += secs
                 knobs.extend(names)
-            if total <= budget:
+            if total <= src_budget:
                 continue
-            dedupe = (where, key)
+            dedupe = (mid, key)
             if dedupe in seen:
                 continue
             seen.add(dedupe)
@@ -1265,24 +1350,242 @@ def check_blocking_budget(
                 fn.node.lineno, fn.node.col_offset + 1,
                 f"worst-case blocking envelope ~{total:g} s (deadline "
                 f"knobs along the chain: {', '.join(sorted(set(knobs)))}) "
-                f"reachable while {where} is held exceeds the "
-                f"{BUDGET_KNOB} watchdog budget ({budget:g} s): the "
-                "request can legally block longer than the watchdog's "
-                "patience — shrink the envelope, move the waiting off "
-                "the held region, or suppress citing why the bound is "
-                "unreachable",
+                f"{mid} ({src_budget:g} s): {tail}",
                 chain=held.chain_strs(key),
             ))
+    return out
+
+
+def _scc_partition(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Tarjan SCCs (iterative) over a name digraph, for KA023."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(root: str) -> None:
+        work: List[Tuple[str, Iterable[str]]] = [
+            (root, iter(sorted(graph.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for succ in it:
+                if succ not in index:
+                    index[succ] = low[succ] = counter[0]
+                    counter[0] += 1
+                    stack.append(succ)
+                    on_stack.add(succ)
+                    work.append((succ, iter(sorted(graph.get(succ, ())))))
+                    advanced = True
+                    break
+                if succ in on_stack:
+                    low[node] = min(low[node], index[succ])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc: List[str] = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+
+    nodes = set(graph) | {i for succs in graph.values() for i in succs}
+    for n in sorted(nodes):
+        if n not in index:
+            strongconnect(n)
+    return sccs
+
+
+def check_thread_safety(project: Project,
+                        display: Dict[str, str]) -> List[Finding]:
+    """KA021/KA022/KA023 over the :mod:`.threads` model.
+
+    Per shared attribute (grouped across every thread entry that reaches
+    it, a CONCURRENT entry — the HTTP surface — counting as two threads
+    since it races with itself):
+
+    - **KA021** fires when two or more thread-weights WRITE it and the
+      intersection of the write lock-sets is empty — nothing serializes
+      the writes. One finding per attribute, anchored at the first write.
+    - **KA022** fires when the writes DO agree on a common lock but some
+      reaching access holds none of it — the forgotten-lock path.
+      Anchored at the unguarded access. Mutually exclusive with KA021.
+
+    Attributes never written outside ``__init__``, or reached by fewer
+    than two thread-weights, are skipped: single-writer flag patterns
+    (one loop publishing, readers polling a bool) are a deliberate
+    non-goal — flagging them would drown the triage in benign reads.
+
+    **KA023** is entry-independent: an edge A→B wherever B is acquired
+    with A held (lexically, or anywhere in the call closure of an A-held
+    region); a strongly-connected component with ≥2 locks is a cycle —
+    two threads can each hold one lock and wait on the other. One
+    finding per SCC, anchored at the first witnessing acquisition."""
+    from .threads import thread_model
+
+    model = thread_model(project)
+    out: List[Finding] = []
+
+    def disp(relpath: str) -> str:
+        return display.get(relpath, relpath)
+
+    def tid(entry_key: str) -> str:
+        # every "main"-kind seed is the SAME OS thread (run_daemon_process
+        # calls serve/start/shutdown in sequence) — collapse them to one
+        # identity so main-only writes never count as a race
+        e = model.entry_by_key.get(entry_key)
+        return "<main>" if (e is not None and e.kind == "main") \
+            else entry_key
+
+    def thread_weight(entry_keys) -> int:
+        # distinct OS threads that can touch the attribute; a CONCURRENT
+        # entry (HTTP surface) races with itself and counts as two
+        by_tid: Dict[str, bool] = {}
+        for ek in entry_keys:
+            e = model.entry_by_key.get(ek)
+            conc = bool(e is not None and e.concurrent)
+            by_tid[tid(ek)] = by_tid.get(tid(ek), False) or conc
+        return sum(2 if conc else 1 for conc in by_tid.values())
+
+    def entry_label(entry_key: str) -> str:
+        e = model.entry_by_key.get(entry_key)
+        return e.label if e is not None else entry_key
+
+    def acc_chain(acc) -> Tuple[str, ...]:
+        reach = model.reach.get(acc.entry)
+        return reach.chain_strs(acc.funckey) if reach else ()
+
+    groups: Dict[Tuple[Tuple[str, str], str], List] = {}
+    for acc in model.accesses:
+        groups.setdefault((acc.owner, acc.attr), []).append(acc)
+
+    for (owner, attr), accs in sorted(groups.items()):
+        orel, ocls = owner
+        writes = [a for a in accs if a.write]
+        if not writes:
+            continue
+        entries = sorted({a.entry for a in accs})
+        if thread_weight(entries) < 2:
+            continue  # single-threaded state
+        writer_entries = sorted({a.entry for a in writes})
+        writer_weight = thread_weight(writer_entries)
+        common_w = frozenset.intersection(*[a.locks for a in writes])
+        sortkey = lambda a: (disp(split_key(a.funckey)[0]), a.line,  # noqa: E731
+                             a.col, a.funckey)
+        if writer_weight >= 2 and not common_w:
+            w = min(writes, key=sortkey)
+            threads_desc = "; ".join(
+                entry_label(e) for e in writer_entries)
+            locks_seen = sorted({n for a in writes for n in a.locks})
+            held_desc = (
+                f" (locks held on SOME writes: {', '.join(locks_seen)})"
+                if locks_seen else ""
+            )
+            out.append(Finding(
+                "KA021", disp(split_key(w.funckey)[0]), w.line, w.col,
+                f"shared attribute {ocls}.{attr} ({orel}) is written by "
+                f"{writer_weight} thread(s) — {threads_desc} — with an "
+                f"empty common lock-set{held_desc}: the writes race; "
+                "guard every write with one lock, or suppress citing "
+                "the happens-before protocol that serializes them",
+                chain=acc_chain(w),
+            ))
+            continue  # an attribute is either unserialized or misguarded
+        if common_w:
+            bad = [a for a in accs if not (a.locks & common_w)]
+            if not bad:
+                continue
+            a = min(bad, key=sortkey)
+            guard = ", ".join(sorted(common_w))
+            kind = "written" if a.write else "read"
+            out.append(Finding(
+                "KA022", disp(split_key(a.funckey)[0]), a.line, a.col,
+                f"shared attribute {ocls}.{attr} ({orel}) is guarded by "
+                f"{guard} on every write but {kind} here with no common "
+                f"lock held (reached from {entry_label(a.entry)}): the "
+                "forgotten-lock path can observe torn state — take "
+                f"{guard} on this path, or suppress citing why it "
+                "cannot race",
+                chain=acc_chain(a),
+            ))
+
+    # -- KA023: lock-order cycles --------------------------------------------
+    digraph: Dict[str, Set[str]] = {}
+    for (outer, inner) in model.lock_edges:
+        digraph.setdefault(outer, set()).add(inner)
+    for scc in _scc_partition(digraph):
+        if len(scc) < 2:
+            continue
+        names = sorted(scc)
+        # reconstruct one concrete cycle from the least lock for the
+        # message: min-name → … → min-name through SCC-internal edges
+        start = names[0]
+        path = [start]
+        seen_nodes = {start}
+        cur = start
+        while True:
+            nxt = next(
+                (i for i in sorted(digraph.get(cur, ()))
+                 if i in scc and (i == start or i not in seen_nodes)),
+                None,
+            )
+            if nxt is None or nxt == start:
+                path.append(start)
+                break
+            path.append(nxt)
+            seen_nodes.add(nxt)
+            cur = nxt
+        first = None
+        for outer, inner in zip(path, path[1:]):
+            edge = model.lock_edges.get((outer, inner))
+            if edge is not None and first is None:
+                first = edge
+        if first is None:  # SCC via edges the walk skipped; take any
+            first = next(
+                e for (o, i), e in sorted(model.lock_edges.items())
+                if o in scc and i in scc
+            )
+        cycle_desc = " -> ".join(path)
+        sites = []
+        for outer, inner in zip(path, path[1:]):
+            edge = model.lock_edges.get((outer, inner))
+            if edge is not None:
+                sites.append(
+                    f"{inner} under {outer} at "
+                    f"{disp(edge.relpath)}:{edge.line}")
+        out.append(Finding(
+            "KA023", disp(first.relpath), first.line, 1,
+            f"lock-order cycle {cycle_desc} (locks identified by name, "
+            f"may-alias): {'; '.join(sites)} — two threads can each "
+            "hold one lock and wait on the other (deadlock); impose a "
+            "global acquisition order, or suppress citing the protocol "
+            "that keeps the inversion unreachable",
+            chain=first.chain,
+        ))
     return out
 
 
 def project_findings(project: Project,
                      display: Dict[str, str]) -> List[Finding]:
     """Every graph-backed finding over one resolved project: the traced-set
-    rules (KA002/KA007/KA016/KA017), the lock-held rule (KA015), and
-    transitive bulkhead reachability (KA012). ``display`` maps module
-    relpaths to the path findings should print (suppressions are applied by
-    the caller, which owns the per-module suppression indexes)."""
+    rules (KA002/KA007/KA016/KA017), the lock-held rule (KA015), the
+    thread-safety rules (KA021/KA022/KA023), and transitive bulkhead
+    reachability (KA012). ``display`` maps module relpaths to the path
+    findings should print (suppressions are applied by the caller, which
+    owns the per-module suppression indexes)."""
     out: List[Finding] = []
     traced = traced_set(project)
     mutable_cache: Dict[str, Set[str]] = {}
@@ -1416,9 +1719,13 @@ def project_findings(project: Project,
         "solve-bearing requests — writes belong on the execute path, "
         "never under the solve lock",
     )
-    # KA020 rides the same two closures: the qualitative rules above kill
-    # unbounded blocking; the budget rule prices the BOUNDED kind.
+    # KA020 rides the same two closures (plus the controller-loop thread
+    # entries): the qualitative rules above kill unbounded blocking; the
+    # budget rule prices the BOUNDED kind.
     out.extend(check_blocking_budget(project, display))
+    # KA021/KA022/KA023: the thread-topology model (who runs where, under
+    # which locks) over the same call graph.
+    out.extend(check_thread_safety(project, display))
 
     gheld, gregions = gate_held_set(project)
     held_rule(

@@ -1,0 +1,636 @@
+"""Thread-topology and shared-state layer (ISSUE 16): who runs where,
+what they share, and under which locks.
+
+The PR 12 graph knows what is *reachable*; this layer adds *from which
+thread* and *holding which locks*, the two facts the RacerD/Eraser-style
+rules (KA021/KA022/KA023) consume:
+
+- **thread entries** — every statically-resolvable ``threading.Thread(
+  target=...)``, ``threading.Timer(...)``, and ``executor.submit(...)``
+  in the project, plus two seeded surfaces the resolver cannot see: the
+  HTTP handler surface (the handler classes are closure-nested inside
+  ``_build_http_server`` — their bodies fold into it, but the routed
+  ``sup.<method>()`` calls are untyped, so the supervisor request methods
+  are seeded explicitly) and the daemon main thread. Unresolvable targets
+  (closure-nested functions like the warm-up worker and the watchdog
+  timer body, out-of-project callables like ``serve_forever``) contribute
+  no entry — the model under-approximates, same posture as the resolver.
+
+- **lock registry** — every in-project ``threading.Lock``/``RLock``/
+  ``Condition`` bound to a ``self.<attr>`` or a module global, identified
+  BY NAME: the tree passes locks around under their defining name
+  (``service._solve_lock`` becomes ``supervisor._solve_lock``), so
+  same-named attributes unify into one may-alias lock. Coarser than true
+  identity — two unrelated ``_lock`` attributes unify — which makes the
+  race rules *miss* cross-class confusions rather than invent them.
+
+- **lock-set inference** — per call site and per attribute access, the
+  set of locks LEXICALLY held (enclosing ``with`` items that mention a
+  known lock name — exact, or as a ``name_``-prefixed helper like
+  ``_solve_lock_scope()``), combined per thread entry with MUST-hold
+  dataflow: a function's incoming lock set is the intersection over
+  every reaching call site (lexical locks at the site plus the caller's
+  own must-hold set), iterated to a fixpoint.
+
+- **shared-state model** — ``self.attr`` (and one-level ``self.x.attr``
+  through the resolver's instance typing) reads/writes on classes in the
+  concurrent subsystems (``daemon/``, ``exec/``), each stamped with its
+  thread entry and effective lock set. ``__init__`` bodies are excluded
+  (construction happens-before any thread start); attribute loads that
+  resolve to methods are calls, not state — and a ``@property`` load IS
+  traversed as a call edge, so a property-guarded read's body joins the
+  reachable set.
+
+Everything is memoized on the :class:`~.resolve.Project` (one model per
+analysis) and every fact carries provenance: entry → … → access chains
+for ``--explain`` and the finding payloads.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .resolve import FUNC, FunctionInfo, ModuleInfo, Project, _LocalEnv
+from .taint import TaintResult
+
+#: threading constructors whose assignment defines an in-project lock.
+LOCK_CTOR_NAMES = frozenset({"Lock", "RLock", "Condition"})
+
+#: Module prefixes whose classes constitute the shared-state model: the
+#: concurrent subsystems the daemon's threads actually share. Classes
+#: elsewhere (solvers, io, obs internals) are reached too, but their
+#: state discipline is owned by their own module contracts — modelling
+#: them would trade triage signal for noise.
+SHARED_STATE_PREFIXES = ("daemon/", "exec/")
+
+#: The HTTP handler surface, seeded: the handler classes are nested inside
+#: ``_build_http_server`` (invisible to the resolver as classes, folded
+#: into the builder as code), and their routed ``sup.<method>()`` calls
+#: are untyped — so the request methods handlers dispatch into are listed
+#: here and existence-checked against the analyzed tree (fixture trees
+#: simply match none of them). Every handler thread is CONCURRENT with
+#: itself: ThreadingHTTPServer runs one thread per connection.
+HTTP_SURFACE_SEEDS: Tuple[Tuple[str, Optional[str], str], ...] = (
+    ("daemon/service.py", None, "_build_http_server"),
+    ("daemon/supervisor.py", "ClusterSupervisor", "handle"),
+    ("daemon/supervisor.py", "ClusterSupervisor", "recommendations"),
+    ("daemon/supervisor.py", "ClusterSupervisor", "groups_request"),
+    ("daemon/supervisor.py", "ClusterSupervisor", "controller_request"),
+    ("daemon/supervisor.py", "ClusterSupervisor", "controller_view"),
+    ("daemon/supervisor.py", "ClusterSupervisor", "prepare_execute"),
+    ("daemon/supervisor.py", "ClusterSupervisor", "run_execute"),
+    ("daemon/supervisor.py", "ClusterSupervisor", "abort_execute"),
+    ("daemon/supervisor.py", "ClusterSupervisor", "state_view"),
+    ("daemon/supervisor.py", "ClusterSupervisor", "healthz_view"),
+    ("daemon/supervisor.py", "ClusterSupervisor", "lifecycle"),
+    ("daemon/supervisor.py", "ClusterSupervisor", "stale"),
+    ("daemon/supervisor.py", "ClusterSupervisor", "active_requests"),
+    ("daemon/supervisor.py", "ClusterSupervisor", "counters"),
+)
+
+#: The daemon main thread: process entry, lifecycle, drain.
+MAIN_THREAD_SEEDS: Tuple[Tuple[str, Optional[str], str], ...] = (
+    ("daemon/service.py", None, "run_daemon_process"),
+    ("daemon/service.py", "AssignerDaemon", "serve"),
+    ("daemon/service.py", "AssignerDaemon", "start"),
+    ("daemon/service.py", "AssignerDaemon", "shutdown"),
+)
+
+
+@dataclass(frozen=True)
+class ThreadEntry:
+    """One discovered or seeded thread root."""
+    key: str              # target funckey (the entry's identity)
+    kind: str             # "thread" | "timer" | "executor" | "http" | "main"
+    line: int             # creation/seed site line
+    relpath: str          # module of the creation/seed site
+    label: str            # human label for messages and --explain roots
+    #: True when more than one OS thread runs this entry against the SAME
+    #: objects (the HTTP surface): its writes race with themselves.
+    concurrent: bool = False
+
+
+@dataclass
+class SharedAccess:
+    """One attribute read/write, stamped with thread and lock context."""
+    owner: Tuple[str, str]        # (relpath, class) of the attribute owner
+    attr: str
+    entry: str                    # ThreadEntry.key that reaches it
+    funckey: str
+    line: int
+    col: int
+    write: bool
+    locks: FrozenSet[str]         # effective lock set (lexical ∪ must-hold)
+
+
+@dataclass
+class LockEdge:
+    """Lock-order fact: ``inner`` can be acquired while ``outer`` is
+    held, witnessed at one concrete acquisition site."""
+    outer: str
+    inner: str
+    funckey: str
+    relpath: str
+    line: int
+    chain: Tuple[str, ...]
+
+
+@dataclass
+class _FnFacts:
+    """Per-function lexical facts, entry-independent and memoized:
+    resolved call sites (including ``@property`` loads — a property read
+    executes its body), raw attribute accesses, and ``with``-acquisitions,
+    each with the lock set LEXICALLY held at that point."""
+    calls: List[Tuple[str, int, FrozenSet[str]]] = field(
+        default_factory=list)
+    accesses: List[Tuple[Tuple[str, str], str, int, int, bool,
+                         FrozenSet[str]]] = field(default_factory=list)
+    withs: List[Tuple[str, int, FrozenSet[str]]] = field(
+        default_factory=list)
+
+
+@dataclass
+class ThreadModel:
+    entries: List[ThreadEntry]
+    #: entry key -> reachable-set closure (with provenance chains)
+    reach: Dict[str, TaintResult]
+    #: lock name -> definition sites [(relpath, class-or-None, line)]
+    locks: Dict[str, List[Tuple[str, Optional[str], int]]]
+    #: every access from every entry, lock sets resolved
+    accesses: List[SharedAccess]
+    #: (outer, inner) -> first witnessing edge
+    lock_edges: Dict[Tuple[str, str], LockEdge]
+    entry_by_key: Dict[str, ThreadEntry] = field(default_factory=dict)
+
+
+# -- lock discovery ----------------------------------------------------------
+
+def _is_lock_ctor(value: Optional[ast.expr]) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    f = value.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    return name in LOCK_CTOR_NAMES
+
+
+def discover_locks(project: Project
+                   ) -> Dict[str, List[Tuple[str, Optional[str], int]]]:
+    """Every ``self.X = threading.Lock()``-style instance attribute and
+    every module-global lock binding, keyed by NAME (see module doc for
+    the may-alias rationale)."""
+    locks: Dict[str, List[Tuple[str, Optional[str], int]]] = {}
+
+    def add(name: str, relpath: str, cls: Optional[str],
+            line: int) -> None:
+        locks.setdefault(name, []).append((relpath, cls, line))
+
+    for relpath, mod in sorted(project.modules.items()):
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and _is_lock_ctor(stmt.value):
+                add(stmt.targets[0].id, relpath, None, stmt.lineno)
+        for ci in mod.classes.values():
+            for m in ci.methods.values():
+                for node in ast.walk(m.node):
+                    target = value = None
+                    if isinstance(node, ast.Assign) \
+                            and len(node.targets) == 1:
+                        target, value = node.targets[0], node.value
+                    elif isinstance(node, ast.AnnAssign):
+                        target, value = node.target, node.value
+                    if (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                        and _is_lock_ctor(value)
+                    ):
+                        add(target.attr, relpath, ci.name, node.lineno)
+    return locks
+
+
+def _lock_names_in(expr: ast.AST, known: FrozenSet[str]) -> Set[str]:
+    """The known locks a ``with``-item context expression mentions: an
+    identifier equal to a lock name, or a ``<name>_``-prefixed helper
+    (``self._solve_lock_scope()`` acquires ``_solve_lock``'s regime)."""
+    hits: Set[str] = set()
+    idents: Set[str] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute):
+            idents.add(node.attr)
+        elif isinstance(node, ast.Name):
+            idents.add(node.id)
+    for ident in idents:
+        if ident in known:
+            hits.add(ident)
+            continue
+        for name in known:
+            if ident.startswith(name) and \
+                    ident[len(name):len(name) + 1] == "_":
+                hits.add(name)
+    return hits
+
+
+# -- thread-entry discovery --------------------------------------------------
+
+def _resolve_callable(project: Project, mod: ModuleInfo, fn: FunctionInfo,
+                      expr: ast.expr, env: _LocalEnv) -> Optional[str]:
+    """A callable-valued expression (a thread target, a timer body, a
+    submit argument) resolved to an in-project funckey — the bare-expr
+    twin of :meth:`Project.resolve_call`."""
+    if isinstance(expr, ast.Attribute):
+        v = expr.value
+        if isinstance(v, ast.Name):
+            if v.id in ("self", "cls") and fn.cls is not None:
+                hit = project.find_method(mod.relpath, fn.cls, expr.attr)
+                return hit.key if hit else None
+            if v.id in env.types:
+                rp, cn = env.types[v.id]
+                hit = project.find_method(rp, cn, expr.attr)
+                return hit.key if hit else None
+        if isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name) \
+                and v.value.id == "self" and fn.cls is not None:
+            ci = mod.classes.get(fn.cls)
+            t = ci.attr_types.get(v.attr) if ci else None
+            if t is not None:
+                hit = project.find_method(t[0], t[1], expr.attr)
+                return hit.key if hit else None
+    target = project._resolve_expr_target(mod, expr, env)
+    if target is not None and target[0] == FUNC:
+        return target[1]
+    return None
+
+
+def _ctor_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _const_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def discover_thread_entries(project: Project) -> List[ThreadEntry]:
+    """Every statically-resolvable thread root (see module doc). One
+    entry per distinct target function — re-spawns of the same target
+    are the same thread class; the first creation site labels it."""
+    entries: Dict[str, ThreadEntry] = {}
+
+    def add(entry: ThreadEntry) -> None:
+        entries.setdefault(entry.key, entry)
+
+    for relpath, mod in sorted(project.modules.items()):
+        for fn in mod.functions.values():
+            env = project.function_env(mod, fn)
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                ctor = _ctor_name(node)
+                target: Optional[ast.expr] = None
+                kind = None
+                tname: Optional[str] = None
+                if ctor == "Thread":
+                    kind = "thread"
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target = kw.value
+                        elif kw.arg == "name":
+                            tname = _const_str(kw.value)
+                elif ctor == "Timer":
+                    kind = "timer"
+                    if len(node.args) >= 2:
+                        target = node.args[1]
+                    for kw in node.keywords:
+                        if kw.arg == "function":
+                            target = kw.value
+                elif ctor == "submit" and isinstance(node.func,
+                                                     ast.Attribute):
+                    kind = "executor"
+                    if node.args:
+                        target = node.args[0]
+                if kind is None or target is None:
+                    continue
+                key = _resolve_callable(project, mod, fn, target, env)
+                if key is None:
+                    continue  # nested/out-of-project target: no entry
+                what = f"{kind} {tname!r}" if tname else kind
+                add(ThreadEntry(
+                    key=key, kind=kind, line=node.lineno, relpath=relpath,
+                    label=f"{what} entry {key} "
+                          f"(spawned at {relpath}:{node.lineno})",
+                ))
+
+    def seed(table, kind: str, label_fmt: str, concurrent: bool) -> None:
+        for relpath, cls, name in table:
+            if cls is None:
+                m = project.module(relpath)
+                fi = m.func_by_name.get(name) if m else None
+            else:
+                fi = project.find_method(relpath, cls, name)
+            if fi is None:
+                continue
+            add(ThreadEntry(
+                key=fi.key, kind=kind, line=fi.node.lineno,
+                relpath=relpath, label=label_fmt.format(key=fi.key),
+                concurrent=concurrent,
+            ))
+
+    seed(HTTP_SURFACE_SEEDS, "http",
+         "HTTP handler surface {key} (one thread per connection)",
+         concurrent=True)
+    seed(MAIN_THREAD_SEEDS, "main", "daemon main thread {key}",
+         concurrent=False)
+    return sorted(entries.values(), key=lambda e: (e.relpath, e.line,
+                                                   e.key))
+
+
+# -- per-function lexical facts ----------------------------------------------
+
+def _is_property(fi: FunctionInfo) -> bool:
+    for dec in fi.node.decorator_list:
+        if isinstance(dec, ast.Name) and dec.id == "property":
+            return True
+        if isinstance(dec, ast.Attribute) and dec.attr in (
+                "setter", "deleter", "getter"):
+            return True
+    return False
+
+
+def _fn_facts(project: Project, funckey: str,
+              lock_names: FrozenSet[str]) -> _FnFacts:
+    """Lexical walk of one function: call sites, attribute accesses and
+    with-acquisitions, each stamped with the locks held at that point."""
+    facts = _FnFacts()
+    fn = project.functions.get(funckey)
+    if fn is None:
+        return facts
+    mod = project.modules[fn.relpath]
+    env = project.function_env(mod, fn)
+    ci = mod.classes.get(fn.cls) if fn.cls else None
+    in_init = fn.name == "__init__"
+
+    def owner_of(node: ast.Attribute
+                 ) -> Optional[Tuple[Tuple[str, str], str]]:
+        v = node.value
+        if isinstance(v, ast.Name):
+            if v.id == "self" and fn.cls is not None:
+                return (fn.relpath, fn.cls), node.attr
+            t = env.types.get(v.id)
+            if t is not None:
+                return t, node.attr
+        elif isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name) \
+                and v.value.id == "self" and ci is not None:
+            t = ci.attr_types.get(v.attr)
+            if t is not None:
+                return t, node.attr
+        return None
+
+    def record_attr(node: ast.Attribute, held: FrozenSet[str]) -> bool:
+        """Record a shared-state access (or a property call edge).
+        Returns True when the attribute resolved to a method/property —
+        i.e. it is code, not state."""
+        hit = owner_of(node)
+        if hit is None:
+            return False
+        (orel, ocls), attr = hit
+        m = project.find_method(orel, ocls, attr)
+        if m is not None:
+            if _is_property(m) and isinstance(node.ctx, ast.Load):
+                facts.calls.append((m.key, node.lineno, held))
+            return True
+        if attr in lock_names:
+            return True  # the lock itself is synchronization, not state
+        if in_init:
+            return True  # happens-before any thread start
+        if not orel.startswith(SHARED_STATE_PREFIXES):
+            return True
+        write = isinstance(node.ctx, (ast.Store, ast.Del))
+        facts.accesses.append(
+            ((orel, ocls), attr, node.lineno, node.col_offset + 1,
+             write, held))
+        return True
+
+    def visit(node: ast.AST, held: FrozenSet[str]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: Set[str] = set()
+            for item in node.items:
+                visit(item.context_expr, held | frozenset(acquired))
+                names = _lock_names_in(item.context_expr, lock_names)
+                for name in names:
+                    facts.withs.append(
+                        (name, item.context_expr.lineno,
+                         held | frozenset(acquired)))
+                acquired |= names
+                if item.optional_vars is not None:
+                    visit(item.optional_vars, held | frozenset(acquired))
+            inner = held | frozenset(acquired)
+            for stmt in node.body:
+                visit(stmt, inner)
+            return
+        if isinstance(node, ast.Call):
+            callee = project.resolve_call(mod, fn, node, env)
+            if callee is not None and callee != funckey:
+                facts.calls.append((callee, node.lineno, held))
+        elif isinstance(node, ast.Attribute):
+            record_attr(node, held)
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in fn.node.body:
+        visit(stmt, frozenset())
+    return facts
+
+
+# -- the model ---------------------------------------------------------------
+
+def _entry_closure(entry: ThreadEntry,
+                   facts_of, project: Project) -> TaintResult:
+    """Reachable set from one entry over the facts call sites (the call
+    graph plus property edges), with provenance."""
+    result = TaintResult()
+    result.members.add(entry.key)
+    result.parents[entry.key] = (None, entry.line)
+    result.entry_of[entry.key] = entry.key
+    result.root_labels[entry.key] = entry.label
+    frontier = [entry.key]
+    while frontier:
+        cur = frontier.pop()
+        for callee, line, _locks in facts_of(cur).calls:
+            if callee in result.members \
+                    or callee not in project.functions:
+                continue
+            result.members.add(callee)
+            result.parents[callee] = (cur, line)
+            result.entry_of[callee] = entry.key
+            frontier.append(callee)
+    return result
+
+
+def _must_hold(entry: ThreadEntry, reach: TaintResult,
+               facts_of) -> Dict[str, FrozenSet[str]]:
+    """Per-function MUST-hold lock sets within one entry's reachable set:
+    the intersection, over every reaching call site, of the caller's
+    must-hold set plus the locks lexically held at the site. Iterated to
+    a fixpoint (sets only shrink once assigned; the entry root holds
+    nothing)."""
+    must: Dict[str, Optional[FrozenSet[str]]] = {
+        key: None for key in reach.members
+    }
+    must[entry.key] = frozenset()
+    work = [entry.key]
+    while work:
+        cur = work.pop()
+        base = must[cur]
+        if base is None:
+            continue
+        for callee, _line, locks in facts_of(cur).calls:
+            if callee not in must:
+                continue
+            cand = base | locks
+            prev = must[callee]
+            new = cand if prev is None else (prev & cand)
+            if new != prev:
+                must[callee] = new
+                work.append(callee)
+    return {k: (v if v is not None else frozenset())
+            for k, v in must.items()}
+
+
+def _lock_order_edges(locks: Dict[str, List[Tuple[str, Optional[str],
+                                                  int]]],
+                      facts_of, all_keys: Sequence[str],
+                      ) -> Dict[Tuple[str, str], LockEdge]:
+    """May-hold lock-order facts: an acquisition of B lexically under A,
+    or anywhere in a function reachable from a call site where A is
+    held. One witnessing edge per (A, B); self-edges are excluded (an
+    RLock — and any same-named alias — re-enters legally)."""
+    edges: Dict[Tuple[str, str], LockEdge] = {}
+
+    def add(outer: str, inner: str, funckey: str, line: int,
+            chain: Tuple[str, ...]) -> None:
+        if outer == inner:
+            return
+        edges.setdefault((outer, inner), LockEdge(
+            outer=outer, inner=inner, funckey=funckey,
+            relpath=funckey.partition("::")[0], line=line, chain=chain,
+        ))
+
+    # Lexical: a with-acquisition whose held set is non-empty.
+    for key in all_keys:
+        for name, line, held in facts_of(key).withs:
+            for outer in sorted(held):
+                add(outer, name, key, line, (f"{key}@{line}",))
+    # Transitive: close over calls made while each lock is held.
+    for outer in sorted(locks):
+        result = TaintResult()
+        frontier: List[str] = []
+        for key in all_keys:
+            for callee, line, held in facts_of(key).calls:
+                if outer not in held or callee in result.members:
+                    continue
+                result.members.add(callee)
+                result.parents[callee] = (key, line)
+                result.entry_of[callee] = key
+                result.root_labels.setdefault(
+                    key, f"lock {outer} held in {key}")
+                frontier.append(callee)
+        while frontier:
+            cur = frontier.pop()
+            for callee, line, _held in facts_of(cur).calls:
+                if callee in result.members:
+                    continue
+                result.members.add(callee)
+                result.parents[callee] = (cur, line)
+                result.entry_of[callee] = result.entry_of[cur]
+                frontier.append(callee)
+        for key in sorted(result.members):
+            for name, line, _held in facts_of(key).withs:
+                add(outer, name, key, line,
+                    result.chain_strs(key) + (f"{key}@{line}",))
+    return edges
+
+
+def _resident_classes(project: Project,
+                      entries: Sequence[ThreadEntry]
+                      ) -> Set[Tuple[str, str]]:
+    """Classes whose instances can actually be SHARED between threads:
+    the classes owning thread-entry methods, closed transitively over
+    their instance-attribute types (``self.x = Class(...)``) and their
+    in-project bases. An instance of any other class only ever lives in
+    function locals (e.g. the ``PlanExecutor`` a handler constructs,
+    drives, and drops within one request) — thread-confined by
+    construction, so its attributes are not shared state."""
+    resident: Set[Tuple[str, str]] = set()
+    work: List[Tuple[str, str]] = []
+    for e in entries:
+        fn = project.functions.get(e.key)
+        if fn is not None and fn.cls is not None:
+            work.append((fn.relpath, fn.cls))
+    while work:
+        rc = work.pop()
+        if rc in resident:
+            continue
+        resident.add(rc)
+        ci = project.class_info(*rc)
+        if ci is None:
+            continue
+        work.extend(ci.attr_types.values())
+        work.extend(ci.resolved_bases)
+    return resident
+
+
+def thread_model(project: Project) -> ThreadModel:
+    """Build (once per project) the full thread/shared-state model."""
+    cached = getattr(project, "_threads", None)
+    if cached is not None:
+        return cached
+
+    lock_defs = discover_locks(project)
+    lock_names = frozenset(lock_defs)
+    facts_cache: Dict[str, _FnFacts] = {}
+
+    def facts_of(key: str) -> _FnFacts:
+        if key not in facts_cache:
+            facts_cache[key] = _fn_facts(project, key, lock_names)
+        return facts_cache[key]
+
+    entries = discover_thread_entries(project)
+    resident = _resident_classes(project, entries)
+    reach: Dict[str, TaintResult] = {}
+    accesses: List[SharedAccess] = []
+    for entry in entries:
+        if entry.key not in project.functions:
+            continue
+        closure = _entry_closure(entry, facts_of, project)
+        reach[entry.key] = closure
+        must = _must_hold(entry, closure, facts_of)
+        for key in sorted(closure.members):
+            base = must.get(key, frozenset())
+            for owner, attr, line, col, write, held in \
+                    facts_of(key).accesses:
+                if owner not in resident:
+                    continue  # thread-confined (function-local) object
+                accesses.append(SharedAccess(
+                    owner=owner, attr=attr, entry=entry.key,
+                    funckey=key, line=line, col=col, write=write,
+                    locks=frozenset(base | held),
+                ))
+
+    edges = _lock_order_edges(
+        lock_defs, facts_of, sorted(project.functions))
+    model = ThreadModel(
+        entries=[e for e in entries if e.key in reach],
+        reach=reach, locks=lock_defs, accesses=accesses,
+        lock_edges=edges,
+        entry_by_key={e.key: e for e in entries},
+    )
+    project._threads = model
+    return model

@@ -234,30 +234,45 @@ class RebalanceController:
         """Window accounting survives a daemon kill (ISSUE 15 satellite):
         the budget is a property of the CLUSTER's recent history, not of
         one process's memory. A missing/corrupt ledger starts fresh,
-        loudly on corruption."""
-        self._ledger_loaded = True
-        path = self._ledger_path()
-        try:
-            with open(path, "r", encoding="utf-8") as f:
-                raw = json.load(f)
-            self._ledger = [
-                (float(t), int(n)) for t, n in raw.get("actions", [])
-            ]
-        except FileNotFoundError:
-            self._ledger = []
-        except (OSError, ValueError, TypeError) as e:
-            self._ledger = []
+        loudly on corruption.
+
+        Idempotent and mutex-guarded: the loop thread and the HTTP view/
+        request threads all lazy-load on first touch, and an unguarded
+        check-then-act here could double-load — the second load's
+        assignment clobbering an append that landed in between (KA021)."""
+        err: Optional[Exception] = None
+        with self._mutex:
+            if self._ledger_loaded:
+                return
+            self._ledger_loaded = True
+            path = self._ledger_path()
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    raw = json.load(f)
+                self._ledger = [
+                    (float(t), int(n)) for t, n in raw.get("actions", [])
+                ]
+            except FileNotFoundError:
+                self._ledger = []
+            except (OSError, ValueError, TypeError) as e:
+                self._ledger = []
+                err = e
+        if err is not None:
+            # logging goes through the supervisor (its own locking) —
+            # emit after release so no lock order couples them
             self._log(
-                f"window ledger {path!r} unreadable ({e}); budget "
-                "accounting restarts empty"
+                f"window ledger {self._ledger_path()!r} unreadable "
+                f"({err}); budget accounting restarts empty"
             )
 
     def _save_ledger(self) -> None:
+        with self._mutex:
+            actions = [[t, n] for t, n in self._ledger]
         try:
             # kalint: disable=KA005 -- controller window ledger, not a plan payload
             atomic_write_text(
                 self._ledger_path(),
-                json.dumps({"actions": [[t, n] for t, n in self._ledger]}),
+                json.dumps({"actions": actions}),
                 prefix=".ka_controller_",
             )
         except OSError as e:
@@ -270,11 +285,10 @@ class RebalanceController:
         """Executed moves inside the rolling window (pruning as time
         passes); forward actions AND rollbacks both count — each is real
         replica movement the blast-radius budget exists to bound."""
-        if not self._ledger_loaded:
-            # Harness paths drive tick()/view() without start(): the
-            # persisted budget must load before anything reads — or
-            # worse, overwrites — the ledger.
-            self._load_ledger()
+        # Harness paths drive tick()/view() without start(): the persisted
+        # budget must load before anything reads — or worse, overwrites —
+        # the ledger. The load is idempotent (guarded check inside).
+        self._load_ledger()
         horizon = time.time() - env_float("KA_CONTROLLER_WINDOW")
         with self._mutex:
             self._ledger = [(t, n) for t, n in self._ledger if t >= horizon]
@@ -289,8 +303,7 @@ class RebalanceController:
     def _record_moves(self, moves: int) -> None:
         if moves <= 0:
             return
-        if not self._ledger_loaded:
-            self._load_ledger()
+        self._load_ledger()
         with self._mutex:
             self._ledger.append((round(time.time(), 3), int(moves)))
         self._count("controller.moves", moves)

@@ -295,6 +295,7 @@ class ClusterSupervisor:
         that can flip it (sync outcomes, session loss, drain)."""
         state = self.lifecycle()
         if state != self._flight_lifecycle:
+            # kalint: disable=KA021 -- benign dedup hint: the watch loop and the HTTP handle surface both write it unguarded, but it only gates duplicate flight events; a lost update re-records one extra lifecycle event, never corrupts state
             self._flight_lifecycle = state
             flight.record("lifecycle", self.name, state=state)
 
@@ -322,8 +323,10 @@ class ClusterSupervisor:
             return "stopped"
         if self.draining.is_set():
             return "draining"
+        # kalint: disable=KA022 -- monitoring view: synced_once is a GIL-atomic bool written under the state lock by the watch loop; a handle-thread read without it can only see before/after, both valid lifecycle answers
         if not self.state.synced_once:
             return "syncing"
+        # kalint: disable=KA022 -- same shape: stale is a GIL-atomic bool; the healthz/lifecycle view tolerates reading either side of a concurrent flip
         return "degraded" if self.state.stale else "ready"
 
     def stale(self) -> bool:
@@ -1115,6 +1118,7 @@ class ClusterSupervisor:
                     self._count("daemon.breaker_opened")
                     self._log(
                         "circuit breaker OPEN after "
+                        # kalint: disable=KA022 -- log-only read: the counter is written under the breaker's lock (record_failure just returned True on this thread); a stale number only misprints the log line
                         f"{self.breaker.consecutive_failures} consecutive "
                         f"session failure(s) ({type(e).__name__}: {e}); "
                         "probing on the cooldown envelope"
@@ -1145,6 +1149,7 @@ class ClusterSupervisor:
         a second connect+handshake against a just-recovered quorum."""
         if not self.breaker.allow_attempt():
             return False
+        # kalint: disable=KA022 -- tolerated TOCTOU: allow_attempt() just transitioned state under the breaker lock on THIS thread, and the watch loop is the only prober (class contract); a misread merely routes one probe as a retry burst, both safe recovery paths
         if self.breaker.state == "half-open":
             self._count("daemon.breaker_probes")
             try:
@@ -1203,6 +1208,7 @@ class ClusterSupervisor:
                             "session re-established underneath; watches "
                             "lost"
                         )
+                    # kalint: disable=KA022 -- change-detection snapshot: version is a monotonic int bumped under the state lock; an unguarded read can only under-detect a bump that a later read catches, triggering at worst one extra publish
                     cache_v0 = self.state.version
                     for kind, arg in events:
                         self._count("daemon.watch_events")
@@ -1240,6 +1246,7 @@ class ClusterSupervisor:
                 if time.monotonic() - last_sync >= self.resync_interval \
                         or (self._prompt_resync and self.state.stale):
                     prompted = self._prompt_resync
+                    # kalint: disable=KA021 -- GIL-atomic bool flag: HTTP handle threads set it True to prompt the watch loop, which is the sole consumer/clearer; a racing set after this clear is re-observed on the next loop tick
                     self._prompt_resync = False
                     reopened = False
                     if self._reopen_requested:

@@ -115,7 +115,15 @@ class ExecutionJournal:
     ) -> "ExecutionJournal":
         """Start a new run: the journal is persisted BEFORE the first wave
         is submitted, so even a kill inside wave 0 leaves a resumable
-        record."""
+        record.
+
+        The move list is frozen in canonical (topic, partition) order, so
+        the wave partition is a pure function of the plan's CONTENT — two
+        daemons freezing the same plan from differently-ordered upstream
+        dicts journal identical waves. ``load`` keeps file order verbatim:
+        an in-flight journal's committed wave boundaries must replay
+        exactly as written, never re-sorted underneath a resume."""
+        moves = sorted(moves, key=lambda m: (m[0], int(m[1])))
         j = cls(path, plan_hash, wave_size, moves, cluster=cluster)
         j.save()
         return j

@@ -101,6 +101,11 @@ class FlightRecorder:
         whole process), ``since`` to events after that sequence number."""
         with self._lock:
             events = [dict(e) for e in self._events]
+        # Pin the dump order to the sequence numbers rather than inheriting
+        # it from ring insertion: ``oldest first`` is a documented contract
+        # of /debug/flight and the NDJSON flush, not an accident of deque
+        # layout.
+        events.sort(key=lambda e: e["seq"])
         return [
             e for e in events
             if e["seq"] > since

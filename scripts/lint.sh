@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
 # The project lint gate: kalint (knob-registry + jit-boundary + write-path
-# + deadline + bulkhead + telemetry-name + metric-unit house rules, plus
-# the ISSUE 12 interprocedural taint/lock/bulkhead-reachability rules,
-# KA001-KA017), the README knob-table and rule-table drift checks,
+# + deadline + bulkhead + telemetry-name + metric-unit house rules, the
+# ISSUE 12 interprocedural taint/lock/bulkhead-reachability rules, plus
+# the ISSUE 16 thread-topology race/deadlock rules — KA001-KA023, smoke
+# scripts swept too), the README knob-table and rule-table drift checks,
 # the run-report fixture schema check, the fault-matrix smoke (one injected
 # fault per class — read, write AND daemon seams — strict + best-effort),
 # the exec crash→resume smoke, the daemon lifecycle smoke, and ruff
@@ -42,6 +43,17 @@ if [ "${KA_LINT_REPORT:-0}" = "1" ]; then
     cp /tmp/kalint.json kalint_report.json
     echo "lint.sh: kalint report published at kalint_report.json" >&2
 fi
+# SARIF artifact (ISSUE 16): the same warm cached analysis rendered as
+# SARIF 2.1.0 for code-scanning UIs — and a --changed-only pass proving
+# the pre-commit fast path stays wired (on a clean tree it must report
+# nothing while the analysis itself still runs whole-tree).
+python -m kafka_assigner_tpu.analysis.kalint --format sarif --out /tmp/kalint.sarif
+grep -q '"version": "2.1.0"' /tmp/kalint.sarif || {
+    echo "lint.sh: kalint SARIF report is not version 2.1.0" >&2
+    exit 1
+}
+python -m kafka_assigner_tpu.analysis.kalint --changed-only --format json \
+    --out /tmp/kalint_changed.json
 python -m kafka_assigner_tpu.analysis.knobdoc --check
 # Rule-table drift: the README kalint rule table is generated from the
 # RULE_DOCS catalog; staleness fails the gate like knob drift does.

@@ -199,14 +199,24 @@ def main() -> int:
                   f"increasing ({req1} -> {req2})", file=sys.stderr)
             return 1
 
-        # 4. flight recorder vs the injected schedule
-        s, raw, _ = _req(port, "GET", "/debug/flight")
-        requests_made += 1
-        if s != 200:
-            print(f"FAIL: /debug/flight http={s}", file=sys.stderr)
-            return 1
-        view = json.loads(raw)
-        events = view["events"]
+        # 4. flight recorder vs the injected schedule. The per-request
+        # flight summary is recorded AFTER the response bytes flush, so an
+        # immediately-following /debug/flight can win that race — poll
+        # with a bounded deadline until both request ids have landed.
+        deadline = time.monotonic() + 10
+        while True:
+            s, raw, _ = _req(port, "GET", "/debug/flight")
+            requests_made += 1
+            if s != 200:
+                print(f"FAIL: /debug/flight http={s}", file=sys.stderr)
+                return 1
+            view = json.loads(raw)
+            events = view["events"]
+            seen_rids = {e.get("request_id")
+                         for e in events if e["kind"] == "request"}
+            if {rid, gen_rid} <= seen_rids or time.monotonic() >= deadline:
+                break
+            time.sleep(0.05)
         fired = [e["spec"] for e in events if e["kind"] == "fault"]
         if fired != [FAULT_SPEC]:
             print(f"FAIL: flight fault events {fired} != injected "

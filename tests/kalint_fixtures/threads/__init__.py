@@ -1,0 +1,1 @@
+# fixture mini-package (parsed by kalint, never imported)

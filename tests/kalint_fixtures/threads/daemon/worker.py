@@ -1,0 +1,54 @@
+"""Thread-topology fixture (parsed by kalint, never imported): three
+spawned entries (a named ``Thread``, a ``Timer``, an executor ``submit``),
+one target the resolver CANNOT see (a closure-nested def — no entry), a
+consistently ``_lock``-guarded counter with one forgotten-lock read
+(KA022), an unguarded cross-thread flag (KA021), and an ``_alock``/
+``_block`` acquisition-order inversion (KA023)."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self.count = 0
+        self.flag = False
+
+    def start(self, pool):
+        threading.Thread(target=self._loop, name="loop").start()
+        threading.Timer(5.0, self._tick).start()
+        pool.submit(self._work)
+
+        def nested():  # unresolvable target: contributes no entry
+            return self.count
+
+        threading.Thread(target=nested).start()
+
+    def _loop(self):
+        with self._lock:
+            self._bump()
+        self.flag = True
+
+    def _tick(self):
+        with self._lock:
+            self.count = 0
+
+    def _work(self):
+        self.flag = False
+        return self.count
+
+    def _bump(self):
+        # only ever called with _lock already held: must-hold inference
+        # has to credit the lock here even though no `with` is in sight
+        self.count = self.count + 1
+
+    def forward(self):
+        with self._alock:
+            with self._block:
+                return self.flag
+
+    def backward(self):
+        with self._block:
+            with self._alock:
+                return self.flag

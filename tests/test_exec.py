@@ -129,6 +129,29 @@ def test_journal_round_trip_and_wave_split(tmp_path):
     assert ExecutionJournal.load(path).status == "complete"
 
 
+def test_fresh_journal_move_order_is_canonical(tmp_path):
+    """The wave partition is a function of plan CONTENT: scrambled upstream
+    ordering freezes into (topic, partition) order — but ``load`` replays a
+    journal file's order verbatim, committed wave boundaries included."""
+    path = str(tmp_path / "j")
+    scrambled = [("tb", 1, [2]), ("ta", 5, [3]), ("tb", 0, [1]),
+                 ("ta", 2, [4])]
+    j = ExecutionJournal.fresh(path, "hash", 2, scrambled)
+    canonical = [("ta", 2, [4]), ("ta", 5, [3]), ("tb", 0, [1]),
+                 ("tb", 1, [2])]
+    assert j.moves == canonical
+    assert ExecutionJournal.fresh(
+        str(tmp_path / "j2"), "hash", 2, list(reversed(scrambled))
+    ).moves == canonical
+    # load() is verbatim: hand the file a NON-canonical order and the
+    # in-flight run must resume against exactly those waves.
+    data = json.loads((tmp_path / "j").read_text())
+    data["moves"] = [list(m) for m in reversed(canonical)]
+    (tmp_path / "j").write_text(json.dumps(data))
+    loaded = ExecutionJournal.load(path)
+    assert loaded.moves == list(reversed(canonical))
+
+
 def test_journal_rejects_corruption_and_bad_schema(tmp_path):
     p = tmp_path / "j"
     p.write_text("{not json")

@@ -236,6 +236,22 @@ def test_flight_ring_bounds_and_filters(tmp_path):
     assert "flight dump" in err.getvalue()
 
 
+def test_flight_snapshot_order_pinned_to_seq(tmp_path):
+    """``oldest first`` is a contract of /debug/flight and the NDJSON
+    flush, not an accident of ring layout: even a rotated ring dumps in
+    sequence order."""
+    rec = flight.FlightRecorder(capacity=4)
+    for i in range(4):
+        rec.record("watch", event=f"e{i}")
+    rec._events.rotate(2)  # simulate any internal reordering
+    assert [e["seq"] for e in rec.snapshot()] == [1, 2, 3, 4]
+    assert [e["seq"] for e in rec.view()["events"]] == [1, 2, 3, 4]
+    path = tmp_path / "flight.ndjson"
+    rec.flush(str(path))
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert [e["seq"] for e in lines] == [1, 2, 3, 4]
+
+
 def test_flight_module_activation(monkeypatch):
     assert flight.recorder() is None
     flight.record("daemon", event="ignored")  # disabled: no-op
@@ -423,9 +439,18 @@ def test_debug_flight_global_and_per_cluster(server):
     with running_daemon(server) as d:
         port = d.http_port
         s, _, _ = req(port, "POST", "/plan", {})
-        s, view, _ = req(port, "GET", "/debug/flight")
-        assert s == 200
-        kinds = {e["kind"] for e in view["events"]}
+        # The "request" flight event is recorded AFTER the /plan response
+        # bytes flush, so an immediately-following /debug/flight can win
+        # that race — poll with a bounded deadline for the write to land.
+        deadline = time.monotonic() + 5
+        while True:
+            s, view, _ = req(port, "GET", "/debug/flight")
+            assert s == 200
+            kinds = {e["kind"] for e in view["events"]}
+            if ({"daemon", "lifecycle", "resync", "request"} <= kinds
+                    or time.monotonic() >= deadline):
+                break
+            time.sleep(0.01)
         assert {"daemon", "lifecycle", "resync", "request"} <= kinds
         assert view["dropped"] == 0
         s, per, _ = req(port, "GET", "/clusters/default/debug/flight")
@@ -433,14 +458,22 @@ def test_debug_flight_global_and_per_cluster(server):
         assert all(
             e.get("cluster", "default") == "default" for e in per["events"]
         )
-        # request summaries carry the envelope's request id
+        # request summaries carry the envelope's request id (same bounded
+        # poll: the summary lands after the response flush)
         s, body, _ = req(port, "POST", "/plan", {})
         rid = body["result"]["request_id"]
-        s, view, _ = req(port, "GET", "/debug/flight")
-        assert any(
-            e["kind"] == "request" and e.get("request_id") == rid
-            for e in view["events"]
-        )
+
+        def _rid_recorded():
+            s, view, _ = req(port, "GET", "/debug/flight")
+            return any(
+                e["kind"] == "request" and e.get("request_id") == rid
+                for e in view["events"]
+            )
+
+        deadline = time.monotonic() + 5
+        while not _rid_recorded() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert _rid_recorded()
 
 
 def test_stderr_summary_gated_on_ka_obs_report(server, monkeypatch):
@@ -455,12 +488,20 @@ def test_stderr_summary_gated_on_ka_obs_report(server, monkeypatch):
         s, _, _ = req(d.http_port, "POST", "/plan", {})
         assert s == 200
         assert "obs: run" not in err.getvalue()
-        # exactly one access-log line for the one POST (GET probes aside)
-        plan_lines = [
-            ln for ln in err.getvalue().splitlines()
-            if ln.startswith("{") and '"path": "/plan"' in ln
-        ]
-        assert len(plan_lines) == 1
+        # exactly one access-log line for the one POST (GET probes aside).
+        # The line is written by the handler thread AFTER the response
+        # bytes flush, so give the post-reply write a bounded moment to
+        # land (same race as the lifetime-metrics test above).
+        def _plan_lines():
+            return [
+                ln for ln in err.getvalue().splitlines()
+                if ln.startswith("{") and '"path": "/plan"' in ln
+            ]
+
+        deadline = time.monotonic() + 5
+        while not _plan_lines() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(_plan_lines()) == 1
         monkeypatch.setenv("KA_OBS_REPORT", "/dev/null")
         s, _, _ = req(d.http_port, "POST", "/plan", {})
         assert s == 200
