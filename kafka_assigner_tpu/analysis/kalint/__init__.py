@@ -26,7 +26,16 @@ inconsistent guarding) and the deadlock rule (KA023 lock-order cycles).
 The smoke harnesses under ``scripts/`` are grafted into the same graph,
 so their plumbing is swept too.
 
-The rule catalog (KA000–KA023) lives in :data:`RULES` with one-line
+Since ISSUE 17 the graph carries a DETERMINISM taint layer
+(:mod:`.determinism`): nondeterminism sources (set/queue/filesystem
+iteration order, wall-clock/random/uuid reads, thread-racy collection
+drains) propagated along the call graph into the byte-pinned sinks
+(``json.dumps``, stdout emission, promtext rendering), with
+``sorted()``/canonical-order sanitizer recognition — the rules KA024–
+KA027 that statically prove the byte-identity contract, plus the KA028
+act-path deadline cross-pricing twin of KA020.
+
+The rule catalog (KA000–KA028) lives in :data:`RULES` with one-line
 meanings and example chains in :data:`RULE_DOCS`; the README rule table is
 generated from it (``python -m kafka_assigner_tpu.analysis.ruledoc
 --write``).
@@ -94,15 +103,27 @@ from .rules import (  # noqa: F401
     WIRE_MODULE,
     WRITE_OPCODES,
     ZK_WRITE_FUNC_NAMES,
+    ACT_BRIDGE_NAME,
+    ACT_BUDGET_KNOB,
+    ACT_ENTRY_NAME,
     BUDGET_KNOB,
     CONTROLLER_BUDGET_KNOB,
     CONTROLLER_MODULE,
+    check_act_budget,
     check_blocking_budget,
     check_dead_knobs,
     check_metric_units,
     check_readme,
     check_thread_safety,
     project_findings,
+)
+from .determinism import (  # noqa: F401
+    DECLARED_SINK_FUNCS,
+    TS_FIELD_ALLOWLIST,
+    TS_FIELD_TOKENS,
+    SinkReach,
+    check_determinism,
+    sink_reach,
 )
 from .threads import (  # noqa: F401
     HTTP_SURFACE_SEEDS,

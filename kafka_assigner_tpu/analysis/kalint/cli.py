@@ -24,8 +24,10 @@ Output:
   findings.
 - ``--changed-only`` — restrict the REPORT (never the analysis: graph
   rules need the whole tree) to findings in files modified since the
-  analysis cache entry was last written — the fast pre-commit loop.
-  With no cache baseline (cache off/cold) every finding is kept.
+  analysis cache entry was last written, OR dirty per ``git status``
+  (untracked + modified — a checkout rewinds mtimes; git's view does
+  not) — the fast pre-commit loop. With no cache baseline (cache
+  off/cold) every finding is kept.
 """
 from __future__ import annotations
 
@@ -57,11 +59,14 @@ def _print_explanations(findings: Sequence[Finding], rule: str) -> None:
 
 
 def _json_payload(findings: Sequence[Finding], root: str) -> dict:
+    # "rules" is additive to schema_version 1: CI annotation steps get
+    # the catalog (id -> one-line meaning) without re-importing kalint.
     return {
         "schema_version": 1,
         "tool": "kalint",
         "root": root,
         "count": len(findings),
+        "rules": dict(sorted(RULES.items())),
         "findings": [f.to_dict() for f in findings],
     }
 
@@ -136,15 +141,47 @@ def _sarif_payload(findings: Sequence[Finding]) -> dict:
     }
 
 
+def _git_dirty_paths(repo: Path) -> frozenset:
+    """Repo-relative posix paths ``git status`` reports as modified or
+    untracked. A ``git checkout``/branch switch REWINDS mtimes, so the
+    mtime-vs-baseline test alone would serve a stale CLEAN verdict for
+    exactly the files that just changed under it; git's own view closes
+    that hole. Empty on any failure (no git, not a repo) — the mtime
+    baseline then stands alone, the pre-ISSUE-17 behavior."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            ["git", "-C", str(repo), "status", "--porcelain",
+             "--untracked-files=all", "--no-renames"],
+            capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.SubprocessError):  # kalint: disable=KA008 -- no git here: fall back to the mtime baseline
+        return frozenset()
+    if proc.returncode != 0:
+        return frozenset()
+    paths = set()
+    for line in proc.stdout.splitlines():
+        if len(line) > 3:
+            paths.add(line[3:].strip().strip('"'))
+    return frozenset(paths)
+
+
 def _changed_only(findings: Sequence[Finding], repo: Path,
                   baseline: Optional[float]) -> List[Finding]:
     """Drop findings in files not modified since ``baseline`` (the cache
-    entry's pre-run mtime). No baseline, or an unstattable path, keeps
-    the finding — restriction must only ever hide KNOWN-stale results."""
+    entry's pre-run mtime) AND not dirty per ``git status`` (untracked +
+    modified — mtime rewinds under checkout, git does not). No baseline,
+    or an unstattable path, keeps the finding — restriction must only
+    ever hide KNOWN-stale results."""
     if baseline is None:
         return list(findings)
+    dirty = _git_dirty_paths(repo)
     kept = []
     for f in findings:
+        if f.path in dirty:
+            kept.append(f)
+            continue
         try:
             if (repo / f.path).stat().st_mtime <= baseline:
                 continue

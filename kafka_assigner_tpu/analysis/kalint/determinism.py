@@ -1,0 +1,823 @@
+"""Determinism taint layer: statically prove the byte-identity invariant.
+
+The system's north-star contract (PAPER.md §7, PARITY.md) is that every
+output surface — plan JSON, daemon envelopes, journals, flight dumps,
+snapshots, Prometheus exposition — is byte-identical across runs,
+processes, and coalescing regimes. Historically the repo only *repaired*
+ordering bugs after they surfaced (the snapshot topic-order fix, the
+journal/flight ordering pins); this layer finds them before they ship,
+by source→sink taint over the ISSUE 12 interprocedural call graph.
+
+**Sources** (nondeterminism origins):
+
+- set iteration / set-typed comprehensions / a set materialized through
+  ``list()``/``tuple()`` — order is ``PYTHONHASHSEED``-dependent (KA024);
+- ``concurrent.futures.as_completed`` / queue-drain order — completion
+  order is scheduling-dependent (KA024);
+- ``os.listdir``/``os.scandir``/``glob.*``/``Path.iterdir`` — the OS
+  returns directory entries in arbitrary order (KA026);
+- wall-clock / ``random.*`` / ``uuid`` / ``id()`` / ``hash()`` value
+  reads (KA025). Monotonic clocks (``time.monotonic``/``perf_counter``)
+  are exempt by construction: they price deadlines and spans, never
+  produce an absolute timestamp that could land in an envelope;
+- a thread-racy collection (written from another PR 16 thread entry)
+  iterated — or its ``dict`` views drained — mid-mutation (KA027).
+
+**Sanitizers**: ``sorted(...)`` (directly, or consuming a comprehension
+over the source), ``.sort()`` on the materialized sequence, canonical-
+order helpers (a callee whose name contains ``canonical`` or ``sorted``),
+and the order-insensitive consumers (``len``/``min``/``max``/``sum``/
+``any``/``all``/membership tests/set algebra), which never observe order
+at all. Sanitizing is PER EXPRESSION: a ``sorted()`` on the wrong axis
+discharges nothing, and ``random.shuffle`` re-taints a sequence that was
+already sorted. KA027 is the exception — ``sorted()`` does not discharge
+it (iterating a collection another thread mutates can raise or tear
+regardless of later ordering); only a snapshot taken under a lock the
+writers hold does.
+
+**Sinks** (byte-pinned surfaces): ``json.dumps``/``json.dump`` call
+sites anywhere in the package (plan emission, envelope builders,
+journal/flight/ledger/snapshot persistence), the declared in-project
+byte surfaces that do not literally call ``json.dumps`` (Prometheus
+exposition rendering in ``obs/promtext.py``), and ``print``/``sys.stdout``
+writes in package modules (the CLI byte contract; ``scripts/`` harness
+progress logging is exempt — smoke-script stdout is operator narration,
+not a pinned surface, and their byte assertions compare *daemon* output).
+
+A function is **sink-reaching** when a sink is reachable from it over
+the call graph; source findings fire only inside sink-reaching functions
+and carry the function→…→sink chain for ``--explain`` and SARIF
+``codeFlows``. Everything here under-approximates like the resolver
+itself: an unresolvable call contributes no reach, an expression the
+local classifier cannot type is silent — CLEAN means "no *demonstrable*
+order leak", the same posture as every other kalint layer.
+
+Timestamps are legal in envelopes at DECLARED field names only:
+:data:`TS_FIELD_ALLOWLIST` / :data:`TS_FIELD_TOKENS` (``ts``,
+``request_id``, ``*_uptime_*`` …) — a wall-clock read stamped into one
+of those fields is the contract working, not a finding.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding
+from .resolve import FunctionInfo, Project
+
+# -- sink taxonomy ------------------------------------------------------------
+
+#: ``json.<name>`` serialization calls that pin bytes at the call site.
+JSON_SINK_NAMES = frozenset({"dumps", "dump"})
+
+#: Module aliases a ``<mod>.dumps(...)`` sink call may be qualified with.
+JSON_MODULE_NAMES = frozenset({"json", "_json"})
+
+#: Declared in-project byte surfaces that do not literally call
+#: ``json.dumps``: (relpath, function name) -> surface description.
+DECLARED_SINK_FUNCS: Dict[Tuple[str, str], str] = {
+    ("obs/promtext.py", "render"): "Prometheus exposition rendering",
+}
+
+#: Module prefix whose stdout is harness narration, not a pinned surface.
+SCRIPTS_PREFIX = "scripts/"
+
+# -- source taxonomy ----------------------------------------------------------
+
+#: Filesystem-enumeration calls (KA026): ``<os>.name(...)`` attribute or
+#: bare-name forms. ``Path`` methods are matched by attribute name alone —
+#: there is exactly one thing ``.iterdir()``/``.rglob()`` can mean.
+FS_ENUM_OS_NAMES = frozenset({"listdir", "scandir"})
+FS_ENUM_GLOB_NAMES = frozenset({"glob", "iglob"})
+FS_ENUM_PATH_METHODS = frozenset({"iterdir", "rglob"})
+
+#: ``random.<name>`` module-level value sources (KA025). A seeded
+#: ``random.Random(seed)`` instance is deterministic by construction, so
+#: only calls qualified with the MODULE name count.
+RANDOM_VALUE_NAMES = frozenset({
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "sample", "getrandbits", "gauss",
+})
+
+#: Wall-clock attribute calls (KA025): ``time.<name>`` / ``datetime.<name>``.
+#: ``monotonic``/``perf_counter`` are deliberately absent (module docstring).
+WALL_CLOCK_NAMES = frozenset({"time", "time_ns", "now", "utcnow", "today"})
+WALL_CLOCK_MODULES = frozenset({"time", "datetime", "date"})
+
+#: ``uuid.<name>`` identity sources (KA025).
+UUID_VALUE_NAMES = frozenset({"uuid1", "uuid4", "getnode"})
+
+#: Builtin identity sources (KA025): ``id(x)`` is an address, ``hash(x)``
+#: is ``PYTHONHASHSEED``-keyed for strs/bytes.
+BUILTIN_VALUE_NAMES = frozenset({"id", "hash"})
+
+#: Envelope field names where a wall-clock/identity value is DECLARED
+#: legal (exact match), plus substring tokens for derived names
+#: (``process_uptime_seconds``, ``started_ts``, ``retry_in_s`` …).
+TS_FIELD_ALLOWLIST = frozenset({"t", "rid", "seq", "now"})
+TS_FIELD_TOKENS = (
+    "ts", "time", "timestamp", "uptime", "elapsed", "duration",
+    "started", "finished", "deadline", "request_id", "seed",
+)
+
+#: Order-insensitive consumers: these never observe iteration order.
+ORDER_INSENSITIVE_CALLS = frozenset({
+    "len", "min", "max", "sum", "any", "all", "bool", "set", "frozenset",
+    "sorted",
+})
+
+#: Consumers that preserve (and therefore expose) the arbitrary order.
+MATERIALIZING_CALLS = frozenset({"list", "tuple", "iter", "reversed",
+                                 "enumerate", "map", "filter", "join"})
+
+#: Source-kind labels for messages.
+_KIND_DESC = {
+    "set": "set iteration order (PYTHONHASHSEED-dependent)",
+    "queue": "completion/drain order (scheduling-dependent)",
+    "fs": "filesystem enumeration order (OS-dependent)",
+    "shuffled": "re-shuffled sequence order",
+}
+_KIND_RULE = {"set": "KA024", "queue": "KA024", "shuffled": "KA024",
+              "fs": "KA026"}
+
+
+# -- sink reachability --------------------------------------------------------
+
+@dataclass
+class SinkReach:
+    """Backward reachability to the nearest byte-pinned sink. ``towards``
+    maps each member to ``(next hop key or None, call-site line in the
+    member)``; ``sink_of`` maps each member to ``(sink funckey, sink
+    description)`` — the seed's own sink call for seeds."""
+    towards: Dict[str, Tuple[Optional[str], int]]
+    sink_of: Dict[str, Tuple[str, str]]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.towards
+
+    def chain(self, key: str) -> Tuple[str, ...]:
+        """``key@line`` hops from ``key`` to the sink function, each line
+        being the call site that leads one hop closer to the sink (the
+        seed's line is its sink call)."""
+        hops: List[str] = []
+        cur: Optional[str] = key
+        seen: Set[str] = set()
+        while cur is not None and cur not in seen:
+            seen.add(cur)
+            nxt, line = self.towards.get(cur, (None, 0))
+            hops.append(f"{cur}@{line}")
+            cur = nxt
+        return tuple(hops)
+
+    def describe(self, key: str) -> str:
+        sink_key, desc = self.sink_of.get(key, (key, "serialization sink"))
+        return f"{desc} at {sink_key}"
+
+
+def _dotted_head(node: ast.AST) -> Optional[str]:
+    """The qualifying name of ``<name>.attr`` (one level), else None."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return node.value.id
+    return None
+
+
+def _sink_call_desc(node: ast.Call, relpath: str) -> Optional[str]:
+    """Description when ``node`` pins bytes at the call site, else None."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in JSON_SINK_NAMES \
+            and _dotted_head(f) in JSON_MODULE_NAMES:
+        return f"json.{f.attr} serialization"
+    if isinstance(f, ast.Name) and f.id in JSON_SINK_NAMES:
+        return f"{f.id}(...) serialization"
+    if relpath.startswith(SCRIPTS_PREFIX):
+        return None  # harness narration is not a pinned surface
+    if isinstance(f, ast.Name) and f.id == "print":
+        for kw in node.keywords:
+            if kw.arg == "file":
+                # print(..., file=sys.stderr) is diagnostics, not bytes
+                head = _dotted_head(kw.value)
+                attr = getattr(kw.value, "attr", None)
+                if head == "sys" and attr != "stdout":
+                    return None
+        return "stdout emission (print)"
+    if isinstance(f, ast.Attribute) and f.attr == "write":
+        recv = f.value
+        if isinstance(recv, ast.Attribute) and recv.attr == "stdout" \
+                and _dotted_head(recv) == "sys":
+            return "stdout emission (sys.stdout.write)"
+    return None
+
+
+def sink_reach(project: Project) -> SinkReach:
+    """Every function from which a byte-pinned sink is reachable, with a
+    next-hop pointer toward the nearest sink (BFS over the reversed call
+    graph — "nearest" keeps ``--explain`` chains short and concrete)."""
+    cached = getattr(project, "_determinism_reach", None)
+    if cached is not None:
+        return cached
+    towards: Dict[str, Tuple[Optional[str], int]] = {}
+    sink_of: Dict[str, Tuple[str, str]] = {}
+    frontier: List[str] = []
+    for key, fn in sorted(project.functions.items()):
+        desc: Optional[str] = None
+        line = fn.node.lineno
+        declared = DECLARED_SINK_FUNCS.get((fn.relpath, fn.name))
+        if declared is not None:
+            desc = declared
+        else:
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Call):
+                    d = _sink_call_desc(node, fn.relpath)
+                    if d is not None:
+                        desc, line = d, node.lineno
+                        break
+        if desc is not None:
+            towards[key] = (None, line)
+            sink_of[key] = (key, desc)
+            frontier.append(key)
+    reverse: Dict[str, List[Tuple[str, int]]] = {}
+    for caller, callees in project.call_graph.items():
+        for callee, line in callees.items():
+            reverse.setdefault(callee, []).append((caller, line))
+    i = 0
+    while i < len(frontier):
+        cur = frontier[i]
+        i += 1
+        for caller, line in sorted(reverse.get(cur, ())):
+            if caller in towards:
+                continue
+            towards[caller] = (cur, line)
+            sink_of[caller] = sink_of[cur]
+            frontier.append(caller)
+    # Phase 2, the callee direction: a helper whose RESULT a member
+    # consumes (the call is not a discarded Expr statement) hands its
+    # return value to code that serializes — the PR 15/16 bug shape, a
+    # builder computing the payload the caller dumps. Side-effect-only
+    # calls (append, lock ops, logging) stay out; a tainted ARGUMENT
+    # passed into a member dies at the boundary (function-granular
+    # under-approximation, same posture as the resolver).
+    j = 0
+    used_frontier = list(frontier)
+    while j < len(used_frontier):
+        cur = used_frontier[j]
+        j += 1
+        fn = project.functions.get(cur)
+        if fn is None:
+            continue
+        mod = project.modules.get(fn.relpath)
+        if mod is None:
+            continue
+        env = project.function_env(mod, fn)
+        parents = _parent_map(fn.node)
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(parents.get(node), ast.Expr):
+                continue  # result discarded — nothing flows back
+            callee = project.resolve_call(mod, fn, node, env)
+            if callee is None or callee in towards:
+                continue
+            towards[callee] = (cur, node.lineno)
+            sink_of[callee] = sink_of[cur]
+            used_frontier.append(callee)
+    result = SinkReach(towards=towards, sink_of=sink_of)
+    project._determinism_reach = result
+    return result
+
+
+# -- intra-function source scanning -------------------------------------------
+
+def _terminal_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _is_fs_enum_call(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id in FS_ENUM_GLOB_NAMES
+    if not isinstance(f, ast.Attribute):
+        return False
+    head = _dotted_head(f)
+    if f.attr in FS_ENUM_OS_NAMES and head in (None, "os"):
+        return head == "os"
+    if f.attr in FS_ENUM_GLOB_NAMES and head == "glob":
+        return True
+    return f.attr in FS_ENUM_PATH_METHODS
+
+
+def _is_sanitizer_call(call: ast.Call) -> bool:
+    """``sorted(...)`` or a canonical-order helper: the result is in a
+    deterministic order regardless of the argument's."""
+    name = _terminal_name(call)
+    if name is None:
+        return False
+    return name == "sorted" or "canonical" in name or "sorted" in name
+
+
+def _parent_map(root: ast.AST) -> Dict[ast.AST, ast.AST]:
+    return {child: parent
+            for parent in ast.walk(root)
+            for child in ast.iter_child_nodes(parent)}
+
+
+class _FnScan:
+    """One function's determinism scan: classify unordered expressions,
+    track materialized taint through local names, and report every
+    order-sensitive consumption that no sanitizer discharges."""
+
+    def __init__(self, fn: FunctionInfo) -> None:
+        self.fn = fn
+        self.parents = _parent_map(fn.node)
+        #: local name -> source kind; "set" names still hold a set object
+        #: (later set algebra keeps working), the rest hold materialized
+        #: sequences whose arbitrary order is now observable.
+        self.tainted: Dict[str, str] = {}
+        #: (line, col, kind) — order-sensitive consumptions to report.
+        self.hits: List[Tuple[int, int, str]] = []
+        #: (line, col, desc) — wall-clock/identity value reads (KA025).
+        self.value_hits: List[Tuple[int, int, str]] = []
+
+    # -- classification ------------------------------------------------------
+
+    def unordered_kind(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return "set"
+        if isinstance(node, ast.Name) and node.id in self.tainted:
+            return self.tainted[node.id]
+        if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.BitOr, ast.BitAnd, ast.BitXor, ast.Sub)):
+            if self.unordered_kind(node.left) == "set" \
+                    or self.unordered_kind(node.right) == "set":
+                return "set"
+            return None
+        if not isinstance(node, ast.Call):
+            return None
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in ("set", "frozenset"):
+            return "set"
+        if _is_fs_enum_call(node):
+            return "fs"
+        name = _terminal_name(node)
+        if name == "as_completed":
+            return "queue"
+        if name in ("get", "get_nowait") and isinstance(f, ast.Attribute):
+            # queue drain: only when the receiver is nameably a queue —
+            # anything else (dict.get!) must stay silent
+            recv = f.value
+            recv_name = recv.id if isinstance(recv, ast.Name) \
+                else getattr(recv, "attr", None)
+            if recv_name is not None and "queue" in recv_name.lower():
+                return "queue"
+        if name in ("union", "intersection", "difference",
+                    "symmetric_difference", "copy") \
+                and isinstance(f, ast.Attribute) \
+                and self.unordered_kind(f.value) == "set":
+            return "set"
+        return None
+
+    # -- consumption ---------------------------------------------------------
+
+    def _comprehension_owner(self, comp: ast.comprehension) -> Optional[ast.AST]:
+        owner = self.parents.get(comp)
+        return owner
+
+    def _consumer(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(node)
+
+    def _sanitized_up(self, node: ast.AST) -> bool:
+        """True when ``node``'s value flows straight into a sanitizer:
+        ``sorted(S)``, ``sorted(f(x) for x in S)`` (the per-element map
+        commutes with the sort), or a canonical-order helper call."""
+        cur = node
+        parent = self.parents.get(cur)
+        # climb through the generator plumbing of a comprehension built
+        # directly over the source
+        while isinstance(parent, (ast.comprehension, ast.GeneratorExp,
+                                  ast.ListComp)):
+            cur = parent if not isinstance(parent, ast.comprehension) \
+                else self.parents.get(parent)
+            if cur is None:
+                return False
+            parent = self.parents.get(cur)
+        if isinstance(parent, ast.Call) and cur in parent.args \
+                and _is_sanitizer_call(parent):
+            return True
+        if isinstance(parent, ast.Starred):
+            grand = self.parents.get(parent)
+            if isinstance(grand, ast.Call) and _is_sanitizer_call(grand):
+                return True
+        return False
+
+    def _order_insensitive(self, node: ast.AST, consumer: ast.AST) -> bool:
+        if isinstance(consumer, ast.Call) and node in consumer.args:
+            name = _terminal_name(consumer)
+            if name in ORDER_INSENSITIVE_CALLS or _is_sanitizer_call(consumer):
+                return True
+        if isinstance(consumer, ast.Compare):
+            # membership / equality never observe order
+            return True
+        if isinstance(consumer, (ast.BinOp, ast.BoolOp, ast.UnaryOp)):
+            return True  # set algebra / truthiness
+        if isinstance(consumer, ast.Subscript):
+            return True  # d[k] on a dict keyed by the set — not iteration
+        return False
+
+    def record(self, node: ast.AST, kind: str) -> None:
+        self.hits.append((node.lineno, node.col_offset + 1, kind))
+
+    def consume(self, node: ast.AST, kind: str) -> None:
+        """Judge one classified unordered expression at its consumer."""
+        consumer = self._consumer(node)
+        if consumer is None:
+            return
+        if self._order_insensitive(node, consumer):
+            return
+        if self._sanitized_up(node):
+            return
+        # iteration: for-loop or comprehension generator
+        if isinstance(consumer, (ast.For, ast.AsyncFor)) \
+                and consumer.iter is node:
+            self.record(node, kind)
+            return
+        if isinstance(consumer, ast.comprehension) and consumer.iter is node:
+            owner = self._comprehension_owner(consumer)
+            if isinstance(owner, (ast.SetComp,)):
+                return  # a set built over a set is still just a set
+            if owner is not None and self._sanitized_up(owner):
+                return  # sorted(f(x) for x in S)
+            self.record(node, kind)
+            return
+        if isinstance(consumer, ast.Call) and node in consumer.args:
+            name = _terminal_name(consumer)
+            if _sink_call_desc(consumer, self.fn.relpath) is not None:
+                # handing the arbitrary order straight to the sink —
+                # json.dumps(list(s)) and json.dumps(items) alike
+                self.record(node, kind)
+                return
+            if name in MATERIALIZING_CALLS:
+                # list(S): the arbitrary order becomes an observable
+                # sequence — legal only when the result is immediately
+                # sorted or bound to a name that is sorted before use
+                grand = self._consumer(consumer)
+                if grand is not None and isinstance(grand, ast.Call) \
+                        and consumer in grand.args \
+                        and _is_sanitizer_call(grand):
+                    return
+                if isinstance(grand, ast.Assign) and len(grand.targets) == 1 \
+                        and isinstance(grand.targets[0], ast.Name):
+                    # the pre-pass already tainted the target (and saw
+                    # any later .sort() discharge) — no state change here
+                    return
+                self.record(node, kind)
+            return
+        if isinstance(consumer, ast.Starred) or isinstance(
+                consumer, ast.YieldFrom):
+            self.record(node, kind)
+            return
+        if isinstance(consumer, ast.Assign) and len(consumer.targets) == 1 \
+                and isinstance(consumer.targets[0], ast.Name):
+            self.tainted[consumer.targets[0].id] = kind
+            return
+        if isinstance(consumer, ast.Return) and kind != "set":
+            # returning a SET is returning a set (the caller's own use is
+            # judged there if it is in this project); returning an already
+            # MATERIALIZED arbitrary order hands the bug to every caller
+            self.record(node, kind)
+            return
+
+    # -- the walk ------------------------------------------------------------
+
+    def run(self) -> None:
+        for stmt in ast.walk(self.fn.node):
+            self._statement_effects(stmt)
+        for node in ast.walk(self.fn.node):
+            kind = self.unordered_kind(node)
+            if kind is not None and not (
+                    isinstance(node, ast.Name)):
+                self.consume(node, kind)
+            elif isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id in self.tainted:
+                self.consume(node, self.tainted[node.id])
+            if isinstance(node, ast.Call):
+                self._value_source(node)
+
+    def _statement_effects(self, stmt: ast.AST) -> None:
+        """Pre-pass, in source order: name bindings, ``.sort()``
+        discharges, ``random.shuffle`` re-taints. ``ast.walk`` is
+        breadth-first but assignments and their uses are judged against
+        the FINAL state only in straight-line code; the repo's (and the
+        fixtures') taint-relevant flows are straight-line, and a
+        flow-join miss under-approximates, which is the house posture."""
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            name = stmt.targets[0].id
+            kind = self.unordered_kind(stmt.value)
+            if kind is None and isinstance(stmt.value, ast.Call):
+                inner = stmt.value
+                tname = _terminal_name(inner)
+                if tname in MATERIALIZING_CALLS and inner.args:
+                    kind = self.unordered_kind(inner.args[0])
+            if kind is not None:
+                self.tainted[name] = kind
+            elif name in self.tainted and not (
+                    isinstance(stmt.value, ast.Name)
+                    and stmt.value.id == name):
+                del self.tainted[name]
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            f = call.func
+            if isinstance(f, ast.Attribute) and f.attr == "sort" \
+                    and isinstance(f.value, ast.Name):
+                self.tainted.pop(f.value.id, None)
+            name = _terminal_name(call)
+            if name == "shuffle" and _dotted_head(f) == "random" \
+                    and call.args and isinstance(call.args[0], ast.Name):
+                self.tainted[call.args[0].id] = "shuffled"
+
+    # -- KA025 value sources -------------------------------------------------
+
+    def _value_source(self, call: ast.Call) -> None:
+        desc = self._value_source_desc(call)
+        if desc is None:
+            return
+        if "identity read" in desc and self._identity_token_use(call):
+            return  # memo key / membership token — never becomes bytes
+        if self._ts_allowlisted(call):
+            return
+        self.value_hits.append(
+            (call.lineno, call.col_offset + 1, desc))
+
+    def _identity_token_use(self, call: ast.Call) -> bool:
+        """``id(x)``/``hash(x)`` consumed as an identity TOKEN — a set
+        membership test, a memo subscript, a dict key, a ``.add(...)`` —
+        names an object, it does not produce a value that could land in
+        output bytes."""
+        parent = self.parents.get(call)
+        if isinstance(parent, ast.Compare):
+            return True
+        if isinstance(parent, ast.Subscript):
+            return True
+        if isinstance(parent, ast.Dict) and call in parent.keys:
+            return True
+        if isinstance(parent, ast.Call) and call in parent.args:
+            name = _terminal_name(parent)
+            if name in ("add", "discard", "remove", "get", "pop",
+                        "setdefault"):
+                return True
+        return False
+
+    def _value_source_desc(self, call: ast.Call) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in BUILTIN_VALUE_NAMES and len(call.args) == 1:
+                return f"{f.id}() identity read"
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        head = _dotted_head(f)
+        if f.attr in WALL_CLOCK_NAMES and head in WALL_CLOCK_MODULES:
+            return f"wall-clock read {head}.{f.attr}()"
+        if f.attr in RANDOM_VALUE_NAMES and head == "random":
+            return f"random.{f.attr}() draw"
+        if f.attr in UUID_VALUE_NAMES and head == "uuid":
+            return f"uuid.{f.attr}() draw"
+        return None
+
+    def _ts_allowlisted(self, node: ast.AST) -> bool:
+        """True when the value lands in a DECLARED timestamp/identity
+        field: the nearest dict-literal key, keyword argument, call-chain
+        attribute, assignment target, or the enclosing function's own
+        name matches the allowlist."""
+        names: List[str] = []
+        cur: ast.AST = node
+        for _ in range(32):
+            parent = self.parents.get(cur)
+            if parent is None:
+                break
+            if isinstance(parent, ast.Dict):
+                for k, v in zip(parent.keys, parent.values):
+                    if v is cur and isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str):
+                        names.append(k.value)
+            if isinstance(parent, ast.keyword) and parent.arg:
+                names.append(parent.arg)
+            if isinstance(parent, ast.Call):
+                recv = parent.func
+                if isinstance(recv, ast.Attribute):
+                    names.append(recv.attr)
+                    # d.setdefault("ts", value): the FIELD is the first
+                    # positional arg, the value rides behind it
+                    if recv.attr in ("setdefault", "set") and parent.args \
+                            and cur is not parent.args[0] \
+                            and isinstance(parent.args[0], ast.Constant) \
+                            and isinstance(parent.args[0].value, str):
+                        names.append(parent.args[0].value)
+            if isinstance(parent, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = parent.targets if isinstance(parent, ast.Assign) \
+                    else [parent.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        names.append(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        names.append(t.attr)
+                    elif isinstance(t, ast.Subscript) \
+                            and isinstance(t.slice, ast.Constant) \
+                            and isinstance(t.slice.value, str):
+                        names.append(t.slice.value)  # d["ts"] = value
+                break  # the statement boundary ends the flow
+            if isinstance(parent, (ast.stmt,)):
+                break
+            cur = parent
+        names.append(self.fn.name)
+        return any(_ts_field_ok(n) for n in names)
+
+
+def _ts_field_ok(name: str) -> bool:
+    low = name.lower().lstrip("_")
+    if low in TS_FIELD_ALLOWLIST:
+        return True
+    return any(tok in low for tok in TS_FIELD_TOKENS)
+
+
+# -- KA027: thread-racy collections at a sink ---------------------------------
+
+#: Attribute-view drains whose result is an iteration of the backing dict.
+DICT_VIEW_NAMES = frozenset({"keys", "values", "items"})
+
+
+def _iterated_attr_nodes(fn: FunctionInfo,
+                         parents: Dict[ast.AST, ast.AST]
+                         ) -> List[Tuple[ast.Attribute, str]]:
+    """``self.<attr>`` loads consumed by iteration — directly (``for``/
+    comprehension/``list()``/``sorted()``), or through a dict view
+    (``.items()`` &c). Returns (node, how)."""
+    out: List[Tuple[ast.Attribute, str]] = []
+    for node in ast.walk(fn.node):
+        if not (isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            continue
+        consumer = parents.get(node)
+        if isinstance(consumer, ast.Attribute) \
+                and consumer.attr in DICT_VIEW_NAMES:
+            call = parents.get(consumer)
+            if isinstance(call, ast.Call) and call.func is consumer:
+                out.append((node, f".{consumer.attr}() view drain"))
+            continue
+        if isinstance(consumer, (ast.For, ast.AsyncFor)) \
+                and consumer.iter is node:
+            out.append((node, "direct iteration"))
+        elif isinstance(consumer, ast.comprehension) \
+                and consumer.iter is node:
+            out.append((node, "comprehension iteration"))
+        elif isinstance(consumer, ast.Call) and node in consumer.args \
+                and _terminal_name(consumer) in (
+                    MATERIALIZING_CALLS | {"sorted", "dict"}):
+            out.append((node, f"{_terminal_name(consumer)}(...) "
+                              "materialization"))
+    return out
+
+
+def _check_racy_iteration(project: Project, reach: SinkReach,
+                          display: Dict[str, str]) -> List[Finding]:
+    """KA027: a collection attribute written from another thread entry,
+    iterated (or view-drained) in a sink-reaching function with no lock
+    in common with every foreign write — iteration is not atomic, so the
+    drain can tear or raise mid-mutation and the surface bytes become a
+    race result. ``sorted()`` does NOT discharge this; a snapshot taken
+    while holding the writers' lock does. Attributes KA021/KA022 already
+    convict are skipped — one rule per defect."""
+    from .threads import thread_model
+
+    model = thread_model(project)
+    out: List[Finding] = []
+
+    def tid(entry_key: str) -> str:
+        e = model.entry_by_key.get(entry_key)
+        return "<main>" if (e is not None and e.kind == "main") \
+            else entry_key
+
+    groups: Dict[Tuple[Tuple[str, str], str], List] = {}
+    for acc in model.accesses:
+        groups.setdefault((acc.owner, acc.attr), []).append(acc)
+
+    # replicate the KA021/KA022 convictions to stay disjoint from them
+    def convicted_elsewhere(writes) -> bool:
+        writer_tids = {tid(a.entry) for a in writes} | {
+            a.entry for a in writes
+            if (e := model.entry_by_key.get(a.entry)) is not None
+            and e.concurrent
+        }
+        common_w = frozenset.intersection(*[a.locks for a in writes])
+        if len(writer_tids) >= 2 and not common_w:
+            return True  # KA021 territory
+        return bool(common_w)  # KA022 owns inconsistent guarding
+
+    seen: Set[Tuple[str, int, int]] = set()
+    for (owner, attr), accs in sorted(groups.items()):
+        writes = [a for a in accs if a.write]
+        if not writes:
+            continue
+        if convicted_elsewhere(writes):
+            continue
+        for acc in accs:
+            if acc.write or acc.funckey not in reach:
+                continue
+            foreign = [w for w in writes if tid(w.entry) != tid(acc.entry)
+                       or ((e := model.entry_by_key.get(w.entry))
+                           is not None and e.concurrent)]
+            if not foreign:
+                continue
+            safe = any(
+                lock in acc.locks
+                and all(lock in w.locks for w in foreign)
+                for lock in frozenset.union(*[w.locks for w in foreign])
+            ) if any(w.locks for w in foreign) else False
+            if safe:
+                continue
+            fn = project.functions.get(acc.funckey)
+            if fn is None:
+                continue
+            parents = _parent_map(fn.node)
+            for node, how in _iterated_attr_nodes(fn, parents):
+                if node.attr != attr:
+                    continue
+                if node.lineno != acc.line or node.col_offset + 1 != acc.col:
+                    continue
+                key = (acc.funckey, node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                orel, ocls = owner
+                writers = "; ".join(sorted(
+                    {model.entry_by_key[w.entry].label
+                     if w.entry in model.entry_by_key else w.entry
+                     for w in foreign}))
+                out.append(Finding(
+                    "KA027",
+                    display.get(fn.relpath, fn.relpath),
+                    node.lineno, node.col_offset + 1,
+                    f"thread-racy collection {ocls}.{attr} ({orel}) "
+                    f"{how} on the way to a byte-pinned sink "
+                    f"({reach.describe(acc.funckey)}) while "
+                    f"{writers} can mutate it, with no lock in common "
+                    "with the writers: the drain can tear or raise "
+                    "mid-mutation and the surface bytes become a race "
+                    "result — snapshot under the writers' lock first, "
+                    "or suppress citing the happens-before protocol",
+                    chain=reach.chain(acc.funckey),
+                ))
+    return out
+
+
+# -- the rule pass ------------------------------------------------------------
+
+def check_determinism(project: Project,
+                      display: Dict[str, str]) -> List[Finding]:
+    """KA024–KA027 over one resolved project (module docstring has the
+    taxonomy). Findings carry the function→…→sink chain."""
+    reach = sink_reach(project)
+    out: List[Finding] = []
+    for key in sorted(reach.towards):
+        fn = project.functions.get(key)
+        if fn is None:
+            continue
+        scan = _FnScan(fn)
+        scan.run()
+        path = display.get(fn.relpath, fn.relpath)
+        chain = reach.chain(key)
+        where = reach.describe(key)
+        for line, col, kind in sorted(set(scan.hits)):
+            rule = _KIND_RULE[kind]
+            fixup = (
+                "wrap the producer in sorted(...) or a canonical-order "
+                "helper (a later sort on a different axis discharges "
+                "nothing), or suppress citing the source→sink chain"
+            )
+            out.append(Finding(
+                rule, path, line, col,
+                f"{_KIND_DESC[kind]} reaches the byte-pinned sink "
+                f"({where}) unsanitized: {fixup}",
+                chain=chain,
+            ))
+        for line, col, desc in sorted(set(scan.value_hits)):
+            out.append(Finding(
+                "KA025", path, line, col,
+                f"{desc} flows toward pinned output bytes ({where}) "
+                "outside every declared timestamp/identity field "
+                f"(allowlist: {', '.join(sorted(TS_FIELD_ALLOWLIST))} "
+                f"plus *{'*, *'.join(TS_FIELD_TOKENS)}* tokens): stamp "
+                "it into a declared envelope field, derive it "
+                "deterministically, or suppress citing the chain",
+                chain=chain,
+            ))
+    out.extend(_check_racy_iteration(project, reach, display))
+    return out

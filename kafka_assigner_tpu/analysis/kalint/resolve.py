@@ -140,6 +140,8 @@ class Project:
         self._gate_held = None
         #: thread/shared-state model memo (filled by .threads)
         self._threads = None
+        #: byte-pinned sink reachability memo (filled by .determinism)
+        self._determinism_reach = None
         #: top-level dotted names of injected out-of-package modules
         #: (``scripts`` for the smoke harnesses) — absolute imports of
         #: these resolve in-project even though they sit outside

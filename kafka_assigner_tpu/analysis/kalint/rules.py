@@ -61,6 +61,17 @@ RULES = {
              "and unguarded on others (forgotten lock)",
     "KA023": "lock-order cycle across the discovered lock set "
              "(potential deadlock)",
+    "KA024": "unordered iteration (set / queue-drain order) reaches a "
+             "byte-pinned serialization sink unsanitized",
+    "KA025": "wall-clock/random/uuid/id()/hash() value flows into pinned "
+             "output bytes outside a declared timestamp field",
+    "KA026": "filesystem-enumeration order (os.listdir/glob/iterdir) "
+             "reaches a byte-pinned sink unsanitized",
+    "KA027": "thread-racy collection iterated at a byte-pinned sink "
+             "without a snapshot under the writers' lock",
+    "KA028": "deadline cross-pricing: the controller act path's "
+             "worst-case execution envelope exceeds the rolling "
+             "move-window budget (KA_CONTROLLER_WINDOW)",
 }
 
 #: One-line meaning + example offending chain per rule — the source of the
@@ -254,6 +265,60 @@ RULE_DOCS: Dict[str, Tuple[str, str]] = {
         "citing the protocol that keeps the cycle unreachable",
         "`_plan_mu` → `_cv` in `submit()` but `_cv` → `_plan_mu` in "
         "`_loop()`",
+    ),
+    "KA024": (
+        "no unordered iteration — a set (literal, comprehension, "
+        "`set()`/`frozenset()` call, set algebra), a queue drain, or "
+        "`as_completed` completion order — may reach a byte-pinned sink "
+        "(`json.dumps`, stdout emission, promtext rendering) without a "
+        "sanitizer: `sorted(...)` on THAT expression, `.sort()` on the "
+        "materialized sequence, or a canonical-order helper; sorting a "
+        "different axis (or re-shuffling after the sort) discharges "
+        "nothing, and `list(S)` merely freezes the arbitrary order",
+        "`for t in {p.topic for p in parts}:` → `emit()` → "
+        "`json.dumps(...)`",
+    ),
+    "KA025": (
+        "no wall-clock (`time.time`, `datetime.now`), `random.*` draw, "
+        "`uuid.uuid1/uuid4`, `id()` or `hash()` value may flow toward "
+        "pinned output bytes except into a DECLARED timestamp/identity "
+        "field (`ts`/`t`/`request_id`/`*_uptime_*`/… — the allowlist in "
+        "`determinism.py`); monotonic clocks are exempt (they price "
+        "deadlines, never serialize)",
+        "`\"build\": time.time()` in an envelope builder → "
+        "`json.dumps(env)`",
+    ),
+    "KA026": (
+        "no filesystem-enumeration order (`os.listdir`/`os.scandir`/"
+        "`glob.*`/`Path.iterdir`/`Path.rglob`) may reach a byte-pinned "
+        "sink unsanitized — the OS returns directory entries in "
+        "arbitrary order, so wrap the enumeration in `sorted(...)` or "
+        "suppress citing the chain",
+        "`for f in os.listdir(d):` → `report()` → `json.dumps(...)`",
+    ),
+    "KA027": (
+        "no collection attribute written from another thread entry may "
+        "be iterated (or `.keys()`/`.values()`/`.items()`-drained) in a "
+        "sink-reaching function without a lock common to the reader and "
+        "every foreign writer — iteration is not atomic, the drain can "
+        "tear or raise mid-mutation and the surface bytes become a race "
+        "result; `sorted()` does NOT discharge this (the sanitizer is a "
+        "snapshot under the writers' lock); attributes KA021/KA022 "
+        "already convict are skipped",
+        "HTTP `handle` → `render()` iterating `self._flights` while the "
+        "worker thread appends, no common lock",
+    ),
+    "KA028": (
+        "deadline cross-pricing (KA020's twin for the act path): the "
+        "worst-case timeout/retry envelope of every chain reachable "
+        "from the controller's `_act` — bridged through "
+        "`controller_execute` into the executor, where "
+        "`KA_EXEC_POLL_TIMEOUT` lives — must not exceed the rolling "
+        "move-window budget (`KA_CONTROLLER_WINDOW`): an action that "
+        "can legally outlast the window corrupts the move-ledger "
+        "accounting every cooldown and blast-radius decision reads",
+        "`_act` → `controller_execute` → `_await_convergence` "
+        "consulting `KA_EXEC_POLL_TIMEOUT` (6000 s > 3600 s window)",
     ),
 }
 
@@ -892,6 +957,7 @@ def check_ka011(tree: ast.AST, path: str) -> List[Finding]:
                 class_methods[id(m)] = methods
 
     def consults_direct(scope: ast.AST) -> bool:
+        # kalint: disable=KA025 -- memo key through a local: id() names the AST node in consult_cache, it never reaches the findings payload (chain check_ka011 -> lint_source -> cli.main)
         key = id(scope)
         if key not in consult_cache:
             consult_cache[key] = _scope_consults_deadline(scope)
@@ -1200,6 +1266,21 @@ BUDGET_KNOB = "KA_DAEMON_REQUEST_TIMEOUT"
 #: knob's registered default).
 CONTROLLER_BUDGET_KNOB = "KA_CONTROLLER_INTERVAL"
 CONTROLLER_MODULE = "daemon/controller.py"
+#: The rolling move-window knob KA028 prices the controller act path
+#: against: `_record_moves` timestamps land in a KA_CONTROLLER_WINDOW
+#: ledger, so an action whose worst-case envelope outlasts the window
+#: corrupts the accounting every cooldown/blast-radius decision reads.
+ACT_BUDGET_KNOB = "KA_CONTROLLER_WINDOW"
+#: The controller-module act-path entry function KA028 seeds at.
+ACT_ENTRY_NAME = "_act"
+#: The supervisor method the act path calls through the UNTYPED
+#: ``self.sup`` ctor attribute — the resolver drops that edge (no
+#: one-level type for ``sup``), so KA028 bridges it BY NAME: an
+#: attribute call ``*.controller_execute(...)`` anywhere in the act
+#: closure edges to every project function of that name. This is the
+#: seam that kept the KA020 controller sweep vacuously clean of the
+#: executor's 600 s poll envelope.
+ACT_BRIDGE_NAME = "controller_execute"
 
 
 def _knob_seconds(name: str, value) -> Optional[float]:
@@ -1353,6 +1434,124 @@ def check_blocking_budget(
                 f"{mid} ({src_budget:g} s): {tail}",
                 chain=held.chain_strs(key),
             ))
+    return out
+
+
+def _act_closure(project: Project) -> Dict[str, Tuple[Optional[str], int]]:
+    """Forward closure from every ``CONTROLLER_MODULE`` function named
+    ``ACT_ENTRY_NAME``, with the by-name ``ACT_BRIDGE_NAME`` edge added
+    wherever the resolver dropped it (untyped ``self.sup``). Returns
+    member -> (parent member or None, call-site line) for chain
+    reconstruction."""
+    bridge_targets = sorted(
+        k for k in project.functions
+        if split_key(k)[1].split(".")[-1] == ACT_BRIDGE_NAME
+    )
+    parent: Dict[str, Tuple[Optional[str], int]] = {}
+    order: List[str] = []
+    for key in sorted(project.functions):
+        relpath, qual = split_key(key)
+        if relpath == CONTROLLER_MODULE \
+                and qual.split(".")[-1] == ACT_ENTRY_NAME:
+            parent[key] = (None, project.functions[key].node.lineno)
+            order.append(key)
+    i = 0
+    while i < len(order):
+        cur = order[i]
+        i += 1
+        callees = dict(project.callees(cur))
+        fn = project.functions.get(cur)
+        if fn is not None and bridge_targets:
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == ACT_BRIDGE_NAME:
+                    for target in bridge_targets:
+                        callees.setdefault(target, node.lineno)
+        for callee, line in sorted(callees.items()):
+            if callee in parent or callee not in project.functions:
+                continue
+            parent[callee] = (cur, line)
+            order.append(callee)
+    return parent
+
+
+def check_act_budget(
+    project: Project,
+    display: Dict[str, str],
+    knob_defaults=None,
+    budget: Optional[float] = None,
+) -> List[Finding]:
+    """KA028: deadline cross-pricing for the controller act path —
+    KA020's machinery pointed at the seam KA020 cannot see. The act
+    closure (``_act`` → name-bridged ``controller_execute`` → executor)
+    is priced with :func:`_fn_budget_envelope` exactly like a held
+    region, against the rolling move-window budget ``ACT_BUDGET_KNOB``:
+    the ledger prunes entries older than one window, so an action that
+    can legally still be executing when its own record expires makes
+    the cooldown and blast-radius gates read phantom headroom. Findings
+    anchor at the contributing function, chain attached."""
+    if knob_defaults is None:
+        from ...utils.env import KNOBS
+
+        knob_defaults = {name: k.default for name, k in KNOBS.items()}
+    if budget is None:
+        b = _knob_seconds(ACT_BUDGET_KNOB, knob_defaults.get(ACT_BUDGET_KNOB))
+        budget = b if b is not None else 3600.0
+
+    parent = _act_closure(project)
+    env_cache: Dict[str, Tuple[float, List[str]]] = {}
+
+    def envelope(key: str) -> Tuple[float, List[str]]:
+        if key not in env_cache:
+            fn = project.functions.get(key)
+            env_cache[key] = (
+                _fn_budget_envelope(fn.node, knob_defaults)
+                if fn is not None else (0.0, [])
+            )
+        return env_cache[key]
+
+    def chain(key: str) -> Tuple[str, ...]:
+        hops: List[str] = []
+        cur: Optional[str] = key
+        while cur is not None:
+            par, line = parent[cur]
+            hops.append(f"{cur}@{line}")
+            cur = par
+        return tuple(reversed(hops))
+
+    out: List[Finding] = []
+    for key in sorted(parent):
+        fn = project.functions.get(key)
+        if fn is None:
+            continue
+        own_secs, _own = envelope(key)
+        if own_secs <= 0.0:
+            continue  # anchor findings where envelope is added
+        total = 0.0
+        knobs: List[str] = []
+        cur: Optional[str] = key
+        while cur is not None:
+            secs, names = envelope(cur)
+            total += secs
+            knobs.extend(names)
+            cur = parent[cur][0]
+        if total <= budget:
+            continue
+        out.append(Finding(
+            "KA028", display.get(fn.relpath, fn.relpath),
+            fn.node.lineno, fn.node.col_offset + 1,
+            f"worst-case act-path execution envelope ~{total:g} s "
+            f"(deadline knobs along the chain: "
+            f"{', '.join(sorted(set(knobs)))}) exceeds the "
+            f"{ACT_BUDGET_KNOB} rolling move-window budget "
+            f"({budget:g} s): an action that can legally outlast the "
+            "window corrupts the move-ledger accounting every cooldown "
+            "and blast-radius decision reads — shrink the executor "
+            "envelope, split the action, or suppress citing why the "
+            "bound is unreachable",
+            chain=chain(key),
+        ))
     return out
 
 
@@ -1582,8 +1781,10 @@ def project_findings(project: Project,
                      display: Dict[str, str]) -> List[Finding]:
     """Every graph-backed finding over one resolved project: the traced-set
     rules (KA002/KA007/KA016/KA017), the lock-held rule (KA015), the
-    thread-safety rules (KA021/KA022/KA023), and transitive bulkhead
-    reachability (KA012). ``display`` maps module relpaths to the path
+    budget rules (KA020/KA028), the thread-safety rules
+    (KA021/KA022/KA023), the determinism taint layer (KA024–KA027), and
+    transitive bulkhead reachability (KA012). ``display`` maps module
+    relpaths to the path
     findings should print (suppressions are applied by the caller, which
     owns the per-module suppression indexes)."""
     out: List[Finding] = []
@@ -1723,9 +1924,17 @@ def project_findings(project: Project,
     # entries): the qualitative rules above kill unbounded blocking; the
     # budget rule prices the BOUNDED kind.
     out.extend(check_blocking_budget(project, display))
+    # KA028: the act-path twin — same pricing, name-bridged through
+    # controller_execute, against the rolling move-window budget.
+    out.extend(check_act_budget(project, display))
     # KA021/KA022/KA023: the thread-topology model (who runs where, under
     # which locks) over the same call graph.
     out.extend(check_thread_safety(project, display))
+    # KA024-KA027: the determinism taint layer (source→sink over the same
+    # call graph; KA027 reuses the thread model memo built above).
+    from .determinism import check_determinism
+
+    out.extend(check_determinism(project, display))
 
     gheld, gregions = gate_held_set(project)
     held_rule(

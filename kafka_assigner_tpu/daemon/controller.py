@@ -289,6 +289,7 @@ class RebalanceController:
         # budget must load before anything reads — or worse, overwrites —
         # the ledger. The load is idempotent (guarded check inside).
         self._load_ledger()
+        # kalint: disable=KA025 -- pruning horizon: compared against ledger timestamps, never serialized (chain _window_moves -> tick; the ledger's own stamps are the declared ts field)
         horizon = time.time() - env_float("KA_CONTROLLER_WINDOW")
         with self._mutex:
             self._ledger = [(t, n) for t, n in self._ledger if t >= horizon]
@@ -304,8 +305,9 @@ class RebalanceController:
         if moves <= 0:
             return
         self._load_ledger()
+        ts = round(time.time(), 3)
         with self._mutex:
-            self._ledger.append((round(time.time(), 3), int(moves)))
+            self._ledger.append((ts, int(moves)))
         self._count("controller.moves", moves)
         self._save_ledger()
         self._window_moves()

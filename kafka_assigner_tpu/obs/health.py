@@ -161,9 +161,11 @@ def movement_debt(
     charge their full replica set — appearing or vanishing IS movement."""
     moves = 0
     leader_moves = 0
+    # kalint: disable=KA024 -- commutative count accumulation: the loop body only sums set-difference sizes, iteration order cannot reach the returned ints (chain movement_debt -> _score_candidate -> plan_fingerprint)
     for topic in set(current) | set(proposed):
         cur_parts = current.get(topic, {})
         new_parts = proposed.get(topic, {})
+        # kalint: disable=KA024 -- commutative count accumulation, same reasoning as the topic loop above
         for p in set(cur_parts) | set(new_parts):
             cur = [int(r) for r in cur_parts.get(p, ())]
             new = [int(r) for r in new_parts.get(p, ())]

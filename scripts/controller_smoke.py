@@ -157,7 +157,7 @@ def main() -> int:
                   f"({pre_score} -> {post_score})", file=sys.stderr)
             return 1
         journals = [
-            p for p in os.listdir(workdir)
+            p for p in sorted(os.listdir(workdir))
             if p.startswith("ka-controller-a-") and p.endswith(".journal")
         ]
         if not journals:

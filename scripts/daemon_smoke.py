@@ -371,7 +371,7 @@ def main_multi() -> int:
             print(f"FAIL: daemon exit code {rc} after SIGTERM (want 0)\n"
                   + "".join(stderr_lines), file=sys.stderr)
             return 1
-        journals = [p for p in os.listdir(tmp)
+        journals = [p for p in sorted(os.listdir(tmp))
                     if p.startswith("ka-execute-b-")]
         if len(journals) != 1:
             print(f"FAIL: expected one cluster-keyed journal, {journals}",

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Dual-PYTHONHASHSEED byte-identity smoke (tier-1, via scripts/lint.sh):
+the DYNAMIC twin of kalint's KA024-KA027 determinism layer (ISSUE 17).
+
+The static layer proves no unordered iteration / wall-clock read / fs
+enumeration reaches a byte-pinned sink; this smoke checks the same
+invariant empirically at the two surfaces users diff:
+
+1. the mode-3 CLI (``PRINT_REASSIGNMENT``) run as a FRESH process once
+   under ``PYTHONHASHSEED=1`` and once under ``PYTHONHASHSEED=104729``
+   against the same snapshot cluster — stdout must be byte-identical
+   (hash randomization perturbs set/dict iteration order, which is
+   exactly what KA024 forbids from reaching stdout);
+2. one ``ka-daemon`` ``/plan`` under each seed — the plan payload
+   (``result.stdout``) must be byte-identical across seeds AND identical
+   to the CLI baseline. The envelope's ``t``/``request_id`` fields vary
+   by design (the KA025 timestamp allowlist), so the comparison targets
+   the payload, the same contract ``daemon_smoke`` pins.
+
+PYTHONHASHSEED only takes effect at interpreter startup, so every run
+under test here is a subprocess.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from scripts.health_smoke import _req, _start_daemon  # noqa: E402
+
+#: Two seeds far apart; 1 vs 104729 (a prime) give different set/dict
+#: orders for small string/int keys, which is the perturbation we want.
+SEEDS = ("1", "104729")
+
+
+def _snapshot(workdir):
+    """An imbalanced 4-broker snapshot (every replica on brokers 1-2):
+    the plan is non-trivial, so stdout actually carries moved replicas."""
+    snap = {
+        "brokers": [
+            {"id": i, "host": f"b{i}", "port": 9092, "rack": f"r{i}"}
+            for i in range(1, 5)
+        ],
+        "topics": {
+            "hot": {str(p): [1, 2] for p in range(4)},
+            "events": {"0": [1, 2, 3]},
+        },
+    }
+    path = os.path.join(workdir, "cluster.json")
+    with open(path, "w") as f:
+        json.dump(snap, f)
+    return path
+
+
+def _cli_stdout(snap, seed):
+    proc = subprocess.run(
+        [sys.executable, "-m", "kafka_assigner_tpu.cli",
+         "--zk_string", snap,
+         "--mode", "PRINT_REASSIGNMENT", "--solver", "greedy"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONHASHSEED": seed},
+    )
+    if proc.returncode != 0:
+        raise SystemExit(
+            f"FAIL: CLI run under PYTHONHASHSEED={seed} "
+            f"rc={proc.returncode}\n{proc.stderr}"
+        )
+    return proc.stdout
+
+
+def _daemon_plan_payload(snap, seed):
+    env = {**os.environ, "PYTHONHASHSEED": seed}
+    daemon, port, stderr_lines = _start_daemon(f"a={snap}", env)
+    try:
+        s, raw, _ = _req(port, "POST", "/clusters/a/plan", payload={})
+        if s != 200:
+            raise SystemExit(
+                f"FAIL: /plan under PYTHONHASHSEED={seed} http={s}: "
+                f"{raw[:300]}\n" + "".join(stderr_lines)
+            )
+        body = json.loads(raw)
+        if body.get("status") != "ok":
+            raise SystemExit(
+                f"FAIL: /plan under PYTHONHASHSEED={seed} "
+                f"status={body.get('status')!r}"
+            )
+        return body["result"]["stdout"]
+    finally:
+        daemon.send_signal(signal.SIGTERM)
+        try:
+            daemon.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            daemon.kill()
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory() as workdir:
+        snap = _snapshot(workdir)
+
+        # 1. fresh-process CLI, two seeds, byte-identical stdout
+        outs = [_cli_stdout(snap, seed) for seed in SEEDS]
+        if outs[0] != outs[1]:
+            print("FAIL: mode-3 CLI stdout differs across "
+                  f"PYTHONHASHSEED={SEEDS[0]} vs {SEEDS[1]} — a KA024-"
+                  "class unordered iteration reached stdout.\n"
+                  f"--- seed {SEEDS[0]} ---\n{outs[0]}\n"
+                  f"--- seed {SEEDS[1]} ---\n{outs[1]}",
+                  file=sys.stderr)
+            return 1
+        if "hot" not in outs[0]:
+            print("FAIL: baseline plan does not mention topic 'hot' — "
+                  "the comparison would be vacuous:\n" + outs[0],
+                  file=sys.stderr)
+            return 1
+
+        # 2. daemon /plan, two seeds, payload byte-identical (and equal
+        # to the CLI baseline: daemon_smoke's oracle, now across seeds)
+        payloads = [_daemon_plan_payload(snap, seed) for seed in SEEDS]
+        if payloads[0] != payloads[1]:
+            print("FAIL: daemon /plan payload differs across "
+                  f"PYTHONHASHSEED={SEEDS[0]} vs {SEEDS[1]}\n"
+                  f"--- seed {SEEDS[0]} ---\n{payloads[0]}\n"
+                  f"--- seed {SEEDS[1]} ---\n{payloads[1]}",
+                  file=sys.stderr)
+            return 1
+        if payloads[0] != outs[0]:
+            print("FAIL: daemon /plan payload != fresh-CLI stdout "
+                  "(byte-identity oracle broken)\n"
+                  f"--- daemon ---\n{payloads[0]}\n"
+                  f"--- cli ---\n{outs[0]}", file=sys.stderr)
+            return 1
+
+    print("hashseed smoke: PASS (CLI stdout and daemon /plan payload "
+          f"byte-identical under PYTHONHASHSEED={SEEDS[0]} and "
+          f"{SEEDS[1]})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

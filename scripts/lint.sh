@@ -2,8 +2,9 @@
 # The project lint gate: kalint (knob-registry + jit-boundary + write-path
 # + deadline + bulkhead + telemetry-name + metric-unit house rules, the
 # ISSUE 12 interprocedural taint/lock/bulkhead-reachability rules, plus
-# the ISSUE 16 thread-topology race/deadlock rules — KA001-KA023, smoke
-# scripts swept too), the README knob-table and rule-table drift checks,
+# the ISSUE 16 thread-topology race/deadlock rules and the ISSUE 17
+# determinism-taint layer — KA001-KA028, smoke scripts swept too), the
+# README knob-table and rule-table drift checks,
 # the run-report fixture schema check, the fault-matrix smoke (one injected
 # fault per class — read, write AND daemon seams — strict + best-effort),
 # the exec crash→resume smoke, the daemon lifecycle smoke, and ruff
@@ -114,6 +115,12 @@ python scripts/dispatch_smoke.py
 # pre-action assignment with the breaker open, the off cluster shows zero
 # controller activity, SIGTERM exit 0.
 python scripts/controller_smoke.py
+# Dual-PYTHONHASHSEED byte-identity smoke (ISSUE 17): the dynamic twin of
+# the KA024-KA027 determinism layer — the mode-3 CLI and a daemon /plan
+# each run twice under two different PYTHONHASHSEED values; stdout and the
+# plan payload must be byte-identical (hash randomization perturbs
+# set/dict order, exactly what the static layer forbids at pinned sinks).
+python scripts/hashseed_smoke.py
 # Warm-start smoke (ISSUE 6): program store populate -> clear-memory -> hit
 # on the CPU backend, byte-identical output, compile.store.hits >= 1. The
 # fresh-process bench is the slow-marked tests/test_bench_warmstart.py.

@@ -1,0 +1,6 @@
+"""Determinism-taint fixture tree (parsed by kalint, never imported):
+every KA024–KA027 source/sanitizer/sink shape the analyzer must judge,
+one function per verdict — see each module's docstring for the expected
+finding set. The `# kalint: disable=KA005` comments keep the house
+json-boundary rule out of the way; they suppress ONLY KA005, so the
+determinism findings anchored on the same lines still surface."""

@@ -1,0 +1,27 @@
+"""KA025 shapes: wall-clock/uuid values flowing into pinned bytes.
+
+Expected: KA025 in ``build`` (undeclared ``"build"`` field) and in
+``tag`` (a raw ``uuid.uuid4()`` return from a sink-reaching function);
+``build_clean`` lands every read in a declared field (``ts``,
+``request_id``) or a monotonic clock, so it stays silent.
+"""
+import json
+import time
+import uuid
+
+
+def build(env):
+    env["build"] = time.time()
+    return json.dumps(env)  # kalint: disable=KA005 -- fixture envelope
+
+
+def build_clean(env):
+    env["ts"] = round(time.time(), 3)
+    env["request_id"] = uuid.uuid4().hex[:16]
+    deadline = time.monotonic() + 5.0
+    return json.dumps(env), deadline  # kalint: disable=KA005 -- fixture envelope
+
+
+def tag(env):
+    env["color"] = str(uuid.uuid4())
+    return json.dumps(env)  # kalint: disable=KA005 -- fixture envelope

@@ -1,0 +1,35 @@
+"""KA027 shapes: thread-racy collections drained at a sink. The file
+and class names deliberately match an HTTP surface seed so ``handle``/
+``state_view`` run as concurrent request threads.
+
+Expected: KA027 in ``handle`` (``self.samples`` view-drained while the
+collector thread republishes it, no common lock — note ``sorted()``
+would NOT discharge this); ``state_view`` snapshots ``self.guarded``
+under the lock its writer holds, so it stays silent.
+"""
+import json
+import threading
+
+
+class ClusterSupervisor:
+    def __init__(self):
+        self._mutex = threading.Lock()
+        self.samples = {}
+        self.guarded = {}
+
+    def start(self):
+        threading.Thread(target=self._collect, name="collector").start()
+
+    def _collect(self):
+        self.samples = {"x": 1}
+        with self._mutex:
+            self.guarded = {"x": 1}
+
+    def handle(self):
+        body = {k: v for k, v in self.samples.items()}
+        return json.dumps(body)  # kalint: disable=KA005 -- fixture envelope
+
+    def state_view(self):
+        with self._mutex:
+            snap = dict(self.guarded)
+        return json.dumps(snap)  # kalint: disable=KA005 -- fixture envelope

@@ -1,0 +1,35 @@
+"""Sanitizer-recognition edge cases: shapes that LOOK sanitized and are
+not. Expected: KA024 in ``wrong_axis`` (the ``sorted()`` copies the set,
+the set itself is serialized unsorted), KA024 in ``reshuffle``
+(``random.shuffle`` undoes the sort), KA024 in ``materialize``
+(``list()`` freezes the arbitrary order without imposing one);
+``materialize_clean`` shows the discharging counterpart.
+"""
+import json
+import random
+
+
+def wrong_axis(parts):
+    s = {p.split("-")[0] for p in parts}
+    vals = sorted(s)
+    keys = [k for k in s]
+    return json.dumps({"v": vals, "k": keys})  # kalint: disable=KA005 -- fixture envelope
+
+
+def reshuffle(parts):
+    seq = sorted({p for p in parts})
+    random.shuffle(seq)
+    return json.dumps(seq)  # kalint: disable=KA005 -- fixture envelope
+
+
+def materialize(parts):
+    s = {p for p in parts}
+    items = list(s)
+    return json.dumps(items)  # kalint: disable=KA005 -- fixture envelope
+
+
+def materialize_clean(parts):
+    s = {p for p in parts}
+    items = list(s)
+    items.sort()
+    return json.dumps(items)  # kalint: disable=KA005 -- fixture envelope

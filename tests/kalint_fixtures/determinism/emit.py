@@ -1,0 +1,37 @@
+"""KA024 shapes: set order reaching a serialization sink.
+
+Expected: KA024 in ``report`` (set materialized through a list-comp),
+KA024 in ``_payload`` (set-algebra iteration two hops from the sink,
+chain ``envelope → _payload``); ``report_clean`` and ``summary_clean``
+discharge via ``sorted()`` / order-insensitive consumers.
+"""
+import json
+
+
+def report(parts):
+    topics = {p.split("-")[0] for p in parts}
+    lines = [t for t in topics]
+    return json.dumps(lines)  # kalint: disable=KA005 -- fixture envelope
+
+
+def report_clean(parts):
+    topics = {p.split("-")[0] for p in parts}
+    return json.dumps(sorted(topics))  # kalint: disable=KA005 -- fixture envelope
+
+
+def _payload(things):
+    out = []
+    for t in things | {"seed"}:
+        out.append(t)
+    return out
+
+
+def envelope(things):
+    body = {"v": _payload(things)}
+    return json.dumps(body)  # kalint: disable=KA005 -- fixture envelope
+
+
+def summary_clean(parts):
+    topics = {p.split("-")[0] for p in parts}
+    body = {"n": len(topics), "has_a": "a" in topics}
+    return json.dumps(body)  # kalint: disable=KA005 -- fixture envelope

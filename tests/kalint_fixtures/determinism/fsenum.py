@@ -1,0 +1,25 @@
+"""KA026 shapes: filesystem-enumeration order reaching a sink.
+
+Expected: KA026 in ``manifest`` (``os.listdir`` through a list-comp)
+and in ``tree_index`` (``Path.rglob`` iterated); ``manifest_clean``
+sorts the enumeration before it becomes observable.
+"""
+import json
+import os
+
+
+def manifest(d):
+    names = [p for p in os.listdir(d) if p.endswith(".json")]
+    return json.dumps(names)  # kalint: disable=KA005 -- fixture envelope
+
+
+def manifest_clean(d):
+    names = [p for p in sorted(os.listdir(d)) if p.endswith(".json")]
+    return json.dumps(names)  # kalint: disable=KA005 -- fixture envelope
+
+
+def tree_index(root):
+    out = []
+    for p in root.rglob("*.journal"):
+        out.append(str(p))
+    return json.dumps(out)  # kalint: disable=KA005 -- fixture envelope

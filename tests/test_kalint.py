@@ -1319,7 +1319,7 @@ def test_ka011_helper_without_deadline_still_flagged():
 
 def test_rule_docs_cover_every_rule():
     assert set(kalint.RULE_DOCS) == set(kalint.RULES)
-    assert set(kalint.RULES) == {f"KA{n:03d}" for n in range(24)}
+    assert set(kalint.RULES) == {f"KA{n:03d}" for n in range(29)}
     for rule, (meaning, example) in kalint.RULE_DOCS.items():
         assert meaning and example, rule
 
@@ -2092,10 +2092,225 @@ def test_changed_only_end_to_end_with_cache(tmp_path, monkeypatch):
     # analysis still ran whole-tree — this is a report restriction)
     assert kalint.main(args) == 0
     assert _json.loads(out.read_text())["count"] == 0
-    # touch one file into the future (content unchanged: still a cache
-    # hit) — its findings come back
+    # touch one file into the future — relative to NOW, not its copytree-
+    # preserved mtime, which is as old as the checkout (content unchanged:
+    # still a cache hit) — its findings come back
+    import time as _time
+
     worker = pkg / "daemon" / "worker.py"
     st = worker.stat()
-    _os.utime(worker, (st.st_atime, st.st_mtime + 3600))
+    _os.utime(worker, (st.st_atime, _time.time() + 3600))
     assert kalint.main(args) == 1
     assert _json.loads(out.read_text())["count"] == 3
+    # ISSUE 17: a git checkout REWINDS mtimes — the mtime-vs-baseline
+    # test alone would hide exactly the files the checkout changed, so
+    # --changed-only must also keep everything `git status` calls dirty.
+    import subprocess as _sp
+
+    def _git(*argv):
+        _sp.run(["git", "-C", str(tmp_path),
+                 "-c", "user.email=t@t", "-c", "user.name=t", *argv],
+                check=True, capture_output=True)
+
+    _git("init", "-q")
+    _git("add", "-A")
+    _git("commit", "-q", "-m", "baseline")
+    # clean per git AND stale per mtime: the report restriction holds
+    st = worker.stat()
+    _os.utime(worker, (st.st_atime, st.st_mtime - 7200))
+    assert kalint.main(args) == 0
+    assert _json.loads(out.read_text())["count"] == 0
+    # a simulated checkout: content changes but the mtime lands in the
+    # PAST — git's view (modified) must bring the findings back even
+    # though the mtime test says "unchanged"
+    worker.write_text(worker.read_text() + "# checked-out variant\n",
+                      encoding="utf-8")
+    st = worker.stat()
+    _os.utime(worker, (st.st_atime, st.st_mtime - 7200))
+    assert kalint.main(args) == 1
+    assert _json.loads(out.read_text())["count"] == 3
+
+
+# --- KA024-KA027: the determinism-taint layer (ISSUE 17) ---------------------
+
+DETERMINISM = FIXTURES / "determinism"
+
+
+def test_determinism_fixture_findings_exact():
+    """The whole fixture mini-package, pinned exactly: every seeded
+    source->sink flow flags ONCE at the source, and every clean variant
+    (sorted producer, declared ts field, monotonic clock, snapshot under
+    the writers' lock, .sort() before the dump) stays silent."""
+    findings = kalint.lint_tree(DETERMINISM)
+    keys = sorted((f.rule, f.path, f.line) for f in findings)
+    assert keys == [
+        ("KA024", "determinism/edges.py", 15),
+        ("KA024", "determinism/edges.py", 22),
+        ("KA024", "determinism/edges.py", 28),
+        ("KA024", "determinism/emit.py", 13),
+        ("KA024", "determinism/emit.py", 24),
+        ("KA025", "determinism/clock.py", 14),
+        ("KA025", "determinism/clock.py", 26),
+        ("KA026", "determinism/fsenum.py", 12),
+        ("KA026", "determinism/fsenum.py", 23),
+        ("KA027", "determinism/daemon/supervisor.py", 29),
+    ]
+    # every determinism finding carries its source->sink chain (SARIF
+    # codeFlows and --explain both feed off it)
+    assert all(f.chain for f in findings)
+
+
+def test_ka024_cross_function_chain_names_the_sink_hop():
+    # the PR 15/16 bug shape: a helper whose RETURN VALUE the caller
+    # serializes — the chain must cross the function boundary
+    findings = [f for f in kalint.lint_tree(DETERMINISM)
+                if f.rule == "KA024" and f.path.endswith("emit.py")]
+    (direct, via_helper) = sorted(findings, key=lambda f: f.line)
+    assert direct.chain == ("emit.py::report@14",)
+    assert via_helper.chain == (
+        "emit.py::_payload@30", "emit.py::envelope@31")
+    assert "PYTHONHASHSEED-dependent" in via_helper.message
+    assert "json.dumps serialization at emit.py::envelope" \
+        in via_helper.message
+
+
+def test_ka025_names_the_allowlist_and_the_sink():
+    ka025 = [f for f in kalint.lint_tree(DETERMINISM)
+             if f.rule == "KA025"]
+    wall, uid = sorted(ka025, key=lambda f: f.line)
+    assert "wall-clock read time.time()" in wall.message
+    assert "declared timestamp/identity field" in wall.message
+    assert "*timestamp*" in wall.message      # the allowlist is printed
+    assert "uuid.uuid4() draw" in uid.message
+
+
+def test_ka026_names_the_enumeration_order():
+    ka026 = [f for f in kalint.lint_tree(DETERMINISM)
+             if f.rule == "KA026"]
+    assert len(ka026) == 2
+    for f in ka026:
+        assert "filesystem enumeration order (OS-dependent)" in f.message
+        assert "sorted(" in f.message
+
+
+def test_ka027_names_the_racing_writer_thread():
+    (ka027,) = [f for f in kalint.lint_tree(DETERMINISM)
+                if f.rule == "KA027"]
+    assert "ClusterSupervisor.samples" in ka027.message
+    assert ".items() view drain" in ka027.message
+    assert "byte-pinned sink" in ka027.message
+
+
+def test_determinism_sanitizer_edge_cases_all_flag():
+    """The satellite-4 traps: sorted() on the WRONG axis discharges
+    nothing, a re-shuffle after a sort re-taints, and list(S) merely
+    freezes the arbitrary order — while .sort() on the materialized
+    list IS a discharge (materialize_clean stays silent)."""
+    edges = [f for f in kalint.lint_tree(DETERMINISM)
+             if f.path.endswith("edges.py")]
+    assert [(f.rule, f.line) for f in sorted(edges, key=lambda f: f.line)] \
+        == [("KA024", 15), ("KA024", 22), ("KA024", 28)]
+    assert any("re-shuffled sequence order" in f.message for f in edges)
+
+
+def test_determinism_repo_sweep_is_clean():
+    # The ISSUE 17 triage landed: the two real findings (controller
+    # ledger timestamp outside a declared field, unsorted os.listdir in
+    # two smoke journald scans) were FIXED; the benign flows (pruning
+    # horizon compared-not-serialized, commutative set-difference count
+    # loops, id() memo keys through a local) are reason-suppressed at
+    # their sites with the source->sink chain cited.
+    findings = kalint.lint_package(use_cache=False)
+    assert not [f for f in findings
+                if f.rule in ("KA024", "KA025", "KA026", "KA027")]
+
+
+def test_determinism_rules_are_documented():
+    for rule in ("KA024", "KA025", "KA026", "KA027", "KA028"):
+        assert rule in kalint.RULES and rule in kalint.RULE_DOCS
+
+
+# --- KA028: deadline cross-pricing of the controller act path ----------------
+
+ACT_TREE = {
+    "__init__.py": "",
+    "daemon/__init__.py": "",
+    "daemon/controller.py": (
+        "class RebalanceController:\n"
+        "    def _act(self, verdict):\n"
+        "        return self.sup.controller_execute(verdict)\n"
+    ),
+    "daemon/supervisor.py": (
+        "def poll(env_float):\n"
+        '    return env_float("KA_EXEC_POLL_TIMEOUT")\n\n\n'
+        "class Sup:\n"
+        "    def controller_execute(self, verdict, env_float=None):\n"
+        "        return poll(env_float)\n"
+    ),
+}
+
+
+def test_ka028_bridges_the_untyped_supervisor_seam(tmp_path):
+    # `self.sup` is untyped, so the resolver drops the _act ->
+    # controller_execute edge; the name-based bridge must restore it and
+    # price the executor poll budget against the move window.
+    root = _write_tree(tmp_path, ACT_TREE)
+    project = kalint.build_project(root)
+    flagged = kalint.check_act_budget(project, {}, budget=100.0)
+    assert [f.rule for f in flagged] == ["KA028"]
+    (f,) = flagged
+    assert f.path.endswith("daemon/supervisor.py")
+    assert "KA_EXEC_POLL_TIMEOUT" in f.message
+    assert kalint.ACT_BUDGET_KNOB in f.message
+    hops = [hop.partition("@")[0] for hop in f.chain]
+    assert hops == [
+        "daemon/controller.py::RebalanceController._act",
+        "daemon/supervisor.py::Sup.controller_execute",
+        "daemon/supervisor.py::poll",
+    ]
+
+
+def test_ka028_window_knob_is_the_dial(tmp_path):
+    root = _write_tree(tmp_path, ACT_TREE)
+    project = kalint.build_project(root)
+    # executor envelope blown past the default 3600 s window: flagged
+    flagged = kalint.check_act_budget(project, {}, {
+        "KA_EXEC_POLL_TIMEOUT": 7200.0,
+    })
+    assert [f.rule for f in flagged] == ["KA028"]
+    # a wider declared window absorbs the same envelope
+    assert kalint.check_act_budget(project, {}, {
+        "KA_EXEC_POLL_TIMEOUT": 7200.0,
+        kalint.ACT_BUDGET_KNOB: 10000.0,
+    }) == []
+
+
+def test_ka028_default_envelope_fits_the_default_window(tmp_path):
+    # the shipped defaults must be coherent: 600 s of executor poll
+    # inside a 3600 s move window — the fixture is CLEAN end to end
+    root = _write_tree(tmp_path, ACT_TREE)
+    assert "KA028" not in rules_of(kalint.lint_tree(root))
+
+
+def test_ka028_repo_sweep_is_clean():
+    # the REAL act path (controller._act -> supervisor.controller_execute
+    # -> executor convergence poll) prices under the shipped window
+    findings = kalint.lint_package(use_cache=False)
+    assert not [f for f in findings if f.rule == "KA028"]
+
+
+def test_ka028_fires_on_the_real_act_path_at_a_tight_budget():
+    # and the same sweep DOES see the bridged chain when the window
+    # shrinks below the executor envelope — the rule is not vacuous
+    from kafka_assigner_tpu.analysis.kalint.driver import _smoke_scripts
+
+    repo = _Path(__file__).resolve().parent.parent
+    project = kalint.build_project(
+        repo / "kafka_assigner_tpu",
+        extra_modules=_smoke_scripts(repo))
+    flagged = kalint.check_act_budget(project, {}, budget=100.0)
+    assert flagged, "tight budget must flag the real act path"
+    chain_text = " -> ".join(flagged[0].chain)
+    assert "daemon/controller.py::RebalanceController._act" in chain_text
+    assert "controller_execute" in chain_text
+    assert "exec/engine.py" in chain_text

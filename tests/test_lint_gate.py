@@ -107,3 +107,52 @@ def test_analysis_cache_cold_then_warm_is_faster(tmp_path):
     assert "analysis cache hit" in warm.stderr
     assert warm.stdout == cold.stdout  # served findings are identical
     assert t_warm < t_cold, (t_warm, t_cold)
+
+
+def test_json_report_carries_the_rule_catalog(tmp_path):
+    """ISSUE 17 satellite: the JSON payload lint.sh publishes as
+    ``kalint_report.json`` (KA_LINT_REPORT=1 copies the warm run's bytes)
+    must carry the full rule catalog — CI annotation steps map rule ids
+    to meanings without re-importing kalint — including the new
+    determinism layer."""
+    import json
+
+    out = tmp_path / "report.json"
+    env = _kalint_env({"KA_LINT_CACHE": "1"})
+    proc, _ = _run_kalint(["--format", "json", "--out", str(out)], env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(out.read_text())
+    rules = payload["rules"]
+    for rule in ("KA001", "KA024", "KA025", "KA026", "KA027", "KA028"):
+        assert rule in rules and rules[rule], rule
+    assert "unordered iteration" in rules["KA024"]
+
+
+def test_sarif_carries_determinism_codeflows(tmp_path):
+    """Every KA024-KA027 finding on the determinism fixture renders its
+    source->sink chain as a SARIF codeFlow (the chain is the triage
+    artifact: it names the sink the source reaches)."""
+    import json
+
+    out = tmp_path / "report.sarif"
+    proc, _ = _run_kalint([
+        "--root", "tests/kalint_fixtures/determinism", "--no-cache",
+        "--format", "sarif", "--out", str(out),
+    ])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    run = json.loads(out.read_text())["runs"][0]
+    by_rule = {}
+    for result in run["results"]:
+        by_rule.setdefault(result["ruleId"], []).append(result)
+    for rule in ("KA024", "KA025", "KA026", "KA027"):
+        assert rule in by_rule, sorted(by_rule)
+        for result in by_rule[rule]:
+            (flow,) = result["codeFlows"]
+            locs = flow["threadFlows"][0]["locations"]
+            assert locs, result
+            for loc in locs:
+                msg = loc["location"]["message"]["text"]
+                assert "::" in msg and "@" in msg  # key@line hops
+    # the driver declares the whole catalog, determinism rules included
+    declared = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"KA024", "KA025", "KA026", "KA027", "KA028"} <= declared
