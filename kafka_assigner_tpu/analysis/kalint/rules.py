@@ -72,6 +72,9 @@ RULES = {
     "KA028": "deadline cross-pricing: the controller act path's "
              "worst-case execution envelope exceeds the rolling "
              "move-window budget (KA_CONTROLLER_WINDOW)",
+    "KA029": "device dispatch (*_jit / store-backed program entry) "
+             "reachable from a daemon handler outside the dispatcher "
+             "seam",
 }
 
 #: One-line meaning + example offending chain per rule — the source of the
@@ -320,6 +323,19 @@ RULE_DOCS: Dict[str, Tuple[str, str]] = {
         "`_act` → `controller_execute` → `_await_convergence` "
         "consulting `KA_EXEC_POLL_TIMEOUT` (6000 s > 3600 s window)",
     ),
+    "KA029": (
+        "every device entry point reachable from daemon request/"
+        "controller handlers must ride the dispatcher seam "
+        "(`daemon/dispatch.py` plus the bucket-boundary modules "
+        "`solvers/tpu.py`, `solvers/warmup.py`, `parallel/whatif.py`): "
+        "a `*_jit` program call or a store-backed `_program`/"
+        "`_sweep_program` entry reached from daemon code outside that "
+        "seam bypasses the gather queue — the solve monopolizes the "
+        "device behind the coalescing plane's back, invisible to the "
+        "dispatch metrics and the solo-fallback accounting",
+        "`daemon/service.py handle_plan` → `helper()` calling "
+        "`place_scan_narrow_jit(...)` directly",
+    ),
 }
 
 #: Modules whose ENTIRE body is treated as traced kernel code (KA002): these
@@ -349,6 +365,21 @@ BULKHEAD_ATTRS = frozenset({"backend", "state"})
 #: The supervisor class whose internals the bulkhead protects: attribute
 #: reads on values of this type are cross-bulkhead wherever they happen.
 SUPERVISOR_CLASS = ("daemon/supervisor.py", "ClusterSupervisor")
+
+#: KA029: the dispatch-plane seam — the ONLY modules through which device
+#: dispatch (a ``*_jit`` program call, or a store-backed ``_program``/
+#: ``_sweep_program`` entry) may be reached from daemon request/controller
+#: handlers. ``daemon/dispatch.py`` is the gather queue itself; the
+#: bucket-boundary modules own the padding + program-store discipline and
+#: route their rows through the installed broker.
+DISPATCH_SEAM_MODULES = (
+    frozenset({"daemon/dispatch.py"}) | BUCKET_BOUNDARY_MODULES
+)
+#: ``*_jit``-suffixed names that BUILD programs rather than dispatch them.
+DISPATCH_BUILDER_NAMES = frozenset({"wrap_jit"})
+#: Store-backed program entry getters (solvers/tpu.py / parallel/whatif.py
+#: module conventions): acquiring one outside the seam is the finding.
+DISPATCH_STORE_ENTRY_NAMES = frozenset({"_program", "_sweep_program"})
 
 #: KA016: the typed accessors whose call inside traced code freezes a knob.
 ENV_ACCESSOR_NAMES = frozenset({
@@ -1782,8 +1813,9 @@ def project_findings(project: Project,
     """Every graph-backed finding over one resolved project: the traced-set
     rules (KA002/KA007/KA016/KA017), the lock-held rule (KA015), the
     budget rules (KA020/KA028), the thread-safety rules
-    (KA021/KA022/KA023), the determinism taint layer (KA024–KA027), and
-    transitive bulkhead reachability (KA012). ``display`` maps module
+    (KA021/KA022/KA023), the determinism taint layer (KA024–KA027),
+    transitive bulkhead reachability (KA012), and dispatch-plane seam
+    reachability (KA029). ``display`` maps module
     relpaths to the path
     findings should print (suppressions are applied by the caller, which
     owns the per-module suppression indexes)."""
@@ -1997,6 +2029,52 @@ def project_findings(project: Project,
                     f"bulkhead boundary, reachable from {label} "
                     "(cross-bulkhead access through a helper chain): route "
                     "through the owning supervisor's methods",
+                    chain=chain,
+                ))
+
+    # -- KA029 transitive: dispatch-plane seam reachability -------------------
+    # Roots: every function in a daemon module other than the dispatcher
+    # itself. Traversal never passes THROUGH the seam (the dispatcher and
+    # the bucket-boundary modules ARE the sanctioned device path — their
+    # internals submit rows to the installed broker). Sinks: a ``*_jit``
+    # program call, or a store-backed ``_program``/``_sweep_program``
+    # entry, anywhere the closure reaches OUTSIDE the seam — a device
+    # dispatch the gather queue never sees.
+    roots29 = {
+        key: (fn.node.lineno, f"daemon handler {fn.qualname} ({fn.relpath})")
+        for key, fn in project.functions.items()
+        if fn.relpath.startswith(DAEMON_PKG_PREFIX)
+        and fn.relpath not in DISPATCH_SEAM_MODULES
+    }
+    reach29 = _closure(
+        project, roots29,
+        stop=lambda k: split_key(k)[0] in DISPATCH_SEAM_MODULES,
+    )
+    for key in sorted(reach29.members):
+        fn = project.functions.get(key)
+        if fn is None or fn.relpath in DISPATCH_SEAM_MODULES:
+            continue
+        chain = reach29.chain_strs(key)
+        label = entry_label(reach29, key)
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_terminal_name(node)
+            if name is None:
+                continue
+            if (
+                name.endswith("_jit")
+                and name not in DISPATCH_BUILDER_NAMES
+            ) or name in DISPATCH_STORE_ENTRY_NAMES:
+                out.append(Finding(
+                    "KA029", disp(fn.relpath), node.lineno,
+                    node.col_offset + 1,
+                    f"device dispatch {name}(...) reachable from {label} "
+                    "outside the dispatcher seam: the gather queue never "
+                    "sees this solve, so it monopolizes the device behind "
+                    "the coalescing plane's back — route the rows through "
+                    "daemon/dispatch.py or a bucket-boundary module "
+                    f"({sorted(BUCKET_BOUNDARY_MODULES)})",
                     chain=chain,
                 ))
     return out

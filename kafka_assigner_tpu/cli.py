@@ -219,6 +219,14 @@ def run_tool(argv: Optional[List[str]] = None) -> int:
             # a future instrumentation site) is reported on stderr, and the
             # solve's exception/exit status always wins.
             try:
+                # The ingest-overlapped warm-up records its outcome
+                # counter/span from a daemon thread; under CPU contention
+                # that thread can lose the scheduling race with report
+                # emission. Drain it first so the report deterministically
+                # carries the warm-up outcome.
+                from .generator import join_warmup_threads
+
+                join_warmup_threads()
                 report = obs.build_report(
                     run, status=status, mode=args.mode,
                     argv=list(argv) if argv is not None else sys.argv[1:],

@@ -96,6 +96,61 @@ def resolve_policy(override: Optional[str]) -> str:
     return env_choice("KA_CONTROLLER")
 
 
+class SharedTicker:
+    """One daemon-wide tick generator for every cluster's controller
+    (ISSUE 19). Independent per-cluster timers drift apart immediately, so
+    N clusters cost N serialized evaluation solves per interval; waiting
+    on a SHARED generation counter releases every controller at the same
+    instant, their evaluation plans dedup/row-pack in the SolveDispatcher,
+    and autonomy costs ONE padded dispatch per tick round.
+
+    The timer thread starts lazily at the first controller's
+    ``ensure_started`` — a daemon whose clusters are all ``off`` keeps the
+    zero-thread guarantee. ``KA_CONTROLLER_INTERVAL`` is re-read each
+    cycle (live knob). On daemon stop the generation bumps once more so
+    no waiter outlives the stop signal."""
+
+    def __init__(self, stopped: threading.Event) -> None:
+        self._stopped = stopped
+        self._cv = threading.Condition()
+        self._gen = 0
+        self._thread: Optional[threading.Thread] = None
+
+    def ensure_started(self) -> None:
+        with self._cv:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(
+                target=self._loop, name="ka-ticker", daemon=True
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(env_float("KA_CONTROLLER_INTERVAL")):
+            with self._cv:
+                self._gen += 1
+                self._cv.notify_all()
+        # Final bump: release every waiter into its stop check.
+        with self._cv:
+            self._gen += 1
+            self._cv.notify_all()
+
+    @property
+    def generation(self) -> int:
+        with self._cv:
+            return self._gen
+
+    def wait_next(self, last_gen: int) -> int:
+        """Block until the generation advances past ``last_gen`` (or the
+        daemon stops); returns the new generation. Wakes periodically to
+        re-check the stop flag so a stop between bumps never strands a
+        controller for a full interval."""
+        with self._cv:
+            while self._gen <= last_gen and not self._stopped.is_set():
+                self._cv.wait(0.5)
+            return self._gen
+
+
 class RebalanceController:
     """One cluster's supervised closed-loop rebalance controller."""
 
@@ -172,6 +227,12 @@ class RebalanceController:
         if self.policy == "off" or self._thread is not None:
             return
         self._load_ledger()
+        # Daemon-wide tick alignment (ISSUE 19): the shared ticker's timer
+        # thread also starts lazily here, so the zero-threads-under-off
+        # guarantee extends to it.
+        ticker = getattr(self.sup, "_ticker", None)
+        if ticker is not None:
+            ticker.ensure_started()
         self._thread = threading.Thread(
             target=self._loop,
             name=f"ka-controller-{self.sup.name}",
@@ -184,8 +245,20 @@ class RebalanceController:
             self._thread.join(timeout=timeout)
 
     def _loop(self) -> None:
+        # Under a shared ticker every cluster's controller blocks on the
+        # SAME generation counter: all tick bodies start together, so
+        # their evaluation solves meet in the dispatcher's gather window
+        # and row-pack (one padded dispatch per tick round, ISSUE 19).
+        # Directly constructed supervisors (unit tests) have no ticker and
+        # keep the per-cluster interval timer.
+        ticker = getattr(self.sup, "_ticker", None)
+        gen = ticker.generation if ticker is not None else 0
         while not self.sup.stopped.is_set():
-            if self.sup.stopped.wait(env_float("KA_CONTROLLER_INTERVAL")):
+            if ticker is not None:
+                gen = ticker.wait_next(gen)
+                if self.sup.stopped.is_set():
+                    return
+            elif self.sup.stopped.wait(env_float("KA_CONTROLLER_INTERVAL")):
                 return
             try:
                 self.tick()

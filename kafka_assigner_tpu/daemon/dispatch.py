@@ -16,21 +16,36 @@ The same amortization argument as the elastic reconfiguration batching in
 arXiv:1602.03770 and the sweep-based autoscaler evaluation in
 arXiv:2402.06085, applied to the serving plane.
 
-Job types and their coalescing semantics:
+Job types and their coalescing semantics (the ONE dispatch plane of
+ISSUE 19 — every device entry point reachable from a daemon handler rides
+this queue; kalint KA029 statically pins that no handler regrows a direct
+path):
 
 ========================== ===============================================
 job                        coalescing
 ========================== ===============================================
 what-if scenario rows      rows whose batch key matches (same sweep entry,
 (``/whatif``, dense and    identical shared operand bytes + static args —
-incremental sweeps)        which holds across clusters whenever their
-                           encodings agree) concatenate along the batch
-                           axis into ONE ``whatif_sweep`` /
-                           ``whatif_subset_sweep`` dispatch; padding rows
+incremental sweeps,        which holds across clusters whenever their
+greedy-rescue re-solves,   encodings agree) concatenate along the batch
+chunked giant-sweep        axis into ONE ``whatif_sweep`` /
+blocks)                    ``whatif_subset_sweep`` dispatch; padding rows
                            are inert, the padded batch lands on the same
                            power-of-two bucket the program store already
                            holds — no new compile keys beyond the bucketed
-                           batch dimension
+                           batch dimension. Chunked giant sweeps submit
+                           one job per chunk so a storm of small requests
+                           interleaves between chunks instead of waiting
+                           out the whole monolith
+placement rows             DISTINCT plans (and controller evaluation
+(``/plan``, controller     ticks) with content-compatible encodings —
+ticks, ``/recommend…``     same bucketed shapes + statics under the
+candidate plans)           ``batch_key`` discipline — concat their
+                           ``place_scan_narrow`` rows on the batch axis
+                           and share one device call, demuxed per job;
+                           placement is counter-independent per row, so
+                           the split placement+ordering pipeline is
+                           byte-identical to the fused solo solve
 group autoscale rows       ditto, through ``group_pack_sweep``
 (``/groups/sweep``)
 identical request bodies   concurrent requests with equal (cluster, cache
@@ -38,13 +53,24 @@ identical request bodies   concurrent requests with equal (cluster, cache
 ``/recommendations``)      body whose stdout bytes serve every waiter
                            (deterministic pipeline ⇒ the bytes each waiter
                            would have produced solo) — the
-                           dashboard-hammering case goes near-flat;
-                           distinct PLANS additionally serialize through
-                           the dispatcher's plan lock (their device half
-                           is not row-packable) — exactly today's
-                           behavior, while distinct what-ifs run
-                           concurrently and coalesce their rows above
+                           dashboard-hammering case goes near-flat. The
+                           dedup entry is stamped with the cache VERSION
+                           at admission: a mid-flight resync splits later
+                           arrivals into a fresh entry instead of serving
+                           them pre-resync bytes. Distinct plans no
+                           longer serialize through a plan lock — their
+                           device half row-packs above (``exclusive=True``
+                           retired in ISSUE 19)
 ========================== ===============================================
+
+The gather window adapts to queue depth within a cap: the effective
+window is ``min(KA_DISPATCH_WINDOW_MS × depth, KA_DISPATCH_WINDOW_MAX_MS)``
+(never below the configured base — tests that pin a wide window keep it),
+so a sustained storm widens batches instead of paying one fixed window per
+tiny batch. Live tuning telemetry: ``dispatch.queue_depth`` (gauge, depth
+at gather-cycle start), ``dispatch.window_ms`` (gauge, last effective
+window), ``dispatch.pad_waste_frac`` (histogram, padded ÷ batch rows per
+coalesced dispatch).
 
 Singleton or incompatible jobs degrade to the solo path (the behavior the
 shared lock gave): they still run one-at-a-time on the dispatcher thread,
@@ -75,7 +101,6 @@ cumulative registry only.
 """
 from __future__ import annotations
 
-import contextlib
 import hashlib
 import io
 import threading
@@ -86,7 +111,7 @@ import numpy as np
 
 from ..faults.inject import fault_point
 from ..obs import flight
-from ..obs.metrics import counter_add, hist_observe
+from ..obs.metrics import counter_add, gauge_set, hist_observe
 from ..obs.trace import record_span
 
 #: Thread-local broker installation: the supervisor wraps a request body in
@@ -168,16 +193,22 @@ class _RowJob:
 
 
 class _PlanEntry:
-    """One in-flight body solve: the leader runs, followers wait."""
+    """One in-flight body solve: the leader runs, followers wait. The
+    entry carries the cache VERSION observed at the leader's admission —
+    a later arrival whose version differs waits this entry out and
+    re-enters admission under a fresh entry instead of being served
+    pre-resync bytes (the ISSUE 19 dedup-across-resync fix)."""
 
-    __slots__ = ("done", "stdout", "degraded", "error", "followers")
+    __slots__ = ("done", "stdout", "degraded", "error", "followers",
+                 "version")
 
-    def __init__(self) -> None:
+    def __init__(self, version: object = None) -> None:
         self.done = threading.Event()
         self.stdout: Optional[str] = None
         self.degraded = False
         self.error: Optional[BaseException] = None
         self.followers = 0
+        self.version = version
 
 
 class SolveDispatcher:
@@ -190,12 +221,11 @@ class SolveDispatcher:
         self._cv = threading.Condition()
         self._queue: List[_RowJob] = []
         self._closed = False
-        #: Identical-plan dedup (single-flight by content key) and the
-        #: serialization of DISTINCT plan bodies — the non-batchable jobs
-        #: keep exactly the old lock's pairwise exclusion among themselves.
+        #: Identical-body dedup (single-flight by content key). Distinct
+        #: bodies run concurrently — their device halves row-pack in the
+        #: queue (the old plan lock is retired, ISSUE 19).
         self._plan_mu = threading.Lock()
         self._plan_entries: Dict[str, _PlanEntry] = {}
-        self._plan_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._loop, name="ka-dispatch", daemon=True
         )
@@ -204,10 +234,18 @@ class SolveDispatcher:
     # -- live knobs ---------------------------------------------------------
 
     @staticmethod
-    def _window_s() -> float:
+    def _window_s(depth: int = 1) -> float:
+        """The effective gather window for a cycle that starts with
+        ``depth`` queued jobs: the base window scaled by depth, capped at
+        ``KA_DISPATCH_WINDOW_MAX_MS`` — but never BELOW the configured
+        base (a test or operator pinning a wide ``KA_DISPATCH_WINDOW_MS``
+        gets exactly that window; adaptivity only ever widens the default
+        under sustained depth, it does not shrink an explicit choice)."""
         from ..utils.env import env_float
 
-        return env_float("KA_DISPATCH_WINDOW_MS") / 1000.0
+        base = env_float("KA_DISPATCH_WINDOW_MS")
+        cap = env_float("KA_DISPATCH_WINDOW_MAX_MS")
+        return min(base * max(1, depth), max(cap, base)) / 1000.0
 
     @staticmethod
     def _max_batch() -> int:
@@ -259,21 +297,25 @@ class SolveDispatcher:
         key: str,
         fn: Callable[[io.StringIO], bool],
         out: io.StringIO,
-        exclusive: bool = True,
+        version: Optional[Callable[[], object]] = None,
     ) -> Optional[Tuple[bool, bool]]:
         """Run one whole-request solve body (``/plan``, ``/whatif``, the
         ``/recommendations`` candidate plan): identical concurrent jobs
         (equal ``key`` — cluster, cache version, params) coalesce into ONE
         run of ``fn`` whose stdout bytes serve every waiter — the
         deterministic pipeline makes those exactly the bytes each waiter
-        would have produced solo.
+        would have produced solo. Distinct jobs run CONCURRENTLY on their
+        request threads — their device rows (placement rows for plans,
+        scenario rows for what-ifs) coalesce in this dispatcher's row
+        queue, which is the whole point; the old plan lock is retired.
 
-        ``exclusive=True`` (plans): distinct jobs additionally serialize
-        through the plan lock — their device half (``assign_many``) is not
-        row-packable, so they keep the old lock's pairwise exclusion among
-        themselves. ``exclusive=False`` (what-if bodies): distinct jobs
-        run CONCURRENTLY on their request threads — their device rows
-        coalesce in this dispatcher's row queue, which is the whole point.
+        ``version`` supplies the caller's live cache version. The dedup
+        entry is stamped with the version observed at the LEADER's
+        admission; an arrival that observes a different live version
+        waits the in-flight entry out and re-enters admission under a
+        fresh entry — a leader that straddles a resync can therefore
+        never serve post-resync followers pre-resync bytes (followers
+        split across the version change, the ISSUE 19 bugfix).
 
         Returns ``(degraded, coalesced)`` — ``coalesced`` True for a
         follower served from the leader's bytes — or ``None`` when the
@@ -285,27 +327,38 @@ class SolveDispatcher:
                 return None
         counter_add("dispatch.jobs")
         t0 = time.perf_counter()
-        with self._plan_mu:
-            entry = self._plan_entries.get(key)
-            leader = entry is None
-            if leader:
-                entry = _PlanEntry()
-                self._plan_entries[key] = entry
-            else:
-                entry.followers += 1
+        while True:
+            live = version() if version is not None else None
+            with self._plan_mu:
+                entry = self._plan_entries.get(key)
+                if entry is None:
+                    leader = True
+                    entry = _PlanEntry(live)
+                    self._plan_entries[key] = entry
+                    break
+                if version is None or entry.version == live:
+                    leader = False
+                    entry.followers += 1
+                    break
+                stale = entry
+            # The in-flight leader was admitted under a DIFFERENT version:
+            # joining it would serve this request another epoch's bytes.
+            # Wait it out (it pops its entry on completion) and re-enter
+            # admission — concurrent same-version arrivals still dedup
+            # among themselves under the fresh entry.
+            stale.done.wait()
         if leader:
             try:
-                with self._plan_lock if exclusive else contextlib.nullcontext():
-                    hist_observe(
-                        "daemon.solve.queue_ms",
-                        (time.perf_counter() - t0) * 1000.0,
-                    )
-                    local = io.StringIO()
-                    try:
-                        entry.degraded = fn(local)
-                        entry.stdout = local.getvalue()
-                    except BaseException as e:
-                        entry.error = e
+                hist_observe(
+                    "daemon.solve.queue_ms",
+                    (time.perf_counter() - t0) * 1000.0,
+                )
+                local = io.StringIO()
+                try:
+                    entry.degraded = fn(local)
+                    entry.stdout = local.getvalue()
+                except BaseException as e:
+                    entry.error = e
             finally:
                 with self._plan_mu:
                     self._plan_entries.pop(key, None)
@@ -333,8 +386,7 @@ class SolveDispatcher:
             # handle; this follower re-runs solo (its own fn carries its
             # own fallback chain).
             counter_add("dispatch.solo_fallbacks")
-            with self._plan_lock if exclusive else contextlib.nullcontext():
-                degraded = fn(out)
+            degraded = fn(out)
             return degraded, False
         out.write(entry.stdout)
         return entry.degraded, True
@@ -367,15 +419,24 @@ class SolveDispatcher:
                     return
                 # Gather: from the FIRST queued job's submit time, wait out
                 # the window for companions — unless the size trigger fires
-                # or the daemon is draining (flush immediately).
-                deadline = self._queue[0].t_submit + self._window_s()
+                # or the daemon is draining (flush immediately). The
+                # effective window adapts to LIVE queue depth within the
+                # KA_DISPATCH_WINDOW_MAX_MS cap, recomputed each wake-up:
+                # sustained depth widens the gather (more coalescing per
+                # dispatch) instead of paying one fixed window per tiny
+                # batch.
+                gauge_set("dispatch.queue_depth", len(self._queue))
+                t_first = self._queue[0].t_submit
                 max_batch = self._max_batch()
+                eff_s = self._window_s(len(self._queue))
                 while not self._closed \
                         and len(self._queue) < max_batch:
-                    left = deadline - time.perf_counter()
+                    eff_s = self._window_s(len(self._queue))
+                    left = t_first + eff_s - time.perf_counter()
                     if left <= 0:
                         break
                     self._cv.wait(left)
+                gauge_set("dispatch.window_ms", eff_s * 1000.0)
                 # The size trigger also CAPS the cycle: jobs beyond
                 # max_batch stay queued (already past their window, so the
                 # next cycle dispatches them immediately). An uncapped
@@ -451,6 +512,11 @@ class SolveDispatcher:
                 # here too would both overstate healthy coalescing and
                 # double-count the jobs.
                 hist_observe("dispatch.batch_size", len(jobs))
+                hist_observe(
+                    "dispatch.pad_waste_frac",
+                    (padded_total - total) / padded_total
+                    if padded_total else 0.0,
+                )
                 if len(jobs) > 1:
                     counter_add("dispatch.batches")
                 else:

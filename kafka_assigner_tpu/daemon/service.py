@@ -130,17 +130,19 @@ surface byte-identical: same endpoints, same bodies, same exit codes
 from __future__ import annotations
 
 import json
+import queue
 import sys
 import threading
 import time
 import uuid
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler, HTTPServer
 from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
 from ..obs import flight
 from ..obs import metrics as obs_metrics
 from ..obs.report import REPORT_SCHEMA_VERSION, TOOL_NAME, AccessLog
+from .controller import SharedTicker
 from .dispatch import SolveDispatcher
 from .supervisor import POLL_S, ClusterSupervisor
 
@@ -309,6 +311,12 @@ class AssignerDaemon:
             SolveDispatcher(err=self.err)
             if env_bool("KA_DISPATCH") else None
         )
+        #: Daemon-wide controller tick generator (ISSUE 19): every
+        #: cluster's controller waits on the same generation counter so N
+        #: clusters' evaluation solves start together and row-pack into
+        #: one dispatch per tick round. Its timer thread starts lazily
+        #: with the first non-off controller (zero threads under off).
+        self.ticker = SharedTicker(self.stopped)
         self.supervisors: Dict[str, ClusterSupervisor] = {
             name: ClusterSupervisor(
                 name, connect,
@@ -320,11 +328,12 @@ class AssignerDaemon:
                 solve_lock=self._solve_lock,
                 dispatcher=self.dispatcher,
                 controller_policy=controller_policy,
+                ticker=self.ticker,
                 err=self.err,
             )
             for name, (connect, controller_policy) in normalized.items()
         }
-        self.httpd: Optional[ThreadingHTTPServer] = None
+        self.httpd: Optional[HTTPServer] = None
         self._serve_thread: Optional[threading.Thread] = None
 
     # -- accessors ----------------------------------------------------------
@@ -562,9 +571,15 @@ def _render_metrics(daemon: AssignerDaemon) -> str:
 
 
 def _build_http_server(daemon: AssignerDaemon, bind: str,
-                       port: int) -> ThreadingHTTPServer:
+                       port: int) -> HTTPServer:
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        #: Socket read timeout: with a BOUNDED worker pool an idle
+        #: keep-alive connection parked in a blocking request-line read
+        #: would pin a worker indefinitely — after this many seconds the
+        #: read times out and the connection closes (normal request and
+        #: streaming WRITES are unaffected; only reads arm it).
+        timeout = 30.0
 
         def log_message(self, fmt, *args):  # stderr discipline: our lines only
             pass
@@ -946,15 +961,71 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
                     file=daemon.err,
                 )
 
-    class Server(ThreadingHTTPServer):
-        daemon_threads = True
+    class Server(HTTPServer):
+        """Bounded worker-pool HTTP server (ISSUE 19). The previous
+        ``ThreadingHTTPServer`` forked one handler thread per accepted
+        connection — at the 1024-client load push that is a thousand
+        stacks and scheduler churn for requests that ultimately coalesce
+        into a handful of device dispatches. Accepted connections queue
+        to ``KA_DAEMON_HTTP_WORKERS`` long-lived handler threads instead;
+        when the queue fills, the accept loop blocks and backpressure
+        lands in the kernel accept queue (``request_queue_size``) — the
+        burst is absorbed by listen(2), not by thread creation."""
+
         #: listen(2) backlog. socketserver's default of 5 makes a burst of
         #: concurrent clients SYN-drop into kernel connect retries
         #: (seconds of invisible latency before the daemon even sees the
         #: request) — absorbing exactly such bursts is the batched
         #: dispatcher's whole point (ISSUE 14), so the accept queue must
-        #: outsize the gather it feeds.
-        request_queue_size = 128
+        #: outsize the burst it feeds (sized for the 1024-client push).
+        request_queue_size = 1024
+
+        def __init__(self, addr, handler) -> None:
+            super().__init__(addr, handler)
+            from ..utils.env import env_int
+
+            n = env_int("KA_DAEMON_HTTP_WORKERS")
+            #: Bounded hand-off: a full queue blocks the accept loop (one
+            #: thread), which parks excess connections in the backlog.
+            self._work: queue.Queue = queue.Queue(maxsize=max(2 * n, 8))
+            self._workers = [
+                threading.Thread(
+                    target=self._worker, name=f"ka-http-{i}", daemon=True
+                )
+                for i in range(n)
+            ]
+            for t in self._workers:
+                t.start()
+
+        def _worker(self) -> None:
+            while True:
+                item = self._work.get()
+                if item is None:
+                    return
+                request, client_address = item
+                try:
+                    self.finish_request(request, client_address)
+                except Exception:
+                    self.handle_error(request, client_address)
+                finally:
+                    self.shutdown_request(request)
+
+        def process_request(self, request, client_address) -> None:
+            self._work.put((request, client_address))
+
+        def server_close(self) -> None:
+            super().server_close()
+            for _ in self._workers:
+                self._work.put(None)
+            # Best-effort, SHORT join: idle workers pick their sentinel
+            # immediately; a worker still streaming a response (e.g. a
+            # drain-timeout exit mid-/execute) must NOT hold the process
+            # alive — it is a daemon thread and dies with the process,
+            # exactly as ThreadingHTTPServer's per-request threads did
+            # (the exec journal makes that abrupt death resumable).
+            deadline = time.monotonic() + 1.0
+            for t in self._workers:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
 
     return Server((bind, port), Handler)
 

@@ -187,6 +187,7 @@ class ClusterSupervisor:
         solve_lock: threading.Lock,
         dispatcher: Optional[SolveDispatcher] = None,
         controller_policy: Optional[str] = None,
+        ticker=None,
         err=None,
     ) -> None:
         from ..utils.env import env_bool, env_choice, env_float, env_int
@@ -263,6 +264,14 @@ class ClusterSupervisor:
         self._reopen_requested = False
         #: Last computed health scores (ISSUE 11), surfaced in /state.
         self._last_health: Optional[health.HealthScores] = None
+        #: The daemon-wide tick generator (ISSUE 19): when present, every
+        #: cluster's controller waits on the SAME generation counter, so N
+        #: clusters evaluate simultaneously and their placement rows
+        #: coalesce into one padded dispatch per tick round instead of N
+        #: serialized solves on independent timers. None for directly
+        #: constructed supervisors (unit tests) — the controller then
+        #: falls back to its own interval timer.
+        self._ticker = ticker
         #: The closed-loop rebalance controller (ISSUE 15): one per
         #: cluster, policy from the per-cluster ``--clusters`` override or
         #: the KA_CONTROLLER knob (default off — an explicit opt-in; under
@@ -1395,18 +1404,20 @@ class ClusterSupervisor:
         return dispatch_scope(self._dispatcher)
 
     def _solve_body(self, kind: str, runner, params: dict,
-                    out: io.StringIO, exclusive: bool) -> bool:
+                    out: io.StringIO) -> bool:
         """One solve body behind the dispatch regime: direct under the
         lock path (the caller already holds the shared lock); under the
         dispatcher, identical concurrent bodies (same cluster, cache
         version and params) coalesce into ONE run whose stdout bytes
         serve every waiter — the deterministic pipeline makes those the
-        exact bytes each waiter would have produced solo. ``exclusive``
-        (plans) keeps distinct bodies on the dispatcher's plan lock (the
-        pairwise exclusion the shared lock gave their non-row-packable
-        device half); what-if bodies run concurrently instead — their
-        scenario rows coalesce in the row queue, which is where the
-        cross-request (and cross-cluster) device amortization happens."""
+        exact bytes each waiter would have produced solo. DISTINCT bodies
+        all run concurrently (the old plan-exclusive lock is retired,
+        ISSUE 19) — their device halves (placement rows for plans,
+        scenario rows for what-ifs) coalesce in the row queue, which is
+        where the cross-request (and cross-cluster) device amortization
+        happens. The live cache-version supplier lets the dispatcher
+        split dedup followers across a mid-flight resync instead of
+        serving them another epoch's bytes."""
         d = self._dispatcher
         if d is None:
             return runner(params, out)
@@ -1414,7 +1425,7 @@ class ClusterSupervisor:
             self._body_job_key(kind, params),
             lambda buf: runner(params, buf),
             out,
-            exclusive=exclusive,
+            version=lambda: self.state.version,
         )
         if res is None:
             # Dispatcher already draining/closed: the straggler takes the
@@ -1425,12 +1436,10 @@ class ClusterSupervisor:
         return degraded
 
     def _solve_plan(self, params: dict, out: io.StringIO) -> bool:
-        return self._solve_body("plan", self._run_plan, params, out,
-                                exclusive=True)
+        return self._solve_body("plan", self._run_plan, params, out)
 
     def _solve_whatif(self, params: dict, out: io.StringIO) -> bool:
-        return self._solve_body("whatif", self._run_whatif, params, out,
-                                exclusive=False)
+        return self._solve_body("whatif", self._run_whatif, params, out)
 
     def _body_job_key(self, kind: str, params: dict) -> str:
         """Identical-request coalescing key: endpoint, cluster identity,

@@ -110,6 +110,11 @@ METRIC_NAMES: frozenset = frozenset({
     # and the queue wait (separated from solve time by construction)
     "dispatch.batches", "dispatch.jobs", "dispatch.solo_fallbacks",
     "dispatch.batch_size", "daemon.solve.queue_ms",
+    # dispatch-plane tuning telemetry (ISSUE 19): live queue depth at
+    # gather-cycle start, the adaptive window actually used, and the
+    # padding overhead fraction of each coalesced dispatch
+    "dispatch.queue_depth", "dispatch.window_ms",
+    "dispatch.pad_waste_frac",
     # controller.* — the closed-loop rebalance controller (ISSUE 15):
     # evaluation/decision counters, executed actions and their moves,
     # safety-rail firings (truncations, window holds), the
@@ -213,6 +218,9 @@ UNITLESS_METRICS: frozenset = frozenset({
     # histogram of jobs-per-coalesced-dispatch
     "dispatch.batches", "dispatch.jobs", "dispatch.solo_fallbacks",
     "dispatch.batch_size",
+    # dispatch.queue_depth is a job count (window_ms/pad_waste_frac carry
+    # unit suffixes)
+    "dispatch.queue_depth",
     # controller.* event/item counts (decisions, actions, executed moves,
     # rail firings, breaker transitions) and the streak/window gauges
     "controller.evaluations", "controller.holds", "controller.actions",

@@ -1186,6 +1186,15 @@ def place_scan(
     compiled dispatch covers the whole rescue subset — through a tunneled
     chip that matters more than the serialization (~80-100 ms per dispatch).
 
+    Each scan row is one topic and carries everything its placement needs
+    (``current``, ``jhash``, ``p_real``, ``rf_actual``) against shared
+    per-cluster operands: rows never read each other's carry (the carry is
+    a dummy). That per-row independence is the batch-concat contract the
+    daemon dispatcher relies on to pack DISTINCT plans whose bucketed
+    shapes and statics agree into one device call along the batch axis and
+    demux the outputs per job — concatenation cannot change any row's
+    result, only its position.
+
     ``currents`` may arrive int16 (callers halve the host→device upload when
     broker indices fit — the transfer rides the chip tunnel on the
     deployment target); it is widened here, on device, before any math."""

@@ -8,6 +8,7 @@ removals over a 1k-broker cluster across a v5e-8 slice).
 """
 from __future__ import annotations
 
+import itertools
 from dataclasses import dataclass
 from typing import List, Mapping, Optional, Sequence, Set, Tuple
 
@@ -84,6 +85,14 @@ def _active_dispatch_broker():
     except Exception:  # pragma: no cover - packaging subset without daemon/
         return None
     return active_broker()
+
+
+#: Per-request token for chunked giant-sweep jobs (ISSUE 19): memory-
+#: budgeted blocks ride the dispatcher queue one at a time but must never
+#: pack with each other — two budget-sized blocks concatenated are exactly
+#: the slab the chunking exists to avoid — so each request's chunks get a
+#: unique statics tag.
+_chunk_token = itertools.count(1)
 
 
 def _submit_coalesced(entry, shared, statics, rows, n_rows, pad, call,
@@ -212,18 +221,49 @@ def _rescue_flagged(
     whatif_sweep_jit = _sweep_program("whatif_sweep")
 
     counter_add("whatif.rescued", len(flagged))
-    sub = np.zeros((batch_bucket(len(flagged)), alive.shape[1]), dtype=bool)
-    for i, s in enumerate(flagged):
-        sub[i] = alive[s]
-    with span("whatif/rescue", hist="whatif.dispatch_ms"):
-        moved2, infeasible2, max_load2 = jax.device_get(
-            whatif_sweep_jit(
-                jnp.asarray(currents), jnp.asarray(rack_idx),
-                jnp.asarray(jhashes), jnp.asarray(p_reals), jnp.asarray(sub),
-                n=n, rf=rf, wave_mode="auto", rfs=jnp.asarray(rfs),
-                r_cap=r_cap,
+
+    def _rescue_call(rows):
+        with span("whatif/rescue", hist="whatif.dispatch_ms"):
+            return tuple(
+                np.asarray(a) for a in jax.device_get(
+                    whatif_sweep_jit(
+                        jnp.asarray(currents), jnp.asarray(rack_idx),
+                        jnp.asarray(jhashes), jnp.asarray(p_reals),
+                        jnp.asarray(rows["alive"]),
+                        n=n, rf=rf, wave_mode="auto", rfs=jnp.asarray(rfs),
+                        r_cap=r_cap,
+                    )
+                )
             )
+
+    def _rescue_pad(k):
+        block = np.zeros((k, alive.shape[1]), dtype=bool)
+        block[:, :n] = True
+        return {"alive": block}
+
+    # Coalesced rescue (ISSUE 19): on a daemon request thread the
+    # flagged-subset re-solve becomes a typed row job — concurrent
+    # requests' rescue rows over byte-identical encodings pack into one
+    # full-chain dispatch instead of serializing behind each other. The
+    # "rescue" statics tag keeps these rows out of the fast-only "dense"
+    # compatibility class: the full auto-chain sweep is a DIFFERENT
+    # compiled program, so packing across the two would be unsound.
+    routed = _submit_coalesced(
+        "whatif_sweep",
+        (currents, rack_idx, jhashes, p_reals, rfs),
+        ("rescue", n, rf, r_cap),
+        {"alive": np.array([alive[s] for s in flagged])}, len(flagged),
+        _rescue_pad, _rescue_call,
+    )
+    if routed is not None:
+        moved2, infeasible2, max_load2 = routed
+    else:
+        sub = np.zeros(
+            (batch_bucket(len(flagged)), alive.shape[1]), dtype=bool
         )
+        for i, s in enumerate(flagged):
+            sub[i] = alive[s]
+        moved2, infeasible2, max_load2 = _rescue_call({"alive": sub})
     for i, s in enumerate(flagged):
         moved[s] = moved2[i]
         infeasible[s] = infeasible2[i]
@@ -521,6 +561,29 @@ def evaluate_removal_scenarios(
                 ),
             )
 
+    def _dense_rows(rows):
+        with span("whatif/dispatch", hist="whatif.dispatch_ms"):
+            return tuple(
+                np.array(a) for a in jax.device_get(
+                    whatif_sweep_jit(
+                        jnp.asarray(currents),
+                        jnp.asarray(enc0.rack_idx),
+                        jnp.asarray(jhashes),
+                        jnp.asarray(p_reals),
+                        jnp.asarray(rows["alive"]),
+                        n=enc0.n,
+                        rf=rf,
+                        rfs=jnp.asarray(rfs),
+                        r_cap=enc0.r_cap,
+                    )
+                )
+            )
+
+    def _dense_pad(k):
+        block = np.zeros((k, enc0.n_pad), dtype=bool)
+        block[:, :enc0.n] = True
+        return {"alive": block}
+
     routed = None
     if mesh is None and s_pad <= s_chunk:
         # The coalescing route (ISSUE 14): only the scenario masks are
@@ -529,29 +592,6 @@ def evaluate_removal_scenarios(
         # same cluster, or different clusters whose caches agree — pack
         # into one dispatch on the same bucketed batch programs the store
         # already holds.
-        def _dense_rows(rows):
-            with span("whatif/dispatch", hist="whatif.dispatch_ms"):
-                return tuple(
-                    np.array(a) for a in jax.device_get(
-                        whatif_sweep_jit(
-                            jnp.asarray(currents),
-                            jnp.asarray(enc0.rack_idx),
-                            jnp.asarray(jhashes),
-                            jnp.asarray(p_reals),
-                            jnp.asarray(rows["alive"]),
-                            n=enc0.n,
-                            rf=rf,
-                            rfs=jnp.asarray(rfs),
-                            r_cap=enc0.r_cap,
-                        )
-                    )
-                )
-
-        def _dense_pad(k):
-            block = np.zeros((k, enc0.n_pad), dtype=bool)
-            block[:, :enc0.n] = True
-            return {"alive": block}
-
         routed = _submit_coalesced(
             "whatif_sweep",
             (currents, enc0.rack_idx, jhashes, p_reals, rfs),
@@ -565,14 +605,37 @@ def evaluate_removal_scenarios(
         moved, infeasible, max_load = sweep_block(alive)
     else:
         # Fixed-size blocks (last one padded all-alive) so every dispatch
-        # hits the same compiled program.
+        # hits the same compiled program. On a daemon request thread each
+        # block becomes a typed dispatcher job (ISSUE 19): between blocks
+        # the dispatcher serves other queued groups, so a giant sweep no
+        # longer monopolizes the device against a storm of small requests.
+        # A per-request token in the statics keeps chunk jobs from packing
+        # with each other — two memory-budgeted blocks concatenated would
+        # be exactly the slab the chunking exists to avoid — and the
+        # power-of-two floor keeps every block on a bucket the program
+        # store already holds (zero dispatcher padding, zero new keys).
+        route_chunks = mesh is None and _active_dispatch_broker() is not None
+        token = 0
+        if route_chunks:
+            s_chunk = 1 << (s_chunk.bit_length() - 1)
+            token = next(_chunk_token)
         blocks = []
         for lo in range(0, s_pad, s_chunk):
             block = np.ones((s_chunk, alive.shape[1]), dtype=bool)
             block[:, enc0.n:] = False
             chunk_rows = alive[lo:lo + s_chunk]
             block[: len(chunk_rows)] = chunk_rows
-            blocks.append(tuple(sweep_block(block)))
+            chunk_routed = _submit_coalesced(
+                "whatif_sweep",
+                (currents, enc0.rack_idx, jhashes, p_reals, rfs),
+                ("chunk", enc0.n, rf, enc0.r_cap, token, lo),
+                {"alive": block}, s_chunk,
+                _dense_pad, _dense_rows,
+            ) if route_chunks else None
+            blocks.append(
+                tuple(chunk_routed) if chunk_routed is not None
+                else tuple(sweep_block(block))
+            )
         moved, infeasible, max_load = (
             np.concatenate([b[i] for b in blocks])[:s_pad]
             for i in range(3)

@@ -245,6 +245,19 @@ def _resolve_native_order(use_pallas: bool) -> bool:
     return leadership_backend() == "native"
 
 
+def _dispatch_broker_active() -> bool:
+    """True when the calling thread is a daemon request thread running
+    under the coalescing SolveDispatcher (``dispatch_scope``, ISSUE 19) —
+    the signal to take the split, row-packable placement pipeline.
+    Lazy/guarded import: ``solvers/`` must not depend on ``daemon/`` at
+    import time, and a packaging subset without it simply never routes."""
+    try:
+        from ..daemon.dispatch import active_broker
+    except Exception:  # pragma: no cover - packaging subset without daemon/
+        return False
+    return active_broker() is not None
+
+
 def _fresh_solve_jit(*args, **kwargs):
     import jax
 
@@ -524,8 +537,20 @@ class TpuSolver:
         self.last_leadership = (
             "native" if native_order else ("pallas" if use_pallas else "device")
         )
+        # Daemon request thread under the coalescing dispatcher (ISSUE 19):
+        # take the SPLIT placement+ordering pipeline even without the
+        # native library so the placement stage — per-row independent, the
+        # row-packable half — can concat with other requests' rows in the
+        # dispatcher queue, with leadership ordering on device
+        # (``order_batched``). Split output is byte-identical to the fused
+        # solve: placement never reads the leadership counters and the
+        # ordering backends are equality-pinned (tests/test_leadership_*).
+        route_place = (
+            not native_order and not use_pallas and self._mesh is None
+            and _dispatch_broker_active()
+        )
         with span("solve", sink=phase_ms, log=phase_log):
-            if native_order:
+            if native_order or route_place:
                 # Heterogeneous split (native/leadership.py): placement — the
                 # parallel tensor phase — on device; the sequential leadership
                 # chain in host C++, where its consumers (decode, Context)
@@ -641,6 +666,13 @@ class TpuSolver:
                     f"({why})",
                     file=sys.stderr,
                 )
+            if self._mesh is None:
+                routed = self._place_routed(
+                    up_currents, enc, jhashes, p_reals, rf, wave_mode,
+                    rfs_arr, width, place_scan_narrow_jit,
+                )
+                if routed is not None:
+                    return routed
             return jax.device_get(
                 place_scan_narrow_jit(
                     jnp.asarray(up_currents),
@@ -718,6 +750,76 @@ class TpuSolver:
             infeasible[bad] = r_inf[:k]
             deficits[bad] = r_def[:k]
         return acc_nodes, acc_count, infeasible, deficits
+
+    def _place_routed(
+        self, up_currents, enc, jhashes, p_reals, rf, wave_mode, rfs_arr,
+        width, place_scan_narrow_jit,
+    ):
+        """Row-packable placement (ISSUE 19): submit the scan placement's
+        FULL padded batch as one row job on the daemon's coalescing
+        dispatcher, so DISTINCT plans (and controller evaluation ticks)
+        with content-compatible encodings — same bucketed row shapes +
+        statics under the ``batch_key`` discipline — concat on the batch
+        axis and share one ``place_scan_narrow`` device call, demuxed per
+        job. Sound because placement is per-row independent (never reads
+        the leadership counters; vmap == scan equality is test-pinned), so
+        a row's outputs are byte-identical whatever rides alongside it.
+        Submitting the padded batch keeps the solo case on the skip-concat
+        fast path (the batch dim is already a power-of-two bucket, so the
+        dispatcher adds zero padding and zero new compile keys — KA009).
+        Returns the 4 host arrays, or None when no dispatcher is routing
+        (the caller then runs its direct dispatch)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..parallel.whatif import _submit_coalesced
+
+        up_np = np.asarray(up_currents)
+        rack_np = np.asarray(enc.rack_idx)
+        jh_np = np.asarray(jhashes)
+        pr_np = np.asarray(p_reals)
+        rows = {"cur": up_np, "jh": jh_np, "pr": pr_np}
+        if rfs_arr is not None:
+            rows["rfs"] = np.asarray(rfs_arr)
+        statics = (
+            "place_scan_narrow", enc.n, rf, wave_mode, enc.r_cap, width,
+            up_np.shape[1], up_np.shape[2], str(up_np.dtype),
+            rfs_arr is None,
+        )
+
+        def _pad(k):
+            pad_rows = {
+                "cur": np.full((k,) + up_np.shape[1:], -1, up_np.dtype),
+                "jh": np.zeros(k, jh_np.dtype),
+                "pr": np.zeros(k, pr_np.dtype),
+            }
+            if rfs_arr is not None:
+                pad_rows["rfs"] = np.full(k, rf, rows["rfs"].dtype)
+            return pad_rows
+
+        def _call(r):
+            return tuple(
+                np.asarray(a) for a in jax.device_get(
+                    place_scan_narrow_jit(
+                        jnp.asarray(r["cur"]),
+                        jnp.asarray(rack_np),
+                        jnp.asarray(r["jh"]),
+                        jnp.asarray(r["pr"]),
+                        n=enc.n,
+                        rf=rf,
+                        wave_mode=wave_mode,
+                        rfs=None if rfs_arr is None
+                        else jnp.asarray(r["rfs"]),
+                        r_cap=enc.r_cap,
+                        width=width,
+                    )
+                )[:4]
+            )
+
+        return _submit_coalesced(
+            "place_scan_narrow", (rack_np,), statics, rows,
+            int(up_np.shape[0]), _pad, _call,
+        )
 
     def _order_placed(
         self, acc_nodes, acc_count, counters_before, jhashes, p_reals, rf,

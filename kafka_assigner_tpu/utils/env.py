@@ -432,6 +432,24 @@ _knob(
         "coalesced batch width and the latency a storm can add to the "
         "first queued request. Read live per gather cycle",
 )
+_knob(
+    "KA_DISPATCH_WINDOW_MAX_MS", "float", 25.0, floor=0.0,
+    doc="cap on the ADAPTIVE gather window: under sustained queue depth "
+        "the effective window grows as `KA_DISPATCH_WINDOW_MS x depth` up "
+        "to this many milliseconds (never below the configured base "
+        "window), widening coalesced batches under load without letting "
+        "latency run away. The live effective value is the "
+        "`dispatch.window_ms` gauge. Read live per gather cycle",
+)
+_knob(
+    "KA_DAEMON_HTTP_WORKERS", "int", 64, floor=1,
+    doc="size of the daemon HTTP server's bounded worker-thread pool "
+        "(`daemon/service.py`): accepted connections queue to this many "
+        "handler threads instead of thread-per-request, so a 1024-client "
+        "burst costs a bounded thread count and excess connections wait "
+        "in the accept queue (backpressure) rather than forking a "
+        "thousand threads. Read once at daemon startup",
+)
 
 # --- autonomous rebalance controller (daemon/controller.py) -----------------
 _knob(

@@ -1,18 +1,24 @@
 #!/usr/bin/env python
-"""Daemon load bench (ISSUE 14, slow — NOT in the tier-1 lint gate): p99
-latency of a REAL ``ka-daemon`` subprocess as client concurrency goes
-1 → 8 → 64, batched dispatch vs. the ``KA_DISPATCH=0`` shared lock.
+"""Daemon load bench (ISSUE 14, pushed to 256–1024 clients by ISSUE 19;
+slow — NOT in the tier-1 lint gate): p99 latency of a REAL ``ka-daemon``
+subprocess as client concurrency goes 1 → 8 → 64 → 256 → 1024, batched
+dispatch vs. the ``KA_DISPATCH=0`` shared lock.
 
 Workload: a deterministic 8-broker / 128-topic / 48-partition / RF-2
-snapshot cluster. The headline endpoint is ``/whatif`` (RANK_DECOMMISSION
-against the cache) — the batch-native, solve-heavy request class the
-coalescing dispatcher exists for (solo ≈ 0.5 s of real solve on this CPU
-host). ``/plan`` (the sticky mode-3 no-op on this fixture) is measured
-alongside for context: its solo cost is tens of ms, so at 64 clients its
-p99 is connection/HTTP-bound, not solve-bound — the lock was never its
-bottleneck and the ≤ 3× bar is asserted on the solve-bound endpoint,
-where the lock pathology actually lives (under the lock, 64 concurrent
-what-ifs queue ~64 full solves deep).
+snapshot cluster, hit by a MIXED solve-bound burst — at every
+concurrency level half the clients POST ``/whatif`` (RANK_DECOMMISSION
+against the cache, solo ≈ 0.5 s of real solve on this CPU host) and the
+other half POST a topic-scoped tpu ``/plan``, all released through one
+barrier. Since ISSUE 19 both request classes ride the same
+SolveDispatcher (what-if rows and routed placement rows as typed jobs in
+one queue), so the mix is the system under test: a single dispatch plane
+absorbing heterogeneous device work. The daemon runs its bounded HTTP
+worker pool sized to admit the full burst
+(``KA_DAEMON_HTTP_WORKERS=1024``, ``KA_DAEMON_MAX_INFLIGHT=2048``) so
+what's measured is the dispatch plane, not the connection ceiling, and
+the gather window is left on its adaptive default (base
+``KA_DISPATCH_WINDOW_MS`` scaling with queue depth up to
+``KA_DISPATCH_WINDOW_MAX_MS``).
 
 Latency is read TWO ways and both are recorded: client-side wall times,
 and the daemon's OWN ``/metrics`` histograms
@@ -23,11 +29,24 @@ be byte-identical to its fresh-process solo CLI baseline.
 
 Asserts (and records in ``BENCH_daemon_load.json``):
 
-- batched ``/whatif`` p99 at 64 clients <= 3x the single-client p99
-  (near-flat; measured from the daemon's own histograms);
+- the solve-bound p99 at 256 clients <= 3x solo (near-flat; measured
+  from the daemon's own histograms), asserted BOTH on the ``/whatif``
+  endpoint alone AND on the merged whatif+plan mix — the one-dispatch-
+  plane bar — with the 64- and 1024-client points recorded alongside;
+- ``/plan`` p99 at 256 clients <= the solve-bound ``/whatif`` p99 at the
+  same level: the fast endpoint rides the plane instead of queueing
+  behind the giant solves sharing it. (Its warm routed solve is ~10 ms —
+  two orders below the ~256-thread HTTP/GIL floor any CPython handler
+  pays — so a ratio against its OWN solo would measure the host's
+  connection tax, not the dispatch plane; the cross-endpoint bound is
+  the meaningful near-flatness claim.)
+- zero compile-store misses across all measured rounds after warm-up —
+  row packing mints no new compile keys at any batch size;
 - every response byte-identical to the solo baseline, under both regimes;
 - the lock-mode comparison point at 64 clients (historically ~64x solo —
-  each client waits out the whole queue of full solves).
+  each client waits out the whole queue of full solves; the lock ladder
+  stops at 64 because 256+ would serialize minutes of pure queue to
+  restate the same pathology).
 """
 from __future__ import annotations
 
@@ -48,14 +67,25 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 from scripts.health_smoke import BANNER_RE, _req  # noqa: E402
 
-LEVELS = (1, 8, 64)
+LEVELS = (1, 8, 64, 256, 1024)
+#: The shared-lock regime only climbs to 64: the pathology is already
+#: ~60-90x solo there and 256+ would pay minutes of serialized solves.
+LOCK_LEVELS = (1, 8, 64)
 #: Fine latency grid (ms) so the daemon-side p99 has usable resolution.
 HIST_EDGES = (
     "1,2,5,10,25,50,75,100,150,200,300,400,500,650,800,1000,1300,1600,"
     "2000,2600,3300,4200,5500,7000,9000,12000,16000,22000,30000,45000,"
     "60000,90000"
 )
-PLAN_BODY: dict = {}
+#: The measured ``/plan`` request is TOPIC-SCOPED (4 of 128 topics) and
+#: runs the tpu solver: a real device placement solve on the ISSUE 19
+#: routed, row-packable path, in front of an ~18 KB response. A
+#: full-cluster PRINT_REASSIGNMENT on this fixture emits a ~600 KB plan,
+#: so at 256-1024 clients its p99 would be GIL-bound response marshaling
+#: — a bandwidth property of the host, not the dispatch plane under
+#: test — while a greedy scoped plan never touches the device at all.
+PLAN_TOPICS = tuple(f"t{t}" for t in range(4))
+PLAN_BODY: dict = {"topics": list(PLAN_TOPICS), "solver": "tpu"}
 
 
 def _snapshot() -> str:
@@ -98,9 +128,12 @@ def _start_daemon(snap: str, dispatch_on: bool):
     env = {
         **os.environ,
         "KA_DISPATCH": "1" if dispatch_on else "0",
-        "KA_DISPATCH_WINDOW_MS": "25",
-        "KA_DAEMON_MAX_INFLIGHT": "128",
-        "KA_DAEMON_REQUEST_TIMEOUT": "120",
+        # The gather window stays on its adaptive default (base 3 ms
+        # scaling with queue depth up to KA_DISPATCH_WINDOW_MAX_MS) —
+        # the bench measures the shipped tuning, not a hand-pinned one.
+        "KA_DAEMON_MAX_INFLIGHT": "2048",
+        "KA_DAEMON_HTTP_WORKERS": "1024",
+        "KA_DAEMON_REQUEST_TIMEOUT": "300",
         "KA_OBS_HIST_EDGES": HIST_EDGES,
     }
     daemon = subprocess.Popen(
@@ -146,31 +179,44 @@ def _post(port, path, body, baseline, timeout=600.0):
     return ms
 
 
-def _burst(port, path, body, baseline, n):
-    lats = []
+def _burst(port, jobs):
+    """Release ``jobs`` — ``(path, body, baseline)`` triples — through one
+    barrier and return ``{path: sorted client latencies (ms)}``."""
+    lats = {path: [] for path, _b, _s in jobs}
     lock = threading.Lock()
-    barrier = threading.Barrier(n)
+    barrier = threading.Barrier(len(jobs))
     errors = []
 
-    def one():
+    def one(path, body, baseline):
         try:
             barrier.wait(timeout=120)
             ms = _post(port, path, body, baseline)
             with lock:
-                lats.append(ms)
+                lats[path].append(ms)
         except BaseException as e:  # surfaced as a bench failure below
             errors.append(e)
 
-    threads = [threading.Thread(target=one) for _ in range(n)]
+    threads = [threading.Thread(target=one, args=job) for job in jobs]
     for t in threads:
         t.start()
     for t in threads:
         t.join(timeout=600)
     if errors:
         raise SystemExit(f"FAIL: burst errors: {errors[:3]}")
-    if len(lats) != n:
-        raise SystemExit(f"FAIL: {n - len(lats)} request(s) hung")
-    return sorted(lats)
+    done = sum(len(v) for v in lats.values())
+    if done != len(jobs):
+        raise SystemExit(f"FAIL: {len(jobs) - done} request(s) hung")
+    return {path: sorted(v) for path, v in lats.items()}
+
+
+def _mixed_jobs(level, base_whatif, base_plan):
+    """The mixed solve-bound burst: alternate whatif / scoped-tpu-plan
+    clients so both request classes hit the dispatch plane together."""
+    return [
+        (("/whatif", {}, base_whatif) if i % 2 == 0
+         else ("/plan", PLAN_BODY, base_plan))
+        for i in range(level)
+    ]
 
 
 def _client_p(lats, q):
@@ -220,18 +266,35 @@ def _delta_p99(before, after):
     return None
 
 
+def _merge_buckets(*bucket_maps):
+    """Sum cumulative-bucket maps edge-wise (same ``KA_OBS_HIST_EDGES``
+    grid) so a p99 can be taken over the MERGED whatif+plan workload."""
+    out = {}
+    for m in bucket_maps:
+        for le, v in m.items():
+            out[le] = out.get(le, 0.0) + v
+    return out
+
+
+def _ctr_total(fams, fam):
+    data = fams.get(fam)
+    return 0.0 if data is None else sum(v for _n, _l, v in data["samples"])
+
+
 def _measure_mode(snap, dispatch_on, base_whatif, base_plan):
     daemon, port, lines = _start_daemon(snap, dispatch_on)
     mode = "dispatch" if dispatch_on else "lock"
     out = {"levels": {}}
     try:
         # Warm: compile/load every program this workload dispatches (the
-        # acceptance criterion is about WARM programs).
+        # acceptance criterion is about WARM programs), solo and mixed.
         _post(port, "/whatif", {}, base_whatif)
         _post(port, "/plan", PLAN_BODY, base_plan)
         if dispatch_on:
-            _burst(port, "/whatif", {}, base_whatif, 8)
-        for level in LEVELS:
+            _burst(port, _mixed_jobs(8, base_whatif, base_plan))
+        fams_warm = _scrape(port)
+        misses_warm = _ctr_total(fams_warm, "ka_compile_store_misses_total")
+        for level in (LEVELS if dispatch_on else LOCK_LEVELS):
             if not dispatch_on and level == 64:
                 # One lock-mode burst at 64 is the whole comparison point;
                 # don't pay the ~half-minute queue twice.
@@ -247,38 +310,51 @@ def _measure_mode(snap, dispatch_on, base_whatif, base_plan):
                     pl += [_post(port, "/plan", PLAN_BODY, base_plan)
                            for _ in range(4)]
                 else:
-                    wl += _burst(port, "/whatif", {}, base_whatif, level)
-                    pl += _burst(port, "/plan", PLAN_BODY, base_plan, level)
+                    got = _burst(
+                        port, _mixed_jobs(level, base_whatif, base_plan)
+                    )
+                    wl += got["/whatif"]
+                    pl += got["/plan"]
             fams1 = _scrape(port)
             row = {}
+            buckets = {}
+            for ep in ("whatif", "plan"):
+                buckets[ep] = (
+                    _hist_buckets(fams0, "ka_daemon_http_request_ms", ep),
+                    _hist_buckets(fams1, "ka_daemon_http_request_ms", ep),
+                )
             for ep, lats in (("whatif", sorted(wl)), ("plan", sorted(pl))):
                 row[ep] = {
                     "n": len(lats),
                     "client_p50_ms": round(_client_p(lats, 0.50), 1),
                     "client_p99_ms": round(_client_p(lats, 0.99), 1),
-                    "daemon_hist_p99_ms": _delta_p99(
-                        _hist_buckets(fams0, "ka_daemon_http_request_ms",
-                                      ep),
-                        _hist_buckets(fams1, "ka_daemon_http_request_ms",
-                                      ep),
-                    ),
+                    "daemon_hist_p99_ms": _delta_p99(*buckets[ep]),
                 }
+            row["mixed"] = {
+                "n": row["whatif"]["n"] + row["plan"]["n"],
+                "daemon_hist_p99_ms": _delta_p99(
+                    _merge_buckets(buckets["whatif"][0],
+                                   buckets["plan"][0]),
+                    _merge_buckets(buckets["whatif"][1],
+                                   buckets["plan"][1]),
+                ),
+            }
             out["levels"][str(level)] = row
             print(f"bench_daemon_load: {mode} c={level}: "
                   f"whatif p99={row['whatif']['client_p99_ms']}ms "
                   f"(daemon {row['whatif']['daemon_hist_p99_ms']}ms), "
-                  f"plan p99={row['plan']['client_p99_ms']}ms",
+                  f"plan p99={row['plan']['client_p99_ms']}ms "
+                  f"(daemon {row['plan']['daemon_hist_p99_ms']}ms), "
+                  f"mixed daemon p99={row['mixed']['daemon_hist_p99_ms']}ms",
                   file=sys.stderr)
         fams = _scrape(port)
-
-        def _ctr(fam):
-            d = fams.get(fam)
-            return 0.0 if d is None else sum(
-                v for _n, _l, v in d["samples"]
-            )
-
-        out["dispatch_jobs"] = _ctr("ka_dispatch_jobs_total")
-        out["dispatch_batches"] = _ctr("ka_dispatch_batches_total")
+        out["dispatch_jobs"] = _ctr_total(fams, "ka_dispatch_jobs_total")
+        out["dispatch_batches"] = _ctr_total(
+            fams, "ka_dispatch_batches_total"
+        )
+        out["compile_store_misses_after_warm"] = (
+            _ctr_total(fams, "ka_compile_store_misses_total") - misses_warm
+        )
         daemon.send_signal(signal.SIGTERM)
         rc = daemon.wait(timeout=120)
         if rc != 0:
@@ -303,14 +379,18 @@ def main(argv=None) -> int:
     snap = _snapshot()
     try:
         base_whatif = _fresh_cli(snap, "RANK_DECOMMISSION")
-        base_plan = _fresh_cli(snap, "PRINT_REASSIGNMENT")
+        base_plan = _fresh_cli(
+            snap, "PRINT_REASSIGNMENT", "--topics", ",".join(PLAN_TOPICS),
+            "--solver", "tpu",
+        )
         report = {
             "bench": "daemon_load",
-            "issue": 14,
+            "issue": 19,
             "cluster": {"brokers": 8, "topics": 128, "partitions": 48,
                         "rf": 2},
             "levels": list(LEVELS),
-            "window_ms": 25,
+            "lock_levels": list(LOCK_LEVELS),
+            "window": {"base_ms": 3.0, "adaptive_cap_ms": 25.0},
             "platform": os.environ.get("JAX_PLATFORMS", "cpu"),
             "modes": {},
         }
@@ -322,21 +402,59 @@ def main(argv=None) -> int:
         )
 
         disp = report["modes"]["dispatch"]["levels"]
-        p99_1 = disp["1"]["whatif"]["daemon_hist_p99_ms"]
-        p99_64 = disp["64"]["whatif"]["daemon_hist_p99_ms"]
         lock64 = report["modes"]["lock"]["levels"]["64"]["whatif"]
-        report["headline"] = {
-            "whatif_p99_solo_ms": p99_1,
-            "whatif_p99_64_batched_ms": p99_64,
+        headline = {
+            "bar": ("solve-bound (whatif, and merged whatif+plan mix) "
+                    "p99@256 <= 3x p99@1; plan p99@256 <= whatif p99@256; "
+                    "zero compile-store misses after warm-up"),
             "whatif_p99_64_lock_ms": lock64["daemon_hist_p99_ms"],
-            "batched_ratio_64_vs_1": round(p99_64 / p99_1, 2),
-            "lock_ratio_64_vs_1": round(
-                lock64["daemon_hist_p99_ms"] / p99_1, 2
-            ),
-            "bar": "batched p99@64 <= 3x p99@1",
         }
-        ok = p99_64 <= 3.0 * p99_1
-        report["headline"]["pass"] = ok
+        ok = True
+        for ep in ("whatif", "mixed", "plan"):
+            p99_1 = disp["1"][ep]["daemon_hist_p99_ms"]
+            p99_256 = disp["256"][ep]["daemon_hist_p99_ms"]
+            headline[f"{ep}_p99_solo_ms"] = p99_1
+            for level in ("64", "256", "1024"):
+                headline[f"{ep}_p99_{level}_batched_ms"] = \
+                    disp[level][ep]["daemon_hist_p99_ms"]
+            headline[f"{ep}_ratio_256_vs_1"] = round(p99_256 / p99_1, 2)
+            if ep != "plan" and p99_256 > 3.0 * p99_1:
+                ok = False
+                print(
+                    f"bench_daemon_load: FAIL {ep} p99@256={p99_256}ms > "
+                    f"3x p99@1={p99_1}ms",
+                    file=sys.stderr,
+                )
+        # The fast endpoint's near-flatness bar is CROSS-endpoint: its
+        # warm routed solve (~10 ms) sits far below the 256-thread HTTP
+        # floor, so the meaningful claim is that it rides the plane at or
+        # below the solve-bound endpoint's latency instead of queueing
+        # behind the giant solves it shares the device with.
+        plan_256 = disp["256"]["plan"]["daemon_hist_p99_ms"]
+        whatif_256 = disp["256"]["whatif"]["daemon_hist_p99_ms"]
+        if plan_256 > whatif_256:
+            ok = False
+            print(
+                f"bench_daemon_load: FAIL plan p99@256={plan_256}ms > "
+                f"whatif p99@256={whatif_256}ms",
+                file=sys.stderr,
+            )
+        misses = report["modes"]["dispatch"][
+            "compile_store_misses_after_warm"]
+        headline["compile_store_misses_after_warm"] = misses
+        if misses != 0:
+            ok = False
+            print(
+                f"bench_daemon_load: FAIL {misses} compile-store misses "
+                "after warm-up (packing minted new compile keys)",
+                file=sys.stderr,
+            )
+        headline["lock_ratio_64_vs_1"] = round(
+            lock64["daemon_hist_p99_ms"]
+            / disp["1"]["whatif"]["daemon_hist_p99_ms"], 2
+        )
+        headline["pass"] = ok
+        report["headline"] = headline
         out_path = args.out
         with open(out_path, "w", encoding="utf-8") as f:
             json.dump(report, f, indent=2, sort_keys=True)
@@ -344,11 +462,6 @@ def main(argv=None) -> int:
         print(f"bench_daemon_load: report at {out_path}", file=sys.stderr)
         print(json.dumps(report["headline"], indent=2), file=sys.stderr)
         if not ok:
-            print(
-                f"bench_daemon_load: FAIL p99@64={p99_64}ms > "
-                f"3x p99@1={p99_1}ms",
-                file=sys.stderr,
-            )
             return 1
         print("bench_daemon_load: PASS", file=sys.stderr)
         return 0

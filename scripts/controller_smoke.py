@@ -19,6 +19,14 @@ at its second wave boundary (real movement already committed). The
 controller must roll the cluster back to the BYTE-IDENTICAL pre-action
 assignment, open its breaker (visible in the endpoint view and the
 decision trail), and leave ``b`` untouched again. SIGTERM exit 0.
+
+Phase 3 — shared ticks on the dispatch plane (ISSUE 19): both clusters
+on ``controller=observe`` with ``--solver tpu``. The daemon-wide
+``SharedTicker`` releases every evaluation loop at the same generation,
+so the clusters' candidate-plan placement rows coalesce into ONE device
+dispatch per tick round: ``ka_dispatch_batches_total`` grows by at least
+one per measured round while both decision trails stay normal
+(``would-act`` on the seeded imbalance, never ``acted``).
 """
 from __future__ import annotations
 
@@ -225,12 +233,92 @@ def main() -> int:
                   "pre-action assignment", file=sys.stderr)
             return 1
 
+        # ---- phase 3: shared ticker — N clusters, ONE dispatch per tick
+        # (ISSUE 19). Both clusters on controller=observe with
+        # --solver tpu: the daemon-wide SharedTicker releases both
+        # evaluation loops at the same generation, their candidate-plan
+        # bodies run concurrently (distinct dedup keys — different
+        # clusters), and their placement rows coalesce in the dispatcher.
+        # With no other traffic, EVERY ka_dispatch_batches_total increment
+        # is a multi-job row group — i.e. the two clusters' evaluation
+        # solves provably sharing one device dispatch per tick round.
+        snap_a3 = _imbalanced_snapshot(workdir, "a3.json")
+        snap_b3 = _imbalanced_snapshot(workdir, "b3.json")
+        env3 = {
+            **base_env,
+            "KA_CONTROLLER_INTERVAL": "1.0",
+            # Widened gather window: the two evaluation threads must meet
+            # deterministically even under CPU-jit timing noise.
+            "KA_DISPATCH_WINDOW_MS": "300",
+        }
+        daemon, port, lines = _start_daemon(
+            f"a={snap_a3}#controller=observe;b={snap_b3}#controller=observe",
+            env3, solver="tpu",
+        )
+
+        def _evals(cluster):
+            v = _counter_total(
+                port, "ka_controller_evaluations_total", cluster=cluster
+            )
+            return v or 0.0
+
+        def _await_evals(floor_a, floor_b, deadline_s=180.0):
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                if _evals("a") >= floor_a and _evals("b") >= floor_b:
+                    return
+                time.sleep(0.25)
+            raise SystemExit(
+                f"FAIL: controllers never reached {floor_a}/{floor_b} "
+                f"evaluations (a={_evals('a')}, b={_evals('b')})"
+            )
+
+        # Let the first (compile-bearing) rounds pass, then measure.
+        _await_evals(2, 2)
+        e0a, e0b = _evals("a"), _evals("b")
+        batches0 = _counter_total(port, "ka_dispatch_batches_total") or 0.0
+        _await_evals(e0a + 3, e0b + 3)
+        e1a, e1b = _evals("a"), _evals("b")
+        batches1 = _counter_total(port, "ka_dispatch_batches_total") or 0.0
+        rounds = int(min(e1a - e0a, e1b - e0b))
+        shared = batches1 - batches0
+        # One shared dispatch per tick round (a scrape can straddle a
+        # round boundary, so allow one round of skew).
+        if shared < rounds - 1 or shared < 2:
+            print(
+                f"FAIL: {rounds} tick rounds produced only {shared} "
+                "coalesced dispatches — controller evaluations are not "
+                "sharing the dispatch plane", file=sys.stderr,
+            )
+            return 1
+        # Decision trails unchanged: both observe controllers keep their
+        # normal evaluation trail (would-act on the seeded imbalance,
+        # never acted).
+        for cluster in ("a", "b"):
+            view = _controller_view(port, cluster)
+            decs = [e["decision"] for e in view["decisions"]]
+            if not decs or "would-act" not in decs:
+                print(
+                    f"FAIL: observe cluster {cluster!r} trail missing "
+                    f"would-act ({decs})", file=sys.stderr,
+                )
+                return 1
+            if "acted" in decs:
+                print(
+                    f"FAIL: observe cluster {cluster!r} acted ({decs})",
+                    file=sys.stderr,
+                )
+                return 1
+        _drain(daemon, lines)
+        daemon = None
+
         print(
             "controller_smoke: PASS (auto cluster converged to an acted "
             "rebalance with a complete journal and improved score, "
             "injected controller:exec-crash rolled back byte-identically "
-            "with the breaker open, off cluster fully inert, clean "
-            "SIGTERM drains)",
+            "with the breaker open, off cluster fully inert, shared "
+            "ticker coalesced both clusters' evaluation solves into one "
+            "dispatch per tick round, clean SIGTERM drains)",
             file=sys.stderr,
         )
         return 0

@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Small-N dispatch-plane load probe (tier-1, via scripts/lint.sh —
+ISSUE 19). The full load bench (scripts/bench_daemon_load.py) takes
+minutes; this probe catches dispatch-plane regressions in seconds:
+
+16 concurrent clients — 4 identical ``/plan`` + 4 identical ``/whatif``
+per cluster, TWO clusters built from the SAME snapshot, ``--solver tpu``
+so plans exercise the routed (split, row-packable) placement pipeline —
+are released through one barrier into a widened gather window. Asserts:
+
+1.  every response is HTTP 200 and byte-identical to its fresh-process
+    solo CLI baseline (coalescing may never change a response);
+2.  ``dispatch.solo_fallbacks`` does NOT grow across the coalesced round:
+    on the healthy path every body leader has followers (identical-request
+    dedup) and every row group packs at least two jobs (cross-cluster
+    placement and scenario rows) — a solo fallback here means the dispatch
+    plane silently stopped coalescing;
+3.  ``dispatch.batches`` grew (the coalescing actually happened).
+
+A warm-up round runs each endpoint solo first (compiles the bucketed
+programs, fills per-cluster caches) and the counters are snapshotted
+after it — the warm-up's own solo fallbacks are expected and excluded.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from scripts.dispatch_smoke import _counter, _scrape  # noqa: E402
+from scripts.health_smoke import _req, _start_daemon  # noqa: E402
+
+
+def _snapshot() -> str:
+    snap = {
+        "brokers": [
+            {"id": i, "host": f"b{i}", "port": 9092, "rack": f"r{i % 2}"}
+            for i in range(4)
+        ],
+        "topics": {
+            "events": {str(p): [p % 4, (p + 1) % 4] for p in range(8)},
+            "logs": {str(p): [(p + 2) % 4, (p + 3) % 4] for p in range(3)},
+        },
+    }
+    fd, path = tempfile.mkstemp(suffix=".json", prefix="ka_load_probe_")
+    with os.fdopen(fd, "w") as f:
+        json.dump(snap, f)
+    return path
+
+
+def _fresh_cli(path: str, mode: str) -> str:
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "kafka_assigner_tpu.cli",
+         "--zk_string", path, "--mode", mode, "--solver", "tpu"],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+        env=dict(os.environ),
+    )
+    if proc.returncode != 0:
+        raise SystemExit(
+            f"FAIL: baseline CLI {mode} rc={proc.returncode}\n{proc.stderr}"
+        )
+    return proc.stdout
+
+
+def _probe_round(port, base_plan, base_whatif):
+    """16 barrier-released clients: 4 identical per (cluster x endpoint)."""
+    jobs = [
+        (cluster, path)
+        for cluster in ("a", "b")
+        for path in ("/plan",) * 4 + ("/whatif",) * 4
+    ]
+    barrier = threading.Barrier(len(jobs))
+    results = {}
+
+    def one(i, cluster, path):
+        barrier.wait(timeout=60)
+        s, raw, _ = _req(
+            port, "POST", f"/clusters/{cluster}{path}", {}, timeout=600
+        )
+        results[i] = (cluster, path, s, raw)
+
+    threads = [
+        threading.Thread(target=one, args=(i, c, p))
+        for i, (c, p) in enumerate(jobs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    if len(results) != len(jobs):
+        raise SystemExit(
+            f"FAIL: {len(jobs) - len(results)} request(s) hung"
+        )
+    for i, (cluster, path, s, raw) in sorted(results.items()):
+        if s != 200:
+            raise SystemExit(
+                f"FAIL: {cluster}{path} http={s}: {raw[:300]}"
+            )
+        body = json.loads(raw)
+        base = base_plan if path == "/plan" else base_whatif
+        if body["result"]["stdout"] != base:
+            raise SystemExit(
+                f"FAIL: {cluster}{path} diverged from the solo baseline "
+                "under coalescing"
+            )
+
+
+def main() -> int:
+    snap = _snapshot()
+    clusters = f"a={snap};b={snap}"
+    env = {
+        **os.environ,
+        "KA_ZK_CLIENT": "wire",
+        # Widen the gather window so barrier-released clients
+        # deterministically coalesce; production default is 3 ms.
+        "KA_DISPATCH_WINDOW_MS": "300",
+        "KA_DAEMON_MAX_INFLIGHT": "32",
+        "KA_DAEMON_REQUEST_TIMEOUT": "300",
+    }
+    try:
+        base_plan = _fresh_cli(snap, "PRINT_REASSIGNMENT")
+        base_whatif = _fresh_cli(snap, "RANK_DECOMMISSION")
+
+        daemon, port, stderr_lines = _start_daemon(
+            clusters, env, solver="tpu"
+        )
+        try:
+            # Warm-up: each endpoint solo, per cluster (program compiles
+            # and cache fills happen HERE; their solo fallbacks are
+            # expected and excluded by snapshotting counters after).
+            for cluster in ("a", "b"):
+                for path in ("/plan", "/whatif"):
+                    s, raw, _ = _req(
+                        port, "POST", f"/clusters/{cluster}{path}", {},
+                        timeout=600,
+                    )
+                    if s != 200:
+                        raise SystemExit(
+                            f"FAIL[warm]: {cluster}{path} http={s}: "
+                            f"{raw[:300]}"
+                        )
+            # One barrier round to compile the COALESCED (wider) batch
+            # buckets, then snapshot and measure the warm coalesced round.
+            _probe_round(port, base_plan, base_whatif)
+            fams0 = _scrape(port)
+            _probe_round(port, base_plan, base_whatif)
+            fams1 = _scrape(port)
+
+            solo0 = _counter(fams0, "ka_dispatch_solo_fallbacks_total")
+            solo1 = _counter(fams1, "ka_dispatch_solo_fallbacks_total")
+            if solo1 != solo0:
+                raise SystemExit(
+                    f"FAIL: dispatch.solo_fallbacks grew {solo0} -> "
+                    f"{solo1} across a healthy coalesced round (the "
+                    "dispatch plane stopped packing)"
+                )
+            b0 = _counter(fams0, "ka_dispatch_batches_total")
+            b1 = _counter(fams1, "ka_dispatch_batches_total")
+            if b1 - b0 < 4:
+                raise SystemExit(
+                    f"FAIL: dispatch.batches grew only {b0} -> {b1} "
+                    "across a 16-client round (expected >= 4: one "
+                    "body-dedup batch per cluster x endpoint plus the "
+                    "cross-cluster row groups)"
+                )
+            daemon.send_signal(signal.SIGTERM)
+            rc = daemon.wait(timeout=60)
+            if rc != 0:
+                raise SystemExit(
+                    f"FAIL: daemon exit {rc} after SIGTERM\n"
+                    + "".join(stderr_lines)
+                )
+        finally:
+            if daemon.poll() is None:
+                daemon.kill()
+    finally:
+        os.unlink(snap)
+    print(
+        "dispatch_load_probe: PASS (16 clients x 2 clusters byte-identical"
+        " under --solver tpu; zero solo fallbacks on the healthy coalesced"
+        " round; batches grew)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

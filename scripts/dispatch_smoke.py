@@ -19,7 +19,9 @@ What it proves, in a few seconds:
     serves (no new compile keys beyond the bucketed batch dimension);
 4.  ``/metrics`` stays parse-consistent (every histogram internally
     consistent, including ``ka_dispatch_batch_size`` and
-    ``ka_daemon_solve_queue_ms``);
+    ``ka_daemon_solve_queue_ms``) and carries the ISSUE 19 dispatch-plane
+    tuning telemetry (``ka_dispatch_queue_depth``,
+    ``ka_dispatch_window_ms``, ``ka_dispatch_pad_waste_frac``);
 5.  the ``KA_DISPATCH=0`` kill-switch restores the shared-lock regime
     byte-for-byte: a restarted daemon serves the same bytes with ZERO
     dispatch.* activity;
@@ -185,7 +187,13 @@ def main() -> int:
                         "warm coalesced round (per-request recompile!)"
                     )
             for fam in ("ka_dispatch_batch_size",
-                        "ka_daemon_solve_queue_ms"):
+                        "ka_daemon_solve_queue_ms",
+                        # ISSUE 19 tuning telemetry: live queue depth and
+                        # the adaptive gather window (gauges), padding
+                        # overhead per coalesced dispatch (histogram).
+                        "ka_dispatch_queue_depth",
+                        "ka_dispatch_window_ms",
+                        "ka_dispatch_pad_waste_frac"):
                 if fam not in fams1:
                     raise SystemExit(f"FAIL: {fam} missing from /metrics")
             daemon.send_signal(signal.SIGTERM)

@@ -100,11 +100,11 @@ def _gauge_labels(families, fam):
         fam, {"samples": []})["samples"]]
 
 
-def _start_daemon(clusters_spec, env):
+def _start_daemon(clusters_spec, env, solver="greedy"):
     daemon = subprocess.Popen(
         [sys.executable, "-c",
          "from kafka_assigner_tpu.cli import daemon_main; daemon_main()",
-         "--clusters", clusters_spec, "--solver", "greedy"],
+         "--clusters", clusters_spec, "--solver", solver],
         cwd=REPO, env=env, text=True,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
     )

@@ -2,8 +2,9 @@
 # The project lint gate: kalint (knob-registry + jit-boundary + write-path
 # + deadline + bulkhead + telemetry-name + metric-unit house rules, the
 # ISSUE 12 interprocedural taint/lock/bulkhead-reachability rules, plus
-# the ISSUE 16 thread-topology race/deadlock rules and the ISSUE 17
-# determinism-taint layer — KA001-KA028, smoke scripts swept too), the
+# the ISSUE 16 thread-topology race/deadlock rules, the ISSUE 17
+# determinism-taint layer and the ISSUE 19 dispatcher-seam rule —
+# KA001-KA029, smoke scripts swept too), the
 # README knob-table and rule-table drift checks,
 # the run-report fixture schema check, the fault-matrix smoke (one injected
 # fault per class — read, write AND daemon seams — strict + best-effort),
@@ -108,6 +109,12 @@ python scripts/groups_smoke.py
 # across a coalesced round (compile counters pinned), /metrics
 # parse-consistent, KA_DISPATCH=0 kill-switch parity, SIGTERM exit 0.
 python scripts/dispatch_smoke.py
+# Dispatch load probe (ISSUE 19): real two-cluster ka-daemon (--solver
+# tpu) under one 16-client barrier burst (/plan + /whatif per cluster) —
+# every response 200 + byte-identical to its fresh-process CLI baseline,
+# dispatch.batches grew, and zero solo fallbacks across the coalesced
+# round (the healthy path packs every job).
+python scripts/dispatch_load_probe.py
 # Closed-loop controller smoke (ISSUE 15): real two-cluster ka-daemon over
 # snapshots, one cluster controller=auto and one off — seeded imbalance
 # converges to an acted rebalance (complete journal, improved health

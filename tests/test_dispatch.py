@@ -377,6 +377,70 @@ def test_plan_leader_crash_isolates_followers(monkeypatch):
         d.close()
 
 
+def test_run_job_version_change_splits_followers(monkeypatch):
+    # ISSUE 19 bugfix: the dedup entry is stamped with the cache version
+    # observed at the leader's admission. An arrival that already observes
+    # a NEWER live version must never be served the stale leader's bytes —
+    # it waits the stale entry out and re-enters admission, while
+    # same-version arrivals keep deduping among themselves.
+    monkeypatch.setenv("KA_DISPATCH_WINDOW_MS", "5")
+    d = SolveDispatcher(err=io.StringIO())
+    try:
+        version = {"v": 1}
+        gate_v1, gate_v2 = threading.Event(), threading.Event()
+        ran = []
+
+        def make_fn(tag, gate):
+            def fn(out):
+                ran.append(tag)
+                if ran.count(tag) == 1:
+                    gate.wait(10)  # hold the first (leader) run
+                out.write(tag)
+                return False
+            return fn
+
+        fn_v1 = make_fn("V1", gate_v1)
+        fn_v2 = make_fn("V2", gate_v2)
+        outs = {i: io.StringIO() for i in range(4)}
+        results = {}
+
+        def one(i, fn):
+            results[i] = d.run_job(
+                "key", fn, outs[i], version=lambda: version["v"]
+            )
+
+        t0 = threading.Thread(target=one, args=(0, fn_v1))
+        t0.start()
+        time.sleep(0.2)  # leader admitted @v1, held at its gate
+        t1 = threading.Thread(target=one, args=(1, fn_v1))
+        t1.start()  # same-version arrival: joins the in-flight leader
+        time.sleep(0.2)
+        version["v"] = 2  # the resync lands mid-flight
+        t2 = threading.Thread(target=one, args=(2, fn_v2))
+        t3 = threading.Thread(target=one, args=(3, fn_v2))
+        t2.start()
+        t3.start()
+        time.sleep(0.3)
+        assert ran == ["V1"], \
+            "post-resync arrivals must not piggyback on the stale leader"
+        gate_v1.set()
+        time.sleep(0.3)  # both v2 arrivals re-admit under a fresh entry
+        gate_v2.set()
+        for t in (t0, t1, t2, t3):
+            t.join(timeout=30)
+        assert ran == ["V1", "V2"], \
+            "the v2 arrivals must dedup among themselves (one run)"
+        assert outs[0].getvalue() == "V1"
+        assert outs[1].getvalue() == "V1"
+        assert outs[2].getvalue() == "V2"
+        assert outs[3].getvalue() == "V2"
+        assert results[1] == (False, True)  # same-version follower
+        assert sorted(results[i][1] for i in (2, 3)) == [False, True], \
+            "one fresh leader + one follower under the NEW entry"
+    finally:
+        d.close()
+
+
 def test_batch_key_fingerprints_content_and_statics():
     a = np.arange(12, dtype=np.int32).reshape(3, 4)
     b = np.arange(12, dtype=np.int32).reshape(3, 4)
@@ -516,6 +580,163 @@ def test_cross_cluster_packing_zero_warm_recompiles(tmp_path, monkeypatch):
             "the two clusters' rows must have coalesced"
         assert misses1 == misses0, \
             "a warm coalesced dispatch must not recompile"
+
+
+# --- ISSUE 19: row-packable plans --------------------------------------------
+
+
+_PACK_SNAP = {
+    "brokers": [
+        {"id": i, "host": f"b{i}", "port": 9092, "rack": f"r{i % 2}"}
+        for i in range(4)
+    ],
+    "topics": {
+        "events": {str(p): [p % 4, (p + 1) % 4] for p in range(8)},
+        "logs": {str(p): [(p + 2) % 4, (p + 3) % 4] for p in range(3)},
+    },
+}
+
+
+def _barrier_round(port, names, path="/plan", timeout=300.0):
+    results = {}
+    barrier = threading.Barrier(len(names))
+
+    def one(name):
+        barrier.wait(timeout=60)
+        results[name] = req_json(
+            port, "POST", f"/clusters/{name}{path}", {}, timeout=timeout
+        )
+
+    threads = [threading.Thread(target=one, args=(n,)) for n in names]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    assert len(results) == len(names), "request(s) hung"
+    return results
+
+
+def test_cross_cluster_plan_rows_pack_and_stay_byte_identical(
+    tmp_path, monkeypatch
+):
+    # Two DISTINCT plans (different clusters -> different dedup keys, so
+    # body dedup cannot merge them) whose placement encodings are
+    # compatible: their placement rows must share ONE device dispatch
+    # while each response stays byte-identical to its solo CLI baseline,
+    # with zero fresh compiles on the warm coalesced round.
+    path = tmp_path / "cluster.json"
+    path.write_text(json.dumps(_PACK_SNAP))
+    monkeypatch.setenv("KA_DISPATCH_WINDOW_MS", "300")
+    base = fresh_cli(str(path), "--solver", "tpu")
+
+    with running_daemon({"a": str(path), "b": str(path)},
+                        solver="tpu") as d:
+        port = d.http_port
+        # Warm round: compiles (or store-loads) the coalesced bucket.
+        first = _barrier_round(port, ("a", "b"))
+        fams0 = scrape(port)
+        second = _barrier_round(port, ("a", "b"))
+        fams1 = scrape(port)
+        for results in (first, second):
+            for name, (status, body, _h) in results.items():
+                assert status == 200, (name, body)
+                assert body["result"]["stdout"] == base, name
+        assert (counter_total(fams1, "ka_dispatch_batches_total")
+                > counter_total(fams0, "ka_dispatch_batches_total")), \
+            "the two plans' placement rows must have shared a dispatch"
+        assert (counter_total(fams1, "ka_compile_store_misses_total")
+                == counter_total(fams0, "ka_compile_store_misses_total")), \
+            "a warm coalesced plan dispatch must not recompile"
+
+
+def test_incompatible_plan_statics_never_pack(tmp_path, monkeypatch):
+    # Clusters with different broker counts encode different placement
+    # statics: their rows share no compatibility class, so nothing may
+    # coalesce — each plan dispatches its own solo group and the bytes
+    # still match each cluster's own baseline.
+    snap_b = {
+        "brokers": [
+            {"id": i, "host": f"b{i}", "port": 9092, "rack": f"r{i % 3}"}
+            for i in range(6)
+        ],
+        "topics": {
+            "events": {str(p): [p % 6, (p + 1) % 6] for p in range(8)},
+            "logs": {str(p): [(p + 2) % 6, (p + 3) % 6] for p in range(3)},
+        },
+    }
+    path_a = tmp_path / "a.json"
+    path_b = tmp_path / "b.json"
+    path_a.write_text(json.dumps(_PACK_SNAP))
+    path_b.write_text(json.dumps(snap_b))
+    monkeypatch.setenv("KA_DISPATCH_WINDOW_MS", "300")
+    base_a = fresh_cli(str(path_a), "--solver", "tpu")
+    base_b = fresh_cli(str(path_b), "--solver", "tpu")
+
+    with running_daemon({"a": str(path_a), "b": str(path_b)},
+                        solver="tpu") as d:
+        port = d.http_port
+        results = _barrier_round(port, ("a", "b"))
+        for name, base in (("a", base_a), ("b", base_b)):
+            status, body, _h = results[name]
+            assert status == 200, (name, body)
+            assert body["result"]["stdout"] == base, name
+        fams = scrape(port)
+        assert counter_total(fams, "ka_dispatch_jobs_total") >= 2
+        assert counter_total(fams, "ka_dispatch_batches_total") == 0, \
+            "incompatible placement statics must never share a dispatch"
+
+
+def test_plan_batch_crash_degrades_only_that_batch(tmp_path, monkeypatch):
+    # A crash inside the coalesced placement dispatch costs retries,
+    # never responses: every job in the crashed batch re-runs its own
+    # rows solo and still serves bytes identical to the solo baseline,
+    # and the dispatcher thread survives for later requests.
+    path = tmp_path / "cluster.json"
+    path.write_text(json.dumps(_PACK_SNAP))
+    monkeypatch.setenv("KA_DISPATCH_WINDOW_MS", "300")
+    faults.install(faults.FaultInjector(faults.parse_spec(
+        "dispatch:0=crash"
+    )))
+    base = fresh_cli(str(path), "--solver", "tpu")
+
+    with running_daemon({"a": str(path), "b": str(path)},
+                        solver="tpu") as d:
+        port = d.http_port
+        results = _barrier_round(port, ("a", "b"))
+        inj = faults.active_injector()
+        assert [str(e) for e in inj.fired] == ["dispatch:0=crash"]
+        for name, (status, body, _h) in results.items():
+            assert status == 200, (name, body)
+            assert body["result"]["stdout"] == base, name
+        fams = scrape(port)
+        assert counter_total(fams, "ka_dispatch_solo_fallbacks_total") >= 2
+        # The dispatcher thread survived: a later plan keeps working.
+        status, body, _h = req_json(
+            port, "POST", "/clusters/a/plan", {}, timeout=300
+        )
+        assert status == 200 and body["result"]["stdout"] == base
+
+
+def test_kill_switch_plan_parity_under_tpu(tmp_path, monkeypatch):
+    # KA_DISPATCH=0 with --solver tpu: no broker is installed, so plans
+    # take the fused (unsplit) solve path under the shared lock — and
+    # must serve exactly the same bytes the routed plane serves.
+    monkeypatch.setenv("KA_DISPATCH", "0")
+    path = tmp_path / "cluster.json"
+    path.write_text(json.dumps(_PACK_SNAP))
+    base = fresh_cli(str(path), "--solver", "tpu")
+
+    with running_daemon({"a": str(path), "b": str(path)},
+                        solver="tpu") as d:
+        assert d.dispatcher is None
+        port = d.http_port
+        results = _barrier_round(port, ("a", "b"))
+        for name, (status, body, _h) in results.items():
+            assert status == 200, (name, body)
+            assert body["result"]["stdout"] == base, name
+        fams = scrape(port)
+        assert counter_total(fams, "ka_dispatch_jobs_total") == 0
+        assert counter_total(fams, "ka_dispatch_batches_total") == 0
 
 
 def test_kill_switch_restores_lock_semantics(server, monkeypatch):

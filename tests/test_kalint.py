@@ -1156,6 +1156,105 @@ def test_ka012_supervisor_itself_reading_backend_stays_legal(tmp_path):
     assert "KA012" not in rules_of(kalint.lint_tree(root))
 
 
+def test_ka029_daemon_handler_helper_jit_chain(tmp_path):
+    # ISSUE 19: a daemon handler reaching a *_jit device dispatch through
+    # a helper OUTSIDE the dispatcher seam bypasses the gather queue.
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "kern.py": (
+            "def _build():\n"
+            "    return lambda x: x\n\n\n"
+            "place_scan_narrow_jit = _build()\n"
+        ),
+        "helpers.py": (
+            "from .kern import place_scan_narrow_jit\n\n\n"
+            "def fast_place(rows):\n"
+            "    return place_scan_narrow_jit(rows)\n"
+        ),
+        "daemon/__init__.py": "",
+        "daemon/service.py": (
+            "from ..helpers import fast_place\n\n\n"
+            "def handle_plan(rows):\n"
+            "    return fast_place(rows)\n"
+        ),
+    })
+    findings = kalint.lint_tree(root)
+    ka029 = [f for f in findings if f.rule == "KA029"]
+    assert len(ka029) == 1
+    f = ka029[0]
+    assert f.path.endswith("helpers.py")
+    assert "place_scan_narrow_jit" in f.message
+    assert any("daemon/service.py::handle_plan" in hop for hop in f.chain)
+
+
+def test_ka029_direct_dispatch_and_store_entry_in_daemon_module(tmp_path):
+    # Both sink shapes inside a daemon module itself: a *_jit call and a
+    # store-backed _sweep_program entry acquisition.
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "kern.py": (
+            "def _build():\n"
+            "    return lambda x: x\n\n\n"
+            "score_batched_jit = _build()\n"
+        ),
+        "parallel/__init__.py": "",
+        "parallel/whatif.py": (
+            "def _sweep_program(name):\n"
+            "    return lambda block: block\n"
+        ),
+        "daemon/__init__.py": "",
+        "daemon/controller.py": (
+            "from ..kern import score_batched_jit\n"
+            "from ..parallel.whatif import _sweep_program\n\n\n"
+            "def tick(rows):\n"
+            "    return score_batched_jit(rows)\n\n\n"
+            "def hot_sweep(block):\n"
+            '    return _sweep_program("whatif_sweep")(block)\n'
+        ),
+    })
+    findings = kalint.lint_tree(root)
+    ka029 = [f for f in findings if f.rule == "KA029"]
+    assert len(ka029) == 2
+    msgs = " ".join(f.message for f in ka029)
+    assert "score_batched_jit" in msgs and "_sweep_program" in msgs
+    assert all(f.path.endswith("daemon/controller.py") for f in ka029)
+
+
+def test_ka029_clean_when_the_chain_passes_through_the_seam(tmp_path):
+    # The sanctioned shape: the handler reaches the device only through a
+    # bucket-boundary module (traversal stops AT the seam, and the seam's
+    # own *_jit dispatches are its business). wrap_jit is a program
+    # BUILDER, not a dispatch, and stays legal anywhere.
+    root = _write_tree(tmp_path, {
+        "__init__.py": "",
+        "kern.py": (
+            "def _build():\n"
+            "    return lambda x: x\n\n\n"
+            "place_scan_narrow_jit = _build()\n"
+        ),
+        "util.py": (
+            "def wrap_jit(name, fn):\n"
+            "    return fn\n"
+        ),
+        "solvers/__init__.py": "",
+        "solvers/tpu.py": (
+            "from ..kern import place_scan_narrow_jit\n\n\n"
+            "def assign_many(rows):\n"
+            "    return place_scan_narrow_jit(rows)\n"
+        ),
+        "daemon/__init__.py": "",
+        "daemon/service.py": (
+            "from ..solvers.tpu import assign_many\n"
+            "from ..util import wrap_jit\n\n\n"
+            "def handle_plan(rows):\n"
+            "    return assign_many(rows)\n\n\n"
+            "def warm(fn):\n"
+            '    return wrap_jit("warm", fn)\n'
+        ),
+    })
+    assert "KA029" not in rules_of(kalint.lint_tree(root))
+
+
 # --- suppressions on wrapped (multi-line) statements --------------------------
 
 def test_suppression_on_last_line_of_wrapped_call():
@@ -1319,7 +1418,7 @@ def test_ka011_helper_without_deadline_still_flagged():
 
 def test_rule_docs_cover_every_rule():
     assert set(kalint.RULE_DOCS) == set(kalint.RULES)
-    assert set(kalint.RULES) == {f"KA{n:03d}" for n in range(29)}
+    assert set(kalint.RULES) == {f"KA{n:03d}" for n in range(30)}
     for rule, (meaning, example) in kalint.RULE_DOCS.items():
         assert meaning and example, rule
 
