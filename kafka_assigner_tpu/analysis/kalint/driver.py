@@ -54,6 +54,7 @@ def _module_findings(
         + _r.check_ka011(tree, path)
         + _r.check_ka012(tree, relpath, path)
         + _r.check_ka013(tree, path, metric_names, span_names)
+        + _r.check_ka030(tree, relpath, path)
     )
 
 

@@ -75,6 +75,8 @@ RULES = {
     "KA029": "device dispatch (*_jit / store-backed program entry) "
              "reachable from a daemon handler outside the dispatcher "
              "seam",
+    "KA030": "fleet-ledger file referenced outside daemon/fleet.py "
+             "(the fleet admission bulkhead)",
 }
 
 #: One-line meaning + example offending chain per rule — the source of the
@@ -336,6 +338,17 @@ RULE_DOCS: Dict[str, Tuple[str, str]] = {
         "`daemon/service.py handle_plan` → `helper()` calling "
         "`place_scan_narrow_jit(...)` directly",
     ),
+    "KA030": (
+        "the fleet admission ledger (`ka-fleet.json`) is read and "
+        "written ONLY by `daemon/fleet.py` — the KA012 bulkhead posture "
+        "one layer up: any other package module naming the ledger file "
+        "(a string literal containing `ka-fleet`) can reach it behind "
+        "the FleetScheduler's back, bypassing the mutex + atomic "
+        "tmp+rename discipline that keeps daemon-wide lease and budget "
+        "accounting untearable",
+        "`open(os.path.join(jdir, \"ka-fleet.json\"))` in "
+        "`daemon/service.py`",
+    ),
 }
 
 #: Modules whose ENTIRE body is treated as traced kernel code (KA002): these
@@ -365,6 +378,16 @@ BULKHEAD_ATTRS = frozenset({"backend", "state"})
 #: The supervisor class whose internals the bulkhead protects: attribute
 #: reads on values of this type are cross-bulkhead wherever they happen.
 SUPERVISOR_CLASS = ("daemon/supervisor.py", "ClusterSupervisor")
+
+#: KA030: the fleet-ledger bulkhead. Any string literal containing this
+#: token names the fleet admission ledger file — only the fleet module
+#: may spell it (plus this rules module, which must spell the token to
+#: define and explain the rule).
+FLEET_LEDGER_TOKEN = "ka-fleet"
+FLEET_BULKHEAD_MODULE = "daemon/fleet.py"
+FLEET_TOKEN_EXEMPT_MODULES = frozenset({
+    FLEET_BULKHEAD_MODULE, "analysis/kalint/rules.py",
+})
 
 #: KA029: the dispatch-plane seam — the ONLY modules through which device
 #: dispatch (a ``*_jit`` program call, or a store-backed ``_program``/
@@ -1062,6 +1085,46 @@ def check_ka012(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
                 "belongs to daemon/supervisor.py — route through the "
                 "owning ClusterSupervisor's methods (handle, lifecycle, "
                 "state_view, healthz_view, counters, ...)",
+            ))
+    return out
+
+
+def check_ka030(tree: ast.AST, relpath: str, path: str) -> List[Finding]:
+    """The fleet-ledger bulkhead (the KA012 posture one layer up): a
+    string literal containing the ledger filename token anywhere but
+    ``daemon/fleet.py`` is a module positioned to read or write
+    ``ka-fleet.json`` behind the FleetScheduler's back — tearing the
+    daemon-wide lease/budget accounting its mutex + atomic-write
+    discipline exists to protect. Docstrings are exempt (prose that
+    EXPLAINS the ledger is not code that touches it)."""
+    if relpath in FLEET_TOKEN_EXEMPT_MODULES:
+        return []
+    doc_nodes = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = node.body
+            if body and isinstance(body[0], ast.Expr) \
+                    and isinstance(body[0].value, ast.Constant) \
+                    and isinstance(body[0].value.value, str):
+                doc_nodes.add(id(body[0].value))
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and FLEET_LEDGER_TOKEN in node.value
+            and id(node) not in doc_nodes
+        ):
+            out.append(Finding(
+                "KA030", path, node.lineno, node.col_offset + 1,
+                f"string literal {node.value!r} names the fleet "
+                "admission ledger outside the fleet bulkhead "
+                f"({FLEET_BULKHEAD_MODULE}): reading or writing "
+                "ka-fleet.json behind the FleetScheduler's back tears "
+                "the daemon-wide lease/budget accounting — route "
+                "through FleetScheduler methods (acquire, release, "
+                "charge, view, recover)",
             ))
     return out
 

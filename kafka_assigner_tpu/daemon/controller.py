@@ -70,7 +70,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 from ..faults.inject import InjectedExecCrash, controller_fault
 from ..io.json_io import format_reassignment_json
 from ..obs import flight
-from ..obs.metrics import gauge_set
+from ..obs.metrics import counter_add, gauge_set
 from ..obs.trace import record_span
 from ..utils.atomicwrite import atomic_write_text
 from ..utils.backoff import JitteredBackoff
@@ -81,6 +81,21 @@ DECISION_RING = 64
 
 #: The policy ladder, weakest to strongest.
 POLICIES = ("off", "observe", "auto")
+
+#: Schema version of the persisted verdict memory
+#: (``ka-controller-<cluster>.verdict.json``). Bump when the streak's
+#: MEANING changes (different fingerprint inputs, different confirmation
+#: semantics): a memory written under another version resets LOUDLY
+#: instead of silently vouching for confirmations it never made.
+VERDICT_MEMORY_VERSION = 1
+
+#: Schema version of the per-action record
+#: (``ka-controller-<cluster>-<sha12>.action.json``) the boot-time fleet
+#: recovery reads to finish an interrupted action the way THIS controller
+#: would have: the record carries the plan bytes (rollback needs the
+#: ``CURRENT ASSIGNMENT:`` snapshot, which lives nowhere else once the
+#: process dies) and whether the controller had already aborted.
+ACTION_RECORD_VERSION = 1
 
 
 def resolve_policy(override: Optional[str]) -> str:
@@ -178,6 +193,9 @@ class RebalanceController:
         #: under the journal dir so restarts keep the budget accounting.
         self._ledger: List[Tuple[float, int]] = []
         self._ledger_loaded = False
+        #: Persisted verdict memory (ISSUE 20 satellite): the hysteresis
+        #: streak survives a restart next to the window ledger.
+        self._memory_loaded = False
 
     # -- plumbing ------------------------------------------------------------
 
@@ -227,6 +245,7 @@ class RebalanceController:
         if self.policy == "off" or self._thread is not None:
             return
         self._load_ledger()
+        self._load_memory()
         # Daemon-wide tick alignment (ISSUE 19): the shared ticker's timer
         # thread also starts lazily here, so the zero-threads-under-off
         # guarantee extends to it.
@@ -385,6 +404,268 @@ class RebalanceController:
         self._save_ledger()
         self._window_moves()
 
+    # -- persisted verdict memory (ISSUE 20 satellite) -----------------------
+
+    def _memory_path(self) -> str:
+        jdir = env_str("KA_DAEMON_JOURNAL_DIR") or "."
+        return os.path.join(
+            jdir, f"ka-controller-{self.sup.name}.verdict.json"
+        )
+
+    def _load_memory(self) -> None:
+        """The hysteresis streak survives a restart: confirmations are a
+        property of the CLUSTER's recent verdicts, not of one process's
+        memory — a daemon bounce must not force a confirmed plan to
+        re-confirm from scratch (nor, worse, let an operator reset
+        hysteresis by bouncing the daemon). Same KA021 discipline as the
+        window ledger: idempotent, mutex-guarded lazy load. A memory
+        written under a DIFFERENT schema version resets loudly — its
+        confirmations were made under rules this controller no longer
+        runs."""
+        err: Optional[Exception] = None
+        stale: Optional[object] = None
+        with self._mutex:
+            if self._memory_loaded:
+                return
+            self._memory_loaded = True
+            path = self._memory_path()
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    raw = json.load(f)
+                if not isinstance(raw, dict):
+                    raise ValueError("not a JSON object")
+                if raw.get("version") != VERDICT_MEMORY_VERSION:
+                    stale = raw.get("version")
+                else:
+                    sha = raw.get("sha")
+                    self._last_sha = str(sha) if sha else None
+                    self._streak = (
+                        max(0, int(raw.get("streak", 0)))
+                        if self._last_sha is not None else 0
+                    )
+            except FileNotFoundError:  # kalint: disable=KA008 -- first boot: no memory to load IS the fresh-start state
+                pass
+            except (OSError, ValueError, TypeError) as e:
+                err = e
+        if stale is not None:
+            counter_add("fleet.memory_resets")
+            self._decide(
+                "memory-reset", found_version=stale,
+                expected_version=VERDICT_MEMORY_VERSION,
+            )
+            self._log(
+                f"verdict memory {self._memory_path()!r} was written "
+                f"under schema version {stale!r} (this controller runs "
+                f"{VERDICT_MEMORY_VERSION}); its confirmations no longer "
+                "mean the same thing — hysteresis restarts from scratch"
+            )
+        elif err is not None:
+            counter_add("fleet.memory_resets")
+            self._log(
+                f"verdict memory {self._memory_path()!r} unreadable "
+                f"({err}); hysteresis restarts from scratch"
+            )
+
+    def _save_memory(self) -> None:
+        """Write-through at every streak mutation: the file always says
+        what the in-memory hysteresis says, so a kill between ticks loses
+        at most nothing."""
+        with self._mutex:
+            payload = {
+                "version": VERDICT_MEMORY_VERSION,
+                "sha": self._last_sha,
+                "streak": self._streak,
+            }
+        try:
+            # kalint: disable=KA005 -- controller verdict memory, not a plan payload
+            atomic_write_text(
+                self._memory_path(),
+                json.dumps(payload, sort_keys=True),
+                prefix=".ka_controller_",
+            )
+        except OSError as e:
+            self._log(
+                f"verdict memory persist failed ({e}); hysteresis is "
+                "in-memory only until the next verdict"
+            )
+
+    # -- per-action records (the fleet recovery contract) --------------------
+
+    def _record_path(self, sha: str) -> str:
+        jdir = env_str("KA_DAEMON_JOURNAL_DIR") or "."
+        return os.path.join(
+            jdir, f"ka-controller-{self.sup.name}-{sha[:12]}.action.json"
+        )
+
+    def _write_action_record(self, sha: str, plan_text: str, moves: int,
+                             *, aborted: bool = False) -> None:
+        """Persist the action's identity BEFORE its first wave: if the
+        daemon dies mid-action, boot recovery needs the plan bytes (the
+        rollback anchor) and the abort decision — neither survives the
+        process otherwise. Written atomically, like everything else in
+        the journal dir."""
+        payload = {
+            "version": ACTION_RECORD_VERSION,
+            "cluster": self.sup.name,
+            "sha": sha,
+            "moves": int(moves),
+            "aborted": bool(aborted),
+            "plan_text": plan_text,
+        }
+        try:
+            # kalint: disable=KA005 -- controller action record, not a plan payload
+            atomic_write_text(
+                self._record_path(sha),
+                json.dumps(payload, sort_keys=True),
+                prefix=".ka_controller_",
+            )
+        except OSError as e:
+            self._log(
+                f"action record persist failed ({e}); a kill during this "
+                "action recovers under journal authority instead"
+            )
+
+    def load_action_record(self, sha: str) -> Optional[dict]:
+        """Read one action record back (boot recovery's view); None when
+        missing or unusable — recovery then falls back to journal
+        authority."""
+        path = self._record_path(sha)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                raw = json.load(f)
+            if not isinstance(raw, dict) \
+                    or raw.get("version") != ACTION_RECORD_VERSION \
+                    or not isinstance(raw.get("plan_text"), str):
+                raise ValueError("not a valid action record")
+            return raw
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError, TypeError) as e:
+            self._log(
+                f"action record {path!r} unusable ({e}); recovery falls "
+                "back to journal authority"
+            )
+            return None
+
+    def _discard_action_record(self, sha: str) -> None:
+        try:
+            os.unlink(self._record_path(sha))
+        except FileNotFoundError:  # kalint: disable=KA008 -- an already-gone record IS the goal state here
+            pass
+        except OSError as e:
+            self._log(
+                f"could not remove action record "
+                f"{self._record_path(sha)!r} ({e})"
+            )
+
+    def discard_superseded(self, sha: str) -> None:
+        """Drop an action's forward journal and record after a rollback
+        superseded them (boot recovery's cleanup when it resumed the
+        rollback under journal authority): the interrupted forward record
+        would otherwise block a future run of the same plan bytes behind
+        a refuse-to-clobber error."""
+        forward = self._journal_path(sha)
+        try:
+            os.unlink(forward)
+        except FileNotFoundError:  # kalint: disable=KA008 -- an already-gone journal IS the goal state here
+            pass
+        except OSError as e:
+            self._log(
+                f"could not remove superseded forward journal "
+                f"{forward!r} ({e})"
+            )
+        self._discard_action_record(sha)
+
+    def discard_orphan_records(self, active_shas) -> None:
+        """Boot-time sweep (called by the fleet recovery scan): drop
+        action records whose sha has NO in-progress journal left — the
+        kill landed before the journal existed (nothing moved), or after
+        the action completed but before its record cleanup. Either way
+        the record vouches for work that needs no recovery."""
+        jdir = env_str("KA_DAEMON_JOURNAL_DIR") or "."
+        prefix = f"ka-controller-{self.sup.name}-"
+        suffix = ".action.json"
+        try:
+            names = sorted(os.listdir(jdir))
+        except OSError:
+            return
+        for fname in names:
+            if not (fname.startswith(prefix) and fname.endswith(suffix)):
+                continue
+            sha = fname[len(prefix):-len(suffix)]
+            if len(sha) == 12 and sha not in active_shas:
+                self._log(
+                    f"dropping orphan action record {fname!r}: no "
+                    "in-progress journal references it (the action never "
+                    "moved a replica, or already completed)"
+                )
+                self._discard_action_record(sha)
+
+    def resume_recovery(
+        self, record: dict, journal_path: Optional[str], *,
+        what: str, moves: int = 0, probe=None, heartbeat=None,
+    ) -> dict:
+        """Finish an interrupted action the way this controller would
+        have (called by the fleet's boot-time recovery scan, which holds
+        the admission lease):
+
+        - ``what="rollback-resume"``: an in-flight rollback journal
+          completes (``journal_path`` is that journal);
+        - ``what="rollback-fresh"``: the record says the controller had
+          ABORTED but the kill landed before the rollback journal
+          existed — drive the record's ``CURRENT`` snapshot back through
+          the engine under a fresh rollback journal;
+        - ``what="forward"``: the interrupted forward run resumes to the
+          fully-verified plan (``journal_path`` is the forward journal).
+
+        On success the superseded files are cleaned up exactly as the
+        live paths would have, the window ledger charges the resumed
+        movement, and — for rollbacks — the controller breaker opens:
+        the plan FAILED before the kill, and a restart must not grant it
+        a fresh probe for free. ``InjectedExecCrash`` (the
+        ``fleet:recovery-crash`` seam) propagates to the caller: a crash
+        mid-recovery leaves the journal in-progress for the next boot."""
+        sha = str(record["sha"])
+        plan_text = record["plan_text"]
+        rollback = what in ("rollback-resume", "rollback-fresh")
+        if journal_path is None:
+            journal_path = self._journal_path(sha, rollback=True)
+
+        def _probe():
+            if heartbeat is not None:
+                heartbeat()
+            if probe is not None:
+                return probe()
+            return None
+
+        terminal = self.sup.controller_execute(
+            plan_text,
+            section="current" if rollback else "new",
+            journal=journal_path,
+            resume=what != "rollback-fresh",
+            probe=_probe,
+        )
+        if "refused" in terminal:
+            return terminal
+        ok = (
+            terminal.get("event") == "exec/done"
+            and terminal.get("status") in ("ok", "degraded")
+        )
+        self._decide(
+            "recovered" if ok else "recovery-failed", what=what,
+            plan_sha=sha[:12],
+            status=terminal.get("status") or terminal.get("kind"),
+        )
+        if ok:
+            self._record_moves(max(0, int(moves)))
+            self.sup.controller_refresh()
+            if rollback:
+                self.discard_superseded(sha)
+                self._breaker_open("recovered rollback")
+            else:
+                self._discard_action_record(sha)
+        return terminal
+
     # -- controller breaker --------------------------------------------------
 
     def breaker_view(self) -> dict:
@@ -443,6 +724,9 @@ class RebalanceController:
             return None
         if self.sup.draining.is_set() or self.sup.stopped.is_set():
             return None
+        # Harness paths drive tick() without start(): the persisted
+        # hysteresis must load before any streak compare touches it.
+        self._load_memory()
         lifecycle = self.sup.lifecycle()
         if lifecycle != "ready":
             # Degraded/syncing: the cache is suspect — advice computed
@@ -476,6 +760,7 @@ class RebalanceController:
                 self._streak = 0
                 self._last_sha = None
             gauge_set(self._metric("controller.streak"), 0)
+            self._save_memory()
             return self._decide(
                 "hold", reason="verdict hold", verdict=verdict,
                 flapped=flapped or None, improvement=ev["improvement"],
@@ -490,6 +775,7 @@ class RebalanceController:
                 self._last_sha = sha
             streak = self._streak
         gauge_set(self._metric("controller.streak"), streak)
+        self._save_memory()
         need = env_int("KA_CONTROLLER_CONFIRMATIONS")
         if streak < need:
             return self._decide(
@@ -554,6 +840,24 @@ class RebalanceController:
                 "truncate", moves=moves, cap=cap,
                 full_moves=ev["moves"], plan_sha=act_sha[:12],
             )
+        fleet = getattr(self.sup, "fleet", None)
+        if fleet is not None:
+            # Every cluster-local rail has passed — the action now needs
+            # a daemon-wide admission lease (ISSUE 20). A denial is a
+            # hold like any other: cooldown arms, the streak stays warm,
+            # and the fleet's own typed decision (deferred / budget-hold
+            # / preempted) is already in the flight trail.
+            status, info = fleet.acquire(
+                self.sup.name, moves=moves, sha=act_sha,
+                score=self.sup.health_score(),
+            )
+            if status != "granted":
+                self._arm_cooldown()
+                return self._decide(
+                    "hold", reason=f"fleet {status}",
+                    fleet_reason=info.get("reason"),
+                    winner=info.get("winner"),
+                )
         return self._act(ev, plan_text, moves, act_sha, projected)
 
     # -- acting --------------------------------------------------------------
@@ -580,6 +884,23 @@ class RebalanceController:
             half_open = self._breaker == "half-open"
         journal = self._journal_path(sha)
         achieved_box: Dict[str, object] = {}
+        fleet = getattr(self.sup, "fleet", None)
+        #: The admission lease won in tick() is released exactly once —
+        #: refunded on a single-flight refusal (no movement happened),
+        #: plainly dropped otherwise.
+        lease_box = {"held": fleet is not None}
+
+        def release_lease(refund: bool = False) -> None:
+            if lease_box["held"]:
+                lease_box["held"] = False
+                fleet.release(self.sup.name, refund=refund)
+
+        def probe():
+            # Wave boundaries double as lease heartbeats: a live action
+            # visibly progresses, so only a CRASHED holder ever expires.
+            if fleet is not None:
+                fleet.heartbeat(self.sup.name)
+            return controller_fault("exec-crash", self.sup.name)
 
         def on_start() -> None:
             # Admission won — execution is really about to begin. Only
@@ -592,6 +913,11 @@ class RebalanceController:
                 self._streak = 0
                 self._last_sha = None
             gauge_set(self._metric("controller.streak"), 0)
+            self._save_memory()
+            # The record persists the action's identity (plan bytes
+            # included — the rollback anchor) before the first wave: a
+            # kill from here on is recoverable at the next boot.
+            self._write_action_record(sha, plan_text, moves)
             self._decide(
                 "act", plan_sha=sha[:12], moves=moves,
                 probe=half_open or None,
@@ -612,9 +938,7 @@ class RebalanceController:
             try:
                 terminal = self.sup.controller_execute(
                     plan_text,
-                    probe=lambda: controller_fault(
-                        "exec-crash", self.sup.name
-                    ),
+                    probe=probe,
                     on_verified=on_verified,
                     on_start=on_start,
                     journal=journal,
@@ -634,7 +958,9 @@ class RebalanceController:
             if "refused" in terminal:
                 # Lost the single-flight race (or a drain began): not a
                 # failure of the plan — no rollback, no breaker, just
-                # hold and re-confirm later.
+                # hold and re-confirm later. The fleet grant is REFUNDED:
+                # no replica moved, so no budget was really spent.
+                release_lease(refund=True)
                 return self._decide(
                     "hold", reason=f"execute refused: {terminal['refused']}"
                 )
@@ -682,12 +1008,14 @@ class RebalanceController:
             ok = True
             if half_open:
                 self._breaker_close()
+            self._discard_action_record(sha)
             return self._decide(
                 "acted", plan_sha=sha[:12], moves=moves,
                 achieved=achieved.score if achieved is not None else None,
                 projected=projected.score, delta=delta,
             )
         finally:
+            release_lease()
             record_span(
                 self._metric("controller/act"),
                 (time.perf_counter() - t0) * 1e3, ok,
@@ -701,10 +1029,19 @@ class RebalanceController:
         ledger charges the rollback's movement too — undoing a rebalance
         is replica traffic like any other."""
         self._count("controller.rollbacks")
+        fleet = getattr(self.sup, "fleet", None)
+        # The abort decision persists BEFORE the rollback runs: a kill
+        # from here on must roll back at the next boot, not resume
+        # forward a plan this controller already condemned.
+        self._write_action_record(sha, plan_text, moves, aborted=True)
         try:
             terminal = self.sup.controller_execute(
                 plan_text, section="current",
                 journal=self._journal_path(sha, rollback=True),
+                probe=(
+                    (lambda: fleet.heartbeat(self.sup.name))
+                    if fleet is not None else None
+                ),
             )
         except InjectedExecCrash as e:
             terminal = {"event": "exec/error", "kind": "crash",
@@ -718,9 +1055,13 @@ class RebalanceController:
         )
         if rolled:
             # Same replica-move currency as the forward charge: undoing a
-            # rebalance is replica traffic like any other.
+            # rebalance is replica traffic like any other — the fleet
+            # window pays for it too.
             self._record_moves(moves)
+            if fleet is not None:
+                fleet.charge(self.sup.name, moves)
             self.sup.controller_refresh()
+            self._discard_action_record(sha)
             # The forward journal is superseded: its interrupted record
             # would otherwise block a future forward run of the same plan
             # bytes behind a refuse-to-clobber error.

@@ -1,0 +1,707 @@
+"""``FleetScheduler`` — daemon-wide crash-safe move-budget arbitration
+(ISSUE 20 tentpole).
+
+PR 15's per-cluster :class:`~.controller.RebalanceController` rails bound
+ONE cluster's blast radius; they are blind to each other. Two clusters
+sharing hardware (or one maintenance window) could fire heavy rebalances
+simultaneously, and a daemon kill mid-rollback stranded the retained
+journal until an operator ran ``ka-execute --resume`` by hand. This module
+closes both gaps with one daemon-wide scheduler, in the spirit of
+PAPERS.md's integrative reconfiguration (arXiv:1602.03770 — reconfigure as
+ONE system, not N uncoordinated loops) with action cost priced against
+disruption (arXiv:2402.06085):
+
+- **Admission leases**: every controller must win a lease here before
+  acting. At most ``KA_FLEET_MAX_CONCURRENT`` leases (default 1) are live
+  at once; contention resolves most-degraded-first by composite health
+  score (higher = worse; ties break on cluster name). A denial is a
+  flight-recorded ``fleet`` decision — ``deferred`` (slots full),
+  ``budget-hold`` (fleet window budget overspent) or ``preempted`` (a
+  worse-off cluster is waiting) — that the controller retries after its
+  cooldown with its hysteresis streak kept warm.
+- **Fleet move budget**: admitted actions charge their replica moves into
+  a rolling ``KA_FLEET_WINDOW`` ledger capped by ``KA_FLEET_MAX_MOVES`` —
+  the daemon's TOTAL concurrent blast radius, across every cluster.
+- **Crash safety**: leases and the budget ledger persist as one JSON file
+  (``ka-fleet.json`` in ``KA_DAEMON_JOURNAL_DIR``) with the same atomic
+  tmp+rename discipline as the controller's window ledger — a reader can
+  never observe torn bytes, and a daemon restart cannot reset the fleet
+  accounting. Leases are heartbeat-stamped at every wave boundary and
+  expire after ``KA_FLEET_LEASE_TTL`` without a heartbeat, so a crashed
+  holder never wedges the fleet.
+- **Startup recovery**: on daemon boot :meth:`recover` scans the journal
+  dir (sorted — the recovery plan is byte-stable across boots) for
+  incomplete forward/rollback journals owned by this daemon's clusters,
+  re-acquires their leases, and drives controller-owned resume: in-flight
+  rollbacks complete, aborted forward actions roll back, interrupted
+  forward actions (and orphaned client ``/execute`` journals — the
+  single-cluster bugfix) resume forward — so a ``kill -9`` at ANY wave
+  boundary converges, without operator intervention, to the pre-action
+  bytes or the fully-verified plan. Normal admissions are deferred
+  (``recovery pending``) until the scan completes: recovery owns the
+  fleet first.
+
+Chaos seams ``fleet:{lease-expire,ledger-torn,recovery-crash}``
+(``faults/inject.py``) drive the ``soak_fleet_matrix`` rows and
+``scripts/fleet_smoke.py``.
+
+Bulkhead discipline (kalint KA030, the KA012 posture one layer up): the
+fleet ledger file is read and written HERE and nowhere else — every other
+module goes through a :class:`FleetScheduler` method.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Deque, Dict, List, Optional, Tuple
+
+from ..faults.inject import InjectedExecCrash, fleet_fault
+from ..obs import flight
+from ..obs.metrics import counter_add, gauge_set
+from ..utils.atomicwrite import atomic_write_text
+from ..utils.env import env_float, env_int, env_str
+
+#: Fleet decision-history ring capacity (the ``GET /fleet`` view).
+FLEET_RING = 64
+
+#: The one ledger file per daemon (per journal dir). kalint KA030 pins
+#: every reference to this name inside this module.
+FLEET_LEDGER_BASENAME = "ka-fleet.json"
+
+FLEET_LEDGER_VERSION = 1
+
+
+class FleetScheduler:
+    """The daemon-wide admission arbiter: one instance per
+    :class:`~.service.AssignerDaemon`, shared by every cluster's
+    controller (via ``ClusterSupervisor.fleet``)."""
+
+    def __init__(self, err=None) -> None:
+        import sys
+
+        self.err = err if err is not None else sys.stderr
+        self._mutex = threading.Lock()
+        #: [(epoch seconds, moves, cluster)] — the rolling fleet budget.
+        self._actions: List[Tuple[float, int, str]] = []
+        #: cluster -> {"sha", "kind", "granted", "heartbeat"}.
+        self._leases: Dict[str, Dict[str, object]] = {}
+        self._loaded = False
+        #: Pending action intents, in-memory only (live controllers
+        #: re-announce every tick): cluster -> (score, monotonic ts).
+        self._wants: Dict[str, Tuple[Optional[float], float]] = {}
+        #: Admission opens once the boot-time recovery scan finished —
+        #: recovery owns the fleet first (set() even when the scan found
+        #: nothing; a daemon that never calls recover() never admits).
+        self._recovered = threading.Event()
+        self._recovery_summary: Dict[str, int] = {}
+        self._decisions: Deque[dict] = collections.deque(maxlen=FLEET_RING)
+        self._seq = 0
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _log(self, msg: str) -> None:
+        print(f"ka-daemon: fleet: {msg}", file=self.err)
+
+    def _decide(self, decision: str, cluster: Optional[str],
+                **fields) -> dict:
+        """One fleet decision: ring entry + flight ``fleet`` event (the
+        machine-visible trail the chaos rows and ``GET /fleet`` read)."""
+        clean = {k: v for k, v in fields.items() if v is not None}
+        with self._mutex:
+            self._seq += 1
+            entry = {
+                "seq": self._seq,
+                "t": round(time.time(), 3),
+                "decision": decision,
+            }
+            if cluster is not None:
+                entry["cluster"] = cluster
+            entry.update(clean)
+            self._decisions.append(entry)
+        # "kind" (the lease kind) collides with flight.record's first
+        # parameter; travel it as lease_kind on the flight event.
+        ev = dict(clean)
+        if "kind" in ev:
+            ev["lease_kind"] = ev.pop("kind")
+        flight.record("fleet", cluster, decision=decision, **ev)
+        return entry
+
+    # -- the persisted ledger (leases + rolling fleet budget) ----------------
+
+    def _ledger_path(self) -> str:
+        jdir = env_str("KA_DAEMON_JOURNAL_DIR") or "."
+        return os.path.join(jdir, FLEET_LEDGER_BASENAME)
+
+    def _load(self) -> None:
+        """Idempotent, mutex-guarded lazy load (the controller window
+        ledger's KA021 discipline): admission threads and the HTTP view
+        all lazy-load on first touch, and an unguarded check-then-act
+        could double-load, the second assignment clobbering a grant that
+        landed in between. A missing ledger starts fresh silently; a
+        corrupt one (or the ``fleet:ledger-torn`` seam) starts fresh
+        LOUDLY — torn bytes must never be half-trusted."""
+        err: Optional[str] = None
+        with self._mutex:
+            if self._loaded:
+                return
+            self._loaded = True
+            path = self._ledger_path()
+            try:
+                if fleet_fault("ledger-torn"):
+                    raise ValueError("injected fault: ledger read as torn")
+                with open(path, "r", encoding="utf-8") as f:
+                    raw = json.load(f)
+                if not isinstance(raw, dict) \
+                        or raw.get("version") != FLEET_LEDGER_VERSION:
+                    raise ValueError(
+                        f"unsupported ledger version "
+                        f"{raw.get('version') if isinstance(raw, dict) else '?'!r}"
+                    )
+                self._actions = [
+                    (float(t), int(n), str(c))
+                    for t, n, c in raw.get("actions", [])
+                ]
+                self._leases = {
+                    str(c): {
+                        "sha": str(l["sha"]),
+                        "kind": str(l.get("kind", "action")),
+                        "granted": float(l["granted"]),
+                        "heartbeat": float(l["heartbeat"]),
+                    }
+                    for c, l in raw.get("leases", {}).items()
+                }
+            except FileNotFoundError:
+                self._actions, self._leases = [], {}
+            except (OSError, ValueError, TypeError, KeyError) as e:
+                self._actions, self._leases = [], {}
+                err = str(e)
+        if err is not None:
+            self._log(
+                f"ledger {self._ledger_path()!r} unreadable ({err}); "
+                "fleet accounting restarts empty"
+            )
+
+    def _save_locked(self) -> Tuple[str, str]:
+        """Snapshot the ledger payload under the caller-held mutex;
+        returns ``(path, text)`` for the atomic write OUTSIDE the lock
+        (file I/O must never serialize admission checks)."""
+        payload = {
+            "version": FLEET_LEDGER_VERSION,
+            "actions": [[t, n, c] for t, n, c in self._actions],
+            "leases": {c: dict(l) for c, l in self._leases.items()},
+        }
+        # kalint: disable=KA005 -- fleet admission ledger, not a plan payload
+        return self._ledger_path(), json.dumps(payload, sort_keys=True)
+
+    def _persist(self, path: str, text: str) -> None:
+        try:
+            atomic_write_text(path, text, prefix=".ka_fleet_")
+        except OSError as e:
+            self._log(
+                f"ledger persist failed ({e}); fleet accounting is "
+                "in-memory only until the next admission event"
+            )
+
+    def _prune_locked(self, now: float) -> None:
+        """Drop window-expired budget entries and TTL-expired leases
+        (caller holds the mutex). The ``fleet:lease-expire`` seam expires
+        every live lease as if its holder stopped heartbeating a TTL ago
+        — the crashed-holder path, compressed to now."""
+        # kalint: disable=KA025 -- pruning horizon: compared against ledger timestamps, never serialized (the ledger's own stamps are the declared ts field)
+        horizon = time.time() - env_float("KA_FLEET_WINDOW")
+        self._actions = [
+            (t, n, c) for t, n, c in self._actions if t >= horizon
+        ]
+        ttl = env_float("KA_FLEET_LEASE_TTL")
+        # kalint: disable=KA025 -- lease-expiry horizon: compared against heartbeat stamps, never serialized
+        stale_before = time.time() - ttl
+        expired = [
+            c for c, l in self._leases.items()
+            if float(l["heartbeat"]) < stale_before
+        ]
+        for c in expired:
+            del self._leases[c]
+        if expired:
+            counter_add("fleet.lease_expired", len(expired))
+        self._expired_last = expired
+
+    def _gauges_locked(self) -> None:
+        gauge_set("fleet.leases", len(self._leases))
+        gauge_set(
+            "fleet.window_moves", sum(n for _t, n, _c in self._actions)
+        )
+
+    # -- the admission lease API ---------------------------------------------
+
+    def acquire(
+        self, cluster: str, *,
+        moves: int,
+        sha: str,
+        score: Optional[float] = None,
+        kind: str = "action",
+    ) -> Tuple[str, dict]:
+        """One admission request: returns ``("granted", lease)`` or a
+        typed denial ``("deferred"|"budget-hold"|"preempted", info)``.
+        A grant reserves ``moves`` against the fleet window budget
+        IMMEDIATELY (conservative accounting: a crash mid-action has
+        already moved replicas) and persists the lease before returning —
+        the ledger on disk never under-reports what the fleet admitted.
+
+        ``kind="recovery"`` is the boot-time scan re-acquiring a crashed
+        run's lease: it bypasses the recovery gate (it IS the recovery),
+        the budget denial (finishing a half-done reassignment restores
+        safety — refusing would wedge the journal forever) and the
+        priority contest (the scan is serial), but still records its
+        charge so post-recovery forward actions see the spent budget."""
+        self._load()
+        now_mono = time.monotonic()
+        recovery = kind == "recovery"
+        expired: List[str] = []
+        with self._mutex:
+            if not recovery:
+                self._wants[cluster] = (score, now_mono)
+            if not recovery and not self._recovered.is_set():
+                status, info = "deferred", {"reason": "recovery pending"}
+            else:
+                if fleet_fault("lease-expire", cluster):
+                    for c in list(self._leases):
+                        del self._leases[c]
+                        expired.append(c)
+                    counter_add("fleet.lease_expired", len(expired))
+                self._prune_locked(now_mono)
+                expired.extend(self._expired_last)
+                status, info = self._admit_locked(
+                    cluster, moves=moves, sha=sha, score=score,
+                    kind=kind, now_mono=now_mono, recovery=recovery,
+                )
+            if status == "granted":
+                self._gauges_locked()
+            path, text = self._save_locked()
+        for c in expired:
+            self._log(
+                f"lease held by {c!r} expired (no heartbeat inside "
+                "KA_FLEET_LEASE_TTL); the slot moves on — if that holder "
+                "is alive its release will be a no-op"
+            )
+            self._decide("lease-expired", c)
+        if status == "granted":
+            counter_add("fleet.grants")
+            self._persist(path, text)
+        elif status == "preempted":
+            counter_add("fleet.preemptions")
+            counter_add("fleet.deferrals")
+        else:
+            counter_add("fleet.deferrals")
+        extra = {
+            k: v for k, v in info.items()
+            if k not in ("sha", "kind", "granted", "heartbeat", "holders")
+        }
+        self._decide(
+            status, cluster, sha=sha[:12] if sha else None,
+            moves=moves, kind=None if kind == "action" else kind, **extra,
+        )
+        return status, info
+
+    def _admit_locked(
+        self, cluster: str, *, moves: int, sha: str,
+        score: Optional[float], kind: str, now_mono: float,
+        recovery: bool,
+    ) -> Tuple[str, dict]:
+        """The admission ladder (caller holds the mutex): concurrency →
+        priority → budget. Returns the typed outcome; a grant mutates the
+        lease table and charges the budget."""
+        cap = env_int("KA_FLEET_MAX_CONCURRENT")
+        held = cluster in self._leases
+        if not held and len(self._leases) >= cap and not recovery:
+            return "deferred", {
+                "reason": "concurrency cap",
+                "holders": sorted(self._leases),
+                "max_concurrent": cap,
+            }
+        if not recovery:
+            # Most-degraded-first: the freshest want with the WORST
+            # composite health score (higher = worse) wins the slot; ties
+            # break on cluster name so contention resolves one way, every
+            # time. Wants age out after a few tick intervals — a cluster
+            # that stopped asking must not block the fleet.
+            horizon = 3.0 * env_float("KA_CONTROLLER_INTERVAL")
+            self._wants = {
+                c: (s, t) for c, (s, t) in self._wants.items()
+                if now_mono - t <= horizon
+            }
+            contenders = [
+                (s if s is not None else float("-inf"), c)
+                for c, (s, _t) in self._wants.items()
+                if c not in self._leases
+            ]
+            if contenders:
+                worst_score, worst = max(contenders)
+                if worst != cluster:
+                    return "preempted", {
+                        "reason": "a worse-off cluster is waiting",
+                        "winner": worst,
+                        "winner_score": (
+                            None if worst_score == float("-inf")
+                            else round(worst_score, 6)
+                        ),
+                        "score": (
+                            round(score, 6) if score is not None else None
+                        ),
+                    }
+        max_moves = env_int("KA_FLEET_MAX_MOVES")
+        window = sum(n for _t, n, _c in self._actions)
+        if window + moves > max_moves and not recovery:
+            return "budget-hold", {
+                "reason": "fleet window budget",
+                "window_moves": window,
+                "requested": moves,
+                "max_moves": max_moves,
+            }
+        now = time.time()
+        lease = {
+            "sha": sha, "kind": kind,
+            "granted": round(now, 3), "heartbeat": round(now, 3),
+        }
+        self._leases[cluster] = lease
+        if moves > 0:
+            self._actions.append((round(now, 3), int(moves), cluster))
+        self._wants.pop(cluster, None)
+        return "granted", dict(lease)
+
+    def heartbeat(self, cluster: str) -> None:
+        """Stamp the holder's lease (called at every execution wave
+        boundary): a live action visibly progresses, so only a CRASHED
+        holder ever ages past ``KA_FLEET_LEASE_TTL``. A heartbeat against
+        a lease that already expired is a loud no-op — the slot has moved
+        on and this holder's release will be one too."""
+        self._load()
+        ts = round(time.time(), 3)
+        with self._mutex:
+            lease = self._leases.get(cluster)
+            if lease is not None:
+                lease["heartbeat"] = ts
+            path, text = self._save_locked()
+        if lease is not None:
+            self._persist(path, text)
+
+    def release(self, cluster: str, *, refund: bool = False) -> bool:
+        """Drop the holder's lease. ``refund=True`` returns the grant's
+        reserved moves (the action never started — a single-flight
+        refusal must not burn fleet budget). Returns False — loudly —
+        when no lease was held (it expired under a live holder, or was
+        already released): idempotent by design, the crashed-holder
+        sweep's other half."""
+        self._load()
+        with self._mutex:
+            lease = self._leases.pop(cluster, None)
+            if lease is not None and refund:
+                granted = float(lease["granted"])
+                for i in range(len(self._actions) - 1, -1, -1):
+                    t, _n, c = self._actions[i]
+                    if c == cluster and t >= granted:
+                        del self._actions[i]
+                        break
+            self._wants.pop(cluster, None)
+            self._gauges_locked()
+            path, text = self._save_locked()
+        self._persist(path, text)
+        if lease is None:
+            self._log(
+                f"release by {cluster!r} found no lease (expired or "
+                "already released); nothing to do"
+            )
+            return False
+        self._decide(
+            "released", cluster, refunded=refund or None,
+            kind=(None if lease.get("kind") == "action"
+                  else lease.get("kind")),
+        )
+        return True
+
+    def charge(self, cluster: str, moves: int) -> None:
+        """Charge extra movement to the fleet window mid-lease (the
+        controller's rollback path: undoing a rebalance is replica
+        traffic like any other)."""
+        if moves <= 0:
+            return
+        self._load()
+        ts = round(time.time(), 3)
+        with self._mutex:
+            self._actions.append((ts, int(moves), cluster))
+            self._gauges_locked()
+            path, text = self._save_locked()
+        self._persist(path, text)
+
+    # -- startup recovery ----------------------------------------------------
+
+    def recover(self, supervisors: Dict[str, object]) -> Dict[str, int]:
+        """The boot-time recovery scan (ISSUE 20): enumerate this
+        daemon's incomplete journals, re-acquire their leases, and drive
+        controller-owned resume so a ``kill -9`` at any wave boundary
+        converges without an operator ``ka-execute --resume``:
+
+        - an in-flight ROLLBACK journal completes (its frozen moves ARE
+          the pre-action assignment), superseding its forward twin;
+        - a forward controller journal whose action record says the
+          controller had already ABORTED rolls back (breaker-open
+          semantics survive the kill via the persisted record);
+        - any other in-progress forward/execute journal resumes forward
+          to the fully-verified plan — including the orphaned client
+          ``/execute`` journal a restarted daemon used to ignore until a
+          client passed ``resume=1`` (the single-cluster bugfix), which
+          resumes under journal authority (the plan bytes are gone; the
+          journal's frozen moves are the run).
+
+        Boot-stale leases of this daemon's clusters are swept first: no
+        other live process may hold them (one daemon per journal dir,
+        the controller window ledger's own assumption). Runs serially;
+        every outcome is flight-recorded. A resume killed by the
+        ``fleet:recovery-crash`` seam (or any crash) leaves its journal
+        in-progress for the NEXT boot — the scan is idempotent."""
+        from ..exec.journal import scan_journal_dir
+
+        jdir = env_str("KA_DAEMON_JOURNAL_DIR") or "."
+        summary = {"resumed": 0, "rolled_back": 0, "failed": 0,
+                   "skipped": 0}
+        self._load()
+        with self._mutex:
+            stale = [c for c in self._leases if c in supervisors]
+            for c in stale:
+                del self._leases[c]
+            self._gauges_locked()
+            path, text = self._save_locked()
+        if stale:
+            counter_add("fleet.lease_expired", len(stale))
+            self._persist(path, text)
+            self._log(
+                f"swept {len(stale)} boot-stale lease(s) "
+                f"({', '.join(sorted(stale))}) — no other process may "
+                "hold this daemon's clusters"
+            )
+        try:
+            scan = scan_journal_dir(jdir, sorted(supervisors))
+            for name in sorted(scan):
+                self._recover_cluster(
+                    name, supervisors[name], scan[name], summary,
+                )
+        finally:
+            # Admission opens even when the scan failed half-way: the
+            # journals it could not finish stay on disk for the next
+            # boot, and wedging the WHOLE fleet on one bad journal would
+            # invert the availability contract.
+            self._recovery_summary = dict(summary)
+            self._recovered.set()
+        if any(summary.values()):
+            self._log(
+                "recovery scan: "
+                f"{summary['resumed']} resumed, "
+                f"{summary['rolled_back']} rolled back, "
+                f"{summary['failed']} failed (retained for next boot), "
+                f"{summary['skipped']} skipped"
+            )
+        self._decide("recovery-done", None, **summary)
+        return summary
+
+    def _recover_cluster(self, name: str, sup, entries: List[dict],
+                         summary: Dict[str, int]) -> None:
+        """Drive one cluster's recovery plan, controller journals first
+        (their rollback/forward pairing carries abort semantics), then
+        orphaned client ``/execute`` journals."""
+        from ..exec.journal import ExecutionJournal, JournalError
+
+        by_sha: Dict[str, Dict[str, dict]] = {}
+        executes: List[dict] = []
+        for entry in entries:
+            try:
+                journal = ExecutionJournal.load(entry["path"])
+            except JournalError as e:
+                self._log(
+                    f"[{name}] journal {entry['path']!r} unusable ({e}); "
+                    "left in place for an operator"
+                )
+                summary["skipped"] += 1
+                continue
+            if journal.cluster is not None and journal.cluster != sup.spec:
+                self._log(
+                    f"[{name}] journal {entry['path']!r} belongs to a "
+                    f"DIFFERENT cluster ({journal.cluster!r}); left "
+                    "untouched"
+                )
+                summary["skipped"] += 1
+                continue
+            if journal.status != "in-progress":
+                continue
+            entry = dict(entry, journal=journal)
+            if entry["kind"] == "execute":
+                executes.append(entry)
+            else:
+                by_sha.setdefault(entry["sha"], {})[entry["kind"]] = entry
+        for sha in sorted(by_sha):
+            self._recover_action(name, sup, sha, by_sha[sha], summary)
+        for entry in executes:
+            self._recover_execute(name, sup, entry, summary)
+        # Records whose journal is gone (the kill landed before wave 0)
+        # or already complete vouch for work that needs no recovery.
+        sup.controller.discard_orphan_records(set(by_sha))
+
+    def _remaining_moves(self, journal) -> int:
+        return max(
+            0,
+            len(journal.moves) - journal.waves_committed * journal.wave_size,
+        )
+
+    def _resume_outcome(self, name: str, terminal: dict,
+                        summary: Dict[str, int], what: str) -> bool:
+        ok = (
+            terminal.get("event") == "exec/done"
+            and terminal.get("status") in ("ok", "degraded")
+        )
+        if ok:
+            counter_add("fleet.recoveries")
+            summary["rolled_back" if what == "rollback" else "resumed"] += 1
+        else:
+            counter_add("fleet.recovery_failures")
+            summary["failed"] += 1
+            why = (
+                terminal.get("refused") or terminal.get("message")
+                or terminal.get("status") or "unknown"
+            )
+            self._log(
+                f"[{name}] {what} recovery did not complete ({why}); "
+                "journal retained — the next boot retries"
+            )
+        self._decide(
+            "recovered" if ok else "recovery-failed", name, what=what,
+            status=terminal.get("status") or terminal.get("kind")
+            or terminal.get("refused"),
+        )
+        return ok
+
+    def _recover_action(self, name: str, sup, sha: str,
+                        pair: Dict[str, dict],
+                        summary: Dict[str, int]) -> None:
+        """One interrupted controller action: complete its rollback if
+        one was in flight (or the record says the controller had aborted),
+        else resume the forward run."""
+        record = sup.controller.load_action_record(sha)
+        rollback = pair.get("rollback")
+        forward = pair.get("forward")
+        anchor = rollback or forward
+        remaining = self._remaining_moves(anchor["journal"])
+        self.acquire(
+            name, moves=remaining, sha=anchor["journal"].plan_hash,
+            kind="recovery",
+        )
+        try:
+            probe = lambda: fleet_fault("recovery-crash", name)  # noqa: E731
+            heartbeat = lambda: self.heartbeat(name)  # noqa: E731
+            if rollback is not None and record is not None:
+                terminal = sup.controller.resume_recovery(
+                    record, rollback["path"], what="rollback-resume",
+                    moves=remaining, probe=probe, heartbeat=heartbeat,
+                )
+                self._resume_outcome(name, terminal, summary, "rollback")
+            elif rollback is not None:
+                # The record is gone but the rollback journal itself
+                # froze every move: journal-authority resume, then drop
+                # the superseded forward twin.
+                terminal = sup.recover_journal(
+                    rollback["path"], probe=probe, heartbeat=heartbeat,
+                )
+                if self._resume_outcome(name, terminal, summary,
+                                        "rollback"):
+                    sup.controller.discard_superseded(sha)
+            elif record is not None and record.get("aborted"):
+                # The controller had DECIDED to roll back (the abort
+                # persisted before the kill): honor that decision — the
+                # record's CURRENT snapshot drives back through the
+                # engine under a fresh rollback journal.
+                terminal = sup.controller.resume_recovery(
+                    record, None, what="rollback-fresh",
+                    moves=remaining, probe=probe, heartbeat=heartbeat,
+                )
+                self._resume_outcome(name, terminal, summary, "rollback")
+            elif record is not None:
+                terminal = sup.controller.resume_recovery(
+                    record, forward["path"], what="forward",
+                    moves=remaining, probe=probe, heartbeat=heartbeat,
+                )
+                self._resume_outcome(name, terminal, summary, "forward")
+            else:
+                # Pre-record forward journal (or the record was lost):
+                # journal-authority forward resume, like an orphan.
+                terminal = sup.recover_journal(
+                    forward["path"], probe=probe, heartbeat=heartbeat,
+                )
+                self._resume_outcome(name, terminal, summary, "forward")
+        except InjectedExecCrash as e:
+            counter_add("fleet.recovery_failures")
+            summary["failed"] += 1
+            self._log(
+                f"[{name}] recovery resume crashed at a wave boundary "
+                f"({e}); journal retained — the next boot retries"
+            )
+            self._decide("recovery-failed", name, what="crash")
+        finally:
+            self.release(name)
+
+    def _recover_execute(self, name: str, sup, entry: dict,
+                         summary: Dict[str, int]) -> None:
+        """One orphaned client ``/execute`` journal (the bugfix): the
+        plan bytes left with the client, so the resume runs under journal
+        authority — the frozen moves ARE the run."""
+        journal = entry["journal"]
+        self.acquire(
+            name, moves=self._remaining_moves(journal),
+            sha=journal.plan_hash, kind="recovery",
+        )
+        try:
+            terminal = sup.recover_journal(
+                entry["path"],
+                probe=lambda: fleet_fault("recovery-crash", name),
+                heartbeat=lambda: self.heartbeat(name),
+            )
+            self._resume_outcome(name, terminal, summary, "execute")
+        except InjectedExecCrash as e:
+            counter_add("fleet.recovery_failures")
+            summary["failed"] += 1
+            self._log(
+                f"[{name}] orphan resume crashed at a wave boundary "
+                f"({e}); journal retained — the next boot retries"
+            )
+            self._decide("recovery-failed", name, what="crash")
+        finally:
+            self.release(name)
+
+    # -- introspection -------------------------------------------------------
+
+    def recovered(self) -> bool:
+        return self._recovered.is_set()
+
+    def view(self) -> dict:
+        """The ``GET /fleet`` body: live leases, the rolling budget, the
+        recovery summary, and the fleet decision ring."""
+        self._load()
+        with self._mutex:
+            self._prune_locked(time.monotonic())
+            leases = {c: dict(l) for c, l in self._leases.items()}
+            window_moves = sum(n for _t, n, _c in self._actions)
+            decisions = list(self._decisions)
+            summary = dict(self._recovery_summary)
+        return {
+            "recovered": self._recovered.is_set(),
+            "recovery": summary or None,
+            "max_concurrent": env_int("KA_FLEET_MAX_CONCURRENT"),
+            "lease_ttl_s": env_float("KA_FLEET_LEASE_TTL"),
+            "leases": leases,
+            "window": {
+                "seconds": env_float("KA_FLEET_WINDOW"),
+                "max_moves": env_int("KA_FLEET_MAX_MOVES"),
+                "moves": window_moves,
+            },
+            "last_decision": decisions[-1] if decisions else None,
+            "decisions": decisions,
+        }

@@ -144,6 +144,7 @@ from ..obs import metrics as obs_metrics
 from ..obs.report import REPORT_SCHEMA_VERSION, TOOL_NAME, AccessLog
 from .controller import SharedTicker
 from .dispatch import SolveDispatcher
+from .fleet import FleetScheduler
 from .supervisor import POLL_S, ClusterSupervisor
 
 #: The implicit cluster name of a single-cluster (``--zk_string``) daemon.
@@ -333,6 +334,12 @@ class AssignerDaemon:
             )
             for name, (connect, controller_policy) in normalized.items()
         }
+        #: The daemon-wide admission arbiter (ISSUE 20): one crash-safe
+        #: move-budget ledger and lease table shared by every cluster's
+        #: controller; also owns the boot-time journal recovery scan.
+        self.fleet = FleetScheduler(err=self.err)
+        for sup in self.supervisors.values():
+            sup.fleet = self.fleet
         self.httpd: Optional[HTTPServer] = None
         self._serve_thread: Optional[threading.Thread] = None
 
@@ -397,6 +404,12 @@ class AssignerDaemon:
         prebuild_native_libraries(err=self.err)
         for sup in self.supervisors.values():
             sup.start(require_sync=self.single)
+        # Boot-time crash recovery (ISSUE 20): synchronous, BEFORE the
+        # HTTP surface exists — incomplete journals from a killed daemon
+        # (controller actions mid-wave, mid-rollback, or orphaned client
+        # /execute runs) resume to convergence first; controllers defer
+        # ("recovery pending") until the scan completes.
+        self.fleet.recover(self.supervisors)
         self.httpd = _build_http_server(self, self.bind, self.port)
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever,
@@ -751,6 +764,12 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
             if path == "/debug/profile":
                 self._debug_profile(split.query)
                 return
+            if path == "/fleet":
+                # Daemon-level by nature (like /metrics): the fleet is
+                # ONE arbiter across every cluster, single-mode included.
+                self._endpoint = "fleet"
+                self._reply(200, daemon.fleet.view())
+                return
             routed = self._route(path)
             if routed is None:
                 return
@@ -767,8 +786,15 @@ def _build_http_server(daemon: AssignerDaemon, bind: str,
                         None if ready else {"Retry-After": "5"},
                     )
                 elif suffix == "/state":
+                    fv = daemon.fleet.view()
                     self._reply(200, {
                         "lifecycle": daemon.lifecycle(),
+                        "fleet": {
+                            k: fv[k] for k in (
+                                "recovered", "leases", "window",
+                                "max_concurrent",
+                            )
+                        },
                         "clusters": {
                             n: s.state_view()
                             for n, s in daemon.supervisors.items()

@@ -272,6 +272,11 @@ class ClusterSupervisor:
         #: constructed supervisors (unit tests) — the controller then
         #: falls back to its own interval timer.
         self._ticker = ticker
+        #: The daemon-wide admission arbiter (ISSUE 20): set by
+        #: AssignerDaemon after construction; None for directly
+        #: constructed supervisors (unit tests) — the controller then
+        #: acts ungated, exactly the pre-fleet behavior.
+        self.fleet = None
         #: The closed-loop rebalance controller (ISSUE 15): one per
         #: cluster, policy from the per-cluster ``--clusters`` override or
         #: the KA_CONTROLLER knob (default off — an explicit opt-in; under
@@ -811,6 +816,16 @@ class ClusterSupervisor:
             watchdog_timer.cancel()
             self._release()
 
+    def health_score(self) -> Optional[float]:
+        """The last composite health score (lower = healthier), or None
+        before the first evaluation — the fleet's most-degraded-first
+        priority key (bulkhead accessor: the controller and the fleet
+        never touch ``_last_health`` directly)."""
+        return (
+            self._last_health.score
+            if self._last_health is not None else None
+        )
+
     def controller_execute(
         self, plan_text: str, *,
         section: str = "new",
@@ -818,6 +833,7 @@ class ClusterSupervisor:
         on_verified=None,
         on_start=None,
         journal: Optional[str] = None,
+        resume: bool = False,
     ) -> dict:
         """Dispatch one controller action (or rollback,
         ``section="current"``) through the SAME supervised single-flight
@@ -832,6 +848,11 @@ class ClusterSupervisor:
         params: dict = {"plan_text": plan_text, "section": section}
         if journal is not None:
             params["journal"] = journal
+        if resume:
+            # Boot-time fleet recovery resuming an interrupted action's
+            # journal: same validation, same frozen-wave replay as a
+            # client /execute with resume=1.
+            params["resume"] = True
         prep = self.prepare_execute(params)
         if prep[0] == "error":
             _, code, body = prep
@@ -1875,6 +1896,78 @@ class ClusterSupervisor:
             self._active += 1  # the drain waits (bounded) for executions too
         return ("run", ctx)
 
+    def recover_journal(self, path: str, *, probe=None,
+                        heartbeat=None) -> dict:
+        """Resume one in-progress journal under JOURNAL AUTHORITY (ISSUE
+        20): the original plan bytes are gone — the client that POSTed
+        them died with the daemon — but the journal froze every move the
+        run committed against, so the plan is reconstructed from the
+        journal itself and the journal's own plan hash is asserted as
+        the executor's identity. This is the boot-recovery path for
+        orphaned ``/execute`` journals (the single-cluster bugfix: they
+        used to sit invisible until a client passed ``resume=1``) and
+        for controller journals whose action record was lost. Returns
+        the terminal event dict, or ``{"refused": ...}``;
+        :class:`InjectedExecCrash` propagates — the fleet scan owns the
+        retry-at-next-boot response."""
+        from ..exec.journal import (
+            ExecutionJournal, JournalError, journal_resume_payload,
+        )
+
+        try:
+            journal = ExecutionJournal.load(path)
+        except JournalError as e:
+            return {
+                "event": "exec/error", "kind": "validation",
+                "message": str(e),
+            }
+        if self.draining.is_set():
+            return {"refused": "draining"}
+        if not self._exec_lock.acquire(blocking=False):
+            return {
+                "refused": "an execution is already in flight on this "
+                           "cluster (single-flight lock)",
+            }
+        plan, topic_order = journal_resume_payload(journal)
+
+        def _probe():
+            if heartbeat is not None:
+                heartbeat()
+            if probe is not None:
+                return probe()
+            return None
+
+        ctx = {
+            "plan": plan,
+            "topic_order": topic_order,
+            "plan_hash": journal.plan_hash,
+            # The reconstructed plan fingerprints differently (noops were
+            # never journaled): the journal's own hash IS the identity
+            # this resume runs under.
+            "asserted_hash": journal.plan_hash,
+            "journal": path,
+            "resume": True,
+            "wave_size": None,
+            "throttle": None,
+            "policy": self.failure_policy,
+            "probe": _probe,
+        }
+        with self._active_lock:
+            self._active += 1
+        terminal: dict = {}
+
+        def collect(event: dict) -> None:
+            if event.get("event") in ("exec/done", "exec/error"):
+                terminal.update(event)
+
+        self.run_execute(ctx, collect)
+        if not terminal:
+            terminal.update({
+                "event": "exec/error", "kind": "internal",
+                "message": "recovery ended without a terminal event",
+            })
+        return terminal
+
     def abort_execute(self) -> None:
         """Release a claimed execution slot WITHOUT running it: the handler
         failed between :meth:`prepare_execute` and :meth:`run_execute`
@@ -1922,6 +2015,7 @@ class ClusterSupervisor:
                 on_event=safe_emit,
                 probe=ctx.get("probe"),
                 on_verified=ctx.get("on_verified"),
+                plan_hash=ctx.get("asserted_hash"),
             )
             try:
                 outcome = executor.execute()

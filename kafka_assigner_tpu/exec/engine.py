@@ -156,6 +156,7 @@ class PlanExecutor:
         on_event=None,
         probe=None,
         on_verified=None,
+        plan_hash: Optional[str] = None,
     ) -> None:
         from ..utils.env import env_float, env_int
 
@@ -200,7 +201,16 @@ class PlanExecutor:
         #: hook is reported and swallowed — re-scoring must never fail an
         #: execution that already converged.
         self.on_verified = on_verified
-        self.plan_hash = plan_fingerprint(self.plan, self.topic_order)
+        #: Plan identity ``--resume`` validates. ``plan_hash`` lets a
+        #: journal-authority caller (the daemon's startup recovery,
+        #: ISSUE 20) ASSERT the identity of a plan it reconstructed from
+        #: the journal's own frozen moves — such a reconstruction
+        #: fingerprints differently from the original bytes (noops were
+        #: never journaled) yet IS that journal's run by construction.
+        self.plan_hash = (
+            plan_hash if plan_hash is not None
+            else plan_fingerprint(self.plan, self.topic_order)
+        )
         self.outcome = ExecOutcome()
         #: The verify pass's observed assignment (fed to ``on_verified``).
         self.observed_state: Dict[str, Dict[int, List[int]]] = {}

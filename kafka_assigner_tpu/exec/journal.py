@@ -43,11 +43,82 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
+import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
 JOURNAL_VERSION = 1
 
 Move = Tuple[str, int, List[int]]
+
+#: The journal-dir filename grammar every journal writer uses: the daemon's
+#: ``/execute`` default (``ka-execute-<cluster>-<sha12>.journal``), the
+#: controller's forward journal (``ka-controller-<cluster>-<sha12>.journal``)
+#: and its rollback twin (``….rollback.journal``). The cluster segment is
+#: greedy — cluster names may contain ``-`` — and the 12-hex sha anchor
+#: disambiguates the split.
+_JOURNAL_FILE_RE = re.compile(
+    r"^ka-(?P<origin>controller|execute)-(?P<cluster>.+)-"
+    r"(?P<sha>[0-9a-f]{12})(?P<rollback>\.rollback)?\.journal$"
+)
+
+
+def scan_journal_dir(
+    jdir: str, clusters: Sequence[str]
+) -> Dict[str, List[Dict[str, str]]]:
+    """Enumerate the journal files one daemon OWNS in ``jdir``: files
+    matching the journal filename grammar whose cluster segment names one
+    of ``clusters``. Returns ``{cluster: [entry, ...]}`` where each entry
+    is ``{"path", "sha", "kind"}`` with ``kind`` one of ``"forward"`` (a
+    controller action), ``"rollback"`` (its abort twin) or ``"execute"``
+    (a client ``/execute`` run). Entries keep the SORTED directory order
+    (deterministic scan — the recovery plan derived from this listing is
+    byte-stable across boots); files of other daemons' clusters are left
+    untouched. An unreadable directory scans empty — recovery is
+    best-effort by construction, never a boot failure."""
+    out: Dict[str, List[Dict[str, str]]] = {name: [] for name in clusters}
+    try:
+        names = sorted(os.listdir(jdir))
+    except OSError:
+        return out
+    for fname in names:
+        m = _JOURNAL_FILE_RE.match(fname)
+        if m is None or m.group("cluster") not in out:
+            continue
+        if m.group("rollback"):
+            kind = "rollback"
+        elif m.group("origin") == "controller":
+            kind = "forward"
+        else:
+            kind = "execute"
+        out[m.group("cluster")].append({
+            "path": os.path.join(jdir, fname),
+            "sha": m.group("sha"),
+            "kind": kind,
+        })
+    return out
+
+
+def journal_resume_payload(
+    journal: "ExecutionJournal",
+) -> Tuple[Dict[str, Dict[int, List[int]]], List[str]]:
+    """Reconstruct a resumable ``(plan, topic_order)`` from a journal's
+    own frozen move list — the journal-authority resume path (ISSUE 20):
+    an orphaned journal whose original plan bytes are gone (the client
+    that POSTed them vanished with them) still freezes every move the
+    interrupted run committed against, so the daemon's startup recovery
+    can finish the run from the journal alone. The reconstructed plan
+    fingerprints differently from the original (noop entries were never
+    journaled), so the caller must assert the journal's own ``plan_hash``
+    as the executor's identity."""
+    plan: Dict[str, Dict[int, List[int]]] = {}
+    order: List[str] = []
+    for t, p, reps in journal.moves:
+        if t not in plan:
+            plan[t] = {}
+            order.append(t)
+        plan[t][int(p)] = [int(r) for r in reps]
+    return plan, order
 
 
 class JournalError(ValueError):

@@ -14,6 +14,7 @@ from .inject import (  # noqa: F401
     InjectedSolverCrash,
     active_injector,
     fault_point,
+    fleet_fault,
     install,
     parse_spec,
     reset,

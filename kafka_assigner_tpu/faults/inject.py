@@ -153,6 +153,15 @@ FAULT_SCOPES: Dict[str, Tuple[str, ...]] = {
     # the post-move re-score read as a health regression (same rollback
     # path, breaker opens).
     "controller": ("verdict-flap", "exec-crash", "regress"),
+    # The fleet scheduler (ISSUE 20): lease-expire sweeps every live
+    # admission lease at a prune point (a crashed holder's TTL elapsing,
+    # compressed to now — the fleet must hand the slot on, and the stale
+    # holder's release must degrade to a loud no-op), ledger-torn makes
+    # one ledger load read as externally damaged (accounting restarts
+    # empty, loudly — never a crash, never silent reuse of torn bytes),
+    # recovery-crash kills a startup-recovery resume at a wave boundary
+    # (the journal stays in-progress; the NEXT boot's scan must converge).
+    "fleet": ("lease-expire", "ledger-torn", "recovery-crash"),
 }
 FAULT_KINDS = tuple(k for kinds in FAULT_SCOPES.values() for k in kinds)
 
@@ -163,7 +172,7 @@ RANDOM_HORIZON: Dict[str, int] = {
     "connect": 3, "handshake": 3, "reply": 64, "solve": 2, "warmup": 2,
     "write": 8, "converge": 8, "wave": 4,
     "watch": 8, "session": 4, "resync": 4, "daemon": 4, "dispatch": 4,
-    "controller": 4,
+    "controller": 4, "fleet": 4,
 }
 
 #: The scope iteration order of :func:`random_schedule`. Frozen EXPLICITLY —
@@ -178,6 +187,7 @@ RANDOM_ORDER: Tuple[str, ...] = (
     "watch", "session", "resync", "daemon",
     "dispatch",
     "controller",
+    "fleet",
 )
 
 ERR_NONODE = -101
@@ -614,6 +624,45 @@ class FaultInjector:
             )
         return True
 
+    def fleet_point(self, kind: str,
+                    cluster: Optional[str] = None) -> bool:
+        """Called by the fleet scheduler (ISSUE 20) at its three seams,
+        each identified by the KIND it consults for: ``lease-expire``
+        once per lease-prune sweep (a firing expires every live lease as
+        if its holder stopped heartbeating `KA_FLEET_LEASE_TTL` ago — the
+        next admission wins the slot, and the stale holder's own release
+        degrades to a loud no-op), ``ledger-torn`` once per ledger load
+        (a firing makes the read report external damage — accounting
+        restarts empty, loudly), ``recovery-crash`` once per startup-
+        recovery wave boundary (raises :class:`InjectedExecCrash` — the
+        resumed journal stays in-progress and the NEXT boot retries).
+
+        Like ``controller_point``, each kind keeps its OWN consult
+        counter, so ``fleet:1=recovery-crash`` means "the second recovery
+        wave boundary" regardless of how many prune sweeps ran first."""
+        key = f"fleet.{kind}"
+        i = self._counts.get(key, 0)
+        self._counts[key] = i + 1
+        ev = self._events.get(("fleet", None, i))
+        if ev is not None and ev.kind != kind:
+            ev = None
+        if ev is None and cluster is not None:
+            ckey = (key, cluster)
+            j = self._cluster_counts.get(ckey, 0)
+            self._cluster_counts[ckey] = j + 1
+            ev = self._events.get(("fleet", cluster, j))
+            if ev is not None and ev.kind != kind:
+                ev = None
+        if ev is None:
+            return False
+        self._fire(ev)
+        if kind == "recovery-crash":
+            raise InjectedExecCrash(
+                "injected fault: fleet startup-recovery resume killed at "
+                "a wave boundary"
+            )
+        return True
+
     def daemon_solve(self, cluster: Optional[str] = None) -> None:
         """Called at the daemon's per-request solve dispatch boundary;
         ``solver-crash`` raises :class:`InjectedSolverCrash` — the request
@@ -690,6 +739,18 @@ def controller_fault(kind: str, cluster: Optional[str] = None) -> bool:
     if inj is None:
         return False
     return inj.controller_point(kind, cluster)
+
+
+def fleet_fault(kind: str, cluster: Optional[str] = None) -> bool:
+    """The fleet scheduler's per-kind fault consult (ISSUE 20): returns
+    True when the scheduled ``fleet`` event of this ``kind`` fired
+    (``lease-expire``/``ledger-torn``); ``recovery-crash`` raises
+    :class:`InjectedExecCrash` instead. No-op False without an active
+    injector."""
+    inj = active_injector()
+    if inj is None:
+        return False
+    return inj.fleet_point(kind, cluster)
 
 
 def fault_point(scope: str, cluster: Optional[str] = None) -> None:

@@ -125,6 +125,13 @@ METRIC_NAMES: frozenset = frozenset({
     "controller.regressions", "controller.exec_failures",
     "controller.breaker_opened", "controller.breaker_closed",
     "controller.moves", "controller.window_moves", "controller.streak",
+    # fleet.* — the daemon-wide fleet scheduler (ISSUE 20): admission
+    # grants/denials, active-lease and fleet-window gauges, lease expiry
+    # sweeps, and the startup recovery scan's resumed/failed journals
+    "fleet.grants", "fleet.deferrals", "fleet.preemptions",
+    "fleet.leases", "fleet.window_moves", "fleet.lease_expired",
+    "fleet.recoveries", "fleet.recovery_failures",
+    "fleet.memory_resets",
 })
 
 #: Span names (``span(...)`` / ``record_span(...)`` first argument).
@@ -228,6 +235,13 @@ UNITLESS_METRICS: frozenset = frozenset({
     "controller.regressions", "controller.exec_failures",
     "controller.breaker_opened", "controller.breaker_closed",
     "controller.moves", "controller.window_moves", "controller.streak",
+    # fleet.* event/item counts (admission decisions, expired leases,
+    # recovered journals, verdict-memory resets) and the live
+    # active-lease / fleet-window-move gauges
+    "fleet.grants", "fleet.deferrals", "fleet.preemptions",
+    "fleet.leases", "fleet.window_moves", "fleet.lease_expired",
+    "fleet.recoveries", "fleet.recovery_failures",
+    "fleet.memory_resets",
     # grandfathered: unit (bytes) lives mid-name, predates KA014; renaming
     # the scrape family would orphan existing dashboards
     "zk.wire_bytes_in", "zk.wire_bytes_out",

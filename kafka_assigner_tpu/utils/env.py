@@ -515,6 +515,46 @@ _knob(
         "abort-to-rollback path and opens the controller breaker",
 )
 
+# --- fleet scheduler (daemon/fleet.py) --------------------------------------
+_knob(
+    "KA_FLEET_MAX_MOVES", "int", 64, floor=1,
+    doc="fleet-wide rolling move budget (`daemon/fleet.py`): replica "
+        "moves charged by controller actions across EVERY cluster of one "
+        "daemon inside the `KA_FLEET_WINDOW` window. A controller whose "
+        "action would overspend the fleet budget is denied admission "
+        "(`budget-hold`) and retries after its cooldown — the per-cluster "
+        "`KA_CONTROLLER_MAX_MOVES` cap bounds one cluster, this bounds "
+        "the daemon's total concurrent blast radius. Read live per "
+        "admission request",
+)
+_knob(
+    "KA_FLEET_WINDOW", "float", 3600.0, floor=1.0,
+    doc="the fleet move budget's rolling window (seconds): moves charged "
+        "by any cluster's admitted actions inside this window count "
+        "against `KA_FLEET_MAX_MOVES`. The fleet ledger file persists in "
+        "the journal dir (owned exclusively by `daemon/fleet.py` — kalint "
+        "KA030), so a daemon restart cannot reset the fleet-wide "
+        "accounting",
+)
+_knob(
+    "KA_FLEET_MAX_CONCURRENT", "int", 1, floor=1,
+    doc="fleet concurrency cap: how many clusters may hold an admission "
+        "lease (i.e. run a controller action) at once. The default of 1 "
+        "serializes the whole fleet — most-degraded cluster first, by "
+        "composite health score — so two clusters sharing hardware can "
+        "never rebalance simultaneously unless an operator raises this. "
+        "Read live per admission request",
+)
+_knob(
+    "KA_FLEET_LEASE_TTL", "float", 300.0, floor=0.1,
+    doc="admission-lease expiry (seconds since the holder's last "
+        "heartbeat; leases are heartbeat-stamped at every wave boundary). "
+        "A crashed lease holder stops heartbeating and its lease ages "
+        "out, so a `kill -9` mid-action can never wedge the fleet — the "
+        "next admission request sweeps the expired lease and proceeds. "
+        "Read live per admission request",
+)
+
 # --- consumer-group workload family (ka-groups / daemon /groups/*) ----------
 _knob(
     "KA_GROUPS_DEFAULT_SCALES", "str", "100,150,200",

@@ -1518,6 +1518,259 @@ def soak_multicluster_matrix(args, report_dir):
     return failures
 
 
+# ---------------------------------------------------------------------------
+# The fleet matrix (ISSUE 20): two auto controllers arbitrating through the
+# FleetScheduler under one injected fault per fleet seam, both failure
+# policies. Acceptance invariants per row: the ledger NEVER shows more
+# leases than KA_FLEET_MAX_CONCURRENT or more window moves than
+# KA_FLEET_MAX_MOVES (sampled throughout), every cluster's final bytes are
+# the pre-action snapshot or a fully-verified plan, 0 hangs, and the
+# contested rows record at least one fleet denial (deferred / budget-hold /
+# preempted).
+#   lease-expire   fleet:2=lease-expire while both clusters contend — the
+#                  loser defers first (consult 2), then the seam sweeps the
+#                  holder's lease on its retry: the swept holder's release
+#                  degrades to a loud no-op, the daemon keeps arbitrating,
+#                  both clusters still land
+#   ledger-torn    fleet:0=ledger-torn at boot — accounting restarts
+#                  empty LOUDLY, then enforces normally for the whole row
+#   recovery-crash a pre-planted in-progress /execute journal's recovery
+#                  resume is killed at a wave boundary on boot 1 (journal
+#                  retained, daemon still serves), boot 2 converges it
+# ---------------------------------------------------------------------------
+
+FLEET_ENV = dict(CONTROLLER_ENV)
+FLEET_ENV.update({
+    # Denials must retry fast, and executions must hold the lease long
+    # enough (1-move waves, slow poll) that the second cluster's acquire
+    # provably lands inside the first one's hold.
+    "KA_CONTROLLER_COOLDOWN": "0",
+    "KA_EXEC_POLL_INTERVAL": "0.05",
+    "KA_EXEC_WAVE_SIZE": "1",
+})
+
+FLEET_DENIALS = ("deferred", "budget-hold", "preempted")
+
+
+def _fleet_snapshot(report_dir, tag, hot_parts):
+    """An imbalanced hermetic cluster like :func:`_controller_snapshot`,
+    with a parameterized hot-partition count so the two contending
+    clusters have provably different composite scores and execution
+    lengths."""
+    snap = {
+        "brokers": [
+            {"id": i, "host": f"b{i}", "port": 9092, "rack": f"r{i}"}
+            for i in range(1, 5)
+        ],
+        "topics": {
+            "hot": {str(p): [1, 2] for p in range(hot_parts)},
+            "events": {"0": [1, 2, 3]},
+        },
+    }
+    path = os.path.join(report_dir, f"fleet_{tag}.json")
+    with open(path, "w") as f:
+        # kalint: disable=KA005 -- harness fixture file, not a plan payload
+        json.dump(snap, f)
+    return path
+
+
+def _fleet_ledger_violation(view):
+    """One ledger sample against the two hard fleet invariants; None when
+    clean."""
+    if len(view["leases"]) > view["max_concurrent"]:
+        return (
+            f"concurrency cap exceeded: {sorted(view['leases'])} leased "
+            f"with max_concurrent={view['max_concurrent']}"
+        )
+    win = view["window"]
+    if win["moves"] > win["max_moves"]:
+        return (
+            f"fleet budget exceeded: {win['moves']} moves in the window "
+            f"with max_moves={win['max_moves']}"
+        )
+    return None
+
+
+def _fleet_contested_row(args, report_dir, name, spec, policy):
+    """lease-expire / ledger-torn: both clusters' controllers on auto,
+    contending for the single admission slot while the seam fires."""
+    from kafka_assigner_tpu.daemon import AssignerDaemon
+
+    tag = f"fleet[{name}/{policy}]"
+    snap_a = _fleet_snapshot(report_dir, f"{name}_{policy}_a", 8)
+    snap_b = _fleet_snapshot(report_dir, f"{name}_{policy}_b", 4)
+    pre = {
+        "a": _snapshot_topics_canonical(snap_a),
+        "b": _snapshot_topics_canonical(snap_b),
+    }
+    jdir = os.path.join(report_dir, f"fleet_j_{name}_{policy}")
+    os.makedirs(jdir, exist_ok=True)
+    env = dict(FLEET_ENV)
+    env["KA_DAEMON_JOURNAL_DIR"] = jdir
+    set_schedule(env, spec=spec)
+    daemon = AssignerDaemon(
+        clusters={"a": snap_a, "b": snap_b}, solver="greedy",
+        failure_policy=policy,
+    )
+    ledger_violations = []
+
+    def _sample():
+        v = _fleet_ledger_violation(daemon.fleet.view())
+        if v is not None and v not in ledger_violations:
+            ledger_violations.append(v)
+
+    def _both_acted():
+        _sample()
+        return all(
+            "acted" in [
+                e["decision"]
+                for e in sup.controller_view()["decisions"]
+            ]
+            for sup in daemon.supervisors.values()
+        )
+
+    try:
+        daemon.start()
+        landed = _await_pred(_both_acted, 60, every=0.05)
+        view = daemon.fleet.view()
+        decisions = [e["decision"] for e in view["decisions"]]
+        inj = faults.active_injector()
+        fired = [str(e) for e in inj.fired] if inj else []
+    finally:
+        daemon.shutdown()
+    if not landed:
+        return f"{tag}: both clusters never acted (0 hangs bar)"
+    if ledger_violations:
+        return f"{tag}: {ledger_violations[0]}"
+    if fired != [spec]:
+        return f"{tag}: fault never fired (fired={fired})"
+    if not any(d in FLEET_DENIALS for d in decisions):
+        return (
+            f"{tag}: contested row recorded no fleet denial "
+            f"(decisions: {decisions})"
+        )
+    for cname, snap in (("a", snap_a), ("b", snap_b)):
+        post_bytes, post_data = _snapshot_topics_canonical(snap)
+        pre_bytes, pre_data = pre[cname]
+        if post_bytes == pre_bytes:
+            return f"{tag}: cluster {cname!r} acted but bytes unchanged"
+        if _snapshot_score(post_data) >= _snapshot_score(pre_data):
+            return (
+                f"{tag}: cluster {cname!r} acted without improving "
+                f"the composite score"
+            )
+    for p in sorted(os.listdir(jdir)):
+        if not p.endswith(".journal"):
+            continue
+        with open(os.path.join(jdir, p), encoding="utf-8") as f:
+            if json.load(f)["status"] != "complete":
+                return f"{tag}: journal {p} not complete"
+    return None
+
+
+def _fleet_recovery_crash_row(args, report_dir, policy):
+    """recovery-crash: boot 1's startup recovery of a pre-planted
+    in-progress /execute journal is killed at a wave boundary (journal
+    retained, daemon still admits), boot 2 converges it byte-identically."""
+    from kafka_assigner_tpu.daemon import AssignerDaemon
+    from kafka_assigner_tpu.exec.journal import (
+        ExecutionJournal, plan_fingerprint,
+    )
+
+    tag = f"fleet[recovery-crash/{policy}]"
+    snap_a = _fleet_snapshot(report_dir, f"rc_{policy}_a", 4)
+    snap_b = _fleet_snapshot(report_dir, f"rc_{policy}_b", 4)
+    pre = {
+        "a": _snapshot_topics_canonical(snap_a),
+        "b": _snapshot_topics_canonical(snap_b),
+    }
+    jdir = os.path.join(report_dir, f"fleet_j_rc_{policy}")
+    os.makedirs(jdir, exist_ok=True)
+    # An orphaned client /execute journal whose single move matches the
+    # CURRENT assignment: resuming it is a byte-noop, so convergence is
+    # exactly "journal complete, cluster untouched".
+    moves = [("events", 0, [1, 2, 3])]
+    sha = plan_fingerprint({"events": {0: [1, 2, 3]}}, ["events"])
+    jpath = os.path.join(jdir, f"ka-execute-a-{sha[:12]}.journal")
+    ExecutionJournal(jpath, sha, 8, moves, cluster=snap_a).save()
+    env = dict(FLEET_ENV)
+    env["KA_DAEMON_JOURNAL_DIR"] = jdir
+    env["KA_CONTROLLER"] = "off"  # the row tests the recovery seam alone
+    set_schedule(env, spec="fleet:0=recovery-crash")
+    daemon = AssignerDaemon(
+        clusters={"a": snap_a, "b": snap_b}, solver="greedy",
+        failure_policy=policy,
+    )
+    try:
+        daemon.start()
+        view = daemon.fleet.view()
+        inj = faults.active_injector()
+        fired = [str(e) for e in inj.fired] if inj else []
+    finally:
+        daemon.shutdown()
+    if fired != ["fleet:0=recovery-crash"]:
+        return f"{tag}: fault never fired (fired={fired})"
+    if view["recovery"].get("failed") != 1:
+        return (
+            f"{tag}: boot 1 did not record the failed recovery "
+            f"({view['recovery']})"
+        )
+    if not view["recovered"]:
+        return f"{tag}: boot 1 never opened admission after the crash"
+    with open(jpath, encoding="utf-8") as f:
+        if json.load(f)["status"] != "in-progress":
+            return f"{tag}: crashed journal not retained for the next boot"
+    # Boot 2: the fault died with the "process"; recovery converges.
+    set_schedule(env)
+    daemon = AssignerDaemon(
+        clusters={"a": snap_a, "b": snap_b}, solver="greedy",
+        failure_policy=policy,
+    )
+    try:
+        daemon.start()
+        view = daemon.fleet.view()
+    finally:
+        daemon.shutdown()
+    if view["recovery"].get("resumed") != 1:
+        return f"{tag}: boot 2 did not resume the journal ({view['recovery']})"
+    with open(jpath, encoding="utf-8") as f:
+        if json.load(f)["status"] != "complete":
+            return f"{tag}: journal not complete after boot 2"
+    for cname, snap in (("a", snap_a), ("b", snap_b)):
+        if _snapshot_topics_canonical(snap)[0] != pre[cname][0]:
+            return (
+                f"{tag}: cluster {cname!r} not byte-identical to the "
+                f"pre-action snapshot after the no-op resume"
+            )
+    return None
+
+
+def soak_fleet_matrix(args, report_dir):
+    failures = []
+    rows = [
+        ("lease-expire",
+         lambda a, r, p: _fleet_contested_row(
+             a, r, "lease-expire", "fleet:2=lease-expire", p)),
+        ("ledger-torn",
+         lambda a, r, p: _fleet_contested_row(
+             a, r, "ledger-torn", "fleet:0=ledger-torn", p)),
+        ("recovery-crash", _fleet_recovery_crash_row),
+    ]
+    for name, fn in rows:
+        for policy in ("strict", "best-effort"):
+            t0 = time.perf_counter()
+            fail = fn(args, report_dir, policy)
+            if fail:
+                failures.append(fail)
+            else:
+                print(
+                    f"chaos_soak: fleet[{name}/{policy}]: ok "
+                    f"({time.perf_counter() - t0:.2f}s)",
+                    file=sys.stderr,
+                )
+    return failures
+
+
 def soak_random(args, report_dir):
     base = with_server(
         lambda s: baseline_bytes(s.port, args.solver, report_dir,
@@ -1626,6 +1879,7 @@ def main(argv=None):
                 failures += soak_multicluster_matrix(args, report_dir)
                 failures += soak_dispatch_matrix(args, report_dir)
                 failures += soak_controller_matrix(args, report_dir)
+                failures += soak_fleet_matrix(args, report_dir)
             else:
                 failures = soak_random(args, report_dir)
     finally:

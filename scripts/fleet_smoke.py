@@ -1,0 +1,344 @@
+#!/usr/bin/env python
+"""Fleet scheduler smoke (tier-1, via scripts/lint.sh): the ISSUE 20
+cross-cluster arbitration rung end to end against REAL ``ka-daemon``
+subprocesses.
+
+Phase 1 — most-degraded-first serialization: one daemon serves clusters
+``a`` (badly imbalanced) and ``b`` (mildly imbalanced), both on
+``controller=auto``, plus ``c`` (policy ``off``) carrying a pre-planted
+in-progress ``/execute`` journal. Boot-time recovery drives ``c``'s
+journal to completion under a throttled engine, which holds the single
+admission slot long enough that BOTH controllers register denied wants —
+so when the slot frees, the fleet's priority contest (not thread timing)
+picks the winner: the FIRST action-kind lease must go to ``a``, the
+worse-off cluster. Both clusters then land serially (the fleet ledger
+never shows two action leases), ``/metrics`` exposes the ``ka_fleet_*``
+family, and SIGTERM drains to exit 0.
+
+Phase 2 — kill -9 mid-action: a fresh daemon's auto controller starts a
+throttled multi-wave action; the process takes a REAL ``SIGKILL`` after
+the first wave commits (replicas have provably moved). A restarted daemon
+— no fault knobs, no client ``--resume`` — must converge on its own: the
+startup recovery scan resumes the forward journal under the persisted
+action record, the journal completes (engine-verified plan bytes),
+``ka_fleet_recoveries_total`` ticks, and the consumed action record
+leaves the journal dir. SIGTERM exit 0.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from scripts.controller_smoke import _drain, _score  # noqa: E402
+from scripts.health_smoke import _req, _start_daemon  # noqa: E402
+
+
+def _snapshot(workdir, name, hot_parts):
+    snap = {
+        "brokers": [
+            {"id": i, "host": f"b{i}", "port": 9092, "rack": f"r{i}"}
+            for i in range(1, 5)
+        ],
+        "topics": {
+            "hot": {str(p): [1, 2] for p in range(hot_parts)},
+            "events": {"0": [1, 2, 3]},
+        },
+    }
+    path = os.path.join(workdir, name)
+    with open(path, "w") as f:
+        json.dump(snap, f)
+    return path
+
+
+def _topics(path):
+    with open(path) as f:
+        return json.load(f)["topics"]
+
+
+def _fleet_view(port):
+    s, raw, _ = _req(port, "GET", "/fleet")
+    if s != 200:
+        raise SystemExit(f"FAIL: /fleet http={s}: {raw[:200]}")
+    return json.loads(raw)
+
+
+def _controller_trail(port, cluster):
+    s, raw, _ = _req(port, "GET", f"/clusters/{cluster}/controller")
+    if s != 200:
+        raise SystemExit(
+            f"FAIL: /clusters/{cluster}/controller http={s}"
+        )
+    return [e["decision"] for e in json.loads(raw)["decisions"]]
+
+
+def _await(pred, what, deadline_s=120.0, every=0.1):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(every)
+    raise SystemExit(f"FAIL: timed out waiting for {what}")
+
+
+def _counter_total(port, fam, labels_want=None):
+    from kafka_assigner_tpu.obs import promtext
+
+    s, raw, _ = _req(port, "GET", "/metrics")
+    if s != 200:
+        raise SystemExit(f"FAIL: /metrics http={s}")
+    data = promtext.parse(raw.decode("utf-8")).get(fam)
+    if data is None:
+        return None
+    total = 0.0
+    seen = False
+    for _n, labels, v in data["samples"]:
+        if labels_want is None or all(
+            dict(labels).get(k) == v2 for k, v2 in labels_want.items()
+        ):
+            total += v
+            seen = True
+    return total if seen else None
+
+
+def _phase1(workdir, base_env):
+    from kafka_assigner_tpu.exec.journal import (
+        ExecutionJournal, plan_fingerprint,
+    )
+
+    snap_a = _snapshot(workdir, "a.json", 8)
+    snap_b = _snapshot(workdir, "b.json", 4)
+    if not _score(snap_a) > _score(snap_b):
+        print("FAIL: fixture scores inverted (a must be worse than b)",
+              file=sys.stderr)
+        return 1
+    # Cluster c: policy off, carrying a half-done client /execute run —
+    # 24 single-move throttled waves of boot recovery hold the admission
+    # slot while a and b queue up behind it.
+    snap_c = {
+        "brokers": [
+            {"id": i, "host": f"b{i}", "port": 9092, "rack": f"r{i}"}
+            for i in range(1, 5)
+        ],
+        "topics": {"bulk": {str(p): [1, 2] for p in range(24)}},
+    }
+    path_c = os.path.join(workdir, "c.json")
+    with open(path_c, "w") as f:
+        json.dump(snap_c, f)
+    plan_c = {"bulk": {p: [3, 4] for p in range(24)}}
+    moves = [("bulk", p, [3, 4]) for p in range(24)]
+    sha = plan_fingerprint(plan_c, ["bulk"])
+    ExecutionJournal(
+        os.path.join(workdir, f"ka-execute-c-{sha[:12]}.journal"),
+        sha, 1, moves, cluster=path_c,
+    ).save()
+
+    daemon, port, lines = _start_daemon(
+        f"a={snap_a}#controller=auto;b={snap_b}#controller=auto;"
+        f"c={path_c}",
+        base_env,
+    )
+    try:
+        _await(
+            lambda: _fleet_view(port)["recovered"],
+            "the boot recovery scan",
+        )
+        view = _fleet_view(port)
+        if view["recovery"].get("resumed") != 1:
+            print(f"FAIL: planted journal not resumed "
+                  f"({view['recovery']})", file=sys.stderr)
+            return 1
+        if _topics(path_c)["bulk"]["0"] != [3, 4]:
+            print("FAIL: recovered cluster c not on the journal's plan",
+                  file=sys.stderr)
+            return 1
+        _await(
+            lambda: "acted" in _controller_trail(port, "a")
+            and "acted" in _controller_trail(port, "b"),
+            "both controllers acting",
+        )
+        view = _fleet_view(port)
+        grants = [
+            e for e in view["decisions"]
+            if e["decision"] == "granted" and e.get("kind") != "recovery"
+        ]
+        if not grants or grants[0]["cluster"] != "a":
+            print(
+                "FAIL: most-degraded-first violated — first action "
+                f"lease went to {grants[0]['cluster'] if grants else None!r}"
+                f" (decisions: {[ (e['decision'], e.get('cluster')) for e in view['decisions'] ]})",
+                file=sys.stderr,
+            )
+            return 1
+        if len(view["leases"]) > view["max_concurrent"]:
+            print(f"FAIL: ledger shows {view['leases']} over the cap",
+                  file=sys.stderr)
+            return 1
+        for fam, floor in (
+            ("ka_fleet_grants_total", 2.0),
+            ("ka_fleet_deferrals_total", 1.0),
+            ("ka_fleet_recoveries_total", 1.0),
+        ):
+            got = _counter_total(port, fam)
+            if got is None or got < floor:
+                print(f"FAIL: {fam} = {got} (wanted >= {floor})",
+                      file=sys.stderr)
+                return 1
+        if _counter_total(port, "ka_fleet_leases") is None:
+            print("FAIL: ka_fleet_leases gauge missing from /metrics",
+                  file=sys.stderr)
+            return 1
+        _drain(daemon, lines)
+        daemon = None
+        for name, snap, pre in (("a", snap_a, 8), ("b", snap_b, 4)):
+            if _topics(snap)["hot"] == {
+                str(p): [1, 2] for p in range(pre)
+            }:
+                print(f"FAIL: acted cluster {name!r} bytes unchanged",
+                      file=sys.stderr)
+                return 1
+        for p in sorted(os.listdir(workdir)):
+            if p.endswith(".journal"):
+                with open(os.path.join(workdir, p)) as f:
+                    if json.load(f)["status"] != "complete":
+                        print(f"FAIL: journal {p} not complete",
+                              file=sys.stderr)
+                        return 1
+        return 0
+    finally:
+        if daemon is not None and daemon.poll() is None:
+            daemon.kill()
+
+
+def _phase2(workdir, base_env):
+    snap = _snapshot(workdir, "a.json", 8)
+    pre_score = _score(snap)
+    daemon, port, lines = _start_daemon(
+        f"a={snap}#controller=auto", base_env
+    )
+
+    def _committed_forward():
+        for p in sorted(os.listdir(workdir)):
+            if (p.startswith("ka-controller-a-")
+                    and p.endswith(".journal")
+                    and ".rollback." not in p):
+                with open(os.path.join(workdir, p)) as f:
+                    data = json.load(f)
+                if (data["status"] == "in-progress"
+                        and data["waves_committed"] >= 1):
+                    return os.path.join(workdir, p)
+        return None
+
+    try:
+        jpath = _await(
+            _committed_forward,
+            "a mid-action forward journal (>=1 wave committed)",
+            every=0.01,
+        )
+        # The real thing: SIGKILL with waves committed and more pending.
+        daemon.send_signal(signal.SIGKILL)
+        daemon.wait(timeout=30)
+        daemon = None
+        with open(jpath) as f:
+            if json.load(f)["status"] != "in-progress":
+                print("FAIL: kill -9 landed after the action finished — "
+                      "nothing to recover", file=sys.stderr)
+                return 1
+        records = [
+            p for p in sorted(os.listdir(workdir))
+            if p.endswith(".action.json")
+        ]
+        if not records:
+            print("FAIL: no persisted action record survived the kill",
+                  file=sys.stderr)
+            return 1
+
+        # Restart: no fault knobs, no client --resume. The daemon's own
+        # recovery must converge the journal.
+        env2 = {**base_env, "KA_EXEC_THROTTLE": "0"}
+        daemon, port, lines = _start_daemon(
+            f"a={snap}#controller=auto", env2
+        )
+        _await(
+            lambda: _fleet_view(port)["recovered"],
+            "the restart recovery scan",
+        )
+        view = _fleet_view(port)
+        if view["recovery"].get("resumed") != 1:
+            print(f"FAIL: restart did not resume the killed action "
+                  f"({view['recovery']})", file=sys.stderr)
+            return 1
+        got = _counter_total(port, "ka_fleet_recoveries_total")
+        if got is None or got < 1:
+            print(f"FAIL: ka_fleet_recoveries_total = {got}",
+                  file=sys.stderr)
+            return 1
+        with open(jpath) as f:
+            if json.load(f)["status"] != "complete":
+                print("FAIL: resumed journal not complete",
+                      file=sys.stderr)
+                return 1
+        if [p for p in sorted(os.listdir(workdir))
+                if p.endswith(".action.json")]:
+            print("FAIL: consumed action record still on disk",
+                  file=sys.stderr)
+            return 1
+        if not _score(snap) < pre_score:
+            print(f"FAIL: recovered cluster did not improve "
+                  f"({pre_score} -> {_score(snap)})", file=sys.stderr)
+            return 1
+        _drain(daemon, lines)
+        daemon = None
+        return 0
+    finally:
+        if daemon is not None and daemon.poll() is None:
+            daemon.kill()
+
+
+def main() -> int:
+    base_env = {
+        **os.environ,
+        "KA_CONTROLLER_INTERVAL": "0.2",
+        "KA_CONTROLLER_CONFIRMATIONS": "2",
+        "KA_CONTROLLER_COOLDOWN": "0",
+        "KA_CONTROLLER_MAX_MOVES": "32",
+        "KA_DAEMON_RESYNC_INTERVAL": "0.3",
+        "KA_EXEC_POLL_INTERVAL": "0.01",
+        "KA_EXEC_WAVE_SIZE": "1",
+        # Throttled single-move waves: actions and recovery provably
+        # HOLD the admission slot across several controller ticks, so
+        # serialization is decided by the fleet's priority contest.
+        "KA_EXEC_THROTTLE": "0.25",
+    }
+    workdir1 = tempfile.mkdtemp(prefix="ka_fleet_smoke1_")
+    env1 = {**base_env, "KA_DAEMON_JOURNAL_DIR": workdir1}
+    rc = _phase1(workdir1, env1)
+    if rc:
+        return rc
+    workdir2 = tempfile.mkdtemp(prefix="ka_fleet_smoke2_")
+    env2 = {**base_env, "KA_DAEMON_JOURNAL_DIR": workdir2}
+    rc = _phase2(workdir2, env2)
+    if rc:
+        return rc
+    print(
+        "fleet_smoke: PASS (boot recovery finished the planted /execute "
+        "journal while both auto controllers queued, the freed slot went "
+        "most-degraded-first, both clusters landed serially with "
+        "ka_fleet_* exported, and a real kill -9 mid-action converged on "
+        "restart via the daemon's own recovery — no client resume)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

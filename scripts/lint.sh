@@ -122,6 +122,13 @@ python scripts/dispatch_load_probe.py
 # pre-action assignment with the breaker open, the off cluster shows zero
 # controller activity, SIGTERM exit 0.
 python scripts/controller_smoke.py
+# Fleet scheduler smoke (ISSUE 20): real three-cluster ka-daemon — boot
+# recovery finishes a pre-planted in-progress /execute journal while two
+# auto controllers queue behind the admission slot, the freed slot goes
+# most-degraded-first, both clusters land serially with ka_fleet_* on
+# /metrics; then a real kill -9 mid-action converges on restart via the
+# daemon's own recovery scan (no client --resume), SIGTERM exit 0.
+python scripts/fleet_smoke.py
 # Dual-PYTHONHASHSEED byte-identity smoke (ISSUE 17): the dynamic twin of
 # the KA024-KA027 determinism layer — the mode-3 CLI and a daemon /plan
 # each run twice under two different PYTHONHASHSEED values; stdout and the

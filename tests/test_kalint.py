@@ -1418,7 +1418,7 @@ def test_ka011_helper_without_deadline_still_flagged():
 
 def test_rule_docs_cover_every_rule():
     assert set(kalint.RULE_DOCS) == set(kalint.RULES)
-    assert set(kalint.RULES) == {f"KA{n:03d}" for n in range(30)}
+    assert set(kalint.RULES) == {f"KA{n:03d}" for n in range(31)}
     for rule, (meaning, example) in kalint.RULE_DOCS.items():
         assert meaning and example, rule
 
@@ -2413,3 +2413,61 @@ def test_ka028_fires_on_the_real_act_path_at_a_tight_budget():
     assert "daemon/controller.py::RebalanceController._act" in chain_text
     assert "controller_execute" in chain_text
     assert "exec/engine.py" in chain_text
+
+
+# --- KA030: the fleet-ledger bulkhead (ISSUE 20) ------------------------------
+
+KA030_SNIPPET = (
+    "import json, os\n"
+    "\n"
+    "def peek(jdir):\n"
+    '    with open(os.path.join(jdir, "ka-fleet.json")) as f:\n'
+    "        return json.load(f)\n"
+)
+
+
+def test_ka030_trips_outside_the_fleet_module():
+    findings = kalint.lint_source(KA030_SNIPPET, "daemon/service.py")
+    assert any(f.rule == "KA030" and f.line == 4 for f in findings)
+
+
+def test_ka030_silent_inside_the_fleet_bulkhead():
+    assert "KA030" not in rules_of(
+        kalint.lint_source(KA030_SNIPPET, "daemon/fleet.py")
+    )
+
+
+def test_ka030_trips_anywhere_in_the_package():
+    # the bulkhead is package-wide, not just daemon/: a CLI helper
+    # spelling the ledger name is just as able to tear it
+    findings = kalint.lint_source(KA030_SNIPPET, "utils/debugtool.py")
+    assert "KA030" in rules_of(findings)
+
+
+def test_ka030_exempts_docstring_prose():
+    src = (
+        '"""Module prose may explain the ka-fleet.json ledger."""\n'
+        "\n"
+        "def helper():\n"
+        '    """Reads go through FleetScheduler, never ka-fleet.json."""\n'
+        "    return None\n"
+    )
+    assert "KA030" not in rules_of(
+        kalint.lint_source(src, "daemon/service.py")
+    )
+
+
+def test_ka030_suppressible_with_a_reason():
+    src = (
+        "import os\n"
+        'LEDGER = "ka-fleet.json"  '
+        "# kalint: disable=KA030 -- migration shim reads the old location\n"
+    )
+    assert "KA030" not in rules_of(
+        kalint.lint_source(src, "daemon/service.py")
+    )
+
+
+def test_ka030_repo_sweep_is_clean():
+    findings = kalint.lint_package(use_cache=False)
+    assert not [f for f in findings if f.rule == "KA030"]
